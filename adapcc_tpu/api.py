@@ -1,0 +1,17 @@
+"""Public AdapCC façade (reference adapcc.py API surface).
+
+Fleshed out together with the collective engine; see SURVEY.md §7 step 2.
+"""
+
+from __future__ import annotations
+
+
+class AdapCC:
+    """Classmethod façade over one communicator instance (reference
+    adapcc.py:6-77).  Populated as the engine lands."""
+
+    communicator = None
+    local_rank = None
+    world_rank = None
+    world_size = None
+    profile_freq = None

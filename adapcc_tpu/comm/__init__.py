@@ -1,0 +1,1 @@
+"""Communication plane: mesh helpers, relay algebra, and collective engine."""

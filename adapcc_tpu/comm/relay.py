@@ -1,0 +1,115 @@
+"""Relay-control role algebra: subset collectives with forwarding stragglers.
+
+The reference's novelty (README.md:8-12) is that any subset of ranks can
+perform a collective while inactive ranks ("relays") stay on the data path as
+pure forwarders.  Its native controller computes four booleans per rank per
+tree — ``<hasRecv, hasLocal, hasKernel, hasSend>`` (csrc/control.cu:27-87,
+csrc/include/control.h:21-26) — that gate each stage of the chunk pipeline.
+
+Here the same algebra is a pure function of (tree, active set).  It serves
+two purposes:
+
+1. **Schedule pruning** — edges whose source subtree holds no active rank
+   carry nothing and are dropped before compilation (the analog of
+   ``getActiveRecvs``, control.cu:89-101).
+2. **Runtime masking** — when the active set is dynamic (changes step to
+   step without recompiling), inactive ranks contribute the reduction
+   identity instead, and the roles here are the proof obligations that
+   masking preserves the reference semantics.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, FrozenSet, Iterable, List, Tuple
+
+from adapcc_tpu.strategy.ir import CommRound, Strategy, Tree
+
+
+@dataclass(frozen=True)
+class RelayRole:
+    """Per-rank pipeline gates for one tree under one active set."""
+
+    has_recv: bool    # some precedent subtree holds an active rank
+    has_local: bool   # this rank's own contribution participates
+    has_kernel: bool  # a reduction is actually needed (vs pure forwarding)
+    has_send: bool    # must push (partial) results toward the root
+
+
+def subtree_active(tree: Tree, rank: int, active: FrozenSet[int]) -> bool:
+    return bool(tree.subtree(rank) & active)
+
+
+def live_ranks(tree: Tree, active: FrozenSet[int]) -> FrozenSet[int]:
+    """Ranks whose subtree holds an active rank, via one bottom-up pass
+    (avoids the per-edge O(n) subtree walk when pruning pod-scale trees)."""
+    live = set()
+    for r in tree._postorder(tree.root):
+        if r in active or any(c in live for c in tree.children.get(r, ())):
+            live.add(r)
+    return frozenset(live)
+
+
+def active_recvs(tree: Tree, rank: int, active: FrozenSet[int]) -> List[int]:
+    """Children whose subtrees still carry live data (control.cu:89-101)."""
+    return [c for c in tree.precedents(rank) if subtree_active(tree, c, active)]
+
+
+def compute_role(tree: Tree, rank: int, active: FrozenSet[int]) -> RelayRole:
+    recvs = active_recvs(tree, rank, active)
+    has_local = rank in active
+    has_recv = bool(recvs)
+
+    # a reduction kernel is needed only when ≥2 live inputs meet at this rank
+    live_inputs = len(recvs) + (1 if has_local else 0)
+    has_kernel = has_recv and live_inputs >= 2
+
+    # nothing below (or at) this rank is live → nothing to send; roots never send
+    has_send = rank != tree.root and subtree_active(tree, rank, active)
+
+    return RelayRole(has_recv, has_local, has_kernel, has_send)
+
+
+def compute_roles(tree: Tree, active: Iterable[int]) -> Dict[int, RelayRole]:
+    act = frozenset(active)
+    return {r: compute_role(tree, r, act) for r in sorted(tree.ranks)}
+
+
+# --------------------------------------------------------------------------- #
+# schedule pruning
+# --------------------------------------------------------------------------- #
+
+def prune_reduce_rounds(tree: Tree, active: Iterable[int]) -> List[CommRound]:
+    """Reduce rounds with dead edges removed.
+
+    An up-edge ``(c → p)`` carries data iff ``subtree(c)`` holds an active
+    rank.  Relay ranks with live subtrees keep forwarding (their own
+    contribution is masked out by the engine), which is exactly the
+    reference's pure-forward role (hasKernel=false, hasSend=true).
+    """
+    live = live_ranks(tree, frozenset(active))
+    rounds = []
+    for rnd in tree.reduce_rounds():
+        kept = tuple((s, d) for s, d in rnd.edges if s in live)
+        if kept:
+            rounds.append(CommRound(kept))
+    return rounds
+
+
+def prune_broadcast_rounds(tree: Tree, active: Iterable[int]) -> List[CommRound]:
+    """Broadcast rounds delivering the result everywhere it is needed.
+
+    The reference broadcasts results to every rank on the tree (relays
+    forward downstream, boardcast.cu:255-305); a down-edge is dead only when
+    the entire destination subtree neither wants the result nor forwards it
+    to anyone who does — i.e. when the subtree is empty of active ranks AND
+    has no active descendants.  Since "wants the result" = active, that is
+    the same subtree-active test, applied to the destination.
+    """
+    live = live_ranks(tree, frozenset(active))
+    rounds = []
+    for rnd in tree.broadcast_rounds():
+        kept = tuple((s, d) for s, d in rnd.edges if d in live)
+        if kept:
+            rounds.append(CommRound(kept))
+    return rounds

@@ -1,0 +1,74 @@
+"""Primitive identifiers and system-wide constants.
+
+The integer primitive IDs are part of the reference's public contract: the
+Python control plane and the native engine share one enum (reference
+commu.py:28-35 mirroring csrc/include/trans.h:27-36).  We keep identical
+numbering so reference-style launch flags (`--entry_point 6`) keep meaning.
+"""
+
+from __future__ import annotations
+
+import enum
+
+# --- primitive ids (reference trans.h:27-36 / commu.py:28-35) -----------------
+ALLREDUCE = 0
+REDUCE = 1
+BOARDCAST = 2  # reference spelling, kept for API compat
+BROADCAST = 2  # sane alias
+ALLGATHER = 3
+ALLTOALL = 4
+REDUCESCATTER = 5
+DETECT = 6
+PROFILE = 7
+
+#: entry_point value meaning "skip the detect/profile bootstrap entirely"
+SKIP_BOOTSTRAP = -1
+
+PRIMITIVE_NAMES = {
+    ALLREDUCE: "allreduce",
+    REDUCE: "reduce",
+    BOARDCAST: "broadcast",
+    ALLGATHER: "allgather",
+    ALLTOALL: "alltoall",
+    REDUCESCATTER: "reducescatter",
+    DETECT: "detect",
+    PROFILE: "profile",
+}
+
+
+class ReduceOp(enum.Enum):
+    """Reduction operator for reduce-style collectives.
+
+    The reference ships sum/avg/max CUDA kernels (reference csrc/trans.cu:10-56
+    reduceSum/Avg/MaxKernel); here the operator is a property of the compiled
+    XLA program instead of a kernel choice.
+    """
+
+    SUM = "sum"
+    AVG = "avg"
+    MAX = "max"
+
+
+# --- system-wide constants ----------------------------------------------------
+# TPU-native analogs of the reference compile-time constants
+# (reference csrc/include/init.h:14-25).  MAX_BUF_SIZE there is a 400MB
+# CUDA staging buffer per fan-in slot; on TPU the staging memory is XLA's
+# problem, so the only constants that survive are schedule-shaping ones.
+
+#: maximum number of parallel transmissions (trees) per strategy
+#: (reference init.h MAX_TRANS=8)
+MAX_TRANS = 8
+
+#: default chunk size for tree pipelining, bytes
+#: (reference gurobi/trees.py:118 default_chunk = 4MB)
+DEFAULT_CHUNK_BYTES = 4 * 1024 * 1024
+
+#: DDP bucket-hook chunking heuristic threshold, bytes
+#: (reference commu.py:401-403: buckets >10MB use 4MB chunks, else size/4)
+CHUNK_HEURISTIC_THRESHOLD = 10 * 1024 * 1024
+
+#: coordinator defaults (reference proto/rpc_server.py:27-46)
+RELAY_THRESHOLD_S = 0.1
+TIME_SLOT_DURATION_S = 0.005
+FAULT_TOLERANT_TIME_S = 10.0
+COORDINATOR_PORT = 50051

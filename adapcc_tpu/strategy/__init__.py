@@ -1,0 +1,34 @@
+"""Strategy layer: schedule IR, XML compatibility, and synthesizers.
+
+The reference encodes communication strategies as XML trees parsed natively by
+tinyxml2 (reference csrc/allreduce.cu:52-104) into per-rank role tables.  Here
+the same XML schema lowers to a pure-Python IR of per-round partial
+permutations, which the collective engine turns into masked
+`jax.lax.ppermute` programs on a `jax.sharding.Mesh` axis.
+"""
+
+from adapcc_tpu.strategy.ir import Tree, Strategy, CommRound
+from adapcc_tpu.strategy.xml_io import (
+    parse_strategy_xml,
+    emit_strategy_xml,
+    parse_logical_graph_xml,
+    emit_logical_graph_xml,
+    read_ip_table,
+    write_ip_table,
+)
+from adapcc_tpu.strategy.partrees import ParTrees
+from adapcc_tpu.strategy.synthesizer import Synthesizer
+
+__all__ = [
+    "Tree",
+    "Strategy",
+    "CommRound",
+    "parse_strategy_xml",
+    "emit_strategy_xml",
+    "parse_logical_graph_xml",
+    "emit_logical_graph_xml",
+    "read_ip_table",
+    "write_ip_table",
+    "ParTrees",
+    "Synthesizer",
+]

@@ -1,0 +1,327 @@
+"""Schedule IR: spanning trees lowered to per-round partial permutations.
+
+The reference's native engine walks each strategy tree with `treeDFS` and
+builds a per-rank role table `{precedents, subsequents, siblingIdx}`
+(reference csrc/allreduce.cu:52-104, csrc/include/trans.h:45-53), then runs a
+per-chunk recv→reduce→send pipeline in persistent pthreads.  On TPU the data
+plane is XLA: we lower each tree to a static list of **communication rounds**,
+where every round is a partial permutation (distinct sources, distinct
+destinations) — exactly the contract of `jax.lax.ppermute`.  The reduction up
+the tree and the broadcast down the tree become masked ppermute+select rounds
+inside one compiled program; pipelining across chunks is XLA's / Pallas'
+concern, not a host thread's.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class CommRound:
+    """One communication round: a partial permutation of rank→rank sends.
+
+    ``edges`` is a tuple of ``(src, dst)`` pairs with all sources distinct and
+    all destinations distinct, so one round maps 1:1 onto one
+    ``jax.lax.ppermute``.
+    """
+
+    edges: Tuple[Tuple[int, int], ...]
+
+    def __post_init__(self) -> None:
+        srcs = [s for s, _ in self.edges]
+        dsts = [d for _, d in self.edges]
+        if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+            raise ValueError(f"round is not a partial permutation: {self.edges}")
+
+    @property
+    def sources(self) -> Tuple[int, ...]:
+        return tuple(s for s, _ in self.edges)
+
+    @property
+    def destinations(self) -> Tuple[int, ...]:
+        return tuple(d for _, d in self.edges)
+
+
+class Tree:
+    """One spanning tree of ranks (one parallel transmission).
+
+    Mirrors the information content of a ``<root …><gpu …/></root>`` strategy
+    element (reference strategy/*.xml; parse loop csrc/allreduce.cu:52-104)
+    without any of the reference's staging-buffer machinery: just parent /
+    children / sibling-index maps plus the rank→ip map used to classify
+    intra- vs inter-host edges.
+    """
+
+    def __init__(
+        self,
+        root: int,
+        children: Dict[int, List[int]],
+        ips: Optional[Dict[int, str]] = None,
+    ) -> None:
+        self.root = root
+        self.children: Dict[int, List[int]] = {r: list(c) for r, c in children.items()}
+        self.ips: Dict[int, str] = dict(ips or {})
+        self.parent: Dict[int, int] = {}
+        for p, cs in self.children.items():
+            for c in cs:
+                if c in self.parent:
+                    raise ValueError(f"rank {c} has two parents in tree rooted at {root}")
+                self.parent[c] = p
+        self._validate()
+
+    def _validate(self) -> None:
+        seen = set()
+        stack = [self.root]
+        while stack:
+            r = stack.pop()
+            if r in seen:
+                raise ValueError(f"cycle through rank {r} in tree rooted at {self.root}")
+            seen.add(r)
+            stack.extend(self.children.get(r, ()))
+        dangling = set(self.parent) - seen
+        if dangling:
+            raise ValueError(f"ranks {sorted(dangling)} unreachable from root {self.root}")
+        self._ranks = seen
+
+    # -- structure queries -----------------------------------------------------
+
+    @property
+    def ranks(self) -> frozenset:
+        return frozenset(self._ranks)
+
+    def precedents(self, rank: int) -> List[int]:
+        """Children of ``rank`` — who sends to it during reduce (reference
+        trans.h role naming)."""
+        return list(self.children.get(rank, ()))
+
+    def subsequent(self, rank: int) -> Optional[int]:
+        """Parent of ``rank`` — where it sends during reduce; None at root."""
+        return self.parent.get(rank)
+
+    def sibling_index(self, rank: int) -> int:
+        """Position among the parent's children (the reference's siblingIdx,
+        which indexed the receiver's staging-buffer slot)."""
+        p = self.parent.get(rank)
+        if p is None:
+            return 0
+        return self.children[p].index(rank)
+
+    def subtree(self, rank: int) -> frozenset:
+        out = set()
+        stack = [rank]
+        while stack:
+            r = stack.pop()
+            out.add(r)
+            stack.extend(self.children.get(r, ()))
+        return frozenset(out)
+
+    def height(self, rank: int) -> int:
+        heights: Dict[int, int] = {}
+        for r in self._postorder(rank):
+            cs = self.children.get(r, ())
+            heights[r] = 1 + max((heights[c] for c in cs), default=-1)
+        return heights[rank]
+
+    def _postorder(self, start: int) -> List[int]:
+        """Iterative post-order (children before parent) from ``start``."""
+        order: List[int] = []
+        stack: List[Tuple[int, bool]] = [(start, False)]
+        while stack:
+            r, done = stack.pop()
+            if done:
+                order.append(r)
+                continue
+            stack.append((r, True))
+            for c in reversed(self.children.get(r, ())):
+                stack.append((c, False))
+        return order
+
+    def depth(self, rank: int) -> int:
+        d = 0
+        while rank != self.root:
+            rank = self.parent[rank]
+            d += 1
+        return d
+
+    def is_cross_host(self, src: int, dst: int) -> bool:
+        """Whether an edge crosses hosts (reference classifies by ip,
+        allreduce.cu:473-522, to choose CUDA-IPC vs MPI; on TPU this picks
+        ICI vs DCN cost in the synthesizer)."""
+        return self.ips.get(src) != self.ips.get(dst)
+
+    # -- lowering to rounds ----------------------------------------------------
+
+    def reduce_rounds(self) -> List[CommRound]:
+        """Rounds of child→parent sends implementing the up-tree reduction.
+
+        Constraint 1 (dataflow): a rank sends to its parent only after all of
+        its children have sent to it.
+        Constraint 2 (ppermute): within one round, sources are distinct
+        (trivially true — each rank has one parent) and destinations are
+        distinct — so siblings sending to one parent are staggered across
+        rounds, the round-based analog of the reference's per-sibling staging
+        slots (allreduce.cu:628-646).
+        """
+        edges = [(r, self.parent[r]) for r in self._topo_leaves_first()]
+        return _pack_rounds(edges, after_all_incoming_of_src=True)
+
+    def broadcast_rounds(self) -> List[CommRound]:
+        """Rounds of parent→child sends implementing the down-tree broadcast.
+
+        A rank forwards only after it has received from its own parent; one
+        source serves its children across consecutive rounds.  Note the
+        reference implements broadcast with the *same* XML but inverted edge
+        semantics (csrc/boardcast.cu:255-305) — lowering from the tree
+        directly makes that symmetry explicit.
+        """
+        edges = [(self.parent[r], r) for r in self._topo_root_first()]
+        return _pack_rounds(edges, after_all_incoming_of_src=False)
+
+    def _topo_leaves_first(self) -> List[int]:
+        return [r for r in self._postorder(self.root) if r != self.root]
+
+    def _topo_root_first(self) -> List[int]:
+        from collections import deque
+
+        order: List[int] = []
+        queue = deque([self.root])
+        while queue:
+            r = queue.popleft()
+            if r != self.root:
+                order.append(r)
+            queue.extend(self.children.get(r, ()))
+        return order
+
+    # -- serialization helpers -------------------------------------------------
+
+    def to_nested(self) -> dict:
+        def rec(r: int) -> dict:
+            return {
+                "id": r,
+                "ip": self.ips.get(r, ""),
+                "children": [rec(c) for c in self.children.get(r, ())],
+            }
+
+        return rec(self.root)
+
+    def __repr__(self) -> str:
+        return f"Tree(root={self.root}, ranks={sorted(self._ranks)})"
+
+
+def _pack_rounds(
+    edges: Sequence[Tuple[int, int]], after_all_incoming_of_src: bool
+) -> List[CommRound]:
+    """Greedy pack dependency-ordered edges into partial-permutation rounds.
+
+    ``edges`` must already be in a valid dependency order.  For reduce
+    (``after_all_incoming_of_src``) an edge ``(s, d)`` may run only strictly
+    after every edge ``(*, s)``; for broadcast, only after the single edge
+    ``(*, s)`` that delivered the value to ``s``.  Both reduce to the same
+    rule: earliest round of (s, d) = 1 + max(round of every packed edge into
+    s), then bump past rounds where s or d is already used.
+    """
+    rounds: List[List[Tuple[int, int]]] = []
+    round_srcs: List[set] = []
+    round_dsts: List[set] = []
+    landed: Dict[int, int] = {}  # dst -> last round in which it received
+
+    for s, d in edges:
+        r = landed[s] + 1 if s in landed else 0
+        while r < len(rounds) and (s in round_srcs[r] or d in round_dsts[r]):
+            r += 1
+        while r >= len(rounds):
+            rounds.append([])
+            round_srcs.append(set())
+            round_dsts.append(set())
+        rounds[r].append((s, d))
+        round_srcs[r].add(s)
+        round_dsts[r].add(d)
+        landed[d] = max(landed.get(d, -1), r)
+
+    return [CommRound(tuple(es)) for es in rounds]
+
+
+@dataclass
+class Strategy:
+    """A full communication strategy: ``num_trans`` parallel spanning trees.
+
+    The tensor is sharded 1/num_trans per tree (reference allreduce.cu:310,536)
+    and each shard's reduction/broadcast follows its own tree — the reference's
+    "parallel transmissions" axis, which on TPU becomes independent ppermute
+    chains that XLA can overlap.
+    """
+
+    trees: List[Tree]
+    world_size: int
+    chunk_bytes: int = 4 * 1024 * 1024
+    #: fraction of the tensor carried by each tree; None = equal split.  Set
+    #: by the MILP solver when it optimizes unequal shares (the reference's
+    #: per-tree sizes s_m, gurobi/solver.py objective).
+    shares: Optional[List[float]] = None
+
+    def __post_init__(self) -> None:
+        if not self.trees:
+            raise ValueError("strategy needs at least one tree")
+        for t in self.trees:
+            missing = set(range(self.world_size)) - t.ranks
+            if missing:
+                raise ValueError(
+                    f"tree rooted at {t.root} is missing ranks {sorted(missing)}"
+                )
+        if self.shares is not None:
+            if len(self.shares) != len(self.trees):
+                raise ValueError("shares must have one entry per tree")
+            total = sum(self.shares)
+            if total <= 0:
+                raise ValueError("shares must sum to a positive value")
+            self.shares = [s / total for s in self.shares]
+
+    def tree_shares(self) -> List[float]:
+        if self.shares is not None:
+            return list(self.shares)
+        return [1.0 / len(self.trees)] * len(self.trees)
+
+    @property
+    def num_trans(self) -> int:
+        return len(self.trees)
+
+    def fingerprint(self) -> str:
+        """Stable hash for the compiled-program cache (the analog of the
+        reference's per-strategy transmission contexts, SURVEY.md §7)."""
+        h = hashlib.sha256()
+        h.update(str(self.world_size).encode())
+        for t in self.trees:
+            h.update(repr(sorted((p, tuple(c)) for p, c in t.children.items())).encode())
+            h.update(str(t.root).encode())
+        return h.hexdigest()[:16]
+
+    @staticmethod
+    def ring(world_size: int, num_trans: int = 1, ips: Optional[Dict[int, str]] = None) -> "Strategy":
+        """Chain ("ring"-schedule) strategy: tree t is the chain rooted at
+        rank t, a degenerate tree matching the reference's intra-node Chain
+        policy (gurobi/trees.py:85-88) and a good default on an ICI ring."""
+        trees = []
+        for t in range(num_trans):
+            order = [(t + i) % world_size for i in range(world_size)]
+            children = {order[i]: [order[i + 1]] for i in range(world_size - 1)}
+            trees.append(Tree(order[0], children, ips))
+        return Strategy(trees, world_size)
+
+    @staticmethod
+    def binary(world_size: int, num_trans: int = 1, ips: Optional[Dict[int, str]] = None) -> "Strategy":
+        """Array-heap binary trees rotated per transmission for root
+        diversity (the shape ParTrees emits for inter-node masters,
+        gurobi/trees.py:110-139)."""
+        trees = []
+        for t in range(num_trans):
+            order = [(t + i) % world_size for i in range(world_size)]
+            children: Dict[int, List[int]] = {}
+            for i in range(world_size):
+                kids = [order[j] for j in (2 * i + 1, 2 * i + 2) if j < world_size]
+                if kids:
+                    children[order[i]] = kids
+            trees.append(Tree(order[0], children, ips))
+        return Strategy(trees, world_size)

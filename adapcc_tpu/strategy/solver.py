@@ -1,0 +1,161 @@
+"""MILP strategy solver (scipy/HiGHS re-formulation of the reference's Gurobi model).
+
+The reference formulates strategy synthesis as a Gurobi MILP with binary
+root-assignment variables, per-tree tensor shares, routing/flow variables and
+a pipeline-aware makespan objective (gurobi/solver.py:143-208, SURVEY.md §2.2
+P8).  Gurobi is proprietary and not part of this image, so this module keeps
+the decision structure that matters — which masters root the parallel trees,
+and how the tensor is split across them — and solves it exactly with
+``scipy.optimize.milp`` (HiGHS):
+
+    min  T
+    s.t. Σ_g x_mg = 1                       each tree m picks one root
+         Σ_m x_mg ≤ 1                       root diversity
+         Σ_m s_m = 1                        tensor fully covered
+         T ≥ lat_g·x_mg + size·k_g·s_m − M·(1−x_mg)   per (m, g)
+
+where, for a candidate root g, ``lat_g`` is the summed per-level latency and
+``k_g`` the summed per-level bottleneck inverse bandwidth of the heap tree
+rooted at g (levels serialize, edges within a level run in parallel — the
+same pipeline-aware completion model as the reference objective
+solver.py:190-208).  Tree shapes themselves follow the ParTrees chain+heap
+construction; the MILP chooses roots and shares.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from adapcc_tpu.primitives import DEFAULT_CHUNK_BYTES
+from adapcc_tpu.strategy.ir import Strategy, Tree
+from adapcc_tpu.strategy.partrees import (
+    ParTrees,
+    _attach_chains,
+    _heap_tree_edges,
+    _host_groups,
+)
+
+
+def _tree_cost_coeffs(
+    order: Sequence[int],
+    bw: Sequence[Sequence[float]],
+    lat: Sequence[Sequence[float]],
+):
+    """(summed per-level latency, summed per-level max 1/bw) for the heap tree
+    over ``order``."""
+    children = _heap_tree_edges(order)
+    depth = {order[0]: 0}
+    levels: Dict[int, List[tuple]] = {}
+    stack = [order[0]]
+    while stack:
+        p = stack.pop()
+        for c in children.get(p, ()):
+            depth[c] = depth[p] + 1
+            levels.setdefault(depth[c], []).append((p, c))
+            stack.append(c)
+    lat_sum, inv_bw_sum = 0.0, 0.0
+    for lvl in sorted(levels):
+        edges = levels[lvl]
+        lat_sum += max(lat[p][c] for p, c in edges)
+        inv_bw_sum += max(1.0 / max(bw[p][c], 1e-9) for p, c in edges)
+    return lat_sum, inv_bw_sum
+
+
+class MilpSolver:
+    def synthesize(
+        self,
+        ip_table: Sequence[str],
+        local_rank0_list: Sequence[int],
+        prim: int,
+        parallel_degree: int,
+        transmission_size: int,
+        bandwidth_graph: Sequence[Sequence[float]],
+        latency_graph: Sequence[Sequence[float]],
+    ) -> Strategy:
+        from scipy.optimize import LinearConstraint, milp
+
+        world = len(ip_table)
+        masters = list(local_rank0_list)
+        n = len(masters)
+        m_trees = min(max(1, parallel_degree), n)
+        size = float(max(transmission_size, 1))
+
+        # candidate tree per root: ring rotation of masters starting at g
+        rotations = {
+            g: [masters[(i + k) % n] for k in range(n)] for i, g in enumerate(masters)
+        }
+        lat_c = np.zeros(n)
+        bw_c = np.zeros(n)
+        for i, g in enumerate(masters):
+            lat_c[i], bw_c[i] = _tree_cost_coeffs(rotations[g], bandwidth_graph, latency_graph)
+
+        # variables: x[m,g] (n*m_trees binaries), s[m] (m_trees), T
+        nx = m_trees * n
+        nvar = nx + m_trees + 1
+        xi = lambda m, g: m * n + g
+        si = lambda m: nx + m
+        Ti = nvar - 1
+
+        c = np.zeros(nvar)
+        c[Ti] = 1.0
+
+        A_rows, lb, ub = [], [], []
+
+        for m in range(m_trees):  # Σ_g x_mg = 1
+            row = np.zeros(nvar)
+            for g in range(n):
+                row[xi(m, g)] = 1.0
+            A_rows.append(row); lb.append(1.0); ub.append(1.0)
+        for g in range(n):  # Σ_m x_mg ≤ 1
+            row = np.zeros(nvar)
+            for m in range(m_trees):
+                row[xi(m, g)] = 1.0
+            A_rows.append(row); lb.append(0.0); ub.append(1.0)
+        row = np.zeros(nvar)  # Σ_m s_m = 1
+        for m in range(m_trees):
+            row[si(m)] = 1.0
+        A_rows.append(row); lb.append(1.0); ub.append(1.0)
+
+        big_m = float(lat_c.max() + size * bw_c.max()) + 1.0
+        for m in range(m_trees):  # T ≥ lat_g·x + size·k_g·s − M(1−x)
+            for g in range(n):
+                row = np.zeros(nvar)
+                row[Ti] = 1.0
+                row[xi(m, g)] = -(lat_c[g] + big_m)
+                row[si(m)] = -size * bw_c[g]
+                A_rows.append(row); lb.append(-big_m); ub.append(np.inf)
+
+        integrality = np.zeros(nvar)
+        integrality[:nx] = 1
+        bounds_lb = np.zeros(nvar)
+        bounds_ub = np.full(nvar, np.inf)
+        bounds_ub[:nx] = 1.0
+
+        from scipy.optimize import Bounds
+
+        res = milp(
+            c=c,
+            constraints=LinearConstraint(np.array(A_rows), np.array(lb), np.array(ub)),
+            integrality=integrality,
+            bounds=Bounds(bounds_lb, bounds_ub),
+        )
+        if not res.success:
+            # solver hiccup → fall back to the heuristic
+            return ParTrees().synthesize(
+                ip_table, local_rank0_list, parallel_degree, bandwidth_graph, latency_graph
+            )
+
+        groups = _host_groups(ip_table, masters)
+        ips = {r: ip for r, ip in enumerate(ip_table)}
+        trees: List[Tree] = []
+        shares: List[float] = []
+        for m in range(m_trees):
+            g = int(np.argmax(res.x[m * n : (m + 1) * n]))
+            order = rotations[masters[g]]
+            children = _heap_tree_edges(order)
+            _attach_chains(children, order, groups)
+            trees.append(Tree(order[0], children, ips))
+            shares.append(float(res.x[si(m)]))
+        return Strategy(trees, world, DEFAULT_CHUNK_BYTES, shares=shares)

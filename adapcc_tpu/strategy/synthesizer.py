@@ -1,0 +1,88 @@
+"""Strategy synthesizer: policy switch over ParTrees / MILP / fixed shapes.
+
+Mirrors the reference's policy dispatch (gurobi/synthesizer.py:44-62): the
+default heuristic is ParTrees; an exact MILP formulation is available when a
+solver backend exists.  Two TPU-native fixed policies (``ring`` and
+``binary``) are added because on an ICI torus the regular schedules are often
+optimal and need no profile data.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from adapcc_tpu.primitives import DEFAULT_CHUNK_BYTES
+from adapcc_tpu.strategy.ir import Strategy
+from adapcc_tpu.strategy.partrees import ParTrees
+from adapcc_tpu.strategy.xml_io import emit_strategy_xml
+
+
+class Synthesizer:
+    """Generates a communication strategy from profiled lat/bw matrices."""
+
+    def __init__(self, strategy_file: Optional[str], ip_table: Sequence[str], policy: str = "par-trees"):
+        self.strategy_file = strategy_file
+        self.ip_table = list(ip_table)
+        self.policy = policy
+
+    def generate_strategy(
+        self,
+        prim: int,
+        parallel_degree: int,
+        transmission_size: int,
+        bandwidth_graph: Sequence[Sequence[float]],
+        latency_graph: Sequence[Sequence[float]],
+        local_rank0_list: Optional[Sequence[int]] = None,
+    ) -> int:
+        """Synthesize + persist the strategy XML; returns chunk bytes."""
+        strategy = self.synthesize(
+            prim, parallel_degree, transmission_size, bandwidth_graph, latency_graph, local_rank0_list
+        )
+        if self.strategy_file:
+            emit_strategy_xml(strategy, self.strategy_file)
+        return strategy.chunk_bytes
+
+    def synthesize(
+        self,
+        prim: int,
+        parallel_degree: int,
+        transmission_size: int,
+        bandwidth_graph: Sequence[Sequence[float]],
+        latency_graph: Sequence[Sequence[float]],
+        local_rank0_list: Optional[Sequence[int]] = None,
+    ) -> Strategy:
+        world = len(self.ip_table)
+        if local_rank0_list is None:
+            local_rank0_list = _infer_local_rank0s(self.ip_table)
+
+        if self.policy == "par-trees":
+            return ParTrees().synthesize(
+                self.ip_table, local_rank0_list, parallel_degree, bandwidth_graph, latency_graph
+            )
+        if self.policy == "milp":
+            from adapcc_tpu.strategy.solver import MilpSolver
+
+            return MilpSolver().synthesize(
+                self.ip_table,
+                local_rank0_list,
+                prim,
+                parallel_degree,
+                transmission_size,
+                bandwidth_graph,
+                latency_graph,
+            )
+        ips = {r: ip for r, ip in enumerate(self.ip_table)}
+        if self.policy == "ring":
+            return Strategy.ring(world, max(1, parallel_degree), ips)
+        if self.policy == "binary":
+            return Strategy.binary(world, max(1, parallel_degree), ips)
+        raise ValueError(f"unknown synthesis policy {self.policy!r}")
+
+
+def _infer_local_rank0s(ip_table: Sequence[str]) -> List[int]:
+    """First rank of each run of equal ips is that host's master."""
+    masters = []
+    for r, ip in enumerate(ip_table):
+        if r == 0 or ip_table[r - 1] != ip:
+            masters.append(r)
+    return masters

@@ -1,0 +1,191 @@
+"""XML compatibility layer for strategy trees, logical graphs and ip tables.
+
+Keeps the reference's declarative artifact formats (SURVEY.md §5.6) so
+hand-written or previously synthesized files keep working:
+
+- strategy XML: ``<trees><root id ip><gpu id ip>…</gpu></root></trees>``
+  (reference strategy/*.xml, parsed natively by tinyxml2 at
+  csrc/allreduce.cu:52-104)
+- logical graph XML: ``<graph version><server id ip><nic id><gpu id/></nic>
+  </server></graph>`` (reference topology/logical_graph_*.xml, parsed at
+  csrc/profile.cu:56-161)
+- ip table: one ip per line, line index = world rank (written by the
+  reference launcher, launcher.py:64-79)
+
+Implemented with the stdlib ``xml.etree`` (no vendored tinyxml2 / xmltodict):
+the reference fixtures contain attribute pairs with no separating whitespace
+(e.g. ``<gpu id='1'ip='…'/>`` in strategy/4.xml), which strict XML rejects, so
+parsing goes through a small lenient pre-pass.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+from xml.etree import ElementTree as ET
+
+from adapcc_tpu.strategy.ir import Strategy, Tree
+
+# closing quote immediately followed by the next attribute pair (name='…'):
+# insert the missing space.  The lookahead requires a quote right after the
+# '=' so attribute *values* containing 'word=' (e.g. ip='host=a') are not
+# touched.
+_MISSING_SPACE = re.compile(r"(['\"])(?=[A-Za-z_][\w.-]*\s*=\s*['\"])")
+
+
+def _lenient_fromstring(text: str) -> ET.Element:
+    try:
+        return ET.fromstring(text)
+    except ET.ParseError:
+        return ET.fromstring(_MISSING_SPACE.sub(r"\1 ", text))
+
+
+# --------------------------------------------------------------------------- #
+# strategy trees
+# --------------------------------------------------------------------------- #
+
+def parse_strategy_xml(text_or_path: str, chunk_bytes: int = 4 * 1024 * 1024) -> Strategy:
+    """Parse a strategy XML document (or file path) into a :class:`Strategy`."""
+    text = _maybe_read(text_or_path)
+    doc = _lenient_fromstring(text)
+    if doc.tag != "trees":
+        raise ValueError(f"expected <trees> root element, got <{doc.tag}>")
+
+    trees: List[Tree] = []
+    all_ranks: set = set()
+    for root_el in doc.findall("root"):
+        children: Dict[int, List[int]] = {}
+        ips: Dict[int, str] = {}
+
+        def walk(el: ET.Element, parent_rank: Optional[int]) -> None:
+            rank = int(el.attrib["id"])
+            ips[rank] = el.attrib.get("ip", "")
+            if parent_rank is not None:
+                children.setdefault(parent_rank, []).append(rank)
+            for sub in el.findall("gpu"):
+                walk(sub, rank)
+
+        walk(root_el, None)
+        root_rank = int(root_el.attrib["id"])
+        trees.append(Tree(root_rank, children, ips))
+        all_ranks |= trees[-1].ranks
+
+    world_size = max(all_ranks) + 1 if all_ranks else 0
+    return Strategy(trees, world_size, chunk_bytes)
+
+
+def emit_strategy_xml(strategy: Strategy, path: Optional[str] = None) -> str:
+    """Serialize a :class:`Strategy` back to the reference XML schema."""
+    doc = ET.Element("trees")
+    for tree in strategy.trees:
+        def build(rank: int, parent_el: ET.Element, tag: str) -> None:
+            el = ET.SubElement(parent_el, tag)
+            el.set("id", str(rank))
+            el.set("ip", tree.ips.get(rank, ""))
+            for c in tree.children.get(rank, ()):
+                build(c, el, "gpu")
+
+        build(tree.root, doc, "root")
+    text = ET.tostring(doc, encoding="unicode")
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+# --------------------------------------------------------------------------- #
+# logical graph
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class LogicalGraph:
+    """Cluster sketch: which ranks live on which server behind which nic.
+
+    On TPU, "server" maps to a host/process and "nic" to an ICI domain or DCN
+    endpoint (SURVEY.md §7's detect.cu mapping).
+    """
+
+    servers: List["ServerEntry"] = field(default_factory=list)
+    version: str = "adapcc-tpu"
+
+    def rank_to_ip(self) -> Dict[int, str]:
+        out: Dict[int, str] = {}
+        for s in self.servers:
+            for g in s.gpus:
+                out[g] = s.ip
+        return out
+
+    def local_rank0_list(self) -> List[int]:
+        return [min(s.gpus) for s in self.servers if s.gpus]
+
+    @property
+    def world_size(self) -> int:
+        return sum(len(s.gpus) for s in self.servers)
+
+
+@dataclass
+class ServerEntry:
+    server_id: int
+    ip: str
+    nic_id: int
+    gpus: List[int] = field(default_factory=list)
+
+
+def parse_logical_graph_xml(text_or_path: str) -> LogicalGraph:
+    text = _maybe_read(text_or_path)
+    doc = _lenient_fromstring(text)
+    if doc.tag != "graph":
+        raise ValueError(f"expected <graph> root element, got <{doc.tag}>")
+    graph = LogicalGraph(version=doc.attrib.get("version", ""))
+    for server_el in doc.findall("server"):
+        sid = int(server_el.attrib["id"])
+        ip = server_el.attrib.get("ip", "")
+        for nic_el in server_el.findall("nic"):
+            entry = ServerEntry(sid, ip, int(nic_el.attrib.get("id", 0)))
+            for gpu_el in nic_el.findall("gpu"):
+                entry.gpus.append(int(gpu_el.attrib["id"]))
+            graph.servers.append(entry)
+    return graph
+
+
+def emit_logical_graph_xml(graph: LogicalGraph, path: Optional[str] = None) -> str:
+    doc = ET.Element("graph")
+    doc.set("version", graph.version)
+    for s in graph.servers:
+        server_el = ET.SubElement(doc, "server")
+        server_el.set("id", str(s.server_id))
+        server_el.set("ip", s.ip)
+        nic_el = ET.SubElement(server_el, "nic")
+        nic_el.set("id", str(s.nic_id))
+        for g in s.gpus:
+            gpu_el = ET.SubElement(nic_el, "gpu")
+            gpu_el.set("id", str(g))
+    text = ET.tostring(doc, encoding="unicode")
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+# --------------------------------------------------------------------------- #
+# ip table
+# --------------------------------------------------------------------------- #
+
+def read_ip_table(path: str) -> List[str]:
+    """Rank→ip list; line index is the world rank (reference commu.py:109-114)."""
+    with open(path) as f:
+        return [line.strip() for line in f if line.strip()]
+
+
+def write_ip_table(ips: List[str], path: str) -> None:
+    with open(path, "w") as f:
+        for ip in ips:
+            f.write(ip + "\n")
+
+
+def _maybe_read(text_or_path: str) -> str:
+    if text_or_path.lstrip().startswith("<"):
+        return text_or_path
+    with open(text_or_path) as f:
+        return f.read()

@@ -1,0 +1,36 @@
+"""Test harness: a virtual 8-device CPU "pod".
+
+Multi-chip behavior is tested without TPU hardware by forcing the host
+platform to expose 8 XLA CPU devices (the analog of the reference's
+fake-multi-node localhost launches, e.g. ``-H 127.0.0.1:4,127.0.0.1:4`` in
+units-test/launch_get_wait_time.sh).  Must run before the first jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected 8 virtual devices, got {len(devices)}"
+    return Mesh(devices[:8], ("ranks",))
+
+
+@pytest.fixture(scope="session")
+def mesh4():
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(jax.devices()[:4], ("ranks",))

@@ -1,0 +1,103 @@
+"""ParTrees heuristic + synthesizer policy switch + MILP solver."""
+
+import numpy as np
+import pytest
+
+from adapcc_tpu.primitives import ALLREDUCE, DEFAULT_CHUNK_BYTES
+from adapcc_tpu.strategy.partrees import ParTrees
+from adapcc_tpu.strategy.synthesizer import Synthesizer, _infer_local_rank0s
+from adapcc_tpu.strategy.xml_io import parse_strategy_xml
+
+
+def two_hosts():
+    ip_table = ["10.0.0.1"] * 4 + ["10.0.0.2"] * 4
+    masters = [0, 4]
+    world = len(ip_table)
+    bw = np.full((world, world), 10.0)
+    lat = np.full((world, world), 1.0)
+    return ip_table, masters, bw, lat
+
+
+def test_infer_local_rank0s():
+    assert _infer_local_rank0s(["a", "a", "b", "b", "b", "c"]) == [0, 2, 5]
+
+
+def test_partrees_two_hosts_structure():
+    ip_table, masters, bw, lat = two_hosts()
+    s = ParTrees().synthesize(ip_table, masters, 2, bw, lat)
+    assert s.num_trans == 2
+    for t in s.trees:
+        assert t.ranks == frozenset(range(8))
+        # roots are masters
+        assert t.root in masters
+        # intra-host chain: each master's first child is its next local rank
+        for m in masters:
+            kids = t.precedents(m)
+            if kids:
+                assert kids[0] == m + 1 or kids[0] in masters
+        # chain links stay on-host
+        for child, parent in t.parent.items():
+            if ip_table[child] == ip_table[parent]:
+                continue
+            # inter-host edges only connect masters
+            assert child in masters or parent in masters
+    # root diversity across trees
+    assert {t.root for t in s.trees} == set(masters)
+
+
+def test_partrees_bdp_sort_picks_best_root():
+    ip_table = ["a", "b", "c"]
+    masters = [0, 1, 2]
+    bw = np.ones((3, 3))
+    lat = np.ones((3, 3))
+    bw[1][2] = 100.0  # master 1's outbound link is best → highest bdp → first root
+    s = ParTrees().synthesize(ip_table, masters, 1, bw, lat)
+    assert s.trees[0].root == 1
+
+
+def test_partrees_optimize_writes_xml(tmp_path):
+    ip_table, masters, bw, lat = two_hosts()
+    out = tmp_path / "strategy.xml"
+    chunk = ParTrees().optimize(ip_table, masters, ALLREDUCE, 2, 1 << 20, bw, lat, str(out))
+    assert chunk == DEFAULT_CHUNK_BYTES
+    s = parse_strategy_xml(str(out))
+    assert s.world_size == 8 and s.num_trans == 2
+
+
+@pytest.mark.parametrize("policy,roots", [("ring", {0, 1}), ("binary", {0, 1})])
+def test_synthesizer_fixed_policies(policy, roots):
+    ip_table = ["a", "b"]
+    syn = Synthesizer(None, ip_table, policy=policy)
+    s = syn.synthesize(ALLREDUCE, 2, 1 << 20, np.ones((2, 2)), np.ones((2, 2)))
+    assert {t.root for t in s.trees} == roots
+
+
+def test_synthesizer_partrees_policy(tmp_path):
+    ip_table, masters, bw, lat = two_hosts()
+    out = tmp_path / "s.xml"
+    syn = Synthesizer(str(out), ip_table)
+    chunk = syn.generate_strategy(ALLREDUCE, 2, 1 << 20, bw, lat)
+    assert chunk == DEFAULT_CHUNK_BYTES
+    assert parse_strategy_xml(str(out)).world_size == 8
+
+
+def test_milp_solver_prefers_fast_root():
+    ip_table = ["a", "b", "c"]
+    masters = [0, 1, 2]
+    bw = np.ones((3, 3)) * 1.0
+    lat = np.ones((3, 3)) * 1.0
+    # links out of rank 2 are far faster → rooting at 2 minimizes makespan
+    bw[2, :] = 1000.0
+    syn = Synthesizer(None, ip_table, policy="milp")
+    s = syn.synthesize(ALLREDUCE, 1, 1 << 26, bw, lat)
+    assert s.num_trans == 1
+    assert s.trees[0].ranks == frozenset(range(3))
+    assert s.trees[0].root == 2
+
+
+def test_milp_solver_splits_shares_across_trees():
+    ip_table, masters, bw, lat = two_hosts()
+    syn = Synthesizer(None, ip_table, policy="milp")
+    s = syn.synthesize(ALLREDUCE, 2, 1 << 26, bw, lat)
+    assert s.num_trans == 2
+    assert {t.root for t in s.trees} == {0, 4}

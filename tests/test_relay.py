@@ -1,0 +1,80 @@
+"""Relay role algebra + schedule pruning (reference control.cu semantics)."""
+
+from adapcc_tpu.comm.relay import (
+    active_recvs,
+    compute_role,
+    compute_roles,
+    prune_broadcast_rounds,
+    prune_reduce_rounds,
+)
+from adapcc_tpu.strategy.ir import Tree
+
+
+def chain4():
+    return Tree(0, {0: [1], 1: [2], 2: [3]})
+
+
+def binary7():
+    return Tree(0, {0: [1, 2], 1: [3, 4], 2: [5, 6]})
+
+
+def test_all_active_roles():
+    t = binary7()
+    roles = compute_roles(t, range(7))
+    assert roles[0].has_recv and roles[0].has_local and roles[0].has_kernel
+    assert not roles[0].has_send  # root never sends
+    assert roles[3] == compute_role(t, 3, frozenset(range(7)))
+    assert not roles[3].has_recv and roles[3].has_send and not roles[3].has_kernel
+
+
+def test_pure_forward_relay():
+    # chain 0<-1<-2<-3 with rank 1 inactive: it receives from 2's subtree and
+    # forwards without reducing (exactly one live input, self inactive)
+    t = chain4()
+    role = compute_role(t, 1, frozenset({0, 2, 3}))
+    assert role.has_recv and not role.has_local
+    assert not role.has_kernel  # pure forward
+    assert role.has_send
+
+
+def test_inactive_leaf_subtree_is_dead():
+    t = chain4()
+    role = compute_role(t, 3, frozenset({0, 1, 2}))
+    assert not role.has_send  # nothing live at or below 3
+    role2 = compute_role(t, 2, frozenset({0, 1, 2}))
+    assert not role2.has_recv  # 3's subtree is dead
+    assert role2.has_send and not role2.has_kernel  # sends only its own data
+
+
+def test_active_recvs_prunes_dead_subtrees():
+    t = binary7()
+    assert active_recvs(t, 1, frozenset({0, 4})) == [4]
+    assert active_recvs(t, 1, frozenset({0})) == []
+    assert active_recvs(t, 0, frozenset({3, 6})) == [1, 2]
+
+
+def test_relay_rank_with_live_subtree_keeps_kernel_choice():
+    # rank 1 inactive but both children active → still needs the reduction
+    t = binary7()
+    role = compute_role(t, 1, frozenset({3, 4}))
+    assert role.has_kernel and role.has_send and not role.has_local
+
+
+def test_prune_reduce_rounds_drops_dead_edges():
+    t = binary7()
+    rounds = prune_reduce_rounds(t, {0, 1, 2})  # all leaves inactive
+    edges = [e for r in rounds for e in r.edges]
+    assert (3, 1) not in edges and (6, 2) not in edges
+    assert (1, 0) in edges and (2, 0) in edges
+
+    full = prune_reduce_rounds(t, range(7))
+    assert [r.edges for r in full] == [r.edges for r in t.reduce_rounds()]
+
+
+def test_prune_broadcast_keeps_forwarding_path():
+    # only leaf 3 active: broadcast must still traverse inactive rank 1
+    t = binary7()
+    rounds = prune_broadcast_rounds(t, {0, 3})
+    edges = [e for r in rounds for e in r.edges]
+    assert (0, 1) in edges and (1, 3) in edges
+    assert (0, 2) not in edges and (1, 4) not in edges

@@ -1,0 +1,117 @@
+"""Schedule IR: tree structure, round lowering invariants."""
+
+import pytest
+
+from adapcc_tpu.strategy.ir import CommRound, Strategy, Tree
+
+
+def chain4():
+    return Tree(0, {0: [1], 1: [2], 2: [3]}, {i: "10.0.0.1" for i in range(4)})
+
+
+def star4():
+    return Tree(0, {0: [1, 2, 3]})
+
+
+def binary7():
+    return Tree(0, {0: [1, 2], 1: [3, 4], 2: [5, 6]})
+
+
+def test_role_queries():
+    t = binary7()
+    assert t.precedents(0) == [1, 2]
+    assert t.subsequent(3) == 1
+    assert t.subsequent(0) is None
+    assert t.sibling_index(4) == 1
+    assert t.sibling_index(0) == 0
+    assert t.subtree(1) == frozenset({1, 3, 4})
+    assert t.height(0) == 2 and t.height(3) == 0
+    assert t.depth(4) == 2
+
+
+def test_tree_validation():
+    with pytest.raises(ValueError):
+        Tree(0, {0: [1], 1: [0]})  # cycle
+    with pytest.raises(ValueError):
+        Tree(0, {0: [1], 2: [1]})  # two parents
+    with pytest.raises(ValueError):
+        Tree(0, {0: [1], 2: [3]})  # unreachable
+
+
+def test_comm_round_partial_permutation():
+    with pytest.raises(ValueError):
+        CommRound(((0, 1), (2, 1)))  # duplicate destination
+    with pytest.raises(ValueError):
+        CommRound(((0, 1), (0, 2)))  # duplicate source
+
+
+def _check_reduce_invariants(tree):
+    rounds = tree.reduce_rounds()
+    seen_landed = {}  # rank -> round of last receive
+    sent = {}
+    for ri, rnd in enumerate(rounds):
+        for s, d in rnd.edges:
+            # dataflow: s sends only after all its children delivered
+            for c in tree.precedents(s):
+                assert c in sent and sent[c] < ri, (s, d, ri)
+            sent[s] = ri
+            seen_landed[d] = ri
+    # every non-root rank sends exactly once
+    assert set(sent) == tree.ranks - {tree.root}
+
+
+def _check_broadcast_invariants(tree):
+    rounds = tree.broadcast_rounds()
+    received = {tree.root: -1}
+    for ri, rnd in enumerate(rounds):
+        for s, d in rnd.edges:
+            assert s in received and received[s] < ri, (s, d, ri)
+            assert d not in received
+            received[d] = ri
+    assert set(received) == tree.ranks
+
+
+@pytest.mark.parametrize("factory", [chain4, star4, binary7])
+def test_round_lowering_invariants(factory):
+    _check_reduce_invariants(factory())
+    _check_broadcast_invariants(factory())
+
+
+def test_chain_rounds_are_sequential():
+    t = chain4()
+    rr = t.reduce_rounds()
+    assert [r.edges for r in rr] == [((3, 2),), ((2, 1),), ((1, 0),)]
+    br = t.broadcast_rounds()
+    assert [r.edges for r in br] == [((0, 1),), ((1, 2),), ((2, 3),)]
+
+
+def test_star_staggers_siblings():
+    rr = star4().reduce_rounds()
+    # all three children target rank 0 → one edge per round
+    assert len(rr) == 3
+    assert all(len(r.edges) == 1 for r in rr)
+
+
+def test_binary_tree_parallel_rounds():
+    rr = binary7().reduce_rounds()
+    # leaves 3,4,5,6 → 1,1,2,2 takes 2 rounds (sibling stagger, two parents in
+    # parallel), then 1,2 → 0 takes 2 more
+    assert len(rr) == 4
+    assert set(rr[0].edges) | set(rr[1].edges) == {(3, 1), (4, 1), (5, 2), (6, 2)}
+
+
+def test_strategy_validation_and_fingerprint():
+    s = Strategy.ring(4, num_trans=2)
+    assert s.num_trans == 2
+    assert s.fingerprint() == Strategy.ring(4, num_trans=2).fingerprint()
+    assert s.fingerprint() != Strategy.binary(4, num_trans=2).fingerprint()
+    with pytest.raises(ValueError):
+        Strategy([chain4()], world_size=5)  # missing rank 4
+
+
+def test_ring_and_binary_builders():
+    s = Strategy.ring(8, num_trans=8)
+    assert all(t.root == i for i, t in enumerate(s.trees))
+    b = Strategy.binary(8, num_trans=1)
+    assert b.trees[0].root == 0
+    assert b.trees[0].precedents(0) == [1, 2]

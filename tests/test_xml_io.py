@@ -1,0 +1,119 @@
+"""XML artifact compatibility: strategy trees, logical graphs, ip tables."""
+
+import pytest
+
+from adapcc_tpu.strategy.ir import Strategy
+from adapcc_tpu.strategy.xml_io import (
+    LogicalGraph,
+    ServerEntry,
+    emit_logical_graph_xml,
+    emit_strategy_xml,
+    parse_logical_graph_xml,
+    parse_strategy_xml,
+    read_ip_table,
+    write_ip_table,
+)
+
+# Same schema as the reference fixtures (strategy/4.xml shape: four rotated
+# intra-host trees over ranks 0-3) — content written fresh for this suite,
+# including the reference files' missing-space attribute quirk.
+STRATEGY_4 = """<trees>
+    <root id='0' ip='10.0.0.1'>
+        <gpu id='1'ip='10.0.0.1'/>
+        <gpu id='2' ip='10.0.0.1'>
+            <gpu id='3' ip='10.0.0.1'/>
+        </gpu>
+    </root>
+    <root id='1' ip='10.0.0.1'>
+        <gpu id='2' ip='10.0.0.1'/>
+        <gpu id='3' ip='10.0.0.1'>
+            <gpu id='0' ip='10.0.0.1'/>
+        </gpu>
+    </root>
+</trees>"""
+
+HIER_2X2 = """<trees>
+    <root id='0' ip='10.0.0.1'>
+        <gpu id='1' ip='10.0.0.1'/>
+        <gpu id='2' ip='10.0.0.2'>
+            <gpu id='3' ip='10.0.0.2'/>
+        </gpu>
+    </root>
+</trees>"""
+
+GRAPH_2N = """<graph version='test-2n'>
+    <server id="0" ip="10.0.0.1">
+        <nic id="0">
+            <gpu id="0"/>
+            <gpu id="1"/>
+        </nic>
+    </server>
+    <server id="1" ip="10.0.0.2">
+        <nic id="1">
+            <gpu id="2"/>
+            <gpu id="3"/>
+        </nic>
+    </server>
+</graph>"""
+
+
+def test_parse_strategy_with_attribute_quirk():
+    s = parse_strategy_xml(STRATEGY_4)
+    assert s.world_size == 4
+    assert s.num_trans == 2
+    t0 = s.trees[0]
+    assert t0.root == 0
+    assert t0.precedents(0) == [1, 2]
+    assert t0.precedents(2) == [3]
+    assert s.trees[1].root == 1
+
+
+def test_strategy_roundtrip():
+    s = parse_strategy_xml(STRATEGY_4)
+    text = emit_strategy_xml(s)
+    s2 = parse_strategy_xml(text)
+    assert s2.fingerprint() == s.fingerprint()
+    assert s2.trees[0].ips == s.trees[0].ips
+
+
+def test_cross_host_classification():
+    s = parse_strategy_xml(HIER_2X2)
+    t = s.trees[0]
+    assert not t.is_cross_host(0, 1)
+    assert t.is_cross_host(0, 2)
+    assert not t.is_cross_host(2, 3)
+
+
+def test_logical_graph_roundtrip(tmp_path):
+    g = parse_logical_graph_xml(GRAPH_2N)
+    assert g.version == "test-2n"
+    assert g.world_size == 4
+    assert g.rank_to_ip() == {0: "10.0.0.1", 1: "10.0.0.1", 2: "10.0.0.2", 3: "10.0.0.2"}
+    assert g.local_rank0_list() == [0, 2]
+
+    p = tmp_path / "graph.xml"
+    emit_logical_graph_xml(g, str(p))
+    g2 = parse_logical_graph_xml(str(p))
+    assert g2.rank_to_ip() == g.rank_to_ip()
+
+
+def test_ip_table_roundtrip(tmp_path):
+    ips = ["10.0.0.1", "10.0.0.1", "10.0.0.2", "10.0.0.2"]
+    p = tmp_path / "ip_table.txt"
+    write_ip_table(ips, str(p))
+    assert read_ip_table(str(p)) == ips
+
+
+def test_emit_builtin_strategies(tmp_path):
+    s = Strategy.binary(8, num_trans=2, ips={i: "h0" for i in range(8)})
+    p = tmp_path / "s.xml"
+    emit_strategy_xml(s, str(p))
+    s2 = parse_strategy_xml(str(p))
+    assert s2.fingerprint() == s.fingerprint()
+
+
+def test_reject_wrong_root_tag():
+    with pytest.raises(ValueError):
+        parse_strategy_xml("<graph></graph>")
+    with pytest.raises(ValueError):
+        parse_logical_graph_xml("<trees></trees>")
