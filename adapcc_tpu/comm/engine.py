@@ -1,0 +1,390 @@
+"""Schedule-driven collective engine on a `jax.sharding.Mesh`.
+
+The TPU-native replacement for the reference's native transmission contexts
+(csrc/allreduce.cu / reduce.cu / boardcast.cu): where the reference spawns two
+persistent pthreads per tree that move 4 MB chunks through IPC staging buffers
+(allreduce.cu:430-659), here every strategy tree lowers to a static sequence
+of masked ``jax.lax.ppermute`` rounds inside one jitted ``shard_map`` program.
+XLA owns chunking, overlap, and ICI routing; the strategy owns the *shape* of
+the communication (which links carry data, in what order, rooted where).
+
+Relay semantics (reference control.cu): the active set arrives as a runtime
+``[world]`` mask, so step-to-step relay decisions never trigger recompilation.
+Inactive ranks contribute the reduction identity but remain on the data path
+as forwarders — the masked-collective formulation of the reference's
+``<hasRecv, hasLocal, hasKernel, hasSend>`` role algebra.
+
+Full-world allreduce additionally has an XLA fast path (``lax.psum``), which
+is the optimal program on an ICI torus; the schedule path exists for subset /
+relay semantics and for topology-shaped strategies.  ``ALLGATHER`` /
+``ALLTOALL`` / ``REDUCESCATTER`` — enum stubs the reference never implemented
+(commu.py:65-69 maps only three primitives) — are provided natively via XLA
+collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from adapcc_tpu.primitives import ReduceOp
+from adapcc_tpu.strategy.ir import CommRound, Strategy, Tree
+from adapcc_tpu.comm.mesh import RANKS_AXIS
+
+
+def _identity_for(op: ReduceOp, dtype) -> jnp.ndarray:
+    if op is ReduceOp.MAX:
+        return jnp.asarray(-jnp.inf if jnp.issubdtype(dtype, jnp.floating) else jnp.iinfo(dtype).min, dtype)
+    return jnp.asarray(0, dtype)
+
+
+def _dst_mask(round_: CommRound, world: int) -> np.ndarray:
+    m = np.zeros((world,), dtype=bool)
+    for _, d in round_.edges:
+        m[d] = True
+    return m
+
+
+def _segment_sizes(n: int, shares: Sequence[float]) -> List[int]:
+    """Static split of ``n`` elements across trees, proportional to shares.
+
+    Mirrors the reference's 1/numTrans sharding (allreduce.cu:310,536), except
+    shares may be non-uniform when the MILP solver optimized them.
+    """
+    sizes = [int(n * s) for s in shares]
+    rem = n - sum(sizes)
+    i = 0
+    while rem > 0:
+        sizes[i % len(sizes)] += 1
+        rem -= 1
+        i += 1
+    return sizes
+
+
+# --------------------------------------------------------------------------- #
+# per-shard (inside shard_map) schedule execution
+# --------------------------------------------------------------------------- #
+
+def _run_reduce_rounds(
+    acc: jnp.ndarray,
+    rounds: Sequence[CommRound],
+    axis_name: str,
+    world: int,
+    op: ReduceOp,
+) -> jnp.ndarray:
+    """Push partial reductions up the tree, one ppermute per round.
+
+    ppermute delivers zeros to ranks that are not a destination, so for SUM
+    the combine is a plain add; MAX needs an explicit destination mask.
+    """
+    for rnd in rounds:
+        recvd = lax.ppermute(acc, axis_name, list(rnd.edges))
+        if op is ReduceOp.MAX:
+            is_dst = jnp.asarray(_dst_mask(rnd, world))[lax.axis_index(axis_name)]
+            acc = jnp.where(is_dst, jnp.maximum(acc, recvd), acc)
+        else:
+            acc = acc + recvd
+    return acc
+
+
+def _run_broadcast_rounds(
+    acc: jnp.ndarray,
+    rounds: Sequence[CommRound],
+    axis_name: str,
+    world: int,
+) -> jnp.ndarray:
+    """Stream the rooted value down the tree; destinations adopt what lands."""
+    for rnd in rounds:
+        recvd = lax.ppermute(acc, axis_name, list(rnd.edges))
+        is_dst = jnp.asarray(_dst_mask(rnd, world))[lax.axis_index(axis_name)]
+        acc = jnp.where(is_dst, recvd, acc)
+    return acc
+
+
+def _mask_contribution(
+    seg: jnp.ndarray, active_mask: jnp.ndarray, axis_name: str, op: ReduceOp
+) -> jnp.ndarray:
+    """Relay masking: inactive ranks contribute the reduction identity while
+    staying on the forwarding path (reference hasLocal gate, control.cu)."""
+    my_active = active_mask[lax.axis_index(axis_name)]
+    return jnp.where(my_active, seg, _identity_for(op, seg.dtype))
+
+
+def _run_segments(
+    x: jnp.ndarray,
+    strategy: Strategy,
+    per_segment: Callable[[jnp.ndarray, Tree], jnp.ndarray],
+) -> jnp.ndarray:
+    """Shared scaffolding: flatten, split across trees by share, run each
+    tree's segment program, reassemble in the original shape."""
+    flat = x.reshape(-1)
+    if flat.size == 0:
+        return x
+    sizes = _segment_sizes(flat.size, strategy.tree_shares())
+    outs: List[jnp.ndarray] = []
+    off = 0
+    for tree, size in zip(strategy.trees, sizes):
+        if size == 0:
+            continue
+        outs.append(per_segment(flat[off : off + size], tree))
+        off += size
+    result = jnp.concatenate(outs) if len(outs) > 1 else outs[0]
+    return result.reshape(x.shape)
+
+
+def _avg_normalize(result: jnp.ndarray, active_mask: jnp.ndarray, op: ReduceOp) -> jnp.ndarray:
+    if op is not ReduceOp.AVG:
+        return result
+    n_active = jnp.maximum(jnp.sum(active_mask.astype(result.dtype)), 1)
+    return result / n_active
+
+
+def allreduce_shard(
+    x: jnp.ndarray,
+    active_mask: jnp.ndarray,
+    strategy: Strategy,
+    axis_name: str = RANKS_AXIS,
+    op: ReduceOp = ReduceOp.SUM,
+) -> jnp.ndarray:
+    """Strategy-shaped allreduce over ``axis_name``; call inside shard_map.
+
+    ``x`` is this rank's contribution (any shape); ``active_mask`` is a
+    ``[world]`` bool/int array.  Result lands on every rank, active or not
+    (relays receive too, matching the reference broadcast phase).
+    """
+    world = strategy.world_size
+
+    def per_segment(seg, tree):
+        acc = _mask_contribution(seg, active_mask, axis_name, op)
+        acc = _run_reduce_rounds(acc, tree.reduce_rounds(), axis_name, world, op)
+        return _run_broadcast_rounds(acc, tree.broadcast_rounds(), axis_name, world)
+
+    return _avg_normalize(_run_segments(x, strategy, per_segment), active_mask, op)
+
+
+def reduce_shard(
+    x: jnp.ndarray,
+    active_mask: jnp.ndarray,
+    strategy: Strategy,
+    axis_name: str = RANKS_AXIS,
+    op: ReduceOp = ReduceOp.SUM,
+) -> jnp.ndarray:
+    """Reduce-to-root: each tree's segment is valid on that tree's root only
+    (reference reduceContext keeps the result at the root, reduce.cu:258-269);
+    other ranks hold partial sums for their segment."""
+    world = strategy.world_size
+
+    def per_segment(seg, tree):
+        acc = _mask_contribution(seg, active_mask, axis_name, op)
+        return _run_reduce_rounds(acc, tree.reduce_rounds(), axis_name, world, op)
+
+    return _avg_normalize(_run_segments(x, strategy, per_segment), active_mask, op)
+
+
+def broadcast_shard(
+    x: jnp.ndarray,
+    strategy: Strategy,
+    axis_name: str = RANKS_AXIS,
+) -> jnp.ndarray:
+    """Broadcast from each tree's root: the root's segment replaces everyone
+    else's (reference boardcastContext reads the user tensor at the root,
+    boardcast.cu:279-282)."""
+    world = strategy.world_size
+
+    def per_segment(seg, tree):
+        return _run_broadcast_rounds(seg, tree.broadcast_rounds(), axis_name, world)
+
+    return _run_segments(x, strategy, per_segment)
+
+
+# --------------------------------------------------------------------------- #
+# host-level engine: compiled-program cache + stacked-array entry points
+# --------------------------------------------------------------------------- #
+
+class CollectiveEngine:
+    """Compiled, cached collective programs over one world mesh.
+
+    The analog of the reference's persistent transmission context
+    (SURVEY.md §3.2): creating one is cheap; the first call per
+    (primitive, shape, dtype, op) compiles and caches, later calls replay the
+    executable.  ``clear()`` drops the cache — the analog of
+    ``exitThreads`` tearing contexts down before re-synthesis
+    (reconstruct_topology, adapcc.py:63-67).
+
+    Entry points take **stacked** arrays of shape ``[world, ...]`` where row
+    ``r`` is rank ``r``'s contribution, and return the same shape (row ``r``
+    = rank ``r``'s result).  This is the single-controller view; training
+    loops instead call the ``*_shard`` functions inside their own shard_map.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        strategy: Strategy,
+        axis_name: str = RANKS_AXIS,
+        use_xla_fastpath: bool = True,
+    ) -> None:
+        if mesh.devices.size != strategy.world_size:
+            raise ValueError(
+                f"mesh has {mesh.devices.size} devices but strategy world is "
+                f"{strategy.world_size}"
+            )
+        self.mesh = mesh
+        self.strategy = strategy
+        self.axis_name = axis_name
+        self.use_xla_fastpath = use_xla_fastpath
+        self._cache: Dict[Tuple, Callable] = {}
+
+    @property
+    def world_size(self) -> int:
+        return self.strategy.world_size
+
+    def clear(self) -> None:
+        self._cache.clear()
+
+    def _active_to_mask(self, active_gpus: Optional[Sequence[int]]) -> jnp.ndarray:
+        if active_gpus is None:
+            return jnp.ones((self.world_size,), dtype=jnp.bool_)
+        ranks = list(active_gpus)
+        bad = [r for r in ranks if not 0 <= r < self.world_size]
+        if bad:
+            raise ValueError(f"active ranks {bad} outside world [0, {self.world_size})")
+        m = np.zeros((self.world_size,), dtype=bool)
+        m[ranks] = True
+        return jnp.asarray(m)
+
+    def _check_world_dim(self, stacked: jnp.ndarray, what: str) -> None:
+        if stacked.shape[0] != self.world_size:
+            raise ValueError(
+                f"{what} expects a stacked [world, ...] array with leading dim "
+                f"{self.world_size}, got shape {stacked.shape}"
+            )
+
+    def _shard_mapped(self, key: Tuple, per_shard: Callable, n_args: int) -> Callable:
+        fn = self._cache.get(key)
+        if fn is None:
+            specs = (P(self.axis_name),) + (P(),) * (n_args - 1)
+            fn = jax.jit(
+                jax.shard_map(
+                    per_shard,
+                    mesh=self.mesh,
+                    in_specs=specs,
+                    out_specs=P(self.axis_name),
+                )
+            )
+            self._cache[key] = fn
+        return fn
+
+    def all_reduce(
+        self,
+        stacked: jnp.ndarray,
+        active_gpus: Optional[Sequence[int]] = None,
+        op: ReduceOp = ReduceOp.SUM,
+    ) -> jnp.ndarray:
+        self._check_world_dim(stacked, "all_reduce")
+        mask = self._active_to_mask(active_gpus)
+        if self.use_xla_fastpath and active_gpus is None and op is not ReduceOp.MAX:
+            per_shard = functools.partial(self._psum_shard, op=op)
+            key = ("psum", stacked.shape, stacked.dtype.name, op)
+        else:
+            per_shard = functools.partial(
+                allreduce_shard,
+                strategy=self.strategy,
+                axis_name=self.axis_name,
+                op=op,
+            )
+            key = ("allreduce", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name, op)
+        return self._shard_mapped(key, per_shard, 2)(stacked, mask)
+
+    def _psum_shard(self, x: jnp.ndarray, mask: jnp.ndarray, op: ReduceOp) -> jnp.ndarray:
+        s = lax.psum(x, self.axis_name)
+        if op is ReduceOp.AVG:
+            s = s / self.world_size
+        return s
+
+    def reduce(
+        self,
+        stacked: jnp.ndarray,
+        active_gpus: Optional[Sequence[int]] = None,
+        op: ReduceOp = ReduceOp.SUM,
+    ) -> jnp.ndarray:
+        self._check_world_dim(stacked, "reduce")
+        per_shard = functools.partial(
+            reduce_shard, strategy=self.strategy, axis_name=self.axis_name, op=op
+        )
+        key = ("reduce", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name, op)
+        return self._shard_mapped(key, per_shard, 2)(stacked, self._active_to_mask(active_gpus))
+
+    def boardcast(self, stacked: jnp.ndarray) -> jnp.ndarray:
+        """Reference spelling kept for API parity (adapcc.py:55-57)."""
+        self._check_world_dim(stacked, "boardcast")
+        per_shard = functools.partial(
+            broadcast_shard, strategy=self.strategy, axis_name=self.axis_name
+        )
+        key = ("broadcast", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name)
+        return self._shard_mapped(key, per_shard, 1)(stacked)
+
+    broadcast = boardcast
+
+    # -- primitives the reference declared but never implemented --------------
+
+    def all_gather(self, stacked: jnp.ndarray) -> jnp.ndarray:
+        """Native XLA all-gather (reference stub: trans.h ALLGATHER enum).
+
+        Input ``[world, *payload]`` (row r = rank r's shard) → output
+        ``[world, world, *payload]`` (row r = the full gathered stack as seen
+        by rank r).
+        """
+
+        self._check_world_dim(stacked, "all_gather")
+
+        def per_shard(x):  # x: [1, *payload]
+            return lax.all_gather(x[0], self.axis_name, axis=0)[None]
+
+        key = ("allgather", stacked.shape, stacked.dtype.name)
+        return self._shard_mapped(key, per_shard, 1)(stacked)
+
+    def all_to_all(self, stacked: jnp.ndarray) -> jnp.ndarray:
+        """Native XLA all-to-all over ICI.
+
+        ``stacked[src, dst]`` blocks are exchanged so each rank ``r`` ends up
+        with ``stacked[:, r]`` — the expert-parallel shuffle the reference
+        delegates to fastmoe/NCCL (models/moe/train_moe.py, AdapCC.alltoall
+        stub adapcc.py:59-61).  Expects ``stacked.shape[1] == world``.
+        """
+        self._check_world_dim(stacked, "all_to_all")
+        if stacked.shape[1] != self.world_size:
+            raise ValueError(
+                f"all_to_all needs a [world, world, ...] stacked array, got {stacked.shape}"
+            )
+
+        def per_shard(x):  # x: [1, world, *payload]
+            return lax.all_to_all(x[0], self.axis_name, split_axis=0, concat_axis=0)[None]
+
+        key = ("alltoall", stacked.shape, stacked.dtype.name)
+        return self._shard_mapped(key, per_shard, 1)(stacked)
+
+    def reduce_scatter(self, stacked: jnp.ndarray, op: ReduceOp = ReduceOp.SUM) -> jnp.ndarray:
+        """Native XLA reduce-scatter (reference stub: REDUCESCATTER enum).
+
+        Row ``r`` of the result is the reduction of everyone's ``r``-th
+        world-slice: input ``[world, n]`` → output ``[world, n // world]``.
+        """
+
+        self._check_world_dim(stacked, "reduce_scatter")
+
+        def per_shard(x):  # x: [1, n]
+            flat = x.reshape(-1)
+            out = lax.psum_scatter(flat, self.axis_name, scatter_dimension=0, tiled=True)
+            if op is ReduceOp.AVG:
+                out = out / self.world_size
+            return out[None, :]
+
+        key = ("reducescatter", stacked.shape, stacked.dtype.name, op)
+        return self._shard_mapped(key, per_shard, 1)(stacked)

@@ -1,0 +1,41 @@
+"""Mesh construction and device-topology helpers.
+
+The reference binds each process to one GPU (`cudaSetDevice(localRank)`,
+csrc/run.cu:49) and builds a rank world over MPI.  On TPU the runtime already
+owns every local chip, so a "world" is a `jax.sharding.Mesh` axis: one mesh
+axis position per reference rank.  Multi-host worlds come from
+`jax.distributed` + the same mesh spanning all processes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+#: canonical mesh axis name for the collective world (reference "world rank")
+RANKS_AXIS = "ranks"
+
+
+def build_world_mesh(world_size: Optional[int] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """A 1-D mesh of ``world_size`` devices — the collective world."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if world_size is not None:
+        if len(devs) < world_size:
+            raise ValueError(f"need {world_size} devices, have {len(devs)}")
+        devs = devs[:world_size]
+    return Mesh(np.array(devs), (RANKS_AXIS,))
+
+
+def device_ip(device) -> str:
+    """Stable host identifier for a device, used where the reference uses the
+    node ip (tree edge classification, strategy XML).  TPU devices expose the
+    owning process; devices in one process share ICI locality."""
+    return f"process-{getattr(device, 'process_index', 0)}"
+
+
+def mesh_ip_table(mesh: Mesh) -> List[str]:
+    """Rank→"ip" list for a world mesh (analog of topology/ip_table.txt)."""
+    return [device_ip(d) for d in mesh.devices.flat]

@@ -1,0 +1,212 @@
+"""Communicator: the control-plane orchestrator (reference CudaCommu analog).
+
+Owns the detect → profile → synthesize → execute workflow that the reference
+spreads across ctypes calls into ``communicator.so`` plus scp file fan-out
+(commu.py:301-352).  Here every stage is in-process: detection reads device
+metadata, profiling runs timed probe collectives, synthesis emits the
+strategy XML, and "transmission contexts" are compiled collective programs
+held by a :class:`CollectiveEngine`.
+
+Lifecycle parity (reference commu.py / run.cu):
+
+- ``init_threads(DETECT)`` / ``exit_threads(DETECT)`` — detect topology, dump
+  per-host XML shards, merge into the logical graph.
+- ``init_threads(PROFILE)`` / ``exit_threads(PROFILE)`` — probe the mesh,
+  dump/gather lat+bw matrices, synthesize + persist the strategy
+  (``_synthesis_strategy``, commu.py:272-278).
+- ``init_threads(<collective>)`` — build the engine from the strategy file
+  (the analog of ``bootstrapFromXMl`` spawning tree threads).
+- ``exit_threads(<collective>)`` / ``clear()`` — drop compiled programs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from adapcc_tpu.comm.engine import CollectiveEngine
+from adapcc_tpu.comm.mesh import RANKS_AXIS, build_world_mesh, mesh_ip_table
+from adapcc_tpu.config import CommArgs
+from adapcc_tpu.primitives import (
+    ALLGATHER,
+    ALLREDUCE,
+    ALLTOALL,
+    BOARDCAST,
+    DETECT,
+    PROFILE,
+    REDUCE,
+    REDUCESCATTER,
+    ReduceOp,
+)
+from adapcc_tpu.strategy.ir import Strategy
+from adapcc_tpu.strategy.synthesizer import Synthesizer
+from adapcc_tpu.strategy.xml_io import parse_strategy_xml, read_ip_table, write_ip_table
+from adapcc_tpu.topology.detect import (
+    detect_topology,
+    dump_detected_topology,
+    gather_detect_graph,
+)
+from adapcc_tpu.topology.profile import NetworkProfiler, gather_topo_profile
+
+_COLLECTIVE_PRIMS = (ALLREDUCE, REDUCE, BOARDCAST, ALLGATHER, ALLTOALL, REDUCESCATTER)
+
+
+class Communicator:
+    """One communication world: mesh + artifacts + compiled engines."""
+
+    def __init__(self, args: CommArgs, mesh: Optional[Mesh] = None, world_size: Optional[int] = None):
+        self.args = args
+        self.mesh = mesh if mesh is not None else build_world_mesh(world_size)
+        self.world_size = int(self.mesh.devices.size)
+        self.axis_name = self.mesh.axis_names[0]
+        self.chunk_bytes = args.default_chunk_bytes
+
+        os.makedirs(args.topology_dir, exist_ok=True)
+        ip_table_path = os.path.join(args.topology_dir, "ip_table.txt")
+        self.ip_table = None
+        if os.path.exists(ip_table_path):
+            table = read_ip_table(ip_table_path)
+            if len(table) == self.world_size:
+                self.ip_table = table
+        if self.ip_table is None:
+            # missing or stale (wrong world size) artifact: re-derive from mesh
+            self.ip_table = mesh_ip_table(self.mesh)
+            write_ip_table(self.ip_table, ip_table_path)
+
+        self.synthesizer = Synthesizer(args.strategy_file, self.ip_table, policy=args.policy)
+        self._engines: Dict[int, CollectiveEngine] = {}
+        self._strategy: Optional[Strategy] = None
+        self._profiler: Optional[NetworkProfiler] = None
+        self.fault_worker_list: List[int] = []
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def init_threads(self, prim: int) -> None:
+        if prim == DETECT:
+            dump_detected_topology(self.mesh, self.args.topology_dir)
+        elif prim == PROFILE:
+            self._profiler = NetworkProfiler(self.mesh, self.axis_name)
+            self._profiler.dump(self.args.topology_dir, rank=0)
+        elif prim in _COLLECTIVE_PRIMS:
+            self._engines[prim] = CollectiveEngine(
+                self.mesh,
+                self._load_strategy(),
+                axis_name=self.axis_name,
+                use_xla_fastpath=self.args.use_xla_fastpath,
+            )
+        else:
+            raise ValueError(f"unknown primitive {prim}")
+
+    def exit_threads(self, prim: int) -> None:
+        if prim == DETECT:
+            gather_detect_graph(self.args.topology_dir, self.args.logical_graph)
+        elif prim == PROFILE:
+            self._synthesis_strategy()
+        elif prim in _COLLECTIVE_PRIMS:
+            eng = self._engines.pop(prim, None)
+            if eng is not None:
+                eng.clear()
+
+    def clear(self) -> None:
+        for eng in self._engines.values():
+            eng.clear()
+        self._engines.clear()
+        self._strategy = None
+
+    def _load_strategy(self) -> Strategy:
+        if self._strategy is not None:
+            return self._strategy
+        if self.args.strategy_file and os.path.exists(self.args.strategy_file):
+            self._strategy = parse_strategy_xml(self.args.strategy_file, self.chunk_bytes)
+        else:
+            # no strategy artifact: default ring over the mesh (TPU-idiomatic)
+            ips = {r: ip for r, ip in enumerate(self.ip_table)}
+            self._strategy = Strategy.ring(
+                self.world_size, max(1, self.args.parallel_degree), ips
+            )
+        return self._strategy
+
+    def _synthesis_strategy(self) -> None:
+        """Profile artifacts → strategy XML + chunk size
+        (reference ``_synthesis_strategy``, commu.py:272-278)."""
+        lat, bw = gather_topo_profile(self.args.topology_dir, self.world_size)
+        if not bw.any():  # profiling produced nothing (single device)
+            return
+        graph_local_rank0s = None
+        if os.path.exists(self.args.logical_graph):
+            from adapcc_tpu.strategy.xml_io import parse_logical_graph_xml
+
+            graph_local_rank0s = parse_logical_graph_xml(self.args.logical_graph).local_rank0_list()
+        self.chunk_bytes = self.synthesizer.generate_strategy(
+            ALLREDUCE,
+            self.args.parallel_degree,
+            transmission_size=self.chunk_bytes,
+            bandwidth_graph=bw,
+            latency_graph=lat,
+            local_rank0_list=graph_local_rank0s,
+        )
+        self._strategy = None  # force reload from the fresh XML
+
+    # -- collectives (stacked [world, ...] single-controller view) -------------
+
+    def _engine(self, prim: int) -> CollectiveEngine:
+        if prim not in self._engines:
+            raise RuntimeError(
+                f"no context for primitive {prim}; call setup/init_threads first "
+                "(reference requires initThreads before collectives, run.cu:103-127)"
+            )
+        return self._engines[prim]
+
+    def all_reduce(
+        self,
+        tensor: jnp.ndarray,
+        size: Optional[int] = None,
+        chunk_bytes: Optional[int] = None,
+        active_gpus: Optional[Sequence[int]] = None,
+        op: ReduceOp = ReduceOp.SUM,
+    ) -> jnp.ndarray:
+        """Reference signature ``all_reduce(tensor, size, chunk_bytes,
+        active_gpus)`` (commu.py:360-365); size/chunk_bytes are accepted for
+        parity only — shapes are static under jit, and chunking belongs to
+        the compiled program (synthesis-time ``self.chunk_bytes``), so a
+        per-call value is ignored rather than mutating communicator state."""
+        return self._engine(ALLREDUCE).all_reduce(tensor, active_gpus=active_gpus, op=op)
+
+    def reduce(
+        self,
+        tensor: jnp.ndarray,
+        size: Optional[int] = None,
+        chunk_bytes: Optional[int] = None,
+        active_gpus: Optional[Sequence[int]] = None,
+        op: ReduceOp = ReduceOp.SUM,
+    ) -> jnp.ndarray:
+        return self._engine(REDUCE).reduce(tensor, active_gpus=active_gpus, op=op)
+
+    def boardcast(
+        self, tensor: jnp.ndarray, size: Optional[int] = None, chunk_bytes: Optional[int] = None
+    ) -> jnp.ndarray:
+        return self._engine(BOARDCAST).boardcast(tensor)
+
+    def alltoall(
+        self, tensor: jnp.ndarray, size: Optional[int] = None, chunk_bytes: Optional[int] = None
+    ) -> jnp.ndarray:
+        return self._engine(ALLTOALL).all_to_all(tensor)
+
+    def all_gather(self, tensor: jnp.ndarray) -> jnp.ndarray:
+        return self._engine(ALLGATHER).all_gather(tensor)
+
+    def reduce_scatter(self, tensor: jnp.ndarray, op: ReduceOp = ReduceOp.SUM) -> jnp.ndarray:
+        return self._engine(REDUCESCATTER).reduce_scatter(tensor, op=op)
+
+    # -- introspection ---------------------------------------------------------
+
+    @property
+    def strategy(self) -> Strategy:
+        return self._load_strategy()
+
+    def active_contexts(self) -> List[int]:
+        return sorted(self._engines)

@@ -1,0 +1,47 @@
+"""Runtime configuration: the flag contract of the reference launcher.
+
+The reference forwards a fixed flag set to every rank's training script
+(launcher.py:19-32 → train_ddp.py:60-69): port, entry_point, strategy_file,
+logical_graph, parallel_degree, profile_freq.  ``CommArgs`` carries the same
+contract (plus TPU-native knobs) and accepts any argparse-style namespace
+using those reference names.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from adapcc_tpu.primitives import COORDINATOR_PORT, DEFAULT_CHUNK_BYTES, SKIP_BOOTSTRAP
+
+
+@dataclass
+class CommArgs:
+    port: int = COORDINATOR_PORT
+    strategy_file: str = "topology/strategy.xml"
+    logical_graph: str = "topology/logical_graph.xml"
+    entry_point: int = SKIP_BOOTSTRAP
+    parallel_degree: int = 1
+    profile_freq: int = 0
+    #: directory holding the XML/CSV topology artifacts
+    topology_dir: str = "topology"
+    #: synthesis policy: par-trees | milp | ring | binary
+    policy: str = "par-trees"
+    #: BSP mode: stragglers skip the collective and reuse stale gradients;
+    #: async mode replays their buckets through relay buffers later
+    #: (reference is_bsp flag, commu.py:107)
+    is_bsp: bool = True
+    #: full-world allreduce uses lax.psum instead of the tree schedule
+    use_xla_fastpath: bool = True
+    default_chunk_bytes: int = DEFAULT_CHUNK_BYTES
+    coordinator_ip: Optional[str] = None
+
+    @classmethod
+    def from_namespace(cls, ns: Any) -> "CommArgs":
+        """Build from an argparse namespace using reference flag names;
+        unknown fields keep their defaults."""
+        kwargs = {}
+        for f in cls.__dataclass_fields__:
+            if hasattr(ns, f) and getattr(ns, f) is not None:
+                kwargs[f] = getattr(ns, f)
+        return cls(**kwargs)

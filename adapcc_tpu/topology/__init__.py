@@ -1,0 +1,11 @@
+"""Topology detection and online network profiling for TPU meshes."""
+
+from adapcc_tpu.topology.detect import detect_topology, dump_detected_topology, gather_detect_graph
+from adapcc_tpu.topology.profile import NetworkProfiler
+
+__all__ = [
+    "detect_topology",
+    "dump_detected_topology",
+    "gather_detect_graph",
+    "NetworkProfiler",
+]

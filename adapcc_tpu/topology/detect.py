@@ -1,0 +1,102 @@
+"""Topology detection: build the logical cluster graph from the device mesh.
+
+The reference burns a whole native context on this — NUMA-pinned loopback
+timing, pairwise PCIe-contention probes, NIC-affinity bandwidth tests
+(csrc/detect.cu:70-361) — because GPU servers hide their topology.  TPU
+runtimes don't: every `jax.Device` carries its owning process, slice, and
+torus coordinates, so "detection" is reading metadata instead of racing DMA
+engines.  What survives from the reference design is the *artifact contract*:
+a per-host detected-topology XML (analog of ``topology/topo_detect_<rank>.xml``,
+detect.cu:367-424) and a merge step producing the logical graph XML that
+drives profiling and synthesis (analog of ``_gather_detect_graph``,
+commu.py:207-244).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List, Optional
+
+from jax.sharding import Mesh
+
+from adapcc_tpu.comm.mesh import device_ip
+from adapcc_tpu.strategy.xml_io import (
+    LogicalGraph,
+    ServerEntry,
+    emit_logical_graph_xml,
+    parse_logical_graph_xml,
+)
+
+
+def _device_slice(device) -> int:
+    """ICI domain id: devices in one slice talk over ICI, across slices over
+    DCN (the TPU analog of the reference's NIC grouping)."""
+    for attr in ("slice_index", "slice"):
+        v = getattr(device, attr, None)
+        if isinstance(v, int):
+            return v
+    return getattr(device, "process_index", 0)
+
+
+def detect_topology(mesh: Mesh, version: str = "tpu-detected") -> LogicalGraph:
+    """Logical graph of the world mesh: one server entry per (process, slice).
+
+    Rank numbering is mesh order (flattened), matching how the collective
+    engine assigns schedule ranks to mesh positions.
+    """
+    devices = list(mesh.devices.flat)
+    buckets: Dict[tuple, List[int]] = {}
+    for rank, dev in enumerate(devices):
+        key = (getattr(dev, "process_index", 0), _device_slice(dev))
+        buckets.setdefault(key, []).append(rank)
+
+    graph = LogicalGraph(version=version)
+    for sid, ((proc, sl), ranks) in enumerate(sorted(buckets.items())):
+        graph.servers.append(
+            ServerEntry(
+                server_id=sid,
+                ip=device_ip(devices[ranks[0]]),
+                nic_id=sl,
+                gpus=sorted(ranks),
+            )
+        )
+    return graph
+
+
+def dump_detected_topology(mesh: Mesh, out_dir: str, process_index: Optional[int] = None) -> List[str]:
+    """Write per-host detected-topology XML files.
+
+    Single-controller JAX sees every process's devices, so this writes the
+    shard of the graph owned by each process (or just ``process_index`` if
+    given) — the analog of each node's local-rank-0 dumping
+    ``topo_detect_<rank>.xml``.
+    """
+    os.makedirs(out_dir, exist_ok=True)
+    graph = detect_topology(mesh)
+    written = []
+    for s in graph.servers:
+        proc = int(s.ip.rsplit("-", 1)[-1]) if "-" in s.ip else s.server_id
+        if process_index is not None and proc != process_index:
+            continue
+        shard = LogicalGraph(servers=[s], version=graph.version)
+        path = os.path.join(out_dir, f"topo_detect_{min(s.gpus)}.xml")
+        emit_logical_graph_xml(shard, path)
+        written.append(path)
+    return written
+
+
+def gather_detect_graph(topology_dir: str, out_path: Optional[str] = None) -> LogicalGraph:
+    """Merge per-host ``topo_detect_*.xml`` shards into one logical graph
+    (analog of the reference's xmltodict merge, commu.py:207-244)."""
+    servers: List[ServerEntry] = []
+    for path in sorted(glob.glob(os.path.join(topology_dir, "topo_detect_*.xml"))):
+        shard = parse_logical_graph_xml(path)
+        servers.extend(shard.servers)
+    servers.sort(key=lambda s: min(s.gpus) if s.gpus else 0)
+    for sid, s in enumerate(servers):
+        s.server_id = sid
+    graph = LogicalGraph(servers=servers, version="tpu-gathered")
+    if out_path:
+        emit_logical_graph_xml(graph, out_path)
+    return graph

@@ -1,0 +1,136 @@
+"""Online network profiling: latency/bandwidth matrices from probe transfers.
+
+The reference's profile context times `cudaMemcpyPeerAsync` per intra-node
+GPU pair and runs N−1 rounds of paired MPI probes inter-node
+(csrc/profile.cu:163-334), dumping ``topo_profile_<rank>`` CSVs that the
+master merges into lat/bw matrices (commu.py:246-270).  The TPU equivalent
+probes *links of the device mesh* with timed one-hop ``ppermute`` programs —
+small payload for latency, large payload for bandwidth — executed offset by
+offset around the mesh axis (the same ring-offset pattern as the reference's
+rounds, profile.cu:220-334).  The CSV artifact format (``src,dst,type,value``)
+is kept.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from adapcc_tpu.comm.mesh import RANKS_AXIS
+
+#: probe payloads, mirroring the reference's probe sizes: 64 floats for
+#: latency, 1M floats for inter-node bandwidth (profile.cu:120-158)
+LATENCY_PROBE_FLOATS = 64
+BANDWIDTH_PROBE_FLOATS = 1 << 20
+
+_LAT, _BW = "lat", "bw"
+
+
+class NetworkProfiler:
+    """Measures per-link latency (s) and bandwidth (GB/s) over a world mesh."""
+
+    def __init__(self, mesh: Mesh, axis_name: str = RANKS_AXIS, warmup: int = 1, iters: int = 3):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.warmup = warmup
+        self.iters = iters
+        self.world = mesh.devices.size
+
+    # -- probe programs --------------------------------------------------------
+
+    def _offset_shift_fn(self, offset: int, n_floats: int):
+        """Jitted program: every rank sends its buffer one hop to
+        ``(rank + offset) % world`` — a full ring-offset round, so one timing
+        exercises every link of that offset class simultaneously."""
+        world = self.world
+        perm = [(i, (i + offset) % world) for i in range(world)]
+
+        def shard_fn(x):
+            return lax.ppermute(x, self.axis_name, perm)
+
+        fn = jax.jit(
+            jax.shard_map(shard_fn, mesh=self.mesh, in_specs=P(self.axis_name), out_specs=P(self.axis_name))
+        )
+        x = jnp.zeros((world, n_floats), dtype=jnp.float32)
+        return fn, x
+
+    def _time(self, fn, x) -> float:
+        for _ in range(self.warmup):
+            jax.block_until_ready(fn(x))
+        t0 = time.perf_counter()
+        for _ in range(self.iters):
+            jax.block_until_ready(fn(x))
+        return (time.perf_counter() - t0) / self.iters
+
+    # -- matrix profiling ------------------------------------------------------
+
+    def profile(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Return (latency_matrix [s], bandwidth_matrix [GB/s]), world×world.
+
+        Every off-diagonal (src, dst) pair is covered: the offset-``o`` round
+        fills all pairs with ``dst − src ≡ o (mod world)``.
+        """
+        world = self.world
+        lat = np.zeros((world, world))
+        bw = np.zeros((world, world))
+        if world == 1:
+            return lat, bw
+        for offset in range(1, world):
+            fn_l, x_l = self._offset_shift_fn(offset, LATENCY_PROBE_FLOATS)
+            t_lat = self._time(fn_l, x_l)
+            fn_b, x_b = self._offset_shift_fn(offset, BANDWIDTH_PROBE_FLOATS)
+            t_bw = self._time(fn_b, x_b)
+            nbytes = BANDWIDTH_PROBE_FLOATS * 4
+            gbps = nbytes / max(t_bw, 1e-9) / 1e9
+            for src in range(world):
+                dst = (src + offset) % world
+                lat[src][dst] = t_lat
+                bw[src][dst] = gbps
+        return lat, bw
+
+    # -- artifacts -------------------------------------------------------------
+
+    def dump(self, out_dir: str, rank: int = 0) -> str:
+        """Write ``topo_profile_<rank>`` CSV rows ``src,dst,type,value``
+        (artifact contract of profile.cu:336-357)."""
+        os.makedirs(out_dir, exist_ok=True)
+        lat, bw = self.profile()
+        path = os.path.join(out_dir, f"topo_profile_{rank}")
+        with open(path, "w") as f:
+            for src in range(self.world):
+                for dst in range(self.world):
+                    if src == dst:
+                        continue
+                    f.write(f"{src},{dst},{_LAT},{lat[src][dst]:.9f}\n")
+                    f.write(f"{src},{dst},{_BW},{bw[src][dst]:.6f}\n")
+        return path
+
+
+def gather_topo_profile(topology_dir: str, world: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Merge ``topo_profile_*`` CSVs into lat/bw matrices (analog of
+    ``_gather_topo_profile``, commu.py:246-270)."""
+    import glob
+
+    lat = np.zeros((world, world))
+    bw = np.zeros((world, world))
+    for path in sorted(glob.glob(os.path.join(topology_dir, "topo_profile_*"))):
+        with open(path) as f:
+            for line in f:
+                parts = line.strip().split(",")
+                if len(parts) != 4:
+                    continue
+                src, dst, typ, val = int(parts[0]), int(parts[1]), parts[2], float(parts[3])
+                if not (0 <= src < world and 0 <= dst < world):
+                    continue  # stale artifact from a different world size
+                if typ == _LAT:
+                    lat[src][dst] = val
+                elif typ == _BW:
+                    bw[src][dst] = val
+    return lat, bw

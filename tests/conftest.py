@@ -8,12 +8,19 @@ units-test/launch_get_wait_time.sh).  Must run before the first jax import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-selects jax_platforms="axon,cpu" at interpreter
+# startup (overriding the env var), so re-pin the platform before any backend
+# initializes.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
