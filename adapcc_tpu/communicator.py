@@ -81,7 +81,18 @@ class Communicator:
         self._engines: Dict[int, CollectiveEngine] = {}
         self._strategy: Optional[Strategy] = None
         self._profiler: Optional[NetworkProfiler] = None
+
+        # coordinator plane (reference commu.py:81-94,143-170)
         self.fault_worker_list: List[int] = []
+        self.coordinator_unreachable = False
+        self.process_rank = 0
+        self.num_processes = 1
+        self._coordinator_server = None
+        self._controller = None
+        self._hooker = None
+        self._controller_thread = None
+        self._step_queue = None
+        self._active_by_step: Dict[int, List[int]] = {}
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -112,10 +123,13 @@ class Communicator:
                 eng.clear()
 
     def clear(self) -> None:
+        """Tear down contexts and the coordinator plane (reference clear
+        stops the controller thread and the grpc server, commu.py:285-291)."""
         for eng in self._engines.values():
             eng.clear()
         self._engines.clear()
         self._strategy = None
+        self.stop_coordinator()
 
     def _load_strategy(self) -> Strategy:
         if self._strategy is not None:
@@ -201,6 +215,111 @@ class Communicator:
 
     def reduce_scatter(self, tensor: jnp.ndarray, op: ReduceOp = ReduceOp.SUM) -> jnp.ndarray:
         return self._engine(REDUCESCATTER).reduce_scatter(tensor, op=op)
+
+    # -- coordinator plane -----------------------------------------------------
+
+    def enable_coordinator(
+        self,
+        is_master: bool = True,
+        process_rank: int = 0,
+        num_processes: Optional[int] = None,
+        ip: str = "127.0.0.1",
+        port: Optional[int] = None,
+    ) -> None:
+        """Start the relay/fault coordinator plane.
+
+        In the reference, world rank 0 hosts the gRPC Coordinator and every
+        rank runs a controller thread plus Controller/Hooker stubs
+        (commu.py:81-94,136-141).  Here the participants are *processes*
+        (hosts), since one JAX process drives all its local chips.
+        """
+        import queue as _queue
+        import threading
+
+        from adapcc_tpu.coordinator import Controller, CoordinatorServer, Hooker
+
+        port = port if port is not None else self.args.port
+        self.num_processes = num_processes if num_processes is not None else 1
+        self.process_rank = process_rank
+        if is_master:
+            self._coordinator_server = CoordinatorServer(self.num_processes, ip=ip, port=port).start()
+            port = self._coordinator_server.port  # resolves port=0 to the bound one
+        self._controller = Controller(ip, port)
+        self._hooker = Hooker(ip, port)
+        self._step_queue = _queue.Queue()
+        self._controller_thread = threading.Thread(target=self._controller_loop, daemon=True)
+        self._controller_thread.start()
+
+    def _controller_loop(self) -> None:
+        """Background heartbeat consumer (reference controller thread,
+        commu.py:143-170): one relay request per training step; a status-0
+        response records the dead ranks and stops the thread.  RPC failures
+        (master gone, channel closed during shutdown) also stop the thread —
+        silently losing fault detection would be worse than reporting the
+        master unreachable."""
+        import grpc as _grpc
+
+        while True:
+            step = self._step_queue.get()
+            if step is None:
+                return
+            try:
+                active, status = self._controller.send_relay_request(step, self.process_rank)
+            except _grpc.RpcError as e:  # noqa: PERF203
+                if e.code() is not _grpc.StatusCode.CANCELLED:
+                    print(f"[adapcc] controller RPC failed ({e.code()}); fault detection stopped")
+                    self.coordinator_unreachable = True
+                return
+            if status == 0:
+                self.fault_worker_list = sorted(set(range(self.num_processes)) - set(active))
+                return
+            self._active_by_step[step] = active
+
+    def update_relay(self, step: int) -> None:
+        """Kick the controller heartbeat for this step (reference
+        commu.py:293-299; called once per training iteration)."""
+        if self._step_queue is not None:
+            self._step_queue.put(step)
+
+    def hook_ready(self, step: int) -> List[int]:
+        """First-bucket-ready negotiation: returns the frozen active list for
+        this step (reference cuda_allreduce_hook → hook_fetch,
+        commu.py:385-399)."""
+        if self._hooker is None:
+            return list(range(self.world_size))
+        return self._hooker.send_ready_request(step, self.process_rank)
+
+    def relay_active_list(self, step: int) -> Optional[List[int]]:
+        return self._active_by_step.get(step)
+
+    def chips_of_processes(self, active_processes: Sequence[int]) -> List[int]:
+        """Expand coordinator *process* ranks to the chip ranks they drive.
+
+        The coordinator's participants are processes (one JAX process per
+        host), while collectives run over chips; a straggling process demotes
+        all of its chips to relays.
+        """
+        procs = set(active_processes)
+        return [
+            r
+            for r, dev in enumerate(self.mesh.devices.flat)
+            if getattr(dev, "process_index", 0) in procs
+        ]
+
+    def stop_coordinator(self) -> None:
+        if self._step_queue is not None:
+            self._step_queue.put(None)
+        if self._controller_thread is not None:
+            self._controller_thread.join(timeout=2)
+            self._controller_thread = None
+        for client in (self._controller, self._hooker):
+            if client is not None:
+                client.close()
+        self._controller = self._hooker = None
+        if self._coordinator_server is not None:
+            self._coordinator_server.stop()
+            self._coordinator_server = None
+        self._step_queue = None
 
     # -- introspection ---------------------------------------------------------
 

@@ -1,0 +1,19 @@
+"""Coordinator plane: relay negotiation + fault detection.
+
+The centralized brain the reference runs as a gRPC service on world rank 0
+(proto/rpc_server.py): per-step it decides which ranks participate in the
+collective (rent-or-buy straggler waiting) and which ranks are considered
+dead (heartbeat timeout).  The decision logic lives in
+:mod:`adapcc_tpu.coordinator.logic`, transport-free and deterministic to
+test; :mod:`adapcc_tpu.coordinator.service` wraps it in a gRPC service that
+is wire-compatible with the reference's ``coordinator.proto``.
+"""
+
+from adapcc_tpu.coordinator.logic import CoordinatorLogic
+from adapcc_tpu.coordinator.service import (
+    CoordinatorServer,
+    Controller,
+    Hooker,
+)
+
+__all__ = ["CoordinatorLogic", "CoordinatorServer", "Controller", "Hooker"]

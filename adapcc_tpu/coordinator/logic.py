@@ -1,0 +1,154 @@
+"""Relay/fault decision logic (transport-free).
+
+Implements the two decisions of the reference coordinator
+(proto/rpc_server.py:48-108) as a plain thread-safe object:
+
+- **hook phase** (``hook_arrive``): the first rank to finish its backward
+  pass for a step becomes the *leader* and runs a rent-or-buy (ski-rental)
+  wait: each 5 ms time slot spent waiting for more ranks accrues "rent";
+  committing to a partial collective with the ``m`` ranks present costs
+  "buy" = the m-rank collective scaled by ``((m-1)/m) / ((n-1)/n)`` plus the
+  deferred full-world cost.  The leader stops waiting when renting longer
+  than buying, when the hard relay threshold (0.1 s) is exceeded, or when
+  everyone arrived — then freezes the step's **active list**
+  (rpc_server.py:69-96).  Ranks arriving before the freeze join it; ranks
+  arriving after are relays and just learn the frozen list.
+
+- **controller phase** (``controller_arrive``): a per-step heartbeat
+  barrier.  If not all ranks report within the fault timeout (10 s), the
+  caller gets the list of ranks that *did* report with ``status=0`` — the
+  alive set the collectives continue with instead of hanging
+  (rpc_server.py:48-62, README "fault tolerance").
+
+The reference implements both with spin-polling and queues; this uses one
+condition variable so waits wake on arrival instead of on a poll tick.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+from adapcc_tpu.primitives import (
+    FAULT_TOLERANT_TIME_S,
+    RELAY_THRESHOLD_S,
+    TIME_SLOT_DURATION_S,
+)
+
+
+class CoordinatorLogic:
+    def __init__(
+        self,
+        world_size: int,
+        relay_threshold: float = RELAY_THRESHOLD_S,
+        time_slot: float = TIME_SLOT_DURATION_S,
+        fault_timeout: float = FAULT_TOLERANT_TIME_S,
+        accumulated_size: float = 100 * 8 / 1024,
+        accumulated_bandwidth: Optional[float] = None,
+    ) -> None:
+        self.world_size = world_size
+        self.relay_threshold = relay_threshold
+        self.time_slot = time_slot
+        self.fault_timeout = fault_timeout
+        # cost-model constants mirroring the reference's defaults
+        # (rpc_server.py:41-46): a nominal accumulated gradient size and an
+        # aggregate bandwidth proportional to the world size
+        self.accumulated_size = accumulated_size
+        self.accumulated_bandwidth = (
+            accumulated_bandwidth if accumulated_bandwidth is not None else 50.0 * world_size
+        )
+
+        self._cond = threading.Condition()
+        self._ready: Dict[int, List[int]] = defaultdict(list)
+        self._frozen: Dict[int, List[int]] = {}
+        self._heartbeats: Dict[int, List[int]] = defaultdict(list)
+
+    # -- hook phase ------------------------------------------------------------
+
+    def _initial_rent_cost(self) -> float:
+        n = self.world_size
+        return 2 * (n - 1) * self.accumulated_size / self.accumulated_bandwidth
+
+    def _buy_cost(self, num_ready: int) -> float:
+        n, m = self.world_size, num_ready
+        ratio = ((m - 1) / m) / ((n - 1) / n)
+        return self._initial_rent_cost() * ratio + n * self.accumulated_size / self.accumulated_bandwidth
+
+    def hook_arrive(self, step: int, rank: int) -> List[int]:
+        """Register ``rank`` as ready for ``step``; block until the active
+        list is frozen; return it.  Thread-safe, reentrant across steps."""
+        with self._cond:
+            if step in self._frozen:
+                # relay worker: the train has left, learn who's on it
+                return list(self._frozen[step])
+
+            self._ready[step].append(rank)
+            self._cond.notify_all()
+
+            if len(self._ready[step]) > 1:
+                # active waiting worker: sleep until the leader freezes
+                while step not in self._frozen:
+                    self._cond.wait()
+                return list(self._frozen[step])
+
+            # leader: rent-or-buy wait loop
+            initial_rent = self._initial_rent_cost()
+            accumulated_rent = 0.0
+            while True:
+                num_ready = len(self._ready[step])
+                if num_ready == self.world_size:
+                    break
+                if num_ready > 1:
+                    if (
+                        accumulated_rent + initial_rent >= self._buy_cost(num_ready)
+                        or accumulated_rent > self.relay_threshold
+                    ):
+                        break
+                self._cond.wait(timeout=self.time_slot)
+                accumulated_rent += self.time_slot
+
+            self._frozen[step] = list(self._ready[step])
+            self._cond.notify_all()
+            return list(self._frozen[step])
+
+    # -- controller phase ------------------------------------------------------
+
+    def controller_arrive(self, step: int, rank: int) -> Tuple[List[int], int]:
+        """Heartbeat for ``step``; block until all ranks heartbeat (then
+        return the frozen active list, status 1) or the fault timeout expires
+        (then return the alive list, status 0)."""
+        with self._cond:
+            self._heartbeats[step].append(rank)
+            self._cond.notify_all()
+
+            deadline = time.monotonic() + self.fault_timeout
+            while len(self._heartbeats[step]) < self.world_size:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return list(self._heartbeats[step]), 0
+                self._cond.wait(timeout=remaining)
+
+            # everyone is alive; hand out the hook phase's decision
+            while step not in self._frozen:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return list(self._heartbeats[step]), 0
+                self._cond.wait(timeout=remaining)
+            return list(self._frozen[step]), 1
+
+    # -- introspection / GC ----------------------------------------------------
+
+    def active_list(self, step: int) -> Optional[List[int]]:
+        with self._cond:
+            frozen = self._frozen.get(step)
+            return list(frozen) if frozen is not None else None
+
+    def forget_steps_before(self, step: int) -> None:
+        """Drop per-step state older than ``step`` (the reference
+        preallocates a dict of 1M steps instead, rpc_server.py:29-34)."""
+        with self._cond:
+            for d in (self._ready, self._frozen, self._heartbeats):
+                for s in [s for s in d if s < step]:
+                    del d[s]

@@ -1,0 +1,5 @@
+"""Generated protobuf messages for the coordinator protocol.
+
+``coordinator_pb2.py`` is generated from ``coordinator.proto``; regenerate
+with ``protoc --python_out=. coordinator.proto`` in this directory.
+"""

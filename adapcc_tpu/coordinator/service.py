@@ -1,0 +1,122 @@
+"""gRPC transport for the coordinator (wire-compatible with the reference).
+
+Service/message names, field numbers, and RPC semantics match the reference's
+``coordinator.proto`` (proto/protobuf/coordinator.proto:20-43) so a reference
+client could talk to this server.  The Python gRPC *stubs* are hand-written
+over the protoc-generated message classes because the image ships protoc but
+not the grpc codegen plugin.
+
+Client classes mirror the reference's (proto/rpc_client.py): ``Controller``
+sends per-step relay/heartbeat requests, ``Hooker`` sends bucket-ready
+requests.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures
+from typing import List, Optional, Tuple
+
+import grpc
+
+from adapcc_tpu.coordinator.logic import CoordinatorLogic
+from adapcc_tpu.coordinator.protocol import coordinator_pb2 as pb
+
+_SERVICE = "coordinator.Coordinator"
+
+
+class CoordinatorServer:
+    """Hosts the decision logic on ``ip:port`` (rank 0 in the reference,
+    commu.py:136-141)."""
+
+    def __init__(
+        self,
+        world_size: int,
+        ip: str = "127.0.0.1",
+        port: int = 50051,
+        logic: Optional[CoordinatorLogic] = None,
+        max_workers: int = 16,
+    ) -> None:
+        self.logic = logic if logic is not None else CoordinatorLogic(world_size)
+        self.address = f"{ip}:{port}"
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+        handlers = {
+            "controller_fetch": grpc.unary_unary_rpc_method_handler(
+                self._controller_fetch,
+                request_deserializer=pb.cont_request.FromString,
+                response_serializer=pb.cont_response.SerializeToString,
+            ),
+            "hook_fetch": grpc.unary_unary_rpc_method_handler(
+                self._hook_fetch,
+                request_deserializer=pb.hook_request.FromString,
+                response_serializer=pb.hook_response.SerializeToString,
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
+        )
+        self._port = self._server.add_insecure_port(self.address)
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def start(self) -> "CoordinatorServer":
+        self._server.start()
+        return self
+
+    def stop(self, grace: Optional[float] = 0.5) -> None:
+        self._server.stop(grace)
+
+    # -- rpc handlers ----------------------------------------------------------
+
+    def _controller_fetch(self, request, context):
+        active, status = self.logic.controller_arrive(request.step, request.world_rank)
+        return pb.cont_response(active_list=active, status=status)
+
+    def _hook_fetch(self, request, context):
+        active = self.logic.hook_arrive(request.step, request.world_rank)
+        return pb.hook_response(active_list=active)
+
+
+class _Stub:
+    def __init__(self, channel: grpc.Channel):
+        self.controller_fetch = channel.unary_unary(
+            f"/{_SERVICE}/controller_fetch",
+            request_serializer=pb.cont_request.SerializeToString,
+            response_deserializer=pb.cont_response.FromString,
+        )
+        self.hook_fetch = channel.unary_unary(
+            f"/{_SERVICE}/hook_fetch",
+            request_serializer=pb.hook_request.SerializeToString,
+            response_deserializer=pb.hook_response.FromString,
+        )
+
+
+class Controller:
+    """Per-rank relay/heartbeat client (reference rpc_client.py Controller)."""
+
+    def __init__(self, ip: str, port: int):
+        self._channel = grpc.insecure_channel(f"{ip}:{port}")
+        self._stub = _Stub(self._channel)
+
+    def send_relay_request(self, step: int, world_rank: int) -> Tuple[List[int], int]:
+        resp = self._stub.controller_fetch(pb.cont_request(step=step, world_rank=world_rank))
+        return list(resp.active_list), resp.status
+
+    def close(self) -> None:
+        self._channel.close()
+
+
+class Hooker:
+    """Per-rank bucket-ready client (reference rpc_client.py Hooker)."""
+
+    def __init__(self, ip: str, port: int):
+        self._channel = grpc.insecure_channel(f"{ip}:{port}")
+        self._stub = _Stub(self._channel)
+
+    def send_ready_request(self, step: int, world_rank: int) -> List[int]:
+        resp = self._stub.hook_fetch(pb.hook_request(step=step, world_rank=world_rank))
+        return list(resp.active_list)
+
+    def close(self) -> None:
+        self._channel.close()

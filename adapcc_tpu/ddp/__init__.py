@@ -1,0 +1,7 @@
+"""Data-parallel training plane: gradient bucketing, sync hook, trainer."""
+
+from adapcc_tpu.ddp.bucketing import BucketPlan, build_bucket_plan
+from adapcc_tpu.ddp.hook import GradSyncHook
+from adapcc_tpu.ddp.trainer import DDPTrainer, TrainState
+
+__all__ = ["BucketPlan", "build_bucket_plan", "GradSyncHook", "DDPTrainer", "TrainState"]

@@ -1,0 +1,146 @@
+"""Gradient-sync hook: the TPU analog of ``cuda_allreduce_hook``.
+
+The reference registers a torch-DDP comm hook that, per gradient bucket,
+negotiates the step's active set with the coordinator, sizes chunks, and
+either runs the adaptive allreduce (active rank), skips it (BSP straggler),
+or hands the bucket to an async relay replay (commu.py:385-435, SURVEY §3.3).
+
+Under XLA the data plane must be one compiled program, so the hook splits
+into the two halves the reference interleaves:
+
+- **host half** (:meth:`GradSyncHook.negotiate`): once per step, before the
+  jitted train step — talk to the coordinator (hook_fetch + update_relay)
+  and produce the ``[world]`` active mask.  Runs in microseconds, off the
+  device critical path (the reference pays the same ~1 ms gRPC cost,
+  proto/latency_0.0.txt).
+
+- **device half** (:meth:`GradSyncHook.sync`): inside the jitted step —
+  bucket the gradient pytree, run the strategy allreduce per bucket with the
+  active mask, scatter back.  AVG semantics over the active count, matching
+  DDP gradient averaging.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from adapcc_tpu.comm.engine import allreduce_shard, masked_psum_shard
+from adapcc_tpu.comm.mesh import RANKS_AXIS
+from adapcc_tpu.ddp.bucketing import (
+    BucketPlan,
+    build_bucket_plan,
+    flatten_to_buckets,
+    unflatten_from_buckets,
+)
+from adapcc_tpu.primitives import ReduceOp
+from adapcc_tpu.strategy.ir import Strategy
+
+
+class GradSyncHook:
+    def __init__(
+        self,
+        strategy: Strategy,
+        axis_name: str = RANKS_AXIS,
+        op: ReduceOp = ReduceOp.AVG,
+        bucket_cap_mb: float = 100.0,
+        use_xla_fastpath: bool = True,
+        communicator: Optional[Any] = None,
+        mode: str = "auto",
+    ) -> None:
+        """``mode``: ``"psum"`` = per-leaf masked psum (one XLA collective per
+        leaf — no bucketing copies, optimal on a flat ICI mesh and still
+        honoring subset semantics); ``"schedule"`` = bucketed strategy-tree
+        allreduce (the adaptive path for hierarchical topologies);
+        ``"auto"`` = psum when fastpath is allowed and the strategy spans a
+        single host group, schedule otherwise."""
+        self.strategy = strategy
+        self.axis_name = axis_name
+        self.op = op
+        self.bucket_cap_mb = bucket_cap_mb
+        self.use_xla_fastpath = use_xla_fastpath
+        self.communicator = communicator
+        self.mode = mode
+        self._plan: Optional[BucketPlan] = None
+        self.recorded_buckets: List[tuple] = []  # (size, chunk_bytes) per bucket
+
+    def _resolved_mode(self) -> str:
+        if self.mode != "auto":
+            return self.mode
+        if not self.use_xla_fastpath:
+            return "schedule"
+        ips = set()
+        for t in self.strategy.trees:
+            ips |= set(t.ips.values())
+        single_host = len(ips) <= 1
+        return "psum" if single_host else "schedule"
+
+    # -- host half -------------------------------------------------------------
+
+    def negotiate(self, step: int) -> jnp.ndarray:
+        """Coordinator round-trip → active mask for this step.
+
+        Mirrors the reference's per-step sequence: ``update_relay(step)``
+        (controller heartbeat) + first-bucket ``hook_fetch`` (rent-or-buy
+        freeze).  Without a communicator/coordinator, everyone is active.
+        """
+        world = self.strategy.world_size
+        if self.communicator is None or self.communicator._hooker is None:
+            return jnp.ones((world,), dtype=jnp.bool_)
+        self.communicator.update_relay(step)
+        active_processes = self.communicator.hook_ready(step)
+        # the coordinator speaks process ranks; the mask indexes chip ranks
+        active_chips = self.communicator.chips_of_processes(active_processes)
+        mask = np.zeros((world,), dtype=bool)
+        mask[[r for r in active_chips if 0 <= r < world]] = True
+        return jnp.asarray(mask)
+
+    # -- device half -----------------------------------------------------------
+
+    def sync(self, grads: Any, active_mask: Optional[jnp.ndarray]) -> Any:
+        """Allreduce a gradient pytree; call inside shard_map.
+
+        ``active_mask=None`` means *statically* full-world (no coordinator
+        attached): masking and the active-count divide fold away at trace
+        time, leaving exactly the plain-DDP program.
+        """
+        import jax as _jax
+        from jax import lax as _lax
+
+        if self._resolved_mode() == "psum":
+            if active_mask is None:
+                world = self.strategy.world_size
+
+                def full(g):
+                    s = _lax.psum(g, self.axis_name)
+                    return s / world if self.op is ReduceOp.AVG else s
+
+                return _jax.tree_util.tree_map(full, grads)
+            return _jax.tree_util.tree_map(
+                lambda g: masked_psum_shard(g, active_mask, self.axis_name, self.op),
+                grads,
+            )
+        if active_mask is None:
+            active_mask = jnp.ones((self.strategy.world_size,), dtype=jnp.bool_)
+        if self._plan is None:
+            # first trace records the bucket table (the analog of the
+            # reference's step-0/1 record phase, commu.py:409-418)
+            self._plan = build_bucket_plan(grads, self.bucket_cap_mb)
+            self.recorded_buckets = [
+                (s, c) for s, c in zip(self._plan.bucket_sizes, self._plan.chunk_bytes)
+            ]
+        buckets = flatten_to_buckets(self._plan, grads)
+        synced = [
+            allreduce_shard(
+                b, active_mask, self.strategy, axis_name=self.axis_name, op=self.op
+            )
+            for b in buckets
+        ]
+        return unflatten_from_buckets(self._plan, synced)
+
+    def reset_plan(self) -> None:
+        """Drop the recorded bucket table (model structure changed)."""
+        self._plan = None
+        self.recorded_buckets = []

@@ -1,0 +1,9 @@
+"""Model zoo: the workload families the reference trains with its collectives
+(SURVEY §2.4: VGG16 DDP, ViT, GPT-2, MoE, elastic ResNet image
+classification) re-implemented as flax modules shaped for TPU execution —
+bf16 matmuls on the MXU, static shapes, remat-friendly blocks."""
+
+from adapcc_tpu.models.mlp import MLP
+from adapcc_tpu.models.gpt2 import GPT2, GPT2Config
+
+__all__ = ["MLP", "GPT2", "GPT2Config"]

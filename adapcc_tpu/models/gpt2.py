@@ -1,0 +1,146 @@
+"""GPT-2: the flagship training workload.
+
+The reference trains HuggingFace GPT-2 (PersonaChat) under torch DDP with its
+adaptive allreduce (models/gpt2/train_gpt2_ddp.py); this is a from-scratch
+flax implementation of the same architecture family, shaped for TPU:
+
+- all matmuls in ``bfloat16`` with ``float32`` accumulation/params — the MXU
+  sweet spot;
+- static shapes everywhere (fixed ``max_seq``), causal mask via additive
+  bias, no dynamic control flow under jit;
+- optional ``nn.remat`` over blocks to trade FLOPs for HBM;
+- weight-tied LM head (embedding transpose), GPT-2 initialization scheme
+  (scaled residual projections).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    max_seq: int = 1024
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+    remat: bool = False
+
+    @staticmethod
+    def small() -> "GPT2Config":
+        return GPT2Config()
+
+    @staticmethod
+    def tiny() -> "GPT2Config":
+        """Test-sized config: compiles in seconds, fits anywhere."""
+        return GPT2Config(vocab_size=512, max_seq=64, n_layer=2, n_head=2, d_model=64)
+
+
+class CausalSelfAttention(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        cfg = self.cfg
+        B, T, C = x.shape
+        head_dim = cfg.d_model // cfg.n_head
+
+        qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, cfg.n_head, head_dim)
+        k = k.reshape(B, T, cfg.n_head, head_dim)
+        v = v.reshape(B, T, cfg.n_head, head_dim)
+
+        scale = 1.0 / np.sqrt(head_dim)
+        att = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+        att = jnp.where(causal[None, None], att, -1e30)
+        att = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
+        att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
+
+        out = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, cfg.d_model)
+        # scaled init on the residual projection (GPT-2 scheme)
+        proj = nn.Dense(
+            cfg.d_model,
+            dtype=cfg.dtype,
+            kernel_init=nn.initializers.normal(0.02 / np.sqrt(2 * cfg.n_layer)),
+            name="proj",
+        )(out)
+        return nn.Dropout(cfg.dropout)(proj, deterministic=deterministic)
+
+
+class Block(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        cfg = self.cfg
+        x = x + CausalSelfAttention(cfg, name="attn")(
+            nn.LayerNorm(dtype=jnp.float32, name="ln1")(x), deterministic
+        )
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        h = nn.Dense(4 * cfg.d_model, dtype=cfg.dtype, name="fc")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(
+            cfg.d_model,
+            dtype=cfg.dtype,
+            kernel_init=nn.initializers.normal(0.02 / np.sqrt(2 * cfg.n_layer)),
+            name="proj",
+        )(h)
+        return x + nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+
+
+class GPT2(nn.Module):
+    cfg: GPT2Config
+
+    @nn.compact
+    def __call__(self, tokens: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        """``tokens [B, T] int32`` → logits ``[B, T, vocab] float32``."""
+        cfg = self.cfg
+        B, T = tokens.shape
+
+        wte = nn.Embed(
+            cfg.vocab_size,
+            cfg.d_model,
+            embedding_init=nn.initializers.normal(0.02),
+            dtype=cfg.dtype,
+            name="wte",
+        )
+        wpe = nn.Embed(
+            cfg.max_seq,
+            cfg.d_model,
+            embedding_init=nn.initializers.normal(0.01),
+            dtype=cfg.dtype,
+            name="wpe",
+        )
+        x = wte(tokens) + wpe(jnp.arange(T))[None]
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=(2,))
+        for i in range(cfg.n_layer):
+            x = block(cfg, name=f"h{i}")(x, deterministic)
+
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        # weight-tied LM head
+        logits = x.astype(cfg.dtype) @ wte.embedding.T.astype(cfg.dtype)
+        return logits.astype(jnp.float32)
+
+
+def lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Next-token cross-entropy over a ``[B, T]`` batch."""
+    logits = logits[:, :-1]
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)

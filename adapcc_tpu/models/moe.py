@@ -1,0 +1,99 @@
+"""Mixture-of-Experts with expert parallelism.
+
+The reference benchmarks a fastmoe ``FMoETransformerMLP`` whose all-to-all
+dispatch is done by fastmoe/NCCL — *not* by AdapCC, whose ALLTOALL primitive
+was an unimplemented stub (SURVEY §2.3; models/moe/train_moe.py:20-41).
+Here EP is native: capacity-based top-k routing with one-hot dispatch/combine
+einsums over a stacked expert axis.  Sharding that axis over an ``experts``
+mesh axis makes XLA lower the dispatch einsums to ICI all-to-alls — the
+TPU-idiomatic form of the fastmoe shuffle; the explicit
+``CollectiveEngine.all_to_all`` covers the manual path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    d_model: int = 256
+    d_hidden: int = 1024
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @staticmethod
+    def tiny() -> "MoEConfig":
+        return MoEConfig(num_experts=4, d_model=32, d_hidden=64, top_k=2)
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed expert MLP (switch-style dispatch, static capacity)."""
+
+    cfg: MoEConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray):
+        """``x [B, T, D]`` → ``(y [B, T, D], aux_loss scalar)``."""
+        cfg = self.cfg
+        B, T, D = x.shape
+        n_tokens = B * T
+        capacity = int(np.ceil(cfg.capacity_factor * cfg.top_k * n_tokens / cfg.num_experts))
+        tokens = x.reshape(n_tokens, D)
+
+        # routing (fp32 for a stable softmax)
+        gate_logits = nn.Dense(cfg.num_experts, dtype=jnp.float32, name="router")(
+            tokens.astype(jnp.float32)
+        )
+        gate_probs = jax.nn.softmax(gate_logits, axis=-1)
+
+        # load-balancing auxiliary loss (switch-transformer form)
+        me = jnp.mean(gate_probs, axis=0)
+        ce = jnp.mean(
+            jax.nn.one_hot(jnp.argmax(gate_probs, axis=-1), cfg.num_experts), axis=0
+        )
+        aux_loss = cfg.num_experts * jnp.sum(me * ce)
+
+        # top-k dispatch with per-expert positional capacity
+        combine = jnp.zeros((n_tokens, cfg.num_experts, capacity), dtype=jnp.float32)
+        remaining = gate_probs
+        used = jnp.zeros((cfg.num_experts,), dtype=jnp.int32)
+        for _ in range(cfg.top_k):
+            choice = jnp.argmax(remaining, axis=-1)                    # [tokens]
+            prob = jnp.take_along_axis(remaining, choice[:, None], 1)[:, 0]
+            onehot = jax.nn.one_hot(choice, cfg.num_experts, dtype=jnp.int32)
+            # position of each token within its chosen expert's buffer
+            pos_in_expert = (jnp.cumsum(onehot, axis=0) - onehot) + used[None, :]
+            pos = jnp.sum(onehot * pos_in_expert, axis=-1)             # [tokens]
+            keep = pos < capacity
+            combine = combine + (
+                (prob * keep)[:, None, None]
+                * jax.nn.one_hot(choice, cfg.num_experts)[:, :, None]
+                * jax.nn.one_hot(pos, capacity)[:, None, :]
+            )
+            used = used + jnp.sum(onehot * keep[:, None], axis=0)
+            remaining = remaining * (1.0 - jax.nn.one_hot(choice, cfg.num_experts))
+
+        dispatch = (combine > 0).astype(cfg.dtype)                     # [tokens, E, C]
+
+        # expert computation over the stacked expert axis; sharding this axis
+        # over an "experts" mesh axis yields all-to-all dispatch under pjit
+        w1 = self.param(
+            "w1", nn.initializers.normal(0.02), (cfg.num_experts, D, cfg.d_hidden)
+        )
+        w2 = self.param(
+            "w2", nn.initializers.normal(0.02), (cfg.num_experts, cfg.d_hidden, D)
+        )
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, tokens.astype(cfg.dtype))
+        h = nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, w1.astype(cfg.dtype)))
+        expert_out = jnp.einsum("ech,ehd->ecd", h, w2.astype(cfg.dtype))
+        y = jnp.einsum("nec,ecd->nd", combine.astype(cfg.dtype), expert_out)
+
+        return y.reshape(B, T, D).astype(x.dtype), aux_loss

@@ -1,0 +1,51 @@
+"""VGG: the reference's default DDP benchmark model (train_ddp.py:33 VGG16).
+
+Conv-heavy with a huge classifier head — the gradient-bucket shapes that
+drove the reference's chunk-size heuristic (log/model_bucket_info.txt lists
+VGG16's 102.8M-float bucket).  NHWC layout, bf16-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# layer specs: int = conv channels, "M" = maxpool (VGG16 = D configuration)
+VGG16_CFG: Tuple[Union[int, str], ...] = (
+    64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+    512, 512, 512, "M", 512, 512, 512, "M",
+)
+VGG11_CFG: Tuple[Union[int, str], ...] = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M")
+
+
+class VGG(nn.Module):
+    cfg: Tuple[Union[int, str], ...] = VGG16_CFG
+    num_classes: int = 10
+    classifier_width: int = 4096
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        """``x [B, H, W, C]`` → logits ``[B, num_classes]``."""
+        for i, spec in enumerate(self.cfg):
+            if spec == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(int(spec), (3, 3), padding="SAME", dtype=self.dtype, name=f"conv_{i}")(x)
+                x = nn.relu(x)
+        x = x.reshape(x.shape[0], -1)
+        x = nn.Dense(self.classifier_width, dtype=self.dtype, name="fc1")(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.classifier_width, dtype=self.dtype, name="fc2")(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def VGG16(**kw) -> VGG:
+    return VGG(cfg=VGG16_CFG, **kw)
+
+
+def VGG11(**kw) -> VGG:
+    return VGG(cfg=VGG11_CFG, **kw)

@@ -1,0 +1,75 @@
+"""ViT: vision-transformer DDP workload (reference models/vit/train_vit.py
+uses vit-pytorch with synthetic data).  Patch embed → encoder blocks → CLS
+head; bf16 matmuls, static shapes."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    d_model: int = 384
+    n_layer: int = 12
+    n_head: int = 6
+    mlp_ratio: int = 4
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @staticmethod
+    def tiny() -> "ViTConfig":
+        return ViTConfig(image_size=32, patch_size=8, num_classes=10, d_model=64, n_layer=2, n_head=2)
+
+
+class EncoderBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = nn.MultiHeadDotProductAttention(
+            num_heads=cfg.n_head, dtype=cfg.dtype, deterministic=deterministic, name="attn"
+        )(h, h)
+        x = x + h
+        h = nn.LayerNorm(dtype=jnp.float32)(x)
+        h = nn.Dense(cfg.mlp_ratio * cfg.d_model, dtype=cfg.dtype)(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, dtype=cfg.dtype)(h)
+        return x + nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+        """``images [B, H, W, C]`` → logits ``[B, num_classes]``."""
+        cfg = self.cfg
+        B = images.shape[0]
+        x = nn.Conv(
+            cfg.d_model,
+            (cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            dtype=cfg.dtype,
+            name="patch_embed",
+        )(images)
+        x = x.reshape(B, -1, cfg.d_model)
+
+        cls = self.param("cls", nn.initializers.normal(0.02), (1, 1, cfg.d_model))
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, cfg.d_model)).astype(cfg.dtype), x], axis=1)
+        pos = self.param("pos", nn.initializers.normal(0.02), (1, x.shape[1], cfg.d_model))
+        x = x + pos.astype(cfg.dtype)
+
+        for i in range(cfg.n_layer):
+            x = EncoderBlock(cfg, name=f"block_{i}")(x, deterministic)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x[:, 0])

@@ -1,0 +1,2 @@
+"""Training workloads mirroring the reference's model benchmarks
+(train_ddp.py, models/{vit,gpt2,moe,image-classification})."""

@@ -1,0 +1,159 @@
+"""DDP training driver — the reference ``train_ddp.py`` re-shaped for TPU.
+
+The reference flow (train_ddp.py:30-58): init AdapCC with the launcher flag
+contract, register the allreduce bucket hook on a torch DDP model, call
+``update_relay(step)`` every iteration, and ``reconstruct_topology`` every
+``profile_freq`` steps.  This driver keeps that flow — same flags, same
+lifecycle — with the jitted :class:`DDPTrainer` as the data plane and
+synthetic data (the reference benchmarks run synthetic batches too).
+
+Run (virtual pod):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python -m adapcc_tpu.workloads.train_ddp --model mlp --steps 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from adapcc_tpu import ALLREDUCE, AdapCC
+from adapcc_tpu.comm.mesh import build_world_mesh
+from adapcc_tpu.config import CommArgs
+from adapcc_tpu.ddp import DDPTrainer, TrainState
+from adapcc_tpu.primitives import DETECT, SKIP_BOOTSTRAP
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    # reference launcher flag contract (launcher.py:19-32)
+    p.add_argument("--port", type=int, default=50051)
+    p.add_argument("--strategy_file", type=str, default="topology/strategy.xml")
+    p.add_argument("--logical_graph", type=str, default="topology/logical_graph.xml")
+    p.add_argument("--entry_point", type=int, default=DETECT)
+    p.add_argument("--parallel_degree", type=int, default=2)
+    p.add_argument("--profile_freq", type=int, default=0)
+    # workload knobs
+    p.add_argument("--model", choices=["mlp", "vgg", "vit", "gpt2"], default="mlp")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--world", type=int, default=None, help="mesh size (default: all devices)")
+    p.add_argument("--coordinator", action="store_true", help="enable the relay/fault coordinator")
+    return p
+
+
+def make_workload(name: str, batch: int, rng):
+    """Returns (loss_fn, params, batch_fn)."""
+    if name == "mlp":
+        from adapcc_tpu.models import MLP
+
+        model = MLP(features=(64, 64, 10))
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(batch, 32)), jnp.float32)
+        y = jnp.asarray(np.random.default_rng(1).integers(0, 10, size=(batch,)))
+        params = model.init(rng, x[:1])
+
+        def loss_fn(p, b):
+            bx, by = b
+            logits = model.apply(p, bx)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, by).mean()
+
+        return loss_fn, params, lambda: (x, y)
+
+    if name == "vgg":
+        from adapcc_tpu.models.vgg import VGG16
+
+        model = VGG16(num_classes=10, classifier_width=512)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(batch, 32, 32, 3)), jnp.float32)
+        y = jnp.asarray(np.random.default_rng(1).integers(0, 10, size=(batch,)))
+        params = model.init(rng, x[:1])
+
+        def loss_fn(p, b):
+            bx, by = b
+            logits = model.apply(p, bx)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, by).mean()
+
+        return loss_fn, params, lambda: (x, y)
+
+    if name == "vit":
+        from adapcc_tpu.models.vit import ViT, ViTConfig
+
+        cfg = ViTConfig(image_size=64, patch_size=8, num_classes=100, d_model=192, n_layer=6, n_head=3)
+        model = ViT(cfg)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(batch, 64, 64, 3)), jnp.float32)
+        y = jnp.asarray(np.random.default_rng(1).integers(0, 100, size=(batch,)))
+        params = model.init(rng, x[:1])
+
+        def loss_fn(p, b):
+            bx, by = b
+            logits = model.apply(p, bx)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, by).mean()
+
+        return loss_fn, params, lambda: (x, y)
+
+    if name == "gpt2":
+        from adapcc_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
+
+        cfg = GPT2Config(vocab_size=8192, max_seq=256, n_layer=4, n_head=4, d_model=256)
+        model = GPT2(cfg)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, size=(batch, cfg.max_seq))
+        )
+        params = model.init(rng, tokens[:1])
+
+        def loss_fn(p, b):
+            return lm_loss(model.apply(p, b), b)
+
+        return loss_fn, params, lambda: tokens
+
+    raise ValueError(name)
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    mesh = build_world_mesh(args.world)
+    world = int(mesh.devices.size)
+
+    comm_args = CommArgs.from_namespace(args)
+    AdapCC.init(comm_args, mesh=mesh)
+    AdapCC.setup(ALLREDUCE)
+    if args.coordinator:
+        AdapCC.communicator.enable_coordinator(is_master=True, num_processes=1, port=0)
+
+    loss_fn, params, batch_fn = make_workload(args.model, args.batch, jax.random.PRNGKey(0))
+    tx = optax.adam(args.lr)
+    trainer = DDPTrainer(
+        loss_fn,
+        tx,
+        mesh,
+        AdapCC.communicator.strategy,
+        communicator=AdapCC.communicator,
+        use_xla_fastpath=comm_args.use_xla_fastpath,
+    )
+    state = TrainState.create(params, tx)
+
+    t_last = time.perf_counter()
+    for step in range(args.steps):
+        # periodic re-adaptation (reference train_ddp.py:45-46)
+        if args.profile_freq and step > 0 and step % args.profile_freq == 0:
+            AdapCC.reconstruct_topology(comm_args, ALLREDUCE)
+            trainer.rebuild(AdapCC.communicator.strategy)
+        state, loss = trainer.step(state, batch_fn(), step_idx=step)
+        if step % 5 == 0 or step == args.steps - 1:
+            now = time.perf_counter()
+            print(
+                f"step {step:4d}  loss {float(jnp.mean(loss)):.4f}  "
+                f"({(now - t_last):.3f}s since last log)  world={world}"
+            )
+            t_last = now
+
+    AdapCC.clear(ALLREDUCE)
+
+
+if __name__ == "__main__":
+    main()

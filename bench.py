@@ -1,0 +1,124 @@
+"""Headline benchmark: GPT-2 DDP training throughput with the adaptive stack.
+
+Prints ONE JSON line: ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+
+The flagship workload (GPT-2 under data parallelism with the AdapCC gradient
+hook — the reference's train_ddp GPT-2 configuration, BASELINE.md north star)
+is timed against a plain-JAX DDP baseline (jit + psum gradient mean, no
+framework) on the same devices.  ``vs_baseline`` = framework tokens/s ÷
+plain-JAX tokens/s: ≥1.0 means the adaptive machinery costs nothing.
+
+Size knobs via env (defaults fit a single v5e chip and compile in ~1 min):
+    BENCH_LAYERS, BENCH_DMODEL, BENCH_HEADS, BENCH_SEQ, BENCH_BATCH,
+    BENCH_STEPS, BENCH_WORLD
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, default))
+
+
+def main() -> None:
+    from adapcc_tpu.comm.mesh import build_world_mesh
+    from adapcc_tpu.ddp import DDPTrainer, TrainState
+    from adapcc_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
+    from adapcc_tpu.strategy.ir import Strategy
+
+    world = _env_int("BENCH_WORLD", 0) or len(jax.devices())
+    mesh = build_world_mesh(world)
+
+    cfg = GPT2Config(
+        vocab_size=16384,
+        max_seq=_env_int("BENCH_SEQ", 512),
+        n_layer=_env_int("BENCH_LAYERS", 8),
+        n_head=_env_int("BENCH_HEADS", 8),
+        d_model=_env_int("BENCH_DMODEL", 512),
+    )
+    per_rank_batch = _env_int("BENCH_BATCH", 8)
+    batch = per_rank_batch * world
+    steps = _env_int("BENCH_STEPS", 10)
+
+    model = GPT2(cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(batch, cfg.max_seq)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens[:1])
+
+    def loss_fn(p, b):
+        return lm_loss(model.apply(p, b), b)
+
+    tx = optax.adamw(3e-4)
+
+    def time_steps(step_fn, state):
+        state = step_fn(state)  # compile + warmup
+        jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state = step_fn(state)
+        jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+        return (time.perf_counter() - t0) / steps
+
+    # --- framework path: DDPTrainer with the adaptive gradient hook -----------
+    trainer = DDPTrainer(
+        loss_fn, tx, mesh, Strategy.ring(world), donate_state=True, use_xla_fastpath=True
+    )
+    # both paths donate their state; give each its own param buffers
+    fw_state = TrainState.create(jax.tree_util.tree_map(jnp.array, params), tx)
+
+    def fw_step(state):
+        state, _ = trainer.step(state, tokens)  # host-side step counter, async dispatch
+        return state
+
+    fw_time = time_steps(fw_step, fw_state)
+
+    # --- baseline: plain jit + psum DDP (no framework) -------------------------
+    from jax.sharding import PartitionSpec as P
+
+    def base_step_shard(state, b):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, b)
+        grads = jax.tree_util.tree_map(lambda g: jax.lax.pmean(g, "ranks"), grads)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params2 = optax.apply_updates(state.params, updates)
+        return TrainState(params=params2, opt_state=opt_state, step=state.step + 1)
+
+    base_fn = jax.jit(
+        jax.shard_map(
+            base_step_shard,
+            mesh=mesh,
+            in_specs=(P(), P("ranks")),
+            out_specs=P(),
+            check_vma=False,
+        ),
+        donate_argnums=(0,),
+    )
+    base_state = TrainState.create(jax.tree_util.tree_map(jnp.array, params), tx)
+    base_time = time_steps(lambda s: base_fn(s, tokens), base_state)
+
+    tokens_per_step = batch * cfg.max_seq
+    value = tokens_per_step / fw_time
+    baseline = tokens_per_step / base_time
+
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2_ddp_train_throughput",
+                "value": round(value, 1),
+                "unit": "tokens/s",
+                "vs_baseline": round(value / baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

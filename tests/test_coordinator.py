@@ -1,0 +1,186 @@
+"""Coordinator plane: rent-or-buy relay decisions + heartbeat fault detection.
+
+Emulated multi-worker scenarios run each "rank" as a thread, the analog of
+the reference's fake-multi-node localhost launches; timings are scaled down
+so the suite stays fast and deterministic.
+"""
+
+import threading
+import time
+
+import pytest
+
+from adapcc_tpu.coordinator import CoordinatorLogic, CoordinatorServer, Controller, Hooker
+
+
+def run_workers(n, fn):
+    """Run fn(rank) in n threads, return {rank: result}."""
+    results = {}
+    errors = []
+
+    def wrap(r):
+        try:
+            results[r] = fn(r)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrap, args=(r,)) for r in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors, errors
+    return results
+
+
+# --------------------------------------------------------------------------- #
+# logic layer
+# --------------------------------------------------------------------------- #
+
+def fast_logic(world, **kw):
+    kw.setdefault("relay_threshold", 0.05)
+    kw.setdefault("time_slot", 0.002)
+    kw.setdefault("fault_timeout", 0.5)
+    return CoordinatorLogic(world, **kw)
+
+
+def test_all_arrive_full_active_list():
+    logic = fast_logic(4)
+    out = run_workers(4, lambda r: logic.hook_arrive(step=0, rank=r))
+    for r, active in out.items():
+        assert sorted(active) == [0, 1, 2, 3]
+
+
+def test_straggler_demoted_to_relay():
+    logic = fast_logic(4)
+    results = {}
+
+    def worker(r):
+        if r == 3:
+            time.sleep(0.4)  # way past the relay threshold
+        results[r] = logic.hook_arrive(step=0, rank=r)
+
+    run_workers(4, worker)
+    # fast ranks froze an active list without rank 3
+    for r in (0, 1, 2):
+        assert 3 not in results[r]
+        assert sorted(results[r]) == [0, 1, 2]
+    # the relay worker learns the frozen list, not a new one
+    assert sorted(results[3]) == [0, 1, 2]
+
+
+def test_leader_waits_for_near_arrivals():
+    # second rank arrives within one time slot: rent-or-buy should wait for it
+    logic = fast_logic(2, relay_threshold=0.5)
+    out = {}
+
+    def worker(r):
+        if r == 1:
+            time.sleep(0.004)
+        out[r] = logic.hook_arrive(step=0, rank=r)
+
+    run_workers(2, worker)
+    assert sorted(out[0]) == [0, 1]
+
+
+def test_controller_barrier_all_alive():
+    logic = fast_logic(3)
+    # hook phase freezes the active list first
+    run_workers(3, lambda r: logic.hook_arrive(step=5, rank=r))
+    out = run_workers(3, lambda r: logic.controller_arrive(step=5, rank=r))
+    for active, status in out.values():
+        assert status == 1
+        assert sorted(active) == [0, 1, 2]
+
+
+def test_controller_fault_timeout_returns_alive_subset():
+    logic = fast_logic(3, fault_timeout=0.1)
+    # rank 2 never heartbeats
+    out = run_workers(2, lambda r: logic.controller_arrive(step=0, rank=r))
+    for active, status in out.values():
+        assert status == 0
+        assert sorted(active) == [0, 1]
+
+
+def test_steps_are_independent():
+    logic = fast_logic(2)
+    run_workers(2, lambda r: logic.hook_arrive(step=0, rank=r))
+    out = run_workers(2, lambda r: logic.hook_arrive(step=1, rank=r))
+    assert sorted(out[0]) == [0, 1]
+    logic.forget_steps_before(1)
+    assert logic.active_list(0) is None
+    assert logic.active_list(1) == [0, 1] or sorted(logic.active_list(1)) == [0, 1]
+
+
+# --------------------------------------------------------------------------- #
+# gRPC transport
+# --------------------------------------------------------------------------- #
+
+@pytest.fixture
+def server():
+    logic = fast_logic(3)
+    srv = CoordinatorServer(3, port=0, logic=logic).start()
+    yield srv
+    srv.stop()
+
+
+def test_grpc_hook_and_controller_roundtrip(server):
+    port = server.port
+
+    def worker(r):
+        hooker = Hooker("127.0.0.1", port)
+        controller = Controller("127.0.0.1", port)
+        active = hooker.send_ready_request(0, r)
+        relay = controller.send_relay_request(0, r)
+        hooker.close()
+        controller.close()
+        return active, relay
+
+    out = run_workers(3, worker)
+    for active, (relay_active, status) in out.values():
+        assert sorted(active) == [0, 1, 2]
+        assert status == 1
+        assert sorted(relay_active) == [0, 1, 2]
+
+
+def test_grpc_fault_detection(server):
+    port = server.port
+
+    def worker(r):
+        controller = Controller("127.0.0.1", port)
+        try:
+            return controller.send_relay_request(0, r)
+        finally:
+            controller.close()
+
+    out = run_workers(2, worker)  # rank 2 missing
+    for active, status in out.values():
+        assert status == 0
+        assert sorted(active) == [0, 1]
+
+
+# --------------------------------------------------------------------------- #
+# communicator integration
+# --------------------------------------------------------------------------- #
+
+def test_communicator_coordinator_plane(tmp_path, mesh4):
+    from adapcc_tpu.communicator import Communicator
+    from adapcc_tpu.config import CommArgs
+
+    args = CommArgs(
+        topology_dir=str(tmp_path / "topo"),
+        strategy_file=str(tmp_path / "topo" / "strategy.xml"),
+        logical_graph=str(tmp_path / "topo" / "lg.xml"),
+    )
+    comm = Communicator(args, mesh=mesh4)
+    comm.enable_coordinator(is_master=True, process_rank=0, num_processes=1, port=0)
+    comm.update_relay(0)
+    active = comm.hook_ready(0)
+    assert active == [0]
+    deadline = time.time() + 2
+    while comm.relay_active_list(0) is None and time.time() < deadline:
+        time.sleep(0.01)
+    assert comm.relay_active_list(0) == [0]
+    assert comm.fault_worker_list == []
+    comm.clear()
+    assert comm._controller_thread is None

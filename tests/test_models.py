@@ -1,0 +1,104 @@
+"""Model zoo sanity: shapes, finiteness, gradient flow, MoE routing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adapcc_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
+from adapcc_tpu.models.moe import MoEConfig, MoEMLP
+from adapcc_tpu.models.vgg import VGG, VGG11_CFG
+from adapcc_tpu.models.vit import ViT, ViTConfig
+
+
+def test_gpt2_forward_and_loss():
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    tokens = jnp.ones((2, cfg.max_seq), dtype=jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    logits = model.apply(params, tokens)
+    assert logits.shape == (2, cfg.max_seq, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    loss = lm_loss(logits, tokens)
+    assert np.isfinite(float(loss))
+
+
+def test_gpt2_gradients_nonzero():
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    # shorter than max_seq exercises position-embedding slicing
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    g = jax.grad(lambda p: lm_loss(model.apply(p, tokens), tokens))(params)
+    norms = [float(jnp.linalg.norm(x)) for x in jax.tree_util.tree_leaves(g)]
+    assert all(np.isfinite(n) for n in norms)
+    assert sum(n > 0 for n in norms) > len(norms) * 0.8
+
+
+def test_gpt2_remat_variant_matches():
+    cfg = GPT2Config.tiny()
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (1, 16), 0, cfg.vocab_size)
+    params = GPT2(cfg).init(jax.random.PRNGKey(0), tokens)
+    import dataclasses
+
+    cfg_r = dataclasses.replace(cfg, remat=True)
+    out_a = GPT2(cfg).apply(params, tokens)
+    out_b = GPT2(cfg_r).apply(params, tokens)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), rtol=1e-5)
+
+
+def test_vgg_forward():
+    model = VGG(cfg=VGG11_CFG, num_classes=10, classifier_width=64)
+    x = jnp.ones((2, 32, 32, 3))
+    params = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(params, x)
+    assert out.shape == (2, 10)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_vit_forward():
+    cfg = ViTConfig.tiny()
+    model = ViT(cfg)
+    x = jnp.ones((2, cfg.image_size, cfg.image_size, 3))
+    params = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(params, x)
+    assert out.shape == (2, cfg.num_classes)
+
+
+def test_moe_forward_and_aux_loss():
+    cfg = MoEConfig.tiny()
+    model = MoEMLP(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, cfg.d_model))
+    params = model.init(jax.random.PRNGKey(1), x)
+    y, aux = model.apply(params, x)
+    assert y.shape == x.shape
+    assert np.isfinite(np.asarray(y)).all()
+    # balanced-ish routing on random inputs: aux loss near 1 (perfect balance
+    # gives exactly 1.0 for the switch formulation)
+    assert 0.5 < float(aux) < cfg.num_experts
+
+
+def test_moe_tokens_actually_routed():
+    cfg = MoEConfig.tiny()
+    model = MoEMLP(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(3), (1, 16, cfg.d_model))
+    params = model.init(jax.random.PRNGKey(4), x)
+    y, _ = model.apply(params, x)
+    # output differs from input (experts transformed it) and is token-dependent
+    assert not np.allclose(np.asarray(y), np.asarray(x))
+    assert np.asarray(y).std(axis=1).mean() > 0
+
+
+def test_moe_gradients_flow_to_experts():
+    cfg = MoEConfig.tiny()
+    model = MoEMLP(cfg)
+    x = jax.random.normal(jax.random.PRNGKey(5), (2, 8, cfg.d_model))
+    params = model.init(jax.random.PRNGKey(6), x)
+
+    def loss(p):
+        y, aux = model.apply(p, x)
+        return jnp.mean(y**2) + 0.01 * aux
+
+    g = jax.grad(loss)(params)
+    w1g = g["params"]["w1"]
+    assert float(jnp.linalg.norm(w1g)) > 0
