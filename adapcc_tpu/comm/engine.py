@@ -297,6 +297,9 @@ class CollectiveEngine:
                     mesh=self.mesh,
                     in_specs=specs,
                     out_specs=P(self.axis_name),
+                    # collective results flow through ppermute/RDMA, whose
+                    # replication jax cannot infer
+                    check_vma=False,
                 )
             )
             self._cache[key] = fn
@@ -389,6 +392,25 @@ class CollectiveEngine:
             return lax.all_to_all(x[0], self.axis_name, split_axis=0, concat_axis=0)[None]
 
         key = ("alltoall", stacked.shape, stacked.dtype.name)
+        return self._shard_mapped(key, per_shard, 1)(stacked)
+
+    def ring_allreduce(self, stacked: jnp.ndarray, interpret: Optional[bool] = None) -> jnp.ndarray:
+        """Pallas ICI ring allreduce (hand-tuned data plane; see
+        :mod:`adapcc_tpu.comm.pallas_ring`).  ``interpret=None`` auto-selects
+        the interpreter off-TPU so the same call works on the virtual pod."""
+        from adapcc_tpu.comm.pallas_ring import ring_allreduce_shard
+
+        self._check_world_dim(stacked, "ring_allreduce")
+        if interpret is None:
+            interpret = jax.devices()[0].platform != "tpu"
+        world = self.world_size
+
+        def per_shard(x):  # x: [1, *payload]
+            return ring_allreduce_shard(
+                x[0], world, self.axis_name, interpret=interpret
+            )[None]
+
+        key = ("ring_allreduce", stacked.shape, stacked.dtype.name, bool(interpret))
         return self._shard_mapped(key, per_shard, 1)(stacked)
 
     def reduce_scatter(self, stacked: jnp.ndarray, op: ReduceOp = ReduceOp.SUM) -> jnp.ndarray:

@@ -250,6 +250,10 @@ class Communicator:
         self._controller_thread = threading.Thread(target=self._controller_loop, daemon=True)
         self._controller_thread.start()
 
+    @property
+    def _controller_alive(self) -> bool:
+        return self._controller_thread is not None and self._controller_thread.is_alive()
+
     def _controller_loop(self) -> None:
         """Background heartbeat consumer (reference controller thread,
         commu.py:143-170): one relay request per training step; a status-0
@@ -274,20 +278,35 @@ class Communicator:
                 self.fault_worker_list = sorted(set(range(self.num_processes)) - set(active))
                 return
             self._active_by_step[step] = active
+            # bounded history: long runs must not accumulate per-step state
+            for old in [s for s in self._active_by_step if s < step - 100]:
+                del self._active_by_step[old]
 
     def update_relay(self, step: int) -> None:
         """Kick the controller heartbeat for this step (reference
-        commu.py:293-299; called once per training iteration)."""
-        if self._step_queue is not None:
+        commu.py:293-299; called once per training iteration).  A dead
+        controller thread (fault detected / master unreachable) makes this a
+        no-op instead of filling an unconsumed queue."""
+        if self._step_queue is not None and self._controller_alive:
             self._step_queue.put(step)
 
     def hook_ready(self, step: int) -> List[int]:
         """First-bucket-ready negotiation: returns the frozen active list for
         this step (reference cuda_allreduce_hook → hook_fetch,
-        commu.py:385-399)."""
+        commu.py:385-399).  If the coordinator is unreachable, training
+        proceeds with every local participant active — the reference's
+        continue-with-alive-subset stance (README "fault tolerance")."""
         if self._hooker is None:
             return list(range(self.world_size))
-        return self._hooker.send_ready_request(step, self.process_rank)
+        import grpc as _grpc
+
+        try:
+            return self._hooker.send_ready_request(step, self.process_rank)
+        except _grpc.RpcError as e:
+            if not self.coordinator_unreachable:
+                print(f"[adapcc] hook RPC failed ({e.code()}); proceeding without coordinator")
+                self.coordinator_unreachable = True
+            return list(range(self.num_processes))
 
     def relay_active_list(self, step: int) -> Optional[List[int]]:
         return self._active_by_step.get(step)

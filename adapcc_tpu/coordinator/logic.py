@@ -136,6 +136,10 @@ class CoordinatorLogic:
                 if remaining <= 0:
                     return list(self._heartbeats[step]), 0
                 self._cond.wait(timeout=remaining)
+            # bounded history (the reference preallocates 1M steps instead,
+            # rpc_server.py:29-34); participants are never 1000 steps apart
+            if step % 100 == 0:
+                self._forget_locked(step - 1000)
             return list(self._frozen[step]), 1
 
     # -- introspection / GC ----------------------------------------------------
@@ -149,6 +153,9 @@ class CoordinatorLogic:
         """Drop per-step state older than ``step`` (the reference
         preallocates a dict of 1M steps instead, rpc_server.py:29-34)."""
         with self._cond:
-            for d in (self._ready, self._frozen, self._heartbeats):
-                for s in [s for s in d if s < step]:
-                    del d[s]
+            self._forget_locked(step)
+
+    def _forget_locked(self, step: int) -> None:
+        for d in (self._ready, self._frozen, self._heartbeats):
+            for s in [s for s in d if s < step]:
+                del d[s]
