@@ -1,0 +1,22 @@
+# Native runtime build (the analog of the reference's single-rule Makefile
+# building communicator.so; here g++ instead of nvcc, no MPI/ibverbs).
+CXX ?= g++
+CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
+
+LIB := libadapcc_rt.so
+SRCS := csrc/schedule_engine.cpp
+
+.PHONY: all native test clean
+
+all: native
+
+native: $(LIB)
+
+$(LIB): $(SRCS)
+	$(CXX) $(CXXFLAGS) -shared -o $@ $(SRCS)
+
+test: native
+	python -m pytest tests/ -q
+
+clean:
+	rm -f $(LIB)

@@ -303,10 +303,19 @@ class Communicator:
         try:
             return self._hooker.send_ready_request(step, self.process_rank)
         except _grpc.RpcError as e:
-            if not self.coordinator_unreachable:
-                print(f"[adapcc] hook RPC failed ({e.code()}); proceeding without coordinator")
-                self.coordinator_unreachable = True
-            return list(range(self.num_processes))
+            if self.num_processes <= 1:
+                # sole participant: falling back to "just me" cannot diverge
+                if not self.coordinator_unreachable:
+                    print(f"[adapcc] hook RPC failed ({e.code()}); proceeding without coordinator")
+                    self.coordinator_unreachable = True
+                return [self.process_rank]
+            # multi-process: inventing an active set here would differ from
+            # what peers got from the coordinator and silently diverge the
+            # SPMD program (different masks/divisors per process) — surface it
+            raise RuntimeError(
+                "coordinator unreachable during hook negotiation; cannot pick an "
+                "active set unilaterally in a multi-process world"
+            ) from e
 
     def relay_active_list(self, step: int) -> Optional[List[int]]:
         return self._active_by_step.get(step)

@@ -1,0 +1,158 @@
+"""ctypes binding for the native schedule engine (``libadapcc_rt.so``).
+
+Mirrors how the reference loads its native layer — ``CDLL('./communicator.so')``
+(reference adapcc.py:17-20) — but the native code here is the *host-side*
+schedule machinery (XML parse, round lowering, relay pruning, role algebra);
+the device data plane stays XLA/Pallas.  Every entry point has an identical
+pure-Python implementation, and :func:`available` gates usage so missing or
+unbuilt native code degrades to Python silently.
+
+Build: ``make native`` at the repo root.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from adapcc_tpu.comm.relay import RelayRole
+from adapcc_tpu.strategy.ir import CommRound
+
+_LIB_NAMES = ("libadapcc_rt.so",)
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    candidates = [os.path.join(_repo_root(), n) for n in _LIB_NAMES]
+    env = os.environ.get("ADAPCC_RT_PATH")
+    if env:
+        candidates.insert(0, env)
+    for path in candidates:
+        if not os.path.exists(path):
+            continue
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            continue
+        lib.adapcc_parse_strategy.restype = ctypes.c_void_p
+        lib.adapcc_parse_strategy.argtypes = [ctypes.c_char_p]
+        lib.adapcc_free_strategy.argtypes = [ctypes.c_void_p]
+        lib.adapcc_error.restype = ctypes.c_char_p
+        lib.adapcc_error.argtypes = [ctypes.c_void_p]
+        for fn in ("adapcc_world_size", "adapcc_num_trees"):
+            getattr(lib, fn).restype = ctypes.c_int
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.adapcc_tree_root.restype = ctypes.c_int
+        lib.adapcc_tree_root.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        for fn in ("adapcc_reduce_rounds", "adapcc_broadcast_rounds"):
+            getattr(lib, fn).restype = ctypes.c_int
+            getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_int, i32p, i32p, ctypes.c_int, ctypes.c_int]
+        for fn in ("adapcc_prune_reduce_rounds", "adapcc_prune_broadcast_rounds"):
+            getattr(lib, fn).restype = ctypes.c_int
+            getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_int, u8p, i32p, i32p, ctypes.c_int, ctypes.c_int]
+        lib.adapcc_relay_role.restype = ctypes.c_int
+        lib.adapcc_relay_role.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, u8p]
+        _lib = lib
+        break
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class NativeStrategy:
+    """A strategy parsed and lowered by the native engine."""
+
+    def __init__(self, xml_text: str):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("libadapcc_rt.so not built; run `make native`")
+        self._lib = lib
+        self._h = lib.adapcc_parse_strategy(xml_text.encode())
+        err = lib.adapcc_error(self._h)
+        if err:
+            msg = err.decode()
+            lib.adapcc_free_strategy(self._h)
+            self._h = None
+            raise ValueError(f"native strategy parse failed: {msg}")
+
+    def __del__(self):
+        if getattr(self, "_h", None):
+            self._lib.adapcc_free_strategy(self._h)
+            self._h = None
+
+    # -- queries ---------------------------------------------------------------
+
+    @property
+    def world_size(self) -> int:
+        return self._lib.adapcc_world_size(self._h)
+
+    @property
+    def num_trees(self) -> int:
+        return self._lib.adapcc_num_trees(self._h)
+
+    def tree_root(self, t: int) -> int:
+        return self._lib.adapcc_tree_root(self._h, t)
+
+    def _rounds(self, fn, t: int, active: Optional[Sequence[int]] = None) -> List[CommRound]:
+        max_edges = max(4 * self.world_size, 64)
+        max_rounds = max_edges
+        edges = (ctypes.c_int32 * (2 * max_edges))()
+        offsets = (ctypes.c_int32 * (max_rounds + 1))()
+        if active is not None:
+            act = set(active)
+            mask = (ctypes.c_uint8 * self.world_size)(
+                *[1 if r in act else 0 for r in range(self.world_size)]
+            )
+            n = fn(self._h, t, mask, edges, offsets, max_edges, max_rounds)
+        else:
+            n = fn(self._h, t, edges, offsets, max_edges, max_rounds)
+        if n < 0:
+            raise RuntimeError("native round lowering failed (buffer or tree index)")
+        out = []
+        for i in range(n):
+            es = tuple(
+                (edges[2 * e], edges[2 * e + 1]) for e in range(offsets[i], offsets[i + 1])
+            )
+            out.append(CommRound(es))
+        return out
+
+    def reduce_rounds(self, t: int) -> List[CommRound]:
+        return self._rounds(self._lib.adapcc_reduce_rounds, t)
+
+    def broadcast_rounds(self, t: int) -> List[CommRound]:
+        return self._rounds(self._lib.adapcc_broadcast_rounds, t)
+
+    def prune_reduce_rounds(self, t: int, active: Sequence[int]) -> List[CommRound]:
+        return self._rounds(self._lib.adapcc_prune_reduce_rounds, t, active)
+
+    def prune_broadcast_rounds(self, t: int, active: Sequence[int]) -> List[CommRound]:
+        return self._rounds(self._lib.adapcc_prune_broadcast_rounds, t, active)
+
+    def relay_role(self, t: int, rank: int, active: Sequence[int]) -> RelayRole:
+        act = set(active)
+        mask = (ctypes.c_uint8 * self.world_size)(
+            *[1 if r in act else 0 for r in range(self.world_size)]
+        )
+        bits = self._lib.adapcc_relay_role(self._h, t, rank, mask)
+        if bits < 0:
+            raise RuntimeError("native relay_role failed")
+        return RelayRole(
+            has_recv=bool(bits & 1),
+            has_local=bool(bits & 2),
+            has_kernel=bool(bits & 4),
+            has_send=bool(bits & 8),
+        )

@@ -71,6 +71,8 @@ class Tree:
                     raise ValueError(f"rank {c} has two parents in tree rooted at {root}")
                 self.parent[c] = p
         self._validate()
+        self._reduce_rounds_cache: Optional[List[CommRound]] = None
+        self._broadcast_rounds_cache: Optional[List[CommRound]] = None
 
     def _validate(self) -> None:
         seen = set()
@@ -154,6 +156,11 @@ class Tree:
 
     # -- lowering to rounds ----------------------------------------------------
 
+    #: ranks above this count delegate round lowering to the native engine
+    #: (libadapcc_rt.so) when it is built — pure-Python lowering of pod-scale
+    #: trees is measurable host time during reconstruction
+    NATIVE_LOWERING_THRESHOLD = 64
+
     def reduce_rounds(self) -> List[CommRound]:
         """Rounds of child→parent sends implementing the up-tree reduction.
 
@@ -165,8 +172,14 @@ class Tree:
         rounds, the round-based analog of the reference's per-sibling staging
         slots (allreduce.cu:628-646).
         """
-        edges = [(r, self.parent[r]) for r in self._topo_leaves_first()]
-        return _pack_rounds(edges, after_all_incoming_of_src=True)
+        if self._reduce_rounds_cache is None:
+            native = self._native_lowering("reduce")
+            if native is not None:
+                self._reduce_rounds_cache = native
+            else:
+                edges = [(r, self.parent[r]) for r in self._topo_leaves_first()]
+                self._reduce_rounds_cache = _pack_rounds(edges, after_all_incoming_of_src=True)
+        return list(self._reduce_rounds_cache)
 
     def broadcast_rounds(self) -> List[CommRound]:
         """Rounds of parent→child sends implementing the down-tree broadcast.
@@ -177,8 +190,30 @@ class Tree:
         semantics (csrc/boardcast.cu:255-305) — lowering from the tree
         directly makes that symmetry explicit.
         """
-        edges = [(self.parent[r], r) for r in self._topo_root_first()]
-        return _pack_rounds(edges, after_all_incoming_of_src=False)
+        if self._broadcast_rounds_cache is None:
+            native = self._native_lowering("broadcast")
+            if native is not None:
+                self._broadcast_rounds_cache = native
+            else:
+                edges = [(self.parent[r], r) for r in self._topo_root_first()]
+                self._broadcast_rounds_cache = _pack_rounds(edges, after_all_incoming_of_src=False)
+        return list(self._broadcast_rounds_cache)
+
+    def _native_lowering(self, kind: str) -> Optional[List[CommRound]]:
+        if len(self._ranks) < self.NATIVE_LOWERING_THRESHOLD:
+            return None
+        try:
+            from adapcc_tpu import native
+            from adapcc_tpu.strategy import xml_io
+
+            if not native.available():
+                return None
+            ns = native.NativeStrategy(
+                xml_io.emit_strategy_xml(Strategy([self], max(self._ranks) + 1))
+            )
+            return ns.reduce_rounds(0) if kind == "reduce" else ns.broadcast_rounds(0)
+        except Exception:
+            return None  # any native hiccup falls back to the Python path
 
     def _topo_leaves_first(self) -> List[int]:
         return [r for r in self._postorder(self.root) if r != self.root]
