@@ -1,0 +1,33 @@
+"""Parallelism strategies beyond DP: sequence (ring attention), tensor,
+pipeline, and expert parallelism.
+
+The reference is a communication library whose only first-class strategy is
+DP (SURVEY §2.3) — TP/PP/SP are absent and ALLTOALL is an unimplemented stub
+(commu.py:31-33, trans.h:27-36).  On TPU these axes are first-class: every
+strategy here is expressed as shardings + collectives over a
+``jax.sharding.Mesh`` axis so XLA schedules the ICI traffic.
+"""
+
+from adapcc_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_attention_shard,
+)
+from adapcc_tpu.parallel.tensor import (
+    column_parallel_dense,
+    gpt2_tp_rules,
+    row_parallel_dense,
+    tree_shardings,
+)
+from adapcc_tpu.parallel.pipeline import pipeline_apply
+from adapcc_tpu.parallel.expert import expert_parallel_moe
+
+__all__ = [
+    "ring_attention",
+    "ring_attention_shard",
+    "column_parallel_dense",
+    "row_parallel_dense",
+    "gpt2_tp_rules",
+    "tree_shardings",
+    "pipeline_apply",
+    "expert_parallel_moe",
+]

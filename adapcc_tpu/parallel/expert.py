@@ -1,0 +1,132 @@
+"""Expert parallelism: MoE dispatch/combine over an ``experts`` mesh axis.
+
+The reference's ALLTOALL primitive is an unimplemented stub — its MoE
+workload delegates the shuffle to fastmoe/NCCL (SURVEY §2.3,
+models/moe/train_moe.py:20-41).  Here the all-to-all is native:
+each rank owns ``E / world`` experts and a token shard; routing happens
+locally, per-expert buffers are exchanged with ``lax.all_to_all`` over ICI,
+experts run on their home rank, and a second all-to-all brings results back
+for the weighted combine.  Capacity is static per (rank, expert) so every
+shape is fixed under jit.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from adapcc_tpu.models.moe import MoEConfig
+
+
+def _moe_shard(
+    router_kernel: jnp.ndarray,
+    router_bias: jnp.ndarray,
+    w1: jnp.ndarray,
+    w2: jnp.ndarray,
+    x: jnp.ndarray,
+    *,
+    cfg: MoEConfig,
+    axis_name: str,
+    capacity: int,
+):
+    """Per-shard EP MoE.  ``x [n_loc, D]`` token shard; ``w1/w2`` carry this
+    rank's expert slice ``[E_loc, ...]``; router params are replicated.
+    Returns ``(y [n_loc, D], aux_loss)``."""
+    world = lax.psum(1, axis_name)
+    n_loc, D = x.shape
+    E = cfg.num_experts
+    e_loc = w1.shape[0]
+
+    # --- local routing (fp32 softmax) ------------------------------------
+    logits = x.astype(jnp.float32) @ router_kernel + router_bias
+    probs = jax.nn.softmax(logits, axis=-1)  # [n_loc, E]
+
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(jnp.argmax(probs, -1), E), axis=0)
+    aux_loss = E * jnp.sum(lax.pmean(me, axis_name) * lax.pmean(ce, axis_name))
+
+    # top-k dispatch with per-rank positional capacity
+    combine = jnp.zeros((n_loc, E, capacity), jnp.float32)
+    remaining = probs
+    used = jnp.zeros((E,), jnp.int32)
+    for _ in range(cfg.top_k):
+        choice = jnp.argmax(remaining, axis=-1)
+        prob = jnp.take_along_axis(remaining, choice[:, None], 1)[:, 0]
+        onehot = jax.nn.one_hot(choice, E, dtype=jnp.int32)
+        pos_in_expert = (jnp.cumsum(onehot, axis=0) - onehot) + used[None, :]
+        pos = jnp.sum(onehot * pos_in_expert, axis=-1)
+        keep = pos < capacity
+        combine = combine + (
+            (prob * keep)[:, None, None]
+            * jax.nn.one_hot(choice, E)[:, :, None]
+            * jax.nn.one_hot(pos, capacity)[:, None, :]
+        )
+        used = used + jnp.sum(onehot * keep[:, None], axis=0)
+        remaining = remaining * (1.0 - jax.nn.one_hot(choice, E))
+    dispatch = (combine > 0).astype(cfg.dtype)  # [n_loc, E, C]
+
+    # --- dispatch all-to-all --------------------------------------------
+    # my tokens' contributions to all E experts, grouped by owner rank
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, x.astype(cfg.dtype))
+    expert_in = expert_in.reshape(world, e_loc, capacity, D)
+    # exchange: afterwards axis 0 indexes the *source* rank and the local
+    # expert slice is mine
+    recv = lax.all_to_all(expert_in, axis_name, split_axis=0, concat_axis=0, tiled=False)
+
+    # --- my experts run on everyone's tokens ----------------------------
+    flat = recv.transpose(1, 0, 2, 3).reshape(e_loc, world * capacity, D)
+    h = jax.nn.gelu(jnp.einsum("ecd,edh->ech", flat, w1.astype(cfg.dtype)))
+    out = jnp.einsum("ech,ehd->ecd", h, w2.astype(cfg.dtype))
+    out = out.reshape(e_loc, world, capacity, D).transpose(1, 0, 2, 3)
+
+    # --- return all-to-all + weighted combine ---------------------------
+    back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    expert_out = back.reshape(E, capacity, D)
+    y = jnp.einsum("nec,ecd->nd", combine.astype(cfg.dtype), expert_out)
+    return y.astype(x.dtype), aux_loss
+
+
+def expert_parallel_moe(
+    params: Any,
+    x: jnp.ndarray,
+    cfg: MoEConfig,
+    mesh: Mesh,
+    axis_name: str = "experts",
+    capacity: int | None = None,
+):
+    """Apply an EP-sharded MoE MLP.
+
+    ``params``: a :class:`~adapcc_tpu.models.moe.MoEMLP` param tree (router
+    Dense + stacked ``w1/w2``); experts shard over ``mesh[axis_name]``, tokens
+    shard over the same axis (DP-style), router is replicated.  ``x [N, D]``
+    with ``N`` divisible by the axis size.  Returns ``(y [N, D], aux_loss)``.
+    """
+    world = mesh.shape[axis_name]
+    p = params["params"]
+    if cfg.num_experts % world:
+        raise ValueError(f"{cfg.num_experts} experts not divisible by world {world}")
+    if capacity is None:
+        n_loc = x.shape[0] // world
+        capacity = max(1, int(-(-cfg.capacity_factor * cfg.top_k * n_loc // cfg.num_experts)))
+
+    fn = shard_map(
+        partial(_moe_shard, cfg=cfg, axis_name=axis_name, capacity=capacity),
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=(P(axis_name), P()),
+        check_vma=False,
+    )
+    y, aux = fn(
+        p["router"]["kernel"].astype(jnp.float32),
+        p["router"]["bias"].astype(jnp.float32),
+        p["w1"],
+        p["w2"],
+        x,
+    )
+    return y, aux

@@ -1,0 +1,124 @@
+"""Tensor parallelism: Megatron-style sharded matmuls, the TPU way.
+
+Two complementary forms:
+
+1. **GSPMD shardings** (:func:`gpt2_tp_rules` + :func:`tree_shardings`):
+   annotate parameter pytrees with ``NamedSharding`` by path pattern and let
+   XLA insert the all-gathers/reduce-scatters over ICI — the idiomatic pjit
+   path.  qkv/fc kernels shard their output dim (column parallel), residual
+   projections shard their input dim (row parallel), so a block needs exactly
+   one collective pair per sublayer.
+
+2. **Explicit shard_map primitives** (:func:`column_parallel_dense` /
+   :func:`row_parallel_dense`): for code already inside a ``shard_map`` body
+   (e.g. combined with ring attention), the classic column→row pairing where
+   the column output stays sharded and the row matmul finishes with one
+   ``psum``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def column_parallel_dense(
+    x: jnp.ndarray, w_shard: jnp.ndarray, b_shard: Optional[jnp.ndarray] = None
+) -> jnp.ndarray:
+    """``x [..., Din] @ w_shard [Din, Dout/world]`` → sharded ``[..., Dout/world]``.
+
+    Input is replicated across the TP axis; output columns stay sharded —
+    feed straight into :func:`row_parallel_dense` with no collective.
+    """
+    y = x @ w_shard
+    if b_shard is not None:
+        y = y + b_shard
+    return y
+
+
+def row_parallel_dense(
+    x_shard: jnp.ndarray,
+    w_shard: jnp.ndarray,
+    axis_name: str,
+    b: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """``x_shard [..., Din/world] @ w_shard [Din/world, Dout]`` → ``psum`` →
+    replicated ``[..., Dout]``.  The single collective of the column→row pair.
+    Bias (if any) must be the full row and is added once, after the psum.
+    """
+    y = lax.psum(x_shard @ w_shard, axis_name)
+    if b is not None:
+        y = y + b
+    return y
+
+
+#: (path-regex, PartitionSpec) rules for the flax GPT-2 in models/gpt2.py.
+def gpt2_tp_rules(tp_axis: str = "model") -> List[Tuple[str, P]]:
+    """Megatron sharding for GPT-2 params: attention qkv + MLP fc are column
+    parallel (kernel ``[Din, Dout]`` → shard ``Dout``), both residual ``proj``
+    kernels are row parallel (shard ``Din``), embeddings shard the vocab /
+    feature dim, everything else (LayerNorm, biases of row layers) replicated.
+    """
+    return [
+        (r".*attn/qkv/kernel", P(None, tp_axis)),
+        (r".*attn/qkv/bias", P(tp_axis)),
+        (r".*attn/proj/kernel", P(tp_axis, None)),
+        (r".*/fc/kernel", P(None, tp_axis)),
+        (r".*/fc/bias", P(tp_axis)),
+        (r".*h\d+/proj/kernel", P(tp_axis, None)),
+        (r".*wte/embedding", P(tp_axis, None)),
+    ]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def tree_shardings(
+    tree: Any, mesh: Mesh, rules: Sequence[Tuple[str, P]]
+) -> Any:
+    """NamedSharding pytree for ``tree``: first rule whose regex fully matches
+    the leaf's ``a/b/c`` path wins; unmatched leaves are replicated.  A rule
+    only applies if the spec divides the leaf's shape evenly — otherwise the
+    leaf falls back to replicated (same lenient behavior XLA would need
+    padding for)."""
+    def assign(path, leaf):
+        name = _path_str(path)
+        for pat, spec in rules:
+            if re.fullmatch(pat, name):
+                ok = True
+                for dim, axes in enumerate(spec):
+                    if axes is None:
+                        continue
+                    axis_names = axes if isinstance(axes, tuple) else (axes,)
+                    size = 1
+                    for a in axis_names:
+                        size *= mesh.shape[a]
+                    if dim >= leaf.ndim or leaf.shape[dim] % size != 0:
+                        ok = False
+                        break
+                if ok:
+                    return NamedSharding(mesh, spec)
+                break
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(assign, tree)
+
+
+def shard_tree(tree: Any, mesh: Mesh, rules: Sequence[Tuple[str, P]]) -> Any:
+    """Place ``tree``'s leaves on ``mesh`` per ``rules`` (device_put)."""
+    shardings = tree_shardings(tree, mesh, rules)
+    return jax.tree_util.tree_map(jax.device_put, tree, shardings)
