@@ -52,6 +52,13 @@ from adapcc_tpu.topology.detect import (
 )
 from adapcc_tpu.topology.profile import NetworkProfiler, gather_topo_profile
 
+# Profile-round counter for KV-store strategy dissemination keys.  Process-wide
+# (not per-Communicator): reconstruct_topology builds a fresh Communicator each
+# cycle, and a per-instance counter would reuse round keys, handing workers the
+# stale previous-round strategy.  Every process executes the same number of
+# PROFILE exits, so the counter stays in lockstep across the job.
+_profile_round_counter = iter(range(1 << 62))
+
 _COLLECTIVE_PRIMS = (ALLREDUCE, REDUCE, BOARDCAST, ALLGATHER, ALLTOALL, REDUCESCATTER)
 
 
@@ -116,7 +123,42 @@ class Communicator:
         if prim == DETECT:
             gather_detect_graph(self.args.topology_dir, self.args.logical_graph)
         elif prim == PROFILE:
-            self._synthesis_strategy()
+            # Profile timings are host-measured and diverge across processes;
+            # only process 0 synthesizes, and the strategy + chunk size travel
+            # through the coordinator KV store so every process runs the
+            # identical schedule (the analog of the reference's
+            # master-synthesize + scp fan-out, commu.py:345-351).  Keys are
+            # versioned per profile round: re-profiling republished under the
+            # same key would hand workers the stale previous-round bytes.
+            import jax
+
+            round_key = f"adapcc/strategy@r{next(_profile_round_counter)}"
+            if jax.process_count() > 1 and jax.process_index() != 0:
+                import base64
+
+                from adapcc_tpu.launch.dispatcher import fetch_value
+
+                # empty payload = master's synthesis was skipped (no profile
+                # data); mirror the master and keep the current strategy
+                payload = fetch_value(round_key)
+                if payload:
+                    os.makedirs(
+                        os.path.dirname(self.args.strategy_file) or ".", exist_ok=True
+                    )
+                    with open(self.args.strategy_file, "wb") as f:
+                        f.write(base64.b64decode(payload))
+                    self._strategy = None  # force reload from the fetched XML
+                self.chunk_bytes = int(fetch_value(round_key + "/chunk_bytes"))
+            else:
+                self._synthesis_strategy()
+                if jax.process_count() > 1:
+                    from adapcc_tpu.launch.dispatcher import publish_file, publish_value
+
+                    if os.path.exists(self.args.strategy_file):
+                        publish_file(self.args.strategy_file, key=round_key)
+                    else:
+                        publish_value(round_key, "")
+                    publish_value(round_key + "/chunk_bytes", str(self.chunk_bytes))
         elif prim in _COLLECTIVE_PRIMS:
             eng = self._engines.pop(prim, None)
             if eng is not None:

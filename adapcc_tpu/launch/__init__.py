@@ -1,0 +1,32 @@
+"""Process launch + artifact dissemination (reference launcher.py/dispatcher.py).
+
+The reference launches ranks with ``mpirun -H host:slots,...`` and fans
+topology/strategy files out with ``scp`` (launcher.py:34-62,
+dispatcher.py:23-54).  The TPU-native equivalents: processes are started per
+*host* (one JAX process per host controls all local chips) with the
+``jax.distributed`` coordinator env replacing the MPI world, and artifacts
+travel over a pluggable transport — local copy (single host / shared fs),
+ssh/scp (bare multi-host), or the jax.distributed KV store (TPU pods).
+"""
+
+from adapcc_tpu.launch.dispatcher import Dispatcher
+from adapcc_tpu.launch.launcher import (
+    HostSpec,
+    build_launch_plan,
+    main,
+    maybe_initialize_distributed,
+    order_hosts,
+    parse_ips,
+    write_ip_table,
+)
+
+__all__ = [
+    "Dispatcher",
+    "HostSpec",
+    "build_launch_plan",
+    "main",
+    "maybe_initialize_distributed",
+    "order_hosts",
+    "parse_ips",
+    "write_ip_table",
+]

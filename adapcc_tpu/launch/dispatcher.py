@@ -1,0 +1,165 @@
+"""Artifact dissemination — the reference's scp fan-out, TPU-shaped.
+
+The reference Dispatcher scp's four artifact classes between nodes
+(dispatcher.py:23-54): the ip table to every rank's node, detected topology
+to each node's local-rank-0, profiled topology to the master, and the
+strategy to every node.  On TPU pods processes usually share a filesystem
+(GCS fuse / NFS) or can exchange bytes through the ``jax.distributed`` KV
+store, so the transport is pluggable:
+
+- ``local``  — plain file copy (single host, virtual pods, shared fs).
+- ``ssh``    — scp, byte-compatible with the reference for bare clusters.
+- ``kvstore``— publish/fetch file bytes through the jax.distributed
+  coordinator client.  Only valid *inside* a running job (the coordinator
+  must exist), so the launcher CLI never uses it; the Communicator does,
+  to keep the synthesized strategy byte-identical across processes.
+
+Method names and call sites match the reference so the control plane reads
+the same either way.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import shutil
+import subprocess
+from typing import Dict, List, Optional, Sequence
+
+
+class Dispatcher:
+    """Fan artifact files out across the hosts of the job.
+
+    ``ip_table`` is the per-rank host list (one entry per rank, duplicates
+    meaning multiple ranks per host), exactly the reference's constructor
+    contract (dispatcher.py:8-17).
+    """
+
+    def __init__(self, ip_table: Sequence[str], transport: str = "local"):
+        if transport not in ("local", "ssh", "kvstore"):
+            raise ValueError(f"unknown transport {transport!r}")
+        self.transport = transport
+        self.ip_dict: Dict[str, bool] = {}
+        self.ip_table: List[str] = []
+        self.renew_ip_table(ip_table)
+        #: record of (src, host, dst) sends — the test/observability surface
+        self.log: List[tuple] = []
+
+    def init_ip_dict(self) -> None:
+        for ip in self.ip_table:
+            self.ip_dict.setdefault(ip, True)
+
+    def renew_ip_table(self, ip_table: Sequence[str]) -> None:
+        self.ip_table = list(ip_table)
+        self.ip_dict = {}
+        self.init_ip_dict()
+
+    # --- transport ------------------------------------------------------------
+
+    def _send(self, src_file: str, host: str, dst_path: str) -> None:
+        self.log.append((src_file, host, dst_path))
+        if self.transport == "local":
+            dst = os.path.join(dst_path, os.path.basename(src_file))
+            os.makedirs(dst_path, exist_ok=True)
+            if os.path.abspath(src_file) != os.path.abspath(dst):
+                shutil.copy2(src_file, dst)
+        else:  # ssh; remote dst anchored to this cwd (workers `cd` here too)
+            dst = dst_path if os.path.isabs(dst_path) else os.path.join(os.getcwd(), dst_path)
+            subprocess.run(["ssh", host, f"mkdir -p {dst}"])
+            proc = subprocess.run(["scp", "-q", src_file, f"{host}:{dst}"])
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"scp {src_file} -> {host}:{dst} failed (rc={proc.returncode})"
+                )
+
+    def _fanout(self, src_file: str, hosts: Sequence[str], dst_path: str) -> None:
+        if self.transport == "kvstore":
+            # one publish covers every receiver; republishing a regenerated
+            # artifact under the same key is allowed (overwrite)
+            self.log.append((src_file, "kvstore", dst_path))
+            publish_file(src_file)
+            return
+        for ip in hosts:
+            self._send(src_file, ip, dst_path)
+
+    # --- reference call sites (dispatcher.py:23-54) ---------------------------
+
+    def dispatch_ip_table(self, src_file: str, dst_path: str) -> None:
+        """Master sends the ip table to every node."""
+        self._fanout(src_file, list(self.ip_dict), dst_path)
+
+    def dispatch_detected_topo(self, src_file: str, dst_path: str) -> None:
+        """Each local-rank-0 shares its detected topology with every node."""
+        self._fanout(src_file, list(self.ip_dict), dst_path)
+
+    def send_profiled_topo(self, src_file: str, dst_path: str) -> None:
+        """Each local-rank-0 sends its profile matrix to the master."""
+        self._fanout(src_file, [self.ip_table[0]], dst_path)
+
+    def dispatch_strategy(self, src_file: str, dst_path: str) -> None:
+        """Master sends the synthesized strategy to every node."""
+        self._fanout(src_file, list(self.ip_dict), dst_path)
+
+
+# --- jax.distributed KV-store transport ---------------------------------------
+
+
+def _kv_client():
+    from jax._src import distributed
+
+    state = distributed.global_state
+    if state.client is None:
+        raise RuntimeError(
+            "kvstore transport needs jax.distributed.initialize() first"
+        )
+    return state.client
+
+
+def file_key(path: str) -> str:
+    """Deterministic KV key for an artifact file name."""
+    return f"adapcc/file/{os.path.basename(path)}"
+
+
+def _kv_set(key: str, value: str) -> None:
+    """Set-with-overwrite: regenerated artifacts republish under their key."""
+    client = _kv_client()
+    try:
+        client.key_value_set(key, value, allow_overwrite=True)
+    except TypeError:  # older jaxlib without the kwarg
+        try:
+            client.key_value_delete(key)
+        except Exception:
+            pass
+        client.key_value_set(key, value)
+
+
+def publish_file(path: str, key: Optional[str] = None) -> str:
+    """Put a file's bytes into the coordinator KV store; returns the key."""
+    key = key or file_key(path)
+    with open(path, "rb") as f:
+        _kv_set(key, base64.b64encode(f.read()).decode())
+    return key
+
+
+def fetch_file(key: str, dst_path: str, timeout_ms: int = 60_000, file_name: Optional[str] = None) -> str:
+    """Blocking fetch of a published file into ``dst_path``.
+
+    ``file_name`` overrides the on-disk name (keys may carry version
+    suffixes that are not part of the artifact's file name).
+    """
+    data = _kv_client().blocking_key_value_get(key, timeout_ms)
+    dst = os.path.join(dst_path, file_name or os.path.basename(key))
+    os.makedirs(dst_path, exist_ok=True)
+    with open(dst, "wb") as f:
+        f.write(base64.b64decode(data))
+    return dst
+
+
+def publish_value(key: str, value: str) -> None:
+    """Put a small string value into the coordinator KV store (overwrite ok)."""
+    _kv_set(key, value)
+
+
+def fetch_value(key: str, timeout_ms: int = 60_000) -> str:
+    """Blocking fetch of a small string value."""
+    return _kv_client().blocking_key_value_get(key, timeout_ms)

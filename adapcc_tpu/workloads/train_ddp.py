@@ -116,6 +116,10 @@ def make_workload(name: str, batch: int, rng):
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    # join the multi-host world if the launcher set the coordinator env
+    from adapcc_tpu.launch import maybe_initialize_distributed
+
+    maybe_initialize_distributed()
     mesh = build_world_mesh(args.world)
     world = int(mesh.devices.size)
 

@@ -1,0 +1,298 @@
+"""Launcher + dispatcher tests (reference launcher.py/dispatcher.py parity)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from adapcc_tpu.launch import (
+    Dispatcher,
+    HostSpec,
+    build_launch_plan,
+    parse_ips,
+    write_ip_table,
+)
+from adapcc_tpu.launch.launcher import build_parser, forwarded_flags
+
+
+def test_parse_ips_multi():
+    hosts = parse_ips("10.0.0.1:4, 10.0.0.2:4")
+    assert hosts == [HostSpec("10.0.0.1", 4), HostSpec("10.0.0.2", 4)]
+
+
+def test_parse_ips_default_chip_count():
+    assert parse_ips("10.0.0.9") == [HostSpec("10.0.0.9", 1)]
+
+
+def test_write_ip_table_one_line_per_rank(tmp_path):
+    path = str(tmp_path / "topology" / "ip_table.txt")
+    lines = write_ip_table([HostSpec("a", 2), HostSpec("b", 1)], path)
+    assert lines == ["a", "a", "b"]
+    assert open(path).read() == "a\na\nb\n"
+
+
+def test_forwarded_flag_contract():
+    args = build_parser().parse_args(
+        ["--socket_port", "5001", "--entry_point", "6", "--parallel_degree", "2"]
+    )
+    flags = forwarded_flags(args)
+    # the six required fields of the reference contract (launcher.py:53-62)
+    keys = {f.split("=")[0] for f in flags}
+    assert keys == {
+        "--port", "--entry_point", "--strategy_file",
+        "--logical_graph", "--parallel_degree", "--profile_freq",
+    }
+    assert "--entry_point=6" in flags
+
+
+def test_single_host_virtual_plan():
+    args = build_parser().parse_args(["--ips", "127.0.0.1:8", "--virtual"])
+    plan = build_launch_plan(args)
+    assert len(plan) == 1
+    env = plan[0]["env"]
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+
+
+def test_multi_host_plan_has_coordinator_env():
+    args = build_parser().parse_args(
+        ["--ips", "10.0.0.1:4,10.0.0.2:4", "--master", "10.0.0.1"]
+    )
+    plan = build_launch_plan(args)
+    assert len(plan) == 2
+    assert plan[0]["env"]["JAX_COORDINATOR_ADDRESS"] == "10.0.0.1:8476"
+    assert plan[0]["env"]["ADAPCC_PROCESS_ID"] == "0"
+    assert plan[1]["env"]["ADAPCC_PROCESS_ID"] == "1"
+    assert plan[1]["env"]["ADAPCC_NUM_PROCESSES"] == "2"
+    # remote host launches are ssh-wrapped
+    assert plan[1]["cmd"][0] == "ssh"
+
+
+def test_master_host_ordered_first():
+    from adapcc_tpu.launch import order_hosts
+
+    args = build_parser().parse_args(
+        ["--ips", "10.0.0.1:4,10.0.0.2:4", "--master", "10.0.0.2"]
+    )
+    hosts = order_hosts(parse_ips(args.ips), args.master)
+    assert hosts[0].ip == "10.0.0.2"
+    plan = build_launch_plan(args)
+    # master process (idx 0) runs locally on the master host; the other is ssh'd
+    assert plan[0]["host"] == "10.0.0.2"
+    assert plan[0]["cmd"][0] != "ssh"
+    assert plan[1]["cmd"][0] == "ssh"
+    assert plan[0]["env"]["JAX_COORDINATOR_ADDRESS"] == "10.0.0.2:8476"
+
+
+def test_module_exec_file_expands_for_remote_hosts():
+    args = build_parser().parse_args(
+        ["--ips", "10.0.0.1:1,10.0.0.2:1", "--exec-file", "-m adapcc_tpu.workloads.train_ddp"]
+    )
+    plan = build_launch_plan(args)
+    assert plan[0]["cmd"][1:3] == ["-m", "adapcc_tpu.workloads.train_ddp"]
+    # ssh command line carries the -m module launch too
+    assert "-m adapcc_tpu.workloads.train_ddp" in plan[1]["cmd"][2]
+
+
+def test_ssh_command_quotes_paths_with_spaces():
+    args = build_parser().parse_args(
+        ["--ips", "10.0.0.1:1,10.0.0.2:1", "--strategy_file", "my dir/strategy.xml"]
+    )
+    plan = build_launch_plan(args)
+    assert "'--strategy_file=my dir/strategy.xml'" in plan[1]["cmd"][2]
+
+
+def test_maybe_initialize_distributed_noop_single_host(monkeypatch):
+    from adapcc_tpu.launch import maybe_initialize_distributed
+
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("ADAPCC_NUM_PROCESSES", raising=False)
+    assert maybe_initialize_distributed() is False
+
+
+def test_unknown_master_rejected():
+    from adapcc_tpu.launch import order_hosts
+
+    with pytest.raises(ValueError, match="not one of"):
+        order_hosts(parse_ips("10.0.0.1:4"), "10.0.0.99")
+
+
+class _FakeKVClient:
+    """Dict-backed stand-in for the jax.distributed coordinator client."""
+
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        if key in self.store and not allow_overwrite:
+            raise RuntimeError(f"duplicate key {key}")
+        self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        return self.store[key]
+
+
+@pytest.fixture
+def fake_kv(monkeypatch):
+    import jax
+    from jax._src import distributed
+
+    jax.devices()  # initialize the backend before faking the kv client
+    client = _FakeKVClient()
+    monkeypatch.setattr(distributed.global_state, "client", client)
+    return client
+
+
+def test_kvstore_publish_fetch_roundtrip(tmp_path, fake_kv):
+    from adapcc_tpu.launch.dispatcher import fetch_file, file_key, publish_file
+
+    src = tmp_path / "strategy.xml"
+    src.write_text("<trees/>")
+    key = publish_file(str(src))
+    assert key == file_key(str(src)) == "adapcc/file/strategy.xml"
+    dst = fetch_file(key, str(tmp_path / "out"))
+    assert open(dst).read() == "<trees/>"
+
+
+def test_kvstore_dispatch_publishes_once_and_allows_republish(tmp_path, fake_kv):
+    src = tmp_path / "strategy.xml"
+    src.write_text("<trees/>")
+    d = Dispatcher(["h1", "h2", "h3"], transport="kvstore")
+    d.dispatch_strategy(str(src), "topology")
+    assert len(d.log) == 1  # one publish serves all hosts
+    # regenerated artifact republishes under the same key (overwrite)
+    src.write_text("<trees><root/></trees>")
+    d.dispatch_strategy(str(src), "topology")
+    from adapcc_tpu.launch.dispatcher import fetch_file
+
+    dst = fetch_file("adapcc/file/strategy.xml", str(tmp_path / "out"))
+    assert "root" in open(dst).read()
+
+
+def test_virtual_multihost_plan_forces_cpu_everywhere():
+    args = build_parser().parse_args(
+        ["--ips", "127.0.0.1:4,127.0.0.1:4", "--virtual"]
+    )
+    plan = build_launch_plan(args)
+    assert len(plan) == 2
+    for rec in plan:
+        assert rec["env"]["JAX_PLATFORMS"] == "cpu"
+        assert "--xla_force_host_platform_device_count=4" in rec["env"]["XLA_FLAGS"]
+
+
+def test_profile_exit_disseminates_strategy_and_chunk_bytes(tmp_path, monkeypatch):
+    """Multi-process PROFILE exit: process 0 publishes strategy + chunk size
+    under a versioned key; workers fetch both (communicator.py PROFILE path)."""
+    import jax
+
+    from adapcc_tpu.communicator import Communicator
+    from adapcc_tpu.config import CommArgs
+
+    jax.devices()  # initialize the real backend before faking the kv client
+
+    args = CommArgs(
+        strategy_file=str(tmp_path / "strategy.xml"),
+        logical_graph=str(tmp_path / "logical_graph.xml"),
+        topology_dir=str(tmp_path),
+    )
+    comm = Communicator(args, world_size=4)
+    comm._profiler = None
+
+    from jax._src import distributed
+
+    fake_kv = _FakeKVClient()
+    monkeypatch.setattr(distributed.global_state, "client", fake_kv)
+
+    # master: pretend synthesis wrote the strategy + picked a chunk size
+    def fake_synth():
+        (tmp_path / "strategy.xml").write_text("<trees/>")
+        comm.chunk_bytes = 12345
+
+    monkeypatch.setattr(comm, "_synthesis_strategy", fake_synth)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    from adapcc_tpu.primitives import PROFILE
+
+    comm.exit_threads(PROFILE)
+    published = [k for k in fake_kv.store if k.startswith("adapcc/strategy@r")]
+    assert len(published) == 2  # file + chunk_bytes under one round key
+    round_key = min(published, key=len)
+
+    # worker: same round, different process — fetches the same artifacts
+    worker_dir = tmp_path / "worker"
+    worker_dir.mkdir()
+    wargs = CommArgs(
+        strategy_file=str(worker_dir / "strategy.xml"),
+        logical_graph=str(worker_dir / "logical_graph.xml"),
+        topology_dir=str(worker_dir),
+    )
+    worker = Communicator(wargs, world_size=4)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    import adapcc_tpu.communicator as comm_mod
+
+    # re-pin the worker's round counter to the master's round
+    monkeypatch.setattr(
+        comm_mod, "_profile_round_counter",
+        iter([int(round_key.split("@r")[1])]),
+    )
+    worker.exit_threads(PROFILE)
+    assert (worker_dir / "strategy.xml").read_text() == "<trees/>"
+    assert worker.chunk_bytes == 12345
+
+
+def test_ssh_dispatch_anchors_relative_dst_to_cwd(tmp_path, monkeypatch):
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+
+        class R:
+            returncode = 0
+
+        return R()
+
+    import adapcc_tpu.launch.dispatcher as disp
+
+    monkeypatch.setattr(disp.subprocess, "run", fake_run)
+    src = tmp_path / "ip_table.txt"
+    src.write_text("h1\n")
+    d = Dispatcher(["h1"], transport="ssh")
+    d.dispatch_ip_table(str(src), "topology")
+    dst = os.path.join(os.getcwd(), "topology")
+    # remote dir is created first; scp path is absolute, anchored at this cwd
+    assert calls[0] == ["ssh", "h1", f"mkdir -p {dst}"]
+    assert calls[1][-1] == f"h1:{dst}"
+
+
+def test_dispatcher_local_copy(tmp_path):
+    src = tmp_path / "strategy.xml"
+    src.write_text("<trees/>")
+    d = Dispatcher(["h1", "h1", "h2"], transport="local")
+    dst = tmp_path / "out"
+    d.dispatch_strategy(str(src), str(dst))
+    assert (dst / "strategy.xml").read_text() == "<trees/>"
+    # fan-out is per unique host, not per rank (dispatcher.py:32-38)
+    assert len(d.log) == 2
+
+
+def test_dispatcher_profiled_topo_goes_to_master(tmp_path):
+    src = tmp_path / "topo_profile_0"
+    src.write_text("0,1,bw,1.0")
+    d = Dispatcher(["master", "worker"], transport="local")
+    d.send_profiled_topo(str(src), str(tmp_path / "out"))
+    assert d.log == [(str(src), "master", str(tmp_path / "out"))]
+
+
+def test_launcher_cli_dry_run(tmp_path):
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "adapcc_tpu.launch.launcher",
+            "--ips", "127.0.0.1:4", "--virtual", "--dry-run",
+            "--ip_table", str(tmp_path / "ip_table.txt"),
+        ],
+        capture_output=True, text=True, cwd="/root/repo",
+    )
+    assert out.returncode == 0, out.stderr
+    assert "train_ddp" in out.stdout
+    assert os.path.exists(tmp_path / "ip_table.txt")
