@@ -56,8 +56,15 @@ from adapcc_tpu.topology.profile import NetworkProfiler, gather_topo_profile
 # (not per-Communicator): reconstruct_topology builds a fresh Communicator each
 # cycle, and a per-instance counter would reuse round keys, handing workers the
 # stale previous-round strategy.  Every process executes the same number of
-# PROFILE exits, so the counter stays in lockstep across the job.
+# PROFILE exits, so the counter stays in lockstep across the job; elastic
+# restarts (which relaunch the whole world and reset the counter) are isolated
+# by the supervisor's ADAPCC_RESTART_GEN in the key prefix.
 _profile_round_counter = iter(range(1 << 62))
+
+
+def _strategy_round_key() -> str:
+    gen = os.environ.get("ADAPCC_RESTART_GEN", "0")
+    return f"adapcc/strategy/g{gen}@r{next(_profile_round_counter)}"
 
 _COLLECTIVE_PRIMS = (ALLREDUCE, REDUCE, BOARDCAST, ALLGATHER, ALLTOALL, REDUCESCATTER)
 
@@ -132,7 +139,7 @@ class Communicator:
             # same key would hand workers the stale previous-round bytes.
             import jax
 
-            round_key = f"adapcc/strategy@r{next(_profile_round_counter)}"
+            round_key = _strategy_round_key()
             if jax.process_count() > 1 and jax.process_index() != 0:
                 import base64
 
@@ -140,7 +147,7 @@ class Communicator:
 
                 # empty payload = master's synthesis was skipped (no profile
                 # data); mirror the master and keep the current strategy
-                payload = fetch_value(round_key)
+                payload = fetch_value(round_key, timeout_ms=self.args.kv_timeout_ms)
                 if payload:
                     os.makedirs(
                         os.path.dirname(self.args.strategy_file) or ".", exist_ok=True
@@ -148,7 +155,9 @@ class Communicator:
                     with open(self.args.strategy_file, "wb") as f:
                         f.write(base64.b64decode(payload))
                     self._strategy = None  # force reload from the fetched XML
-                self.chunk_bytes = int(fetch_value(round_key + "/chunk_bytes"))
+                self.chunk_bytes = int(
+                    fetch_value(round_key + "/chunk_bytes", timeout_ms=self.args.kv_timeout_ms)
+                )
             else:
                 self._synthesis_strategy()
                 if jax.process_count() > 1:

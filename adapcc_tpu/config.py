@@ -35,6 +35,9 @@ class CommArgs:
     use_xla_fastpath: bool = True
     default_chunk_bytes: int = DEFAULT_CHUNK_BYTES
     coordinator_ip: Optional[str] = None
+    #: worker-side wait for master-published artifacts (profile gather +
+    #: synthesis can take minutes at large world sizes)
+    kv_timeout_ms: int = 600_000
 
     @classmethod
     def from_namespace(cls, ns: Any) -> "CommArgs":

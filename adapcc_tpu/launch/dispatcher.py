@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import base64
 import os
+import shlex
 import shutil
 import subprocess
 from typing import Dict, List, Optional, Sequence
@@ -65,8 +66,10 @@ class Dispatcher:
                 shutil.copy2(src_file, dst)
         else:  # ssh; remote dst anchored to this cwd (workers `cd` here too)
             dst = dst_path if os.path.isabs(dst_path) else os.path.join(os.getcwd(), dst_path)
-            subprocess.run(["ssh", host, f"mkdir -p {dst}"])
-            proc = subprocess.run(["scp", "-q", src_file, f"{host}:{dst}"])
+            mk = subprocess.run(["ssh", host, f"mkdir -p {shlex.quote(dst)}"])
+            if mk.returncode != 0:
+                raise RuntimeError(f"ssh {host} mkdir -p {dst} failed (rc={mk.returncode})")
+            proc = subprocess.run(["scp", "-q", src_file, f"{host}:{shlex.quote(dst)}"])
             if proc.returncode != 0:
                 raise RuntimeError(
                     f"scp {src_file} -> {host}:{dst} failed (rc={proc.returncode})"

@@ -92,6 +92,33 @@ def _exec_argv(exec_file: str, flags: Sequence[str]) -> List[str]:
     return [sys.executable, exec_file, *flags]
 
 
+def _is_local_host(ip: str) -> bool:
+    """Does ``ip`` name the machine the launcher runs on?"""
+    if ip in ("127.0.0.1", "localhost"):
+        return True
+    import socket
+
+    try:
+        local_names = {socket.gethostname(), socket.getfqdn()}
+        local_addrs = set()
+        for name in list(local_names):
+            local_addrs.update(socket.gethostbyname_ex(name)[2])
+        return ip in local_names or ip in local_addrs
+    except OSError:
+        return False
+
+
+def _virtual_env(num_chips: int) -> Dict[str, str]:
+    """Forced-CPU virtual-pod env for one process."""
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={num_chips}"
+        ).strip(),
+    }
+
+
 def build_launch_plan(
     args: argparse.Namespace, hosts: Optional[List[HostSpec]] = None
 ) -> List[Dict]:
@@ -109,13 +136,7 @@ def build_launch_plan(
 
     plan: List[Dict] = []
     if len(hosts) == 1:
-        env = {}
-        if args.virtual:
-            env["JAX_PLATFORMS"] = "cpu"
-            env["XLA_FLAGS"] = (
-                os.environ.get("XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count={hosts[0].num_chips}"
-            ).strip()
+        env = _virtual_env(hosts[0].num_chips) if args.virtual else {}
         plan.append({"host": hosts[0].ip, "cmd": argv, "env": env})
         return plan
 
@@ -129,13 +150,9 @@ def build_launch_plan(
             # fake multi-node on localhost: every process gets its own
             # forced-CPU device set, joined through the coordinator (the
             # reference's -H 127.0.0.1:4,127.0.0.1:4 localhost launches)
-            env["JAX_PLATFORMS"] = "cpu"
-            env["XLA_FLAGS"] = (
-                os.environ.get("XLA_FLAGS", "")
-                + f" --xla_force_host_platform_device_count={h.num_chips}"
-            ).strip()
-        if idx == 0:
-            cmd = argv  # master process runs locally on the launch host
+            env.update(_virtual_env(h.num_chips))
+        if args.virtual or _is_local_host(h.ip):
+            cmd = argv  # local process; env rides the Popen env dict
         else:
             exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
             remote = " ".join(shlex.quote(a) for a in argv)

@@ -1,0 +1,129 @@
+"""Elastic image-classification training — the reference main_elastic.py flow.
+
+Reference behavior (models/image-classification/main_elastic.py): torchrun
+elastic workers restore the newest checkpoint at rendezvous, train epochs
+with DDP, atomically checkpoint each epoch, and survive ``--max_restarts``
+crashes.  Here the worker trains a VGG classifier under the AdapCC DDP
+trainer, checkpoints through :mod:`adapcc_tpu.checkpoint`, and the
+``--supervise`` mode wraps the worker in the elastic restart loop.
+
+Run (virtual pod):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+    python -m adapcc_tpu.workloads.main_elastic --epochs 3 --steps-per-epoch 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import optax
+
+from adapcc_tpu.checkpoint import (
+    TrainCheckpointState,
+    restore_newest_across_processes,
+    run_elastic,
+    save_checkpoint,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--steps-per-epoch", type=int, default=5)
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--checkpoint-file", type=str, default="/tmp/adapcc_elastic/checkpoint.ckpt")
+    p.add_argument("--world", type=int, default=None)
+    p.add_argument("--crash-at-epoch", type=int, default=None,
+                   help="fault injection: die after checkpointing this epoch")
+    p.add_argument("--supervise", action="store_true",
+                   help="run as the elastic supervisor wrapping a worker")
+    p.add_argument("--max-restarts", type=int, default=3)
+    return p
+
+
+def worker(args) -> int:
+    from adapcc_tpu.launch import maybe_initialize_distributed
+
+    maybe_initialize_distributed()
+
+    import jax
+    import jax.numpy as jnp
+
+    from adapcc_tpu.comm.mesh import build_world_mesh
+    from adapcc_tpu.ddp import DDPTrainer, TrainState
+    from adapcc_tpu.models.vgg import VGG11
+    from adapcc_tpu.strategy.ir import Strategy
+
+    mesh = build_world_mesh(args.world)
+    world = int(mesh.devices.size)
+
+    model = VGG11(num_classes=10, classifier_width=128, dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.normal(size=(args.batch, 32, 32, 3)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, 10, size=(args.batch,)))
+    params = model.init(jax.random.PRNGKey(0), images[:1])
+
+    def loss_fn(p, batch):
+        x, y = batch
+        logits = model.apply(p, x)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    tx = optax.sgd(args.lr, momentum=0.9)
+    trainer = DDPTrainer(loss_fn, tx, mesh, Strategy.ring(world))
+    train_state = TrainState.create(params, tx)
+
+    # rendezvous restore: newest checkpoint wins across the (new) world
+    ckpt = TrainCheckpointState(params=train_state.params, opt_state=train_state.opt_state)
+    ckpt = restore_newest_across_processes(ckpt, args.checkpoint_file)
+    start_epoch = ckpt.epoch + 1
+    if start_epoch > 0:
+        print(f"=> resuming from epoch {start_epoch}")
+        train_state = TrainState(
+            params=ckpt.params, opt_state=ckpt.opt_state, step=ckpt.step
+        )
+
+    for epoch in range(start_epoch, args.epochs):
+        for _ in range(args.steps_per_epoch):
+            train_state, loss = trainer.step(train_state, (images, labels))
+        print(f"epoch {epoch:3d}  loss {float(jnp.mean(loss)):.4f}  world={world}")
+
+        ckpt.params = train_state.params
+        ckpt.opt_state = train_state.opt_state
+        ckpt.epoch = epoch
+        ckpt.step = int(train_state.step)
+        save_checkpoint(ckpt, args.checkpoint_file)
+
+        # fault injection fires only in the first generation, so the
+        # supervisor's restart actually makes progress past the crash point
+        gen = int(os.environ.get("ADAPCC_RESTART_GEN", "0"))
+        if args.crash_at_epoch is not None and epoch == args.crash_at_epoch and gen == 0:
+            print(f"=> injected fault at epoch {epoch}", flush=True)
+            return 17  # nonzero: the supervisor restarts us
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.supervise:
+        worker_argv = [
+            sys.executable, "-m", "adapcc_tpu.workloads.main_elastic",
+            "--epochs", str(args.epochs),
+            "--steps-per-epoch", str(args.steps_per_epoch),
+            "--batch", str(args.batch),
+            "--lr", str(args.lr),
+            "--checkpoint-file", args.checkpoint_file,
+        ]
+        if args.world:
+            worker_argv += ["--world", str(args.world)]
+        if args.crash_at_epoch is not None:
+            worker_argv += ["--crash-at-epoch", str(args.crash_at_epoch)]
+        return run_elastic(worker_argv, max_restarts=args.max_restarts)
+    return worker(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,194 @@
+"""Checkpoint/resume + elastic recovery tests (reference main_elastic.py
+State/save_checkpoint/load_checkpoint semantics)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from adapcc_tpu.checkpoint import (
+    CheckpointManager,
+    TrainCheckpointState,
+    load_checkpoint,
+    restore_newest_across_processes,
+    run_elastic,
+    save_checkpoint,
+)
+
+
+def _params(seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return {
+        "dense": {"kernel": jnp.asarray(rng.normal(size=(4, 3)) * scale, jnp.float32)},
+        "bias": jnp.zeros((3,), jnp.float32),
+    }
+
+
+def _assert_tree_equal(a, b):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)), a, b
+    )
+
+
+def test_snapshot_roundtrip():
+    s0 = TrainCheckpointState(params=_params(), epoch=4, step=100, best_metric=0.9)
+    s1 = TrainCheckpointState(params=_params(seed=1))
+    s1.apply_snapshot(s0.capture_snapshot())
+    assert (s1.epoch, s1.step, s1.best_metric) == (4, 100, 0.9)
+    _assert_tree_equal(s0.params, s1.params)
+
+
+def test_bytes_roundtrip_through_template():
+    s0 = TrainCheckpointState(params=_params(scale=2.0), epoch=7)
+    blob = s0.to_bytes()
+    s1 = TrainCheckpointState(params=_params(seed=3))
+    s1.load_bytes(blob)
+    assert s1.epoch == 7
+    _assert_tree_equal(s0.params, s1.params)
+
+
+def test_save_is_atomic_and_best_copied(tmp_path):
+    path = str(tmp_path / "ckpt" / "checkpoint.ckpt")
+    s = TrainCheckpointState(params=_params(), epoch=1)
+    save_checkpoint(s, path, is_best=True)
+    assert os.path.exists(path)
+    assert not os.path.exists(path + ".tmp")  # tmp committed by rename
+    assert os.path.exists(str(tmp_path / "ckpt" / "model_best.ckpt"))
+
+    s2 = TrainCheckpointState(params=_params(seed=5))
+    assert load_checkpoint(s2, path)
+    assert s2.epoch == 1
+    _assert_tree_equal(s.params, s2.params)
+
+
+def test_load_missing_returns_false(tmp_path):
+    s = TrainCheckpointState(params=_params())
+    assert not load_checkpoint(s, str(tmp_path / "nope.ckpt"))
+    assert s.epoch == -1
+
+
+def test_restore_newest_single_process(tmp_path):
+    path = str(tmp_path / "c.ckpt")
+    saved = TrainCheckpointState(params=_params(scale=3.0), epoch=2, step=50)
+    save_checkpoint(saved, path)
+    s = TrainCheckpointState(params=_params(seed=9))
+    out = restore_newest_across_processes(s, path)
+    assert out.epoch == 2 and out.step == 50
+
+
+def test_checkpoint_state_carries_opt_state(tmp_path):
+    params = _params()
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+    s = TrainCheckpointState(params=params, opt_state=opt_state, epoch=0)
+    path = str(tmp_path / "c.ckpt")
+    save_checkpoint(s, path)
+    s2 = TrainCheckpointState(params=_params(seed=2), opt_state=tx.init(_params(seed=2)))
+    assert load_checkpoint(s2, path)
+    # adam mu/nu restored exactly
+    _assert_tree_equal(s.opt_state, s2.opt_state)
+
+
+def test_orbax_manager_latest_and_retention(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "steps"), max_to_keep=2)
+    s = TrainCheckpointState(params=_params(), epoch=0)
+    for step in (1, 2, 3):
+        s.step = step
+        s.epoch = step
+        mgr.save(step, s)
+    assert mgr.latest_step() == 3
+
+    s2 = TrainCheckpointState(params=_params(seed=4))
+    assert mgr.restore(s2)
+    assert s2.step == 3 and s2.epoch == 3
+    _assert_tree_equal(s.params, s2.params)
+    # retention bounded
+    kept = [p for p in os.listdir(tmp_path / "steps") if p.isdigit()]
+    assert sorted(kept) == ["2", "3"]
+    mgr.close()
+
+
+def test_orbax_manager_empty_restore(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "steps"))
+    s = TrainCheckpointState(params=_params())
+    assert mgr.restore(s) is False
+    mgr.close()
+
+
+def test_run_elastic_restarts_until_success():
+    calls = []
+
+    def spawn(cmd, env):
+        calls.append(env["ADAPCC_RESTART_GEN"])
+        return 0 if len(calls) >= 3 else 17
+
+    rc = run_elastic(["worker"], max_restarts=3, restart_delay_s=0, _spawn=spawn)
+    assert rc == 0
+    assert calls == ["0", "1", "2"]  # generation counter advances per restart
+
+
+def test_run_elastic_gives_up_after_max_restarts():
+    def spawn(cmd, env):
+        return 17
+
+    rc = run_elastic(["worker"], max_restarts=2, restart_delay_s=0, _spawn=spawn)
+    assert rc == 17
+
+
+def test_elastic_workload_survives_injected_crash(tmp_path):
+    """E2E: supervised worker crashes after checkpointing epoch 0, restarts,
+    and resumes from epoch 1 (main_elastic.py torchrun-elastic flow)."""
+    import subprocess
+    import sys
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    }
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "adapcc_tpu.workloads.main_elastic",
+            "--supervise", "--epochs", "2", "--steps-per-epoch", "2",
+            "--world", "2", "--batch", "8", "--crash-at-epoch", "0",
+            "--checkpoint-file", str(tmp_path / "checkpoint.ckpt"),
+        ],
+        capture_output=True, text=True, cwd="/root/repo", env=env, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "injected fault at epoch 0" in out.stdout
+    assert "resuming from epoch 1" in out.stdout
+    assert "epoch   1" in out.stdout
+
+
+def test_restore_newest_multiprocess_broadcast(tmp_path, monkeypatch):
+    """Two fake processes: rank 1 has the newer checkpoint; rank 0 adopts it
+    through the KV store (the reference's max-epoch gloo broadcast)."""
+    jax.devices()
+    from jax._src import distributed
+
+    from tests.test_launch import _FakeKVClient
+
+    kv = _FakeKVClient()
+    monkeypatch.setattr(distributed.global_state, "client", kv)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+    # rank 1 goes first (has epoch 5 on disk), publishing its epoch + blob
+    path1 = str(tmp_path / "r1.ckpt")
+    save_checkpoint(TrainCheckpointState(params=_params(scale=5.0), epoch=5), path1)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    s1 = TrainCheckpointState(params=_params(seed=7))
+    # publish rank-0's epoch before rank-1 gathers, to avoid blocking
+    kv.store["adapcc/elastic/g0/epoch/0"] = "-1"
+    restore_newest_across_processes(s1, path1)
+    assert s1.epoch == 5
+
+    # rank 0 has no checkpoint and fetches the blob rank 1 published
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    s0 = TrainCheckpointState(params=_params(seed=8))
+    restore_newest_across_processes(s0, str(tmp_path / "r0.ckpt"))
+    assert s0.epoch == 5
+    _assert_tree_equal(s0.params, s1.params)

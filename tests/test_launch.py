@@ -78,10 +78,12 @@ def test_master_host_ordered_first():
     hosts = order_hosts(parse_ips(args.ips), args.master)
     assert hosts[0].ip == "10.0.0.2"
     plan = build_launch_plan(args)
-    # master process (idx 0) runs locally on the master host; the other is ssh'd
+    # master host is first (process 0); both are remote from this launch
+    # machine, so both are ssh-wrapped — the coordinator must bind on the
+    # master host itself, not wherever the launcher runs
     assert plan[0]["host"] == "10.0.0.2"
-    assert plan[0]["cmd"][0] != "ssh"
-    assert plan[1]["cmd"][0] == "ssh"
+    assert plan[0]["cmd"][0] == "ssh" and plan[0]["cmd"][1] == "10.0.0.2"
+    assert plan[1]["cmd"][0] == "ssh" and plan[1]["cmd"][1] == "10.0.0.1"
     assert plan[0]["env"]["JAX_COORDINATOR_ADDRESS"] == "10.0.0.2:8476"
 
 
@@ -90,9 +92,9 @@ def test_module_exec_file_expands_for_remote_hosts():
         ["--ips", "10.0.0.1:1,10.0.0.2:1", "--exec-file", "-m adapcc_tpu.workloads.train_ddp"]
     )
     plan = build_launch_plan(args)
-    assert plan[0]["cmd"][1:3] == ["-m", "adapcc_tpu.workloads.train_ddp"]
-    # ssh command line carries the -m module launch too
-    assert "-m adapcc_tpu.workloads.train_ddp" in plan[1]["cmd"][2]
+    # every remote ssh command line carries the -m module launch
+    for rec in plan:
+        assert "-m adapcc_tpu.workloads.train_ddp" in rec["cmd"][2]
 
 
 def test_ssh_command_quotes_paths_with_spaces():
@@ -215,7 +217,7 @@ def test_profile_exit_disseminates_strategy_and_chunk_bytes(tmp_path, monkeypatc
     from adapcc_tpu.primitives import PROFILE
 
     comm.exit_threads(PROFILE)
-    published = [k for k in fake_kv.store if k.startswith("adapcc/strategy@r")]
+    published = [k for k in fake_kv.store if k.startswith("adapcc/strategy/g")]
     assert len(published) == 2  # file + chunk_bytes under one round key
     round_key = min(published, key=len)
 
