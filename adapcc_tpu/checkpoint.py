@@ -22,6 +22,7 @@ import shutil
 import subprocess
 import sys
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -90,7 +91,10 @@ def save_checkpoint(
         f.write(state.to_bytes())
     os.rename(tmp_filename, filename)
     if is_best:
-        shutil.copyfile(filename, os.path.join(checkpoint_dir, "model_best.ckpt"))
+        best = os.path.join(checkpoint_dir, "model_best.ckpt")
+        best_tmp = f"{best}.tmp.{os.getpid()}"
+        shutil.copyfile(filename, best_tmp)
+        os.rename(best_tmp, best)
 
 
 def load_checkpoint(state: TrainCheckpointState, filename: str) -> bool:
@@ -103,6 +107,9 @@ def load_checkpoint(state: TrainCheckpointState, filename: str) -> bool:
 
 
 # --- newest-checkpoint rendezvous broadcast (main_elastic.py:306-385) ---------
+
+#: base64 chars per KV-store blob chunk (~2 MB < the ~4 MB gRPC message cap)
+_BLOB_CHUNK_CHARS = 2 * 1024 * 1024
 
 
 def restore_newest_across_processes(
@@ -130,19 +137,36 @@ def restore_newest_across_processes(
     prefix = f"adapcc/elastic/g{gen}"
 
     publish_value(f"{prefix}/epoch/{me}", str(state.epoch))
-    epochs = [int(fetch_value(f"{prefix}/epoch/{p}", timeout_ms)) for p in range(n)]
+    with ThreadPoolExecutor(max_workers=min(32, n)) as pool:
+        epochs = list(
+            pool.map(
+                lambda p: int(fetch_value(f"{prefix}/epoch/{p}", timeout_ms)), range(n)
+            )
+        )
     max_epoch = max(epochs)
     if max_epoch < 0:
         return state  # nobody has a checkpoint: fresh start everywhere
     max_rank = epochs.index(max_epoch)
 
     # ranks already at max_epoch (shared-fs steady state: all of them) need no
-    # blob; the holder publishes only if someone is actually behind
+    # blob; the holder publishes only if someone is actually behind.  The blob
+    # is chunked: the KV store carries values over gRPC, whose message cap a
+    # single whole-checkpoint string would blow past on any real model.
     if me == max_rank and min(epochs) < max_epoch:
-        publish_value(f"{prefix}/blob", base64.b64encode(state.to_bytes()).decode())
+        encoded = base64.b64encode(state.to_bytes()).decode()
+        chunks = [
+            encoded[i : i + _BLOB_CHUNK_CHARS]
+            for i in range(0, len(encoded), _BLOB_CHUNK_CHARS)
+        ] or [""]
+        publish_value(f"{prefix}/blob/count", str(len(chunks)))
+        for i, chunk in enumerate(chunks):
+            publish_value(f"{prefix}/blob/{i}", chunk)
     elif state.epoch < max_epoch:
-        blob = fetch_value(f"{prefix}/blob", timeout_ms)
-        state.load_bytes(base64.b64decode(blob))
+        count = int(fetch_value(f"{prefix}/blob/count", timeout_ms))
+        encoded = "".join(
+            fetch_value(f"{prefix}/blob/{i}", timeout_ms) for i in range(count)
+        )
+        state.load_bytes(base64.b64decode(encoded))
     return state
 
 

@@ -21,6 +21,7 @@ instead — the analog of the reference's fake multi-node localhost launches
 from __future__ import annotations
 
 import argparse
+import functools
 import os
 import shlex
 import subprocess
@@ -92,20 +93,44 @@ def _exec_argv(exec_file: str, flags: Sequence[str]) -> List[str]:
     return [sys.executable, exec_file, *flags]
 
 
-def _is_local_host(ip: str) -> bool:
-    """Does ``ip`` name the machine the launcher runs on?"""
-    if ip in ("127.0.0.1", "localhost"):
-        return True
+@functools.lru_cache(maxsize=1)
+def _local_identities() -> frozenset:
+    """Every name/address this machine answers to, computed once per process.
+
+    DNS of the hostname alone is unreliable (Debian maps the hostname to
+    127.0.1.1; interface IPs often have no PTR/A records), so also discover
+    the primary interface addresses via the UDP connect trick — no packets
+    are sent, the kernel just picks the source address it would route with.
+    """
     import socket
 
+    ids = set()
     try:
-        local_names = {socket.gethostname(), socket.getfqdn()}
-        local_addrs = set()
-        for name in list(local_names):
-            local_addrs.update(socket.gethostbyname_ex(name)[2])
-        return ip in local_names or ip in local_addrs
+        ids.add(socket.gethostname())
+        ids.add(socket.getfqdn())
+        for name in list(ids):
+            try:
+                ids.update(socket.gethostbyname_ex(name)[2])
+            except OSError:
+                pass
     except OSError:
-        return False
+        pass
+    for probe in ("8.8.8.8", "2001:4860:4860::8888"):
+        fam = socket.AF_INET6 if ":" in probe else socket.AF_INET
+        try:
+            with socket.socket(fam, socket.SOCK_DGRAM) as s:
+                s.connect((probe, 80))
+                ids.add(s.getsockname()[0])
+        except OSError:
+            pass
+    return frozenset(ids)
+
+
+def _is_local_host(ip: str) -> bool:
+    """Does ``ip`` name the machine the launcher runs on?"""
+    if ip in ("127.0.0.1", "::1", "localhost"):
+        return True
+    return ip in _local_identities()
 
 
 def _virtual_env(num_chips: int) -> Dict[str, str]:

@@ -1,0 +1,26 @@
+"""Benchmark suite (the analog of the reference's nccl-perf/ tree).
+
+The reference ships a fork of NVIDIA nccl-tests plus hand-written chunked-tree
+prototypes as its baseline/competitive harness (SURVEY.md §4.4, §6).  Here the
+same role is played by :mod:`benchmarks.collectives`: a message-size sweep over
+every collective the engine provides, reporting algbw/busbw with the standard
+nccl-tests correction factors (nccl-perf/benchmark/PERFORMANCE.md), comparing
+the framework's strategy-shaped schedules against raw XLA collectives and the
+Pallas ring kernel on the same mesh.
+"""
+
+from benchmarks.collectives import (
+    BUS_FACTORS,
+    BenchResult,
+    format_table,
+    parse_size,
+    run_sweep,
+)
+
+__all__ = [
+    "BUS_FACTORS",
+    "BenchResult",
+    "format_table",
+    "parse_size",
+    "run_sweep",
+]

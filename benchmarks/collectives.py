@@ -1,0 +1,210 @@
+"""Collective busbw/algbw sweep — the nccl-tests analog for the TPU engine.
+
+Measures every primitive the engine exposes across a message-size sweep and
+reports nccl-tests-style numbers (nccl-perf/benchmark/PERFORMANCE.md):
+
+    algbw = bytes_moved / time
+    busbw = algbw × correction_factor
+
+with the standard per-collective correction factors — AllReduce ``2(n-1)/n``,
+ReduceScatter/AllGather/AllToAll ``(n-1)/n``, Broadcast/Reduce ``1`` — so
+numbers are directly comparable to the reference's NCCL baselines
+(nccl-perf/tree/report_allreduce.txt) and to any nccl-tests run.
+
+Three allreduce implementations are swept side by side:
+
+* ``xla`` — the ``lax.psum`` fast path (XLA's own ICI schedule),
+* ``strategy`` — the synthesized masked-ppermute tree schedule,
+* ``pallas_ring`` — the hand-written Pallas ring kernel.
+
+Bytes accounting per collective (``n`` = payload floats per rank, ``w`` =
+world): allreduce/broadcast/reduce move ``4n`` bytes per rank; all_gather's
+and all_to_all's payload is the full ``4·n·w`` exchanged volume;
+reduce_scatter's is its ``4n`` input per rank.
+
+Usage (real TPU or the virtual CPU pod)::
+
+    python -m benchmarks.collectives --world 8 --sizes 4K,1M,16M --iters 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import statistics
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: busbw = algbw × factor(world); nccl-perf/benchmark/PERFORMANCE.md:1-140
+BUS_FACTORS: Dict[str, Callable[[int], float]] = {
+    "allreduce": lambda w: 2 * (w - 1) / w,
+    "reduce_scatter": lambda w: (w - 1) / w,
+    "all_gather": lambda w: (w - 1) / w,
+    "all_to_all": lambda w: (w - 1) / w,
+    "broadcast": lambda w: 1.0,
+    "reduce": lambda w: 1.0,
+}
+
+
+@dataclasses.dataclass
+class BenchResult:
+    collective: str
+    impl: str
+    size_bytes: int  # bytes moved (see module docstring accounting)
+    world: int
+    time_us: float  # median per-op wall time
+    algbw_gbps: float
+    busbw_gbps: float
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+
+def parse_size(text: str) -> int:
+    """``"4K" → 4096``; accepts K/M/G suffixes (powers of 1024) or raw ints."""
+    text = text.strip().upper()
+    mult = 1
+    if text and text[-1] in "KMG":
+        mult = {"K": 1024, "M": 1024**2, "G": 1024**3}[text[-1]]
+        text = text[:-1]
+    return int(float(text) * mult)
+
+
+def _format_size(nbytes: int) -> str:
+    for unit, div in (("G", 1024**3), ("M", 1024**2), ("K", 1024)):
+        if nbytes >= div and nbytes % div == 0:
+            return f"{nbytes // div}{unit}"
+    return str(nbytes)
+
+
+def _time_op(fn: Callable[[], jnp.ndarray], iters: int, warmup: int) -> float:
+    """Median wall-clock seconds per op, after ``warmup`` compile/cache calls."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    samples = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        samples.append(time.perf_counter() - t0)
+    return statistics.median(samples)
+
+
+def _make_ops(engine, elems: int) -> Dict[str, tuple]:
+    """(callable, bytes_moved) per (collective, impl) for one message size."""
+    world = engine.world_size
+    itemsize = 4  # float32 sweep, matching nccl-tests' default dtype
+    rng = np.random.default_rng(elems)
+    flat = jnp.asarray(rng.normal(size=(world, elems)), jnp.float32)
+    per_rank = elems * itemsize
+    total = per_rank * world
+
+    ops: Dict[str, tuple] = {
+        ("allreduce", "xla"): (lambda: engine.all_reduce(flat), per_rank),
+        ("allreduce", "strategy"): (
+            lambda: engine.all_reduce(flat, active_gpus=list(range(world))),
+            per_rank,
+        ),
+        ("allreduce", "pallas_ring"): (lambda: engine.ring_allreduce(flat), per_rank),
+        ("reduce", "strategy"): (lambda: engine.reduce(flat), per_rank),
+        ("broadcast", "strategy"): (lambda: engine.boardcast(flat), per_rank),
+        ("all_gather", "xla"): (lambda: engine.all_gather(flat), total),
+        ("reduce_scatter", "xla"): (lambda: engine.reduce_scatter(flat), per_rank),
+    }
+    if elems % world == 0:
+        blocked = flat.reshape(world, world, elems // world)
+        ops[("all_to_all", "xla")] = (lambda: engine.all_to_all(blocked), total)
+    return ops
+
+
+def run_sweep(
+    engine,
+    sizes_bytes: Sequence[int],
+    collectives: Optional[Sequence[str]] = None,
+    impls: Optional[Sequence[str]] = None,
+    iters: int = 20,
+    warmup: int = 2,
+) -> List[BenchResult]:
+    """Sweep ``sizes_bytes`` (per-rank payload bytes) over the engine's ops."""
+    world = engine.world_size
+    results: List[BenchResult] = []
+    for nbytes in sizes_bytes:
+        elems = max(1, nbytes // 4)
+        for (coll, impl), (fn, moved) in _make_ops(engine, elems).items():
+            if collectives and coll not in collectives:
+                continue
+            if impls and impl not in impls:
+                continue
+            sec = _time_op(fn, iters, warmup)
+            algbw = moved / sec / 1e9
+            results.append(
+                BenchResult(
+                    collective=coll,
+                    impl=impl,
+                    size_bytes=moved,
+                    world=world,
+                    time_us=sec * 1e6,
+                    algbw_gbps=algbw,
+                    busbw_gbps=algbw * BUS_FACTORS[coll](world),
+                )
+            )
+    return results
+
+
+def format_table(results: Sequence[BenchResult]) -> str:
+    """nccl-tests-style report table."""
+    lines = [
+        f"{'collective':<15}{'impl':<13}{'size':>8}{'time(us)':>12}"
+        f"{'algbw(GB/s)':>13}{'busbw(GB/s)':>13}"
+    ]
+    for r in results:
+        lines.append(
+            f"{r.collective:<15}{r.impl:<13}{_format_size(r.size_bytes):>8}"
+            f"{r.time_us:>12.1f}{r.algbw_gbps:>13.3f}{r.busbw_gbps:>13.3f}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.comm.mesh import build_world_mesh
+    from adapcc_tpu.strategy.ir import Strategy
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--world", type=int, default=0, help="mesh size (default: all devices)")
+    ap.add_argument("--sizes", default="4K,64K,1M,16M", help="comma list, K/M/G suffixes")
+    ap.add_argument("--collectives", default="", help="comma subset (default: all)")
+    ap.add_argument("--impls", default="", help="comma subset of xla,strategy,pallas_ring")
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--strategy", choices=["ring", "binary"], default="binary")
+    ap.add_argument("--json", action="store_true", help="emit JSON lines instead of a table")
+    args = ap.parse_args(argv)
+
+    world = args.world or len(jax.devices())
+    mesh = build_world_mesh(world)
+    strategy = Strategy.ring(world) if args.strategy == "ring" else Strategy.binary(world)
+    engine = CollectiveEngine(mesh, strategy)
+
+    results = run_sweep(
+        engine,
+        [parse_size(s) for s in args.sizes.split(",") if s],
+        collectives=[c for c in args.collectives.split(",") if c] or None,
+        impls=[i for i in args.impls.split(",") if i] or None,
+        iters=args.iters,
+        warmup=args.warmup,
+    )
+    if args.json:
+        for r in results:
+            print(r.to_json())
+    else:
+        print(f"# world={world} platform={jax.devices()[0].platform}")
+        print(format_table(results))
+
+
+if __name__ == "__main__":
+    main()

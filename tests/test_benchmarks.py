@@ -1,0 +1,81 @@
+"""Benchmark harness tests on the virtual CPU pod (tiny sizes)."""
+
+import numpy as np
+import pytest
+
+from benchmarks.collectives import (
+    BUS_FACTORS,
+    format_table,
+    parse_size,
+    run_sweep,
+)
+
+
+def test_parse_size():
+    assert parse_size("4K") == 4096
+    assert parse_size("1M") == 1024**2
+    assert parse_size("2g") == 2 * 1024**3
+    assert parse_size("512") == 512
+
+
+def test_bus_factors_match_nccl_tests():
+    # PERFORMANCE.md: AllReduce 2(n-1)/n, RS/AG (n-1)/n, Bcast/Reduce 1
+    assert BUS_FACTORS["allreduce"](8) == pytest.approx(2 * 7 / 8)
+    assert BUS_FACTORS["all_gather"](8) == pytest.approx(7 / 8)
+    assert BUS_FACTORS["reduce_scatter"](4) == pytest.approx(3 / 4)
+    assert BUS_FACTORS["broadcast"](16) == 1.0
+
+
+@pytest.fixture(scope="module")
+def engine(request):
+    import jax
+
+    from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.comm.mesh import build_world_mesh
+    from adapcc_tpu.strategy.ir import Strategy
+
+    mesh = build_world_mesh(4, jax.devices()[:4])
+    return CollectiveEngine(mesh, Strategy.binary(4))
+
+
+def test_run_sweep_all_collectives(engine):
+    results = run_sweep(engine, [256], iters=2, warmup=1)
+    colls = {r.collective for r in results}
+    assert colls == {
+        "allreduce",
+        "reduce",
+        "broadcast",
+        "all_gather",
+        "reduce_scatter",
+        "all_to_all",
+    }
+    for r in results:
+        assert r.time_us > 0
+        assert r.algbw_gbps > 0
+        assert r.busbw_gbps == pytest.approx(
+            r.algbw_gbps * BUS_FACTORS[r.collective](r.world)
+        )
+
+
+def test_run_sweep_filters(engine):
+    results = run_sweep(
+        engine, [128], collectives=["allreduce"], impls=["xla", "strategy"], iters=1, warmup=1
+    )
+    assert {r.collective for r in results} == {"allreduce"}
+    assert {r.impl for r in results} == {"xla", "strategy"}
+
+
+def test_format_table(engine):
+    results = run_sweep(engine, [128], collectives=["broadcast"], iters=1, warmup=1)
+    table = format_table(results)
+    assert "busbw(GB/s)" in table
+    assert "broadcast" in table
+
+
+def test_json_roundtrip(engine):
+    import json
+
+    results = run_sweep(engine, [128], collectives=["reduce"], iters=1, warmup=1)
+    rec = json.loads(results[0].to_json())
+    assert rec["collective"] == "reduce"
+    assert rec["world"] == 4

@@ -55,7 +55,8 @@ def test_save_is_atomic_and_best_copied(tmp_path):
     s = TrainCheckpointState(params=_params(), epoch=1)
     save_checkpoint(s, path, is_best=True)
     assert os.path.exists(path)
-    assert not os.path.exists(path + ".tmp")  # tmp committed by rename
+    # tmp files (pid-suffixed) all committed by rename, none leaked
+    assert not list((tmp_path / "ckpt").glob("*.tmp.*"))
     assert os.path.exists(str(tmp_path / "ckpt" / "model_best.ckpt"))
 
     s2 = TrainCheckpointState(params=_params(seed=5))
@@ -191,4 +192,34 @@ def test_restore_newest_multiprocess_broadcast(tmp_path, monkeypatch):
     s0 = TrainCheckpointState(params=_params(seed=8))
     restore_newest_across_processes(s0, str(tmp_path / "r0.ckpt"))
     assert s0.epoch == 5
+    _assert_tree_equal(s0.params, s1.params)
+
+
+def test_restore_broadcast_chunks_large_blobs(tmp_path, monkeypatch):
+    """Snapshots bigger than one KV value are split into chunked keys (gRPC
+    message caps); rank 0 reassembles them in order."""
+    import adapcc_tpu.checkpoint as ckpt_mod
+
+    jax.devices()
+    from jax._src import distributed
+
+    from tests.test_launch import _FakeKVClient
+
+    kv = _FakeKVClient()
+    monkeypatch.setattr(distributed.global_state, "client", kv)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(ckpt_mod, "_BLOB_CHUNK_CHARS", 64)  # force many chunks
+
+    path1 = str(tmp_path / "r1.ckpt")
+    save_checkpoint(TrainCheckpointState(params=_params(scale=2.0), epoch=3), path1)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    kv.store["adapcc/elastic/g0/epoch/0"] = "-1"
+    s1 = TrainCheckpointState(params=_params(seed=11))
+    restore_newest_across_processes(s1, path1)
+    assert int(kv.store["adapcc/elastic/g0/blob/count"]) > 1
+
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    s0 = TrainCheckpointState(params=_params(seed=12))
+    restore_newest_across_processes(s0, str(tmp_path / "r0.ckpt"))
+    assert s0.epoch == 3
     _assert_tree_equal(s0.params, s1.params)
