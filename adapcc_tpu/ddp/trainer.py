@@ -19,6 +19,7 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import struct
 from jax.sharding import Mesh, PartitionSpec as P
@@ -61,6 +62,7 @@ class DDPTrainer:
         # should turn it on for in-place updates
         donate_state: bool = False,
         sync_mode: str = "auto",
+        measure_gns: bool = False,
     ) -> None:
         self.loss_fn = loss_fn
         self.tx = tx
@@ -77,6 +79,18 @@ class DDPTrainer:
         )
         self._compiled: Optional[Callable] = None
         self._host_step = 0
+        # optional gradient-noise-scale measurement (units-test/get_gns.py):
+        # the per-rank vs allreduced gradient norms fall out of the sync step
+        # for free; the estimator is created at the first step, when the
+        # per-rank batch size is known
+        if measure_gns and mesh.devices.size < 2:
+            raise ValueError(
+                "measure_gns needs a multi-device mesh: the estimator contrasts "
+                "per-rank (small-batch) vs allreduced (big-batch) gradients"
+            )
+        self.measure_gns = measure_gns
+        self._gns: Optional[Any] = None
+        self._gns_pending: list = []
 
     # -- step program ----------------------------------------------------------
 
@@ -87,18 +101,24 @@ class DDPTrainer:
 
         def per_shard(state: TrainState, batch: Any, *mask: jnp.ndarray):
             loss, grads = jax.value_and_grad(self.loss_fn)(state.params, batch)
-            grads = self.hook.sync(grads, mask[0] if mask else None)
-            updates, opt_state = self.tx.update(grads, state.opt_state, state.params)
+            synced = self.hook.sync(grads, mask[0] if mask else None)
+            updates, opt_state = self.tx.update(synced, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             new_state = TrainState(params=params, opt_state=opt_state, step=state.step + 1)
+            if self.measure_gns:
+                from adapcc_tpu.measure.gns import ddp_grad_sq_norms
+
+                small, big = ddp_grad_sq_norms(grads, synced, self.axis_name)
+                return new_state, loss[None], jnp.stack([small, big])
             return new_state, loss[None]  # [1] per rank → stacked [world]
 
         in_specs = (P(), P(self.axis_name)) + ((P(),) if dynamic_mask else ())
+        out_specs = (P(), P(self.axis_name)) + ((P(),) if self.measure_gns else ())
         fn = jax.shard_map(
             per_shard,
             mesh=self.mesh,
             in_specs=in_specs,
-            out_specs=(P(), P(self.axis_name)),
+            out_specs=out_specs,
             # gradients pass through ppermute chains; jax cannot prove the
             # result replicated, but the allreduce guarantees it
             check_vma=False,
@@ -118,9 +138,39 @@ class DDPTrainer:
         idx = self._host_step if step_idx is None else step_idx
         self._host_step = idx + 1
         if self.hook.communicator is None:
-            return self._compiled(state, batch)
-        active_mask = self.hook.negotiate(idx)
-        return self._compiled(state, batch, active_mask)
+            active_mask = None
+            out = self._compiled(state, batch)
+        else:
+            active_mask = self.hook.negotiate(idx)
+            out = self._compiled(state, batch, active_mask)
+        if not self.measure_gns:
+            return out
+        new_state, loss, norms = out
+        self._record_gns(batch, norms, active_mask)
+        return new_state, loss
+
+    def _record_gns(self, batch: Any, norms: jnp.ndarray, active_mask) -> None:
+        if self._gns is None:
+            from adapcc_tpu.measure.gns import GNSEstimator
+
+            world = self.mesh.devices.size
+            b_big = jax.tree_util.tree_leaves(batch)[0].shape[0]
+            self._gns = GNSEstimator(b_small=max(1, b_big // world), b_big=b_big)
+        # partial-world steps break the estimator's batch-size accounting
+        # (synced averages only the active ranks), so only full-world steps
+        # contribute; norms stay on device until someone reads `gns`, keeping
+        # async dispatch intact (see the host-step comment above)
+        if active_mask is None or bool(np.asarray(active_mask).all()):
+            self._gns_pending.append(norms)
+
+    @property
+    def gns(self) -> Optional[Any]:
+        """The GNS estimator (flushes buffered per-step norms on access)."""
+        if self._gns is not None and self._gns_pending:
+            pending, self._gns_pending = self._gns_pending, []
+            for small, big in np.asarray(jax.device_get(pending)):
+                self._gns.update(small, big)
+        return self._gns
 
     # -- re-adaptation ---------------------------------------------------------
 

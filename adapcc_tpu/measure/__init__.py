@@ -1,0 +1,23 @@
+"""Measurement harnesses (the reference's units-test/ instrumentation suite).
+
+Three tools the reference keeps as standalone scripts become a library here:
+
+- :mod:`adapcc_tpu.measure.wait_time` — per-step worker-skew (straggler)
+  measurement with emulated heterogeneity (units-test/get_wait_time.py).
+- :mod:`adapcc_tpu.measure.throughput` — coordinator-timestamped training
+  throughput (units-test/throughput.py).
+- :mod:`adapcc_tpu.measure.gns` — gradient-noise-scale estimation
+  (units-test/get_gns.py).
+"""
+
+from adapcc_tpu.measure.gns import GNSEstimator, gns_from_norms
+from adapcc_tpu.measure.throughput import ThroughputMeter
+from adapcc_tpu.measure.wait_time import WaitTimeProbe, emulate_heterogeneous_steps
+
+__all__ = [
+    "GNSEstimator",
+    "gns_from_norms",
+    "ThroughputMeter",
+    "WaitTimeProbe",
+    "emulate_heterogeneous_steps",
+]

@@ -1,0 +1,81 @@
+"""Gradient-noise-scale (GNS) estimation (units-test/get_gns.py analog).
+
+The reference computes the GNS of a DDP run from two gradient-norm
+estimates per step — the per-worker gradient (small batch ``b``) and the
+allreduced gradient (large batch ``B = b × world``) — using the unbiased
+estimators of the large-batch-training noise model (get_gns.py:26-108):
+
+    |G|²  ≈ (B·|G_B|² − b·|G_b|²) / (B − b)
+    S     ≈ (|G_b|² − |G_B|²) / (1/b − 1/B)
+    B_noise = S / |G|²
+
+Both are noisy per step, so the estimator EMA-smooths S and |G|²
+*separately* before taking the ratio (the reference's running averages).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_sq_norm(tree: Any) -> jnp.ndarray:
+    """Σ‖leaf‖² over a pytree (one scalar)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves)
+
+
+def ddp_grad_sq_norms(
+    local_grads: Any, mean_grads: Any, axis_name: str
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(E|G_b|², |G_B|²) from inside shard_map: the small-batch norm is the
+    cross-rank average of each worker's local grad norm; the big-batch norm
+    is the norm of the already-averaged gradient."""
+    small = jax.lax.pmean(tree_sq_norm(local_grads), axis_name)
+    big = tree_sq_norm(mean_grads)
+    return small, big
+
+
+def gns_from_norms(
+    small_sq: float, big_sq: float, b_small: int, b_big: int
+) -> Tuple[float, float]:
+    """Unbiased (|G|², S) from one pair of norm estimates."""
+    if b_big <= b_small:
+        raise ValueError(f"need b_big > b_small, got {b_big} <= {b_small}")
+    g2 = (b_big * big_sq - b_small * small_sq) / (b_big - b_small)
+    s = (small_sq - big_sq) / (1.0 / b_small - 1.0 / b_big)
+    return g2, s
+
+
+class GNSEstimator:
+    """EMA-smoothed gradient noise scale over a training run.
+
+    ``update`` per step with the two squared norms (host floats or scalars
+    from :func:`ddp_grad_sq_norms`); read ``gns`` any time.
+    """
+
+    def __init__(self, b_small: int, b_big: int, ema: float = 0.9) -> None:
+        self.b_small = b_small
+        self.b_big = b_big
+        self.ema = ema
+        self._g2: Optional[float] = None
+        self._s: Optional[float] = None
+
+    def update(self, small_sq: float, big_sq: float) -> Optional[float]:
+        g2, s = gns_from_norms(float(small_sq), float(big_sq), self.b_small, self.b_big)
+        if self._g2 is None:
+            self._g2, self._s = g2, s
+        else:
+            self._g2 = self.ema * self._g2 + (1 - self.ema) * g2
+            self._s = self.ema * self._s + (1 - self.ema) * s
+        return self.gns
+
+    @property
+    def gns(self) -> Optional[float]:
+        """Current B_noise estimate (None before any update or while the
+        smoothed |G|² is ≤ 0, which happens early in noisy runs)."""
+        if self._g2 is None or self._g2 <= 0:
+            return None
+        return self._s / self._g2

@@ -1,0 +1,96 @@
+"""Per-step worker-skew (straggler) measurement.
+
+The reference instruments its DDP hook with gRPC timestamps and reports, per
+step, how long the fastest worker waits for the slowest (max−min arrival),
+optionally scaling one rank's compute by ``heter_alpha`` to emulate
+heterogeneity (units-test/get_wait_time.py:29-62,96-140; results
+wait_time_{homo,heter}_bc128.csv).
+
+Here the probe wraps :class:`~adapcc_tpu.coordinator.logic.CoordinatorLogic`:
+every ``hook_arrive`` stamps a host clock per (step, rank), and skew is
+computed from those stamps — the same measurement point as the reference
+(the moment a worker's backward pass finishes and it reports ready).
+"""
+
+from __future__ import annotations
+
+import csv
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence
+
+from adapcc_tpu.coordinator.logic import CoordinatorLogic
+
+
+class WaitTimeProbe:
+    """Records hook-arrival timestamps and derives per-step skew.
+
+    Use as a shim in front of the coordinator: call :meth:`hook_arrive`
+    wherever the training loop would call the coordinator's, or call
+    :meth:`stamp` directly from a custom hook.
+    """
+
+    def __init__(self, logic: Optional[CoordinatorLogic] = None) -> None:
+        self.logic = logic
+        self._lock = threading.Lock()
+        self._stamps: Dict[int, Dict[int, float]] = defaultdict(dict)
+
+    def stamp(self, step: int, rank: int, t: Optional[float] = None) -> None:
+        with self._lock:
+            self._stamps[step][rank] = time.monotonic() if t is None else t
+
+    def hook_arrive(self, step: int, rank: int) -> List[int]:
+        """Stamp, then forward to the wrapped coordinator (if any)."""
+        self.stamp(step, rank)
+        if self.logic is not None:
+            return self.logic.hook_arrive(step, rank)
+        return []
+
+    def wait_time(self, step: int) -> float:
+        """max−min arrival across ranks for ``step`` (0.0 if <2 arrivals)."""
+        with self._lock:
+            stamps = list(self._stamps.get(step, {}).values())
+        if len(stamps) < 2:
+            return 0.0
+        return max(stamps) - min(stamps)
+
+    def steps(self) -> List[int]:
+        with self._lock:
+            return sorted(self._stamps)
+
+    def write_csv(self, path: str) -> None:
+        """``step,wait_time_s`` rows — the reference's CSV shape."""
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["step", "wait_time_s"])
+            for step in self.steps():
+                w.writerow([step, f"{self.wait_time(step):.6f}"])
+
+
+def emulate_heterogeneous_steps(
+    probe: WaitTimeProbe,
+    world_size: int,
+    num_steps: int,
+    base_compute_s: float = 0.005,
+    heter_alpha: float = 1.0,
+    slow_ranks: Sequence[int] = (0,),
+) -> List[float]:
+    """Drive ``world_size`` emulated workers through ``num_steps`` hook
+    rounds; ``slow_ranks`` compute for ``base_compute_s × heter_alpha``
+    (everyone else ``base_compute_s``) — the reference's ``heter_alpha``
+    emulation (get_wait_time.py:60,103).  Returns the per-step wait times.
+    """
+
+    def worker(rank: int) -> None:
+        for step in range(num_steps):
+            delay = base_compute_s * (heter_alpha if rank in slow_ranks else 1.0)
+            time.sleep(delay)
+            probe.hook_arrive(step, rank)
+
+    threads = [threading.Thread(target=worker, args=(r,)) for r in range(world_size)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return [probe.wait_time(s) for s in range(num_steps)]

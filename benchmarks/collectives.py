@@ -39,6 +39,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 #: busbw = algbw × factor(world); nccl-perf/benchmark/PERFORMANCE.md:1-140
 BUS_FACTORS: Dict[str, Callable[[int], float]] = {
@@ -99,7 +100,12 @@ def _make_ops(engine, elems: int) -> Dict[str, tuple]:
     world = engine.world_size
     itemsize = 4  # float32 sweep, matching nccl-tests' default dtype
     rng = np.random.default_rng(elems)
-    flat = jnp.asarray(rng.normal(size=(world, elems)), jnp.float32)
+    # pre-place the payload with the engine's sharding: the timed region must
+    # cover the collective alone, not a per-call reshard of the input
+    sharding = NamedSharding(engine.mesh, P(engine.axis_name))
+    flat = jax.device_put(
+        np.asarray(rng.normal(size=(world, elems)), np.float32), sharding
+    )
     per_rank = elems * itemsize
     total = per_rank * world
 
@@ -116,7 +122,9 @@ def _make_ops(engine, elems: int) -> Dict[str, tuple]:
         ("reduce_scatter", "xla"): (lambda: engine.reduce_scatter(flat), per_rank),
     }
     if elems % world == 0:
-        blocked = flat.reshape(world, world, elems // world)
+        blocked = jax.device_put(
+            np.asarray(flat).reshape(world, world, elems // world), sharding
+        )
         ops[("all_to_all", "xla")] = (lambda: engine.all_to_all(blocked), total)
     return ops
 
