@@ -162,14 +162,20 @@ class DDPTrainer:
         # async dispatch intact (see the host-step comment above)
         if active_mask is None or bool(np.asarray(active_mask).all()):
             self._gns_pending.append(norms)
+            # bound retained device buffers on runs that never read `gns`
+            if len(self._gns_pending) > 256:
+                self._flush_gns()
 
-    @property
-    def gns(self) -> Optional[Any]:
-        """The GNS estimator (flushes buffered per-step norms on access)."""
+    def _flush_gns(self) -> None:
         if self._gns is not None and self._gns_pending:
             pending, self._gns_pending = self._gns_pending, []
             for small, big in np.asarray(jax.device_get(pending)):
                 self._gns.update(small, big)
+
+    @property
+    def gns(self) -> Optional[Any]:
+        """The GNS estimator (flushes buffered per-step norms on access)."""
+        self._flush_gns()
         return self._gns
 
     # -- re-adaptation ---------------------------------------------------------

@@ -80,13 +80,19 @@ def emulate_heterogeneous_steps(
     rounds; ``slow_ranks`` compute for ``base_compute_s × heter_alpha``
     (everyone else ``base_compute_s``) — the reference's ``heter_alpha``
     emulation (get_wait_time.py:60,103).  Returns the per-step wait times.
+
+    Workers barrier between steps, like a real DDP loop barriers on the
+    gradient allreduce: without it the straggler's lag would accumulate and
+    later steps would report the *cumulative* skew, not the per-step skew.
     """
+    barrier = threading.Barrier(world_size)
 
     def worker(rank: int) -> None:
         for step in range(num_steps):
             delay = base_compute_s * (heter_alpha if rank in slow_ranks else 1.0)
             time.sleep(delay)
             probe.hook_arrive(step, rank)
+            barrier.wait()
 
     threads = [threading.Thread(target=worker, args=(r,)) for r in range(world_size)]
     for t in threads:

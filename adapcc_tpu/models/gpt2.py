@@ -49,7 +49,9 @@ class CausalSelfAttention(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+    def __call__(
+        self, x: jnp.ndarray, deterministic: bool = True, decode: bool = False
+    ) -> jnp.ndarray:
         cfg = self.cfg
         B, T, C = x.shape
         head_dim = cfg.d_model // cfg.n_head
@@ -61,9 +63,42 @@ class CausalSelfAttention(nn.Module):
         v = v.reshape(B, T, cfg.n_head, head_dim)
 
         scale = 1.0 / np.sqrt(head_dim)
-        att = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-        causal = jnp.tril(jnp.ones((T, T), dtype=bool))
-        att = jnp.where(causal[None, None], att, -1e30)
+        if decode:
+            # single-token autoregressive step against a fixed-shape KV cache
+            # (static [max_seq] slots — no dynamic shapes under jit)
+            if T != 1:
+                raise ValueError(f"decode mode feeds one token at a time, got T={T}")
+            is_init = self.has_variable("cache", "cached_key")
+            cached_k = self.variable(
+                "cache", "cached_key",
+                jnp.zeros, (B, cfg.max_seq, cfg.n_head, head_dim), cfg.dtype,
+            )
+            cached_v = self.variable(
+                "cache", "cached_value",
+                jnp.zeros, (B, cfg.max_seq, cfg.n_head, head_dim), cfg.dtype,
+            )
+            cache_idx = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            if is_init:
+                idx = cache_idx.value
+                cached_k.value = jax.lax.dynamic_update_slice(
+                    cached_k.value, k.astype(cfg.dtype), (0, idx, 0, 0)
+                )
+                cached_v.value = jax.lax.dynamic_update_slice(
+                    cached_v.value, v.astype(cfg.dtype), (0, idx, 0, 0)
+                )
+                cache_idx.value = idx + 1
+                k, v = cached_k.value, cached_v.value
+                att = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+                valid = jnp.arange(cfg.max_seq) <= idx
+                att = jnp.where(valid[None, None, None], att, -1e30)
+            else:
+                att = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        else:
+            att = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+            causal = jnp.tril(jnp.ones((T, T), dtype=bool))
+            att = jnp.where(causal[None, None], att, -1e30)
         att = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
         att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
 
@@ -82,10 +117,12 @@ class Block(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
+    def __call__(
+        self, x: jnp.ndarray, deterministic: bool = True, decode: bool = False
+    ) -> jnp.ndarray:
         cfg = self.cfg
         x = x + CausalSelfAttention(cfg, name="attn")(
-            nn.LayerNorm(dtype=jnp.float32, name="ln1")(x), deterministic
+            nn.LayerNorm(dtype=jnp.float32, name="ln1")(x), deterministic, decode
         )
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         h = nn.Dense(4 * cfg.d_model, dtype=cfg.dtype, name="fc")(h)
@@ -103,8 +140,19 @@ class GPT2(nn.Module):
     cfg: GPT2Config
 
     @nn.compact
-    def __call__(self, tokens: jnp.ndarray, deterministic: bool = True) -> jnp.ndarray:
-        """``tokens [B, T] int32`` → logits ``[B, T, vocab] float32``."""
+    def __call__(
+        self,
+        tokens: jnp.ndarray,
+        deterministic: bool = True,
+        decode: bool = False,
+        pos: Optional[jnp.ndarray] = None,
+    ) -> jnp.ndarray:
+        """``tokens [B, T] int32`` → logits ``[B, T, vocab] float32``.
+
+        ``decode=True`` runs one-token autoregressive steps against a mutable
+        ``'cache'`` collection; ``pos`` (int32 scalar) is the absolute
+        position of the fed token (required in decode mode).
+        """
         cfg = self.cfg
         B, T = tokens.shape
 
@@ -122,14 +170,15 @@ class GPT2(nn.Module):
             dtype=cfg.dtype,
             name="wpe",
         )
-        x = wte(tokens) + wpe(jnp.arange(T))[None]
+        positions = jnp.arange(T) if pos is None else jnp.asarray(pos).reshape((1,))
+        x = wte(tokens) + wpe(positions)[None]
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, static_argnums=(2,))
+            block = nn.remat(Block, static_argnums=(2, 3))
         for i in range(cfg.n_layer):
-            x = block(cfg, name=f"h{i}")(x, deterministic)
+            x = block(cfg, name=f"h{i}")(x, deterministic, decode)
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         # weight-tied LM head

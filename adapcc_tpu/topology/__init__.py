@@ -2,10 +2,14 @@
 
 from adapcc_tpu.topology.detect import detect_topology, dump_detected_topology, gather_detect_graph
 from adapcc_tpu.topology.profile import NetworkProfiler
+from adapcc_tpu.topology.variability import VariabilityMonitor, detect_drift, load_trace
 
 __all__ = [
     "detect_topology",
     "dump_detected_topology",
     "gather_detect_graph",
     "NetworkProfiler",
+    "VariabilityMonitor",
+    "detect_drift",
+    "load_trace",
 ]

@@ -33,6 +33,11 @@ BANDWIDTH_PROBE_FLOATS = 1 << 20
 _LAT, _BW = "lat", "bw"
 
 
+def bandwidth_gbps(nbytes: int, seconds: float) -> float:
+    """Transfer rate in GB/s (decimal), guarded against zero timings."""
+    return nbytes / max(seconds, 1e-9) / 1e9
+
+
 class NetworkProfiler:
     """Measures per-link latency (s) and bandwidth (GB/s) over a world mesh."""
 
@@ -69,6 +74,14 @@ class NetworkProfiler:
             jax.block_until_ready(fn(x))
         return (time.perf_counter() - t0) / self.iters
 
+    def make_probe(self, offset: int, n_floats: int):
+        """A reusable zero-arg probe: each call times one ring-offset round
+        and returns seconds.  Build once, call many — the compiled program is
+        captured, so repeated sampling (e.g. the variability monitor) never
+        re-traces."""
+        fn, x = self._offset_shift_fn(offset, n_floats)
+        return lambda: self._time(fn, x)
+
     # -- matrix profiling ------------------------------------------------------
 
     def profile(self) -> Tuple[np.ndarray, np.ndarray]:
@@ -83,12 +96,9 @@ class NetworkProfiler:
         if world == 1:
             return lat, bw
         for offset in range(1, world):
-            fn_l, x_l = self._offset_shift_fn(offset, LATENCY_PROBE_FLOATS)
-            t_lat = self._time(fn_l, x_l)
-            fn_b, x_b = self._offset_shift_fn(offset, BANDWIDTH_PROBE_FLOATS)
-            t_bw = self._time(fn_b, x_b)
-            nbytes = BANDWIDTH_PROBE_FLOATS * 4
-            gbps = nbytes / max(t_bw, 1e-9) / 1e9
+            t_lat = self.make_probe(offset, LATENCY_PROBE_FLOATS)()
+            t_bw = self.make_probe(offset, BANDWIDTH_PROBE_FLOATS)()
+            gbps = bandwidth_gbps(BANDWIDTH_PROBE_FLOATS * 4, t_bw)
             for src in range(world):
                 dst = (src + offset) % world
                 lat[src][dst] = t_lat

@@ -1,0 +1,151 @@
+"""Long-horizon network-variability probes (the reference's cloud/ study).
+
+The reference characterizes cloud interconnect variability with iperf probes
+fired every 5 s for hours, logging timestamped bandwidth/latency readings to
+trace files (cloud/band_profile.py:16-30, traces under cloud/trace/) — the
+evidence that motivates periodic re-adaptation (``profile_freq``).  The TPU
+analog samples the mesh's links with the same one-hop ``ppermute`` probes the
+online profiler uses, on a background thread, appending to trace files of the
+same shape; drift detection over the trace decides when a re-profile +
+re-synthesis (``reconstruct_topology``) is worth its cost.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from jax.sharding import Mesh
+
+from adapcc_tpu.comm.mesh import RANKS_AXIS
+from adapcc_tpu.topology.profile import (
+    LATENCY_PROBE_FLOATS,
+    NetworkProfiler,
+    bandwidth_gbps,
+)
+
+
+def detect_drift(
+    history: Sequence[float], threshold: float = 0.3, window: int = 12
+) -> bool:
+    """Has the newest reading drifted > ``threshold`` (relative) from the
+    median of the trailing ``window``?  The trigger condition for
+    re-adaptation: a sustained bandwidth dip like the reference's observed
+    14.7 → 1.7 GB-scale drops (cloud/trace/bandwidth-hw.txt)."""
+    if len(history) < 2:
+        return False
+    base = statistics.median(history[-window - 1 : -1])
+    if base <= 0:
+        return False
+    return abs(history[-1] - base) / base > threshold
+
+
+class VariabilityMonitor:
+    """Periodic link sampling with timestamped traces.
+
+    One sample = one ring-offset-1 probe round (every neighbor link at once,
+    the cheapest full-coverage probe): a small payload timing → latency, a
+    large one → aggregate bandwidth.  ``on_drift`` (if given) is invoked from
+    the monitor thread when :func:`detect_drift` fires on the bandwidth
+    trace — the hook where a training loop schedules reconstruct_topology.
+    """
+
+    def __init__(
+        self,
+        mesh: Mesh,
+        axis_name: str = RANKS_AXIS,
+        interval_s: float = 5.0,
+        out_dir: Optional[str] = None,
+        probe_floats: int = 1 << 18,
+        drift_threshold: float = 0.3,
+        on_drift: Optional[Callable[[float], None]] = None,
+    ) -> None:
+        self.interval_s = interval_s
+        self.out_dir = out_dir
+        self.drift_threshold = drift_threshold
+        self.on_drift = on_drift
+        self.bandwidth_trace: List[Tuple[float, float]] = []  # (ts, GB/s)
+        self.latency_trace: List[Tuple[float, float]] = []  # (ts, s)
+        profiler = NetworkProfiler(mesh, axis_name, warmup=1, iters=1)
+        self._bw_probe = profiler.make_probe(1, probe_floats)
+        self._lat_probe = profiler.make_probe(1, LATENCY_PROBE_FLOATS)
+        self._probe_bytes = probe_floats * 4
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+
+    # -- sampling --------------------------------------------------------------
+
+    def sample(self) -> Tuple[float, float]:
+        """One (bandwidth GB/s, latency s) reading across neighbor links."""
+        t_lat = self._lat_probe()
+        gbps = bandwidth_gbps(self._probe_bytes, self._bw_probe())
+        ts = time.time()
+        self.bandwidth_trace.append((ts, gbps))
+        self.latency_trace.append((ts, t_lat))
+        if self.out_dir:
+            self._append(os.path.join(self.out_dir, "bandwidth.txt"), ts, gbps)
+            self._append(os.path.join(self.out_dir, "latency.txt"), ts, t_lat)
+        if self.on_drift is not None and detect_drift(
+            [v for _, v in self.bandwidth_trace], self.drift_threshold
+        ):
+            self.on_drift(gbps)
+        return gbps, t_lat
+
+    @staticmethod
+    def _append(path: str, ts: float, value: float) -> None:
+        # %g keeps significant digits for µs-scale latencies, where fixed
+        # 6-decimal formatting would round everything to zero
+        with open(path, "a") as f:
+            f.write(f"{ts:.3f} {value:.9g}\n")
+
+    # -- background loop -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("monitor already running")
+        self._stop.clear()
+
+        def loop() -> None:
+            while not self._stop.is_set():
+                self.sample()
+                self._stop.wait(self.interval_s)
+
+        self._thread = threading.Thread(target=loop, daemon=True, name="adapcc-varmon")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    # -- analysis --------------------------------------------------------------
+
+    def summary(self) -> Dict[str, float]:
+        """min/median/max over the bandwidth trace (the reference's study
+        reports exactly this spread per instance pair)."""
+        values = [v for _, v in self.bandwidth_trace]
+        if not values:
+            return {"samples": 0.0}
+        return {
+            "samples": float(len(values)),
+            "bw_min_gbps": min(values),
+            "bw_median_gbps": statistics.median(values),
+            "bw_max_gbps": max(values),
+        }
+
+
+def load_trace(path: str) -> List[Tuple[float, float]]:
+    """Read a ``ts value`` trace file back into memory."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) == 2:
+                out.append((float(parts[0]), float(parts[1])))
+    return out
