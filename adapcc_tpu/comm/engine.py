@@ -25,7 +25,7 @@ collectives.
 from __future__ import annotations
 
 import functools
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -250,6 +250,7 @@ class CollectiveEngine:
         strategy: Strategy,
         axis_name: str = RANKS_AXIS,
         use_xla_fastpath: bool = True,
+        trace: Optional[Any] = None,
     ) -> None:
         if mesh.devices.size != strategy.world_size:
             raise ValueError(
@@ -260,7 +261,13 @@ class CollectiveEngine:
         self.strategy = strategy
         self.axis_name = axis_name
         self.use_xla_fastpath = use_xla_fastpath
+        #: optional CollectiveTrace recording every dispatch (track.txt analog)
+        self.trace = trace
         self._cache: Dict[Tuple, Callable] = {}
+
+    def _record(self, primitive: str, impl: str, stacked: jnp.ndarray) -> None:
+        if self.trace is not None:
+            self.trace.record(primitive, impl, int(stacked.nbytes))
 
     @property
     def world_size(self) -> int:
@@ -324,6 +331,7 @@ class CollectiveEngine:
                 op=op,
             )
             key = ("allreduce", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name, op)
+        self._record("allreduce", key[0], stacked)
         return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
     def _psum_shard(self, x: jnp.ndarray, mask: jnp.ndarray, op: ReduceOp) -> jnp.ndarray:
@@ -343,6 +351,7 @@ class CollectiveEngine:
             reduce_shard, strategy=self.strategy, axis_name=self.axis_name, op=op
         )
         key = ("reduce", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name, op)
+        self._record("reduce", "schedule", stacked)
         return self._shard_mapped(key, per_shard, 2)(stacked, self._active_to_mask(active_gpus))
 
     def boardcast(self, stacked: jnp.ndarray) -> jnp.ndarray:
@@ -352,6 +361,7 @@ class CollectiveEngine:
             broadcast_shard, strategy=self.strategy, axis_name=self.axis_name
         )
         key = ("broadcast", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name)
+        self._record("boardcast", "schedule", stacked)
         return self._shard_mapped(key, per_shard, 1)(stacked)
 
     broadcast = boardcast
@@ -372,6 +382,7 @@ class CollectiveEngine:
             return lax.all_gather(x[0], self.axis_name, axis=0)[None]
 
         key = ("allgather", stacked.shape, stacked.dtype.name)
+        self._record("all_gather", "xla", stacked)
         return self._shard_mapped(key, per_shard, 1)(stacked)
 
     def all_to_all(self, stacked: jnp.ndarray) -> jnp.ndarray:
@@ -392,6 +403,7 @@ class CollectiveEngine:
             return lax.all_to_all(x[0], self.axis_name, split_axis=0, concat_axis=0)[None]
 
         key = ("alltoall", stacked.shape, stacked.dtype.name)
+        self._record("all_to_all", "xla", stacked)
         return self._shard_mapped(key, per_shard, 1)(stacked)
 
     def ring_allreduce(self, stacked: jnp.ndarray, interpret: Optional[bool] = None) -> jnp.ndarray:
@@ -411,6 +423,7 @@ class CollectiveEngine:
             )[None]
 
         key = ("ring_allreduce", stacked.shape, stacked.dtype.name, bool(interpret))
+        self._record("allreduce", "pallas_ring", stacked)
         return self._shard_mapped(key, per_shard, 1)(stacked)
 
     def reduce_scatter(self, stacked: jnp.ndarray, op: ReduceOp = ReduceOp.SUM) -> jnp.ndarray:
@@ -430,4 +443,5 @@ class CollectiveEngine:
             return out[None, :]
 
         key = ("reducescatter", stacked.shape, stacked.dtype.name, op)
+        self._record("reduce_scatter", "xla", stacked)
         return self._shard_mapped(key, per_shard, 1)(stacked)

@@ -88,11 +88,17 @@ def emulate_heterogeneous_steps(
     barrier = threading.Barrier(world_size)
 
     def worker(rank: int) -> None:
-        for step in range(num_steps):
-            delay = base_compute_s * (heter_alpha if rank in slow_ranks else 1.0)
-            time.sleep(delay)
-            probe.hook_arrive(step, rank)
-            barrier.wait()
+        try:
+            for step in range(num_steps):
+                delay = base_compute_s * (heter_alpha if rank in slow_ranks else 1.0)
+                time.sleep(delay)
+                probe.hook_arrive(step, rank)
+                barrier.wait(timeout=60.0)
+        except threading.BrokenBarrierError:
+            pass  # a peer failed; unwind instead of waiting forever
+        except Exception:
+            barrier.abort()  # release peers so the caller's join() returns
+            raise
 
     threads = [threading.Thread(target=worker, args=(r,)) for r in range(world_size)]
     for t in threads:

@@ -170,6 +170,8 @@ class GPT2(nn.Module):
             dtype=cfg.dtype,
             name="wpe",
         )
+        if decode and pos is None:
+            raise ValueError("decode=True needs pos (the fed token's absolute position)")
         positions = jnp.arange(T) if pos is None else jnp.asarray(pos).reshape((1,))
         x = wte(tokens) + wpe(positions)[None]
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)

@@ -1,0 +1,175 @@
+"""Multi-round broadcast flow LP over arbitrary connectivity graphs.
+
+The reference carries an exploratory CVXPY study (gurobi/code-gen/
+cvxpy-broadcast-multi-round.py:43-60) formulating broadcast as a multi-round
+flow problem with forwarding-rule constraints: a node may only forward data
+it has already received in earlier rounds.  That study was Python-2-era and
+never wired into the runtime; here it is reformulated for
+``scipy.optimize.linprog`` (HiGHS) and made loadable into the schedule IR.
+
+Formulation (unit data broadcast from ``source`` over ``R`` rounds):
+
+    variables  f[e, r] ≥ 0   data moved on directed edge e during round r
+               T[r]    ≥ 0   duration of round r
+    foreach e, r:        f[e, r] ≤ bandwidth[e] · T[r]       (capacity)
+    foreach v≠src, r:    Σ_out f[·, r] ≤ Σ_{r'<r} Σ_in f[·, r']   (forwarding)
+    foreach v≠src:       Σ_r Σ_in f[·, r] ≥ 1                (delivery)
+    minimize   Σ_r T[r]                                      (makespan)
+
+The optimal per-round flows lower to :class:`~adapcc_tpu.strategy.ir`
+``CommRound`` edge lists (an edge participates in round r when it carries
+non-negligible flow), giving a broadcast schedule for irregular topologies
+that tree synthesis cannot express.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+Edge = Tuple[int, int]
+
+
+@dataclass
+class FlowSolution:
+    """LP output: per-round edge flows + round durations."""
+
+    num_nodes: int
+    source: int
+    rounds: List[Dict[Edge, float]]  # flow per edge, per round
+    durations: List[float]
+    makespan: float
+
+    def comm_rounds(self, threshold: float = 1e-6):
+        """Lower to schedule-IR rounds (edges carrying > threshold flow).
+
+        A ``CommRound`` executes as one ``ppermute``, which is a partial
+        permutation — each rank sends to at most one peer and receives from
+        at most one.  An LP round may fan flows out (one node feeding several
+        in the same time slot), so it is split greedily into as many
+        permutation sub-rounds as its maximum fan degree requires; heavier
+        flows are scheduled first so the dominant traffic leads.
+        """
+        from adapcc_tpu.strategy.ir import CommRound
+
+        out = []
+        for flows in self.rounds:
+            remaining = sorted(
+                ((f, e) for e, f in flows.items() if f > threshold), reverse=True
+            )
+            while remaining:
+                srcs, dsts, batch, deferred = set(), set(), [], []
+                for f, (u, v) in remaining:
+                    if u in srcs or v in dsts:
+                        deferred.append((f, (u, v)))
+                    else:
+                        srcs.add(u)
+                        dsts.add(v)
+                        batch.append((u, v))
+                out.append(CommRound(edges=tuple(sorted(batch))))
+                remaining = deferred
+        return out
+
+
+def solve_broadcast_lp(
+    num_nodes: int,
+    edges: Sequence[Edge],
+    bandwidth: Sequence[float],
+    source: int = 0,
+    num_rounds: int = 0,
+) -> FlowSolution:
+    """Solve the multi-round broadcast LP; raises if infeasible.
+
+    ``edges`` are directed; pass both directions for full-duplex links.
+    ``num_rounds=0`` picks ⌈log2(n)⌉ + 1 (enough for any connected graph a
+    binomial-tree broadcast can cover; more rounds never hurt the optimum).
+    """
+    from scipy.optimize import linprog
+
+    n, E = num_nodes, len(edges)
+    if not 0 <= source < n:
+        raise ValueError(f"source {source} outside [0, {n})")
+    if len(bandwidth) != E:
+        raise ValueError("bandwidth list must match edges")
+    if len(set(edges)) != E:
+        raise ValueError(
+            "duplicate directed edges; merge parallel links into one edge "
+            "with summed bandwidth"
+        )
+    R = num_rounds or (max(1, int(np.ceil(np.log2(max(n, 2))))) + 1)
+
+    # variable layout: [f[e0,r0], f[e1,r0], ..., f[E-1,R-1], T[0..R-1]]
+    nf = E * R
+    nvar = nf + R
+
+    def fi(e: int, r: int) -> int:
+        return r * E + e
+
+    c = np.zeros(nvar)
+    c[nf:] = 1.0  # minimize Σ T_r
+
+    A_ub: List[np.ndarray] = []
+    b_ub: List[float] = []
+
+    # capacity: f[e,r] − bw[e]·T[r] ≤ 0
+    for r in range(R):
+        for e in range(E):
+            row = np.zeros(nvar)
+            row[fi(e, r)] = 1.0
+            row[nf + r] = -bandwidth[e]
+            A_ub.append(row)
+            b_ub.append(0.0)
+
+    in_edges: List[List[int]] = [[] for _ in range(n)]
+    out_edges: List[List[int]] = [[] for _ in range(n)]
+    for e, (u, v) in enumerate(edges):
+        out_edges[u].append(e)
+        in_edges[v].append(e)
+
+    # forwarding: what v sends in round r is bounded by what it held before
+    for v in range(n):
+        if v == source:
+            continue
+        for r in range(R):
+            row = np.zeros(nvar)
+            for e in out_edges[v]:
+                row[fi(e, r)] = 1.0
+            for rp in range(r):
+                for e in in_edges[v]:
+                    row[fi(e, rp)] -= 1.0
+            A_ub.append(row)
+            b_ub.append(0.0)
+
+    # delivery: every non-source node receives ≥ 1 in total
+    for v in range(n):
+        if v == source:
+            continue
+        row = np.zeros(nvar)
+        for r in range(R):
+            for e in in_edges[v]:
+                row[fi(e, r)] = -1.0
+        A_ub.append(row)
+        b_ub.append(-1.0)
+
+    res = linprog(
+        c, A_ub=np.array(A_ub), b_ub=np.array(b_ub), bounds=[(0, None)] * nvar,
+        method="highs",
+    )
+    if not res.success:
+        raise ValueError(f"broadcast LP infeasible: {res.message}")
+
+    x = res.x
+    rounds = [
+        {edges[e]: float(x[fi(e, r)]) for e in range(E) if x[fi(e, r)] > 1e-9}
+        for r in range(R)
+    ]
+    durations = [float(t) for t in x[nf:]]
+    return FlowSolution(
+        num_nodes=n,
+        source=source,
+        rounds=rounds,
+        durations=durations,
+        makespan=float(sum(durations)),
+    )

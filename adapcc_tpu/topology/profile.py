@@ -77,10 +77,24 @@ class NetworkProfiler:
     def make_probe(self, offset: int, n_floats: int):
         """A reusable zero-arg probe: each call times one ring-offset round
         and returns seconds.  Build once, call many — the compiled program is
-        captured, so repeated sampling (e.g. the variability monitor) never
-        re-traces."""
+        captured (no re-tracing), and the compile/cache warmup runs only on
+        the first call, so steady-state sampling injects exactly ``iters``
+        probe rounds into the live network per reading."""
         fn, x = self._offset_shift_fn(offset, n_floats)
-        return lambda: self._time(fn, x)
+        warmed = False
+
+        def probe() -> float:
+            nonlocal warmed
+            if not warmed:
+                for _ in range(self.warmup):
+                    jax.block_until_ready(fn(x))
+                warmed = True
+            t0 = time.perf_counter()
+            for _ in range(self.iters):
+                jax.block_until_ready(fn(x))
+            return (time.perf_counter() - t0) / self.iters
+
+        return probe
 
     # -- matrix profiling ------------------------------------------------------
 

@@ -28,8 +28,12 @@ from adapcc_tpu.topology.profile import (
 )
 
 
+#: trailing-median window for drift detection (samples)
+_DRIFT_WINDOW = 12
+
+
 def detect_drift(
-    history: Sequence[float], threshold: float = 0.3, window: int = 12
+    history: Sequence[float], threshold: float = 0.3, window: int = _DRIFT_WINDOW
 ) -> bool:
     """Has the newest reading drifted > ``threshold`` (relative) from the
     median of the trailing ``window``?  The trigger condition for
@@ -62,11 +66,15 @@ class VariabilityMonitor:
         probe_floats: int = 1 << 18,
         drift_threshold: float = 0.3,
         on_drift: Optional[Callable[[float], None]] = None,
+        max_samples: int = 100_000,
     ) -> None:
         self.interval_s = interval_s
         self.out_dir = out_dir
         self.drift_threshold = drift_threshold
         self.on_drift = on_drift
+        # in-memory traces are bounded (oldest trimmed) — day-scale runs keep
+        # their full history in the trace *files*, not in RAM
+        self.max_samples = max_samples
         self.bandwidth_trace: List[Tuple[float, float]] = []  # (ts, GB/s)
         self.latency_trace: List[Tuple[float, float]] = []  # (ts, s)
         profiler = NetworkProfiler(mesh, axis_name, warmup=1, iters=1)
@@ -87,11 +95,16 @@ class VariabilityMonitor:
         ts = time.time()
         self.bandwidth_trace.append((ts, gbps))
         self.latency_trace.append((ts, t_lat))
+        for trace in (self.bandwidth_trace, self.latency_trace):
+            if len(trace) > self.max_samples:
+                del trace[: -self.max_samples]
         if self.out_dir:
             self._append(os.path.join(self.out_dir, "bandwidth.txt"), ts, gbps)
             self._append(os.path.join(self.out_dir, "latency.txt"), ts, t_lat)
         if self.on_drift is not None and detect_drift(
-            [v for _, v in self.bandwidth_trace], self.drift_threshold
+            # drift only reads the trailing window; don't copy full history
+            [v for _, v in self.bandwidth_trace[-_DRIFT_WINDOW - 1 :]],
+            self.drift_threshold,
         ):
             self.on_drift(gbps)
         return gbps, t_lat

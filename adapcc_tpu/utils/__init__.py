@@ -1,0 +1,21 @@
+"""Shared utilities: observability (metrics, traces, profiling helpers)."""
+
+from adapcc_tpu.utils.observability import (
+    AverageMeter,
+    CollectiveTrace,
+    MetricsRegistry,
+    ProgressMeter,
+    parse_track_log,
+    parse_training_log,
+    profiler_trace,
+)
+
+__all__ = [
+    "AverageMeter",
+    "CollectiveTrace",
+    "MetricsRegistry",
+    "ProgressMeter",
+    "parse_track_log",
+    "parse_training_log",
+    "profiler_trace",
+]

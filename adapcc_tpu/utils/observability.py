@@ -1,0 +1,249 @@
+"""Observability: structured metrics, collective traces, profiler hooks.
+
+The reference's observability is printf-based throughout (SURVEY.md §5.5):
+relay decisions and per-element progress printed from the native layer
+(control.cu:79-81, allreduce.cu:541-542), chunk-arrival debug dumps in
+log/track.txt, AverageMeter/ProgressMeter training meters
+(accuracy_benchmark.py:470-539), and ad-hoc log-scraping post-processors
+(process_log.py, process_gns.py).  This module provides the structured
+versions: the same meters, a metrics registry with JSON export, a collective
+trace that records engine dispatches (the track.txt analog), a
+``jax.profiler`` context for Perfetto traces, and parsers for both trace and
+training logs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import re
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
+
+
+# --- training meters (accuracy_benchmark.py:470-539) --------------------------
+
+
+class AverageMeter:
+    """Tracks current value, running average, sum, count."""
+
+    def __init__(self, name: str, fmt: str = ":f") -> None:
+        self.name = name
+        self.fmt = fmt
+        self.reset()
+
+    def reset(self) -> None:
+        self.val = 0.0
+        self.avg = 0.0
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, val: float, n: int = 1) -> None:
+        self.val = float(val)
+        self.sum += float(val) * n
+        self.count += n
+        self.avg = self.sum / max(self.count, 1)
+
+    def __str__(self) -> str:
+        fmtstr = "{name} {val" + self.fmt + "} ({avg" + self.fmt + "})"
+        return fmtstr.format(name=self.name, val=self.val, avg=self.avg)
+
+
+class ProgressMeter:
+    """``[ 10/500] loss 0.61 (0.73)  acc 81.2 (76.9)``-style progress lines."""
+
+    def __init__(self, num_batches: int, meters: Sequence[AverageMeter], prefix: str = "") -> None:
+        num_digits = len(str(num_batches // 1))
+        self._batch_fmt = "[" + "{:" + str(num_digits) + "d}" + "/" + str(num_batches) + "]"
+        self.meters = list(meters)
+        self.prefix = prefix
+
+    def display(self, batch: int) -> str:
+        entries = [self.prefix + self._batch_fmt.format(batch)]
+        entries += [str(m) for m in self.meters]
+        line = "\t".join(entries)
+        print(line)
+        return line
+
+
+# --- metrics registry ---------------------------------------------------------
+
+
+class MetricsRegistry:
+    """Named counters/gauges/timers with JSON export; thread-safe."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = defaultdict(float)
+        self._gauges: Dict[str, float] = {}
+        self._timings: Dict[str, List[float]] = defaultdict(list)
+
+    def incr(self, name: str, by: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += by
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    @contextlib.contextmanager
+    def timer(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._timings[name].append(dt)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            timings = {
+                k: {
+                    "count": len(v),
+                    "total_s": sum(v),
+                    "mean_s": sum(v) / len(v),
+                    "max_s": max(v),
+                }
+                for k, v in self._timings.items()
+                if v
+            }
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "timings": timings,
+            }
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+
+# --- collective dispatch trace (log/track.txt analog) -------------------------
+
+
+@dataclass
+class TraceEvent:
+    ts: float
+    primitive: str
+    impl: str
+    nbytes: int
+    step: Optional[int] = None
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+class CollectiveTrace:
+    """Records engine dispatches — which collective ran, with what payload,
+    under which implementation.  The reference dumps per-chunk arrival lines
+    into log/track.txt from inside the CUDA contexts; under XLA the chunk
+    loop lives inside one compiled program, so the traceable boundary is the
+    dispatch (one event per collective call), with Perfetto
+    (:func:`profiler_trace`) covering intra-program detail.
+    """
+
+    def __init__(self, capacity: int = 100_000) -> None:
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._events: List[TraceEvent] = []
+        self._dropped = 0
+
+    def record(
+        self,
+        primitive: str,
+        impl: str,
+        nbytes: int,
+        step: Optional[int] = None,
+        **extra: Any,
+    ) -> None:
+        ev = TraceEvent(time.time(), primitive, impl, nbytes, step, extra)
+        with self._lock:
+            if len(self._events) >= self.capacity:
+                self._dropped += 1
+                return
+            self._events.append(ev)
+
+    def events(self) -> List[TraceEvent]:
+        with self._lock:
+            return list(self._events)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def dump(self, path: str) -> None:
+        """``track.txt``-style lines: ``ts primitive impl nbytes step {extra}``."""
+        with open(path, "w") as f:
+            for e in self.events():
+                f.write(
+                    f"{e.ts:.6f} {e.primitive} {e.impl} {e.nbytes} "
+                    f"{-1 if e.step is None else e.step} {json.dumps(e.extra)}\n"
+                )
+
+
+def parse_track_log(path: str) -> List[TraceEvent]:
+    """Read a :meth:`CollectiveTrace.dump` file back into events."""
+    out: List[TraceEvent] = []
+    with open(path) as f:
+        for line in f:
+            parts = line.split(" ", 5)
+            if len(parts) != 6:
+                continue
+            step = int(parts[4])
+            out.append(
+                TraceEvent(
+                    ts=float(parts[0]),
+                    primitive=parts[1],
+                    impl=parts[2],
+                    nbytes=int(parts[3]),
+                    step=None if step < 0 else step,
+                    extra=json.loads(parts[5]),
+                )
+            )
+    return out
+
+
+# --- jax profiler (Perfetto) --------------------------------------------------
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str) -> Iterator[None]:
+    """Capture a ``jax.profiler`` trace (XLA ops, transfers, host activity)
+    into ``log_dir`` — the TPU answer to the reference's nsys reports
+    (nccl-perf/tree/report_allreduce.txt, SURVEY.md §5.1)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+# --- training-log post-processors (process_log.py/process_gns.py) -------------
+
+_FLOAT = r"([-+]?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?)"
+
+
+def parse_training_log(
+    path: str, key: str = "loss", pattern: Optional[str] = None
+) -> List[Tuple[int, float]]:
+    """Scrape ``(step, value)`` pairs out of a free-form training log.
+
+    Default pattern matches ``... step <N> ... <key> <float>`` or
+    ``<key>: <float>`` lines (the shapes the reference's process_log.py and
+    process_gns.py scrape); pass ``pattern`` with two groups (step, value)
+    for custom formats.
+    """
+    if pattern is None:
+        pattern = rf"step\s*[:=]?\s*(\d+).*?{re.escape(key)}\s*[:=]?\s*{_FLOAT}"
+    rx = re.compile(pattern)
+    out: List[Tuple[int, float]] = []
+    with open(path) as f:
+        for line in f:
+            m = rx.search(line)
+            if m:
+                out.append((int(m.group(1)), float(m.group(2))))
+    return out

@@ -1,0 +1,114 @@
+"""Multi-round broadcast flow LP (cvxpy code-gen study analog)."""
+
+import numpy as np
+import pytest
+
+from adapcc_tpu.strategy.flow_lp import solve_broadcast_lp
+
+
+def _ring_edges(n):
+    """Bidirectional ring."""
+    edges = []
+    for i in range(n):
+        edges.append((i, (i + 1) % n))
+        edges.append(((i + 1) % n, i))
+    return edges
+
+
+def test_line_graph_two_rounds():
+    # 0 → 1 → 2, unit bandwidth.  With exactly 2 rounds there is no room to
+    # pipeline: the full unit crosses each hop sequentially → makespan 2.
+    edges = [(0, 1), (1, 2)]
+    two = solve_broadcast_lp(3, edges, [1.0, 1.0], source=0, num_rounds=2)
+    assert two.makespan == pytest.approx(2.0, abs=1e-6)
+
+    # extra rounds let the LP pipeline chunks (the reference's chunked-tree
+    # insight): 3 rounds reach 4/3, and more rounds approach 1 asymptotically
+    three = solve_broadcast_lp(3, edges, [1.0, 1.0], source=0, num_rounds=3)
+    assert three.makespan == pytest.approx(4.0 / 3.0, abs=1e-6)
+    six = solve_broadcast_lp(3, edges, [1.0, 1.0], source=0, num_rounds=6)
+    assert six.makespan < three.makespan
+    sol = solve_broadcast_lp(3, edges, [1.0, 1.0], source=0)
+    # delivery: each non-source node received a full unit
+    recv = {1: 0.0, 2: 0.0}
+    for flows in sol.rounds:
+        for (u, v), f in flows.items():
+            if v in recv:
+                recv[v] += f
+    # ≥: delivery is a lower bound, and round-duration slack makes modest
+    # overshipping free in alternate optima
+    assert recv[1] >= 1.0 - 1e-6
+    assert recv[2] >= 1.0 - 1e-6
+
+
+def test_forwarding_rule_respected():
+    """Node 1 never sends more (cumulatively) than it has received before."""
+    edges = [(0, 1), (1, 2)]
+    sol = solve_broadcast_lp(3, edges, [1.0, 1.0], source=0)
+    held = 0.0
+    for flows in sol.rounds:
+        sent = flows.get((1, 2), 0.0)
+        assert sent <= held + 1e-6
+        held += flows.get((0, 1), 0.0)
+
+
+def test_star_beats_line():
+    # source directly connected to everyone: one round suffices
+    n = 5
+    edges = [(0, v) for v in range(1, n)]
+    sol = solve_broadcast_lp(n, edges, [1.0] * len(edges), source=0)
+    assert sol.makespan == pytest.approx(1.0, abs=1e-6)
+
+
+def test_bandwidth_scales_makespan():
+    edges = [(0, 1)]
+    slow = solve_broadcast_lp(2, edges, [0.5], source=0)
+    fast = solve_broadcast_lp(2, edges, [2.0], source=0)
+    assert slow.makespan == pytest.approx(2.0, abs=1e-6)
+    assert fast.makespan == pytest.approx(0.5, abs=1e-6)
+
+
+def test_ring_multipath():
+    # both ring directions can carry halves; makespan beats a single path
+    sol = solve_broadcast_lp(4, _ring_edges(4), [1.0] * 8, source=0)
+    assert sol.makespan <= 2.0 + 1e-6
+
+
+def test_lowering_splits_fanout_into_permutations():
+    """A round where the source feeds two peers must lower to ≥2 ppermute
+    rounds, each a valid partial permutation (CommRound enforces this)."""
+    n = 3
+    edges = [(0, 1), (0, 2)]
+    sol = solve_broadcast_lp(n, edges, [1.0, 1.0], source=0, num_rounds=1)
+    rounds = sol.comm_rounds()
+    assert len(rounds) >= 2  # fan-out of 2 cannot be one permutation
+    for r in rounds:
+        srcs = [u for u, _ in r.edges]
+        dsts = [v for _, v in r.edges]
+        assert len(srcs) == len(set(srcs)) and len(dsts) == len(set(dsts))
+    flat = [e for r in rounds for e in r.edges]
+    assert set(flat) == {(0, 1), (0, 2)}
+
+
+def test_lowering_to_comm_rounds():
+    sol = solve_broadcast_lp(3, [(0, 1), (1, 2)], [1.0, 1.0], source=0)
+    rounds = sol.comm_rounds()
+    assert rounds, "expected at least one lowered round"
+    flat = [e for r in rounds for e in r.edges]
+    assert (0, 1) in flat and (1, 2) in flat
+    # (1,2) must not precede the first (0,1) round
+    first_01 = next(i for i, r in enumerate(rounds) if (0, 1) in r.edges)
+    first_12 = next(i for i, r in enumerate(rounds) if (1, 2) in r.edges)
+    assert first_12 >= first_01
+
+
+def test_infeasible_disconnected():
+    with pytest.raises(ValueError, match="infeasible"):
+        solve_broadcast_lp(3, [(0, 1)], [1.0], source=0)  # node 2 unreachable
+
+
+def test_input_validation():
+    with pytest.raises(ValueError, match="source"):
+        solve_broadcast_lp(3, [(0, 1)], [1.0], source=7)
+    with pytest.raises(ValueError, match="bandwidth"):
+        solve_broadcast_lp(3, [(0, 1)], [1.0, 2.0], source=0)

@@ -1,0 +1,122 @@
+"""Observability: meters, metrics registry, collective trace, log parsers."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adapcc_tpu.utils import (
+    AverageMeter,
+    CollectiveTrace,
+    MetricsRegistry,
+    ProgressMeter,
+    parse_track_log,
+    parse_training_log,
+)
+
+
+def test_average_meter():
+    m = AverageMeter("loss", ":.2f")
+    m.update(2.0)
+    m.update(4.0, n=3)
+    assert m.val == 4.0
+    assert m.avg == pytest.approx((2 + 12) / 4)
+    assert "loss" in str(m)
+    m.reset()
+    assert m.count == 0
+
+
+def test_progress_meter(capsys):
+    m = AverageMeter("acc", ":.1f")
+    m.update(81.25)
+    line = ProgressMeter(500, [m], prefix="epoch 1 ").display(10)
+    out = capsys.readouterr().out
+    assert line in out
+    assert "acc" in line and "[ 10/500]" in line
+
+
+def test_metrics_registry():
+    reg = MetricsRegistry()
+    reg.incr("collectives")
+    reg.incr("collectives", 2)
+    reg.gauge("bw_gbps", 3.5)
+    with reg.timer("step"):
+        pass
+    snap = json.loads(reg.to_json())
+    assert snap["counters"]["collectives"] == 3
+    assert snap["gauges"]["bw_gbps"] == 3.5
+    assert snap["timings"]["step"]["count"] == 1
+    assert snap["timings"]["step"]["mean_s"] >= 0
+
+
+def test_collective_trace_roundtrip(tmp_path):
+    tr = CollectiveTrace()
+    tr.record("allreduce", "psum", 4096, step=3, strategy="ring")
+    tr.record("all_to_all", "xla", 128)
+    path = str(tmp_path / "track.txt")
+    tr.dump(path)
+    back = parse_track_log(path)
+    assert len(back) == 2
+    assert back[0].primitive == "allreduce"
+    assert back[0].step == 3
+    assert back[0].extra == {"strategy": "ring"}
+    assert back[1].step is None
+
+
+def test_collective_trace_bounded():
+    tr = CollectiveTrace(capacity=2)
+    for _ in range(5):
+        tr.record("allreduce", "psum", 1)
+    assert len(tr.events()) == 2
+    assert tr.dropped == 3
+
+
+def test_engine_records_dispatches(mesh4):
+    from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.strategy.ir import Strategy
+
+    tr = CollectiveTrace()
+    eng = CollectiveEngine(mesh4, Strategy.ring(4), trace=tr)
+    x = jnp.ones((4, 8))
+    eng.all_reduce(x)
+    eng.all_reduce(x, active_gpus=[0, 1, 2])
+    eng.boardcast(x)
+    eng.all_gather(x)
+    prims = [(e.primitive, e.impl) for e in tr.events()]
+    assert prims == [
+        ("allreduce", "psum"),
+        ("allreduce", "allreduce"),
+        ("boardcast", "schedule"),
+        ("all_gather", "xla"),
+    ]
+    assert tr.events()[0].nbytes == 4 * 8 * 4
+
+
+def test_parse_training_log(tmp_path):
+    path = tmp_path / "train.log"
+    path.write_text(
+        "junk line\n"
+        "step 1 loss 0.75 acc 12.0\n"
+        "step: 2  loss: 0.5\n"
+        "epoch done\n"
+        "step 3 loss 2.5e-1\n"
+    )
+    pairs = parse_training_log(str(path))
+    assert pairs == [(1, 0.75), (2, 0.5), (3, 0.25)]
+    accs = parse_training_log(str(path), key="acc")
+    assert accs == [(1, 12.0)]
+
+
+def test_profiler_trace_writes(tmp_path):
+    import os
+
+    from adapcc_tpu.utils import profiler_trace
+
+    with profiler_trace(str(tmp_path / "prof")):
+        jnp.sum(jnp.ones((16, 16))).block_until_ready()
+    # a trace directory with at least one artifact appears
+    entries = []
+    for root, _, files in os.walk(tmp_path / "prof"):
+        entries.extend(files)
+    assert entries
