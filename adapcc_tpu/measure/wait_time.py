@@ -75,6 +75,7 @@ def emulate_heterogeneous_steps(
     base_compute_s: float = 0.005,
     heter_alpha: float = 1.0,
     slow_ranks: Sequence[int] = (0,),
+    step_timeout_s: float = 60.0,
 ) -> List[float]:
     """Drive ``world_size`` emulated workers through ``num_steps`` hook
     rounds; ``slow_ranks`` compute for ``base_compute_s × heter_alpha``
@@ -86,6 +87,7 @@ def emulate_heterogeneous_steps(
     later steps would report the *cumulative* skew, not the per-step skew.
     """
     barrier = threading.Barrier(world_size)
+    errors: List[BaseException] = []
 
     def worker(rank: int) -> None:
         try:
@@ -93,16 +95,18 @@ def emulate_heterogeneous_steps(
                 delay = base_compute_s * (heter_alpha if rank in slow_ranks else 1.0)
                 time.sleep(delay)
                 probe.hook_arrive(step, rank)
-                barrier.wait(timeout=60.0)
+                barrier.wait(timeout=step_timeout_s)
         except threading.BrokenBarrierError:
-            pass  # a peer failed; unwind instead of waiting forever
-        except Exception:
+            pass  # a peer failed and aborted; its error is already captured
+        except BaseException as exc:  # noqa: BLE001 — re-raised in the caller
+            errors.append(exc)
             barrier.abort()  # release peers so the caller's join() returns
-            raise
 
     threads = [threading.Thread(target=worker, args=(r,)) for r in range(world_size)]
     for t in threads:
         t.start()
     for t in threads:
         t.join()
+    if errors:
+        raise errors[0]
     return [probe.wait_time(s) for s in range(num_steps)]

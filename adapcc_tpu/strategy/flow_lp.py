@@ -7,19 +7,31 @@ it has already received in earlier rounds.  That study was Python-2-era and
 never wired into the runtime; here it is reformulated for
 ``scipy.optimize.linprog`` (HiGHS) and made loadable into the schedule IR.
 
-Formulation (unit data broadcast from ``source`` over ``R`` rounds):
+Formulation (unit data broadcast from ``source`` over ``R`` rounds), as a
+**multicast commodity LP**: one unit commodity per receiver ``d``, all
+commodities sharing each edge's transmissions (one physical send serves
+every commodity — the multicast property):
 
-    variables  f[e, r] ≥ 0   data moved on directed edge e during round r
-               T[r]    ≥ 0   duration of round r
-    foreach e, r:        f[e, r] ≤ bandwidth[e] · T[r]       (capacity)
-    foreach v≠src, r:    Σ_out f[·, r] ≤ Σ_{r'<r} Σ_in f[·, r']   (forwarding)
-    foreach v≠src:       Σ_r Σ_in f[·, r] ≥ 1                (delivery)
-    minimize   Σ_r T[r]                                      (makespan)
+    variables  f[d, e, r] ≥ 0   commodity-d data on edge e during round r
+               x[e, r]    ≥ 0   physical transmission on e during round r
+               T[r]       ≥ 0   duration of round r
+    foreach d, e, r:    f[d, e, r] ≤ x[e, r]                     (multicast)
+    foreach e, r:       x[e, r] ≤ bandwidth[e] · T[r]            (capacity)
+    foreach d, v≠src, r: Σ_{r'≤r} Σ_out f[d, ·, r'] ≤ Σ_{r'<r} Σ_in f[d, ·, r']
+                                         (store-and-forward, time-expanded)
+    foreach d:          Σ_r (Σ_in − Σ_out) f[d, ·, r] at d ≥ 1   (delivery)
+    minimize   Σ_r T[r]                                          (makespan)
 
-The optimal per-round flows lower to :class:`~adapcc_tpu.strategy.ir`
-``CommRound`` edge lists (an edge participates in round r when it carries
-non-negligible flow), giving a broadcast schedule for irregular topologies
-that tree synthesis cannot express.
+Delivery counts *net* inflow at the receiver, so data recirculating around a
+cycle cancels out — gross-inflow formulations are unsound on any graph with
+a cycle among non-source nodes (data bounced around a fast cycle would
+satisfy them without ever crossing the source's slow uplink).
+
+The optimal per-round transmissions ``x`` lower to
+:class:`~adapcc_tpu.strategy.ir` ``CommRound`` edge lists (an edge
+participates in round r when it carries non-negligible flow), giving a
+broadcast schedule for irregular topologies that tree synthesis cannot
+express.
 """
 
 from __future__ import annotations
@@ -73,6 +85,21 @@ class FlowSolution:
         return out
 
 
+def _bfs_depths(n: int, out_neighbors: List[List[int]], source: int) -> List[int]:
+    depth = [-1] * n
+    depth[source] = 0
+    frontier = [source]
+    while frontier:
+        nxt = []
+        for u in frontier:
+            for v in out_neighbors[u]:
+                if depth[v] < 0:
+                    depth[v] = depth[u] + 1
+                    nxt.append(v)
+        frontier = nxt
+    return depth
+
+
 def solve_broadcast_lp(
     num_nodes: int,
     edges: Sequence[Edge],
@@ -80,11 +107,13 @@ def solve_broadcast_lp(
     source: int = 0,
     num_rounds: int = 0,
 ) -> FlowSolution:
-    """Solve the multi-round broadcast LP; raises if infeasible.
+    """Solve the multi-round multicast broadcast LP; raises if infeasible.
 
     ``edges`` are directed; pass both directions for full-duplex links.
-    ``num_rounds=0`` picks ⌈log2(n)⌉ + 1 (enough for any connected graph a
-    binomial-tree broadcast can cover; more rounds never hurt the optimum).
+    ``num_rounds=0`` picks max(graph eccentricity from the source,
+    ⌈log2(n)⌉ + 1): a sparse line graph needs its diameter in rounds, a
+    dense one benefits from the extra pipelining slots; more rounds never
+    hurt the optimum.
     """
     from scipy.optimize import linprog
 
@@ -98,58 +127,96 @@ def solve_broadcast_lp(
             "duplicate directed edges; merge parallel links into one edge "
             "with summed bandwidth"
         )
-    R = num_rounds or (max(1, int(np.ceil(np.log2(max(n, 2))))) + 1)
+    bad = [e for e in edges if not (0 <= e[0] < n and 0 <= e[1] < n) or e[0] == e[1]]
+    if bad:
+        raise ValueError(f"edges outside [0, {n}) or self-loops: {bad}")
 
-    # variable layout: [f[e0,r0], f[e1,r0], ..., f[E-1,R-1], T[0..R-1]]
-    nf = E * R
-    nvar = nf + R
+    in_edges: List[List[int]] = [[] for _ in range(n)]
+    out_edges: List[List[int]] = [[] for _ in range(n)]
+    out_neighbors: List[List[int]] = [[] for _ in range(n)]
+    for e, (u, v) in enumerate(edges):
+        out_edges[u].append(e)
+        in_edges[v].append(e)
+        out_neighbors[u].append(v)
 
-    def fi(e: int, r: int) -> int:
-        return r * E + e
+    depths = _bfs_depths(n, out_neighbors, source)
+    unreachable = [v for v in range(n) if depths[v] < 0]
+    if unreachable:
+        raise ValueError(f"broadcast LP infeasible: nodes {unreachable} unreachable from {source}")
+    if num_rounds:
+        R = num_rounds
+    else:
+        R = max(max(depths), max(1, int(np.ceil(np.log2(max(n, 2))))) + 1)
+
+    receivers = [v for v in range(n) if v != source]
+    D = len(receivers)
+
+    # variable layout:
+    #   f[d, e, r]  commodity flows        D·E·R
+    #   x[e, r]     physical transmissions E·R
+    #   T[r]        round durations        R
+    nf = D * E * R
+    nx = E * R
+    nvar = nf + nx + R
+
+    def fi(d: int, e: int, r: int) -> int:
+        return (d * R + r) * E + e
+
+    def xi(e: int, r: int) -> int:
+        return nf + r * E + e
 
     c = np.zeros(nvar)
-    c[nf:] = 1.0  # minimize Σ T_r
+    c[nf + nx :] = 1.0  # minimize Σ T_r
 
     A_ub: List[np.ndarray] = []
     b_ub: List[float] = []
 
-    # capacity: f[e,r] − bw[e]·T[r] ≤ 0
     for r in range(R):
         for e in range(E):
+            # capacity: x[e,r] − bw[e]·T[r] ≤ 0
             row = np.zeros(nvar)
-            row[fi(e, r)] = 1.0
-            row[nf + r] = -bandwidth[e]
+            row[xi(e, r)] = 1.0
+            row[nf + nx + r] = -bandwidth[e]
             A_ub.append(row)
             b_ub.append(0.0)
+            # multicast: each commodity rides the shared transmission
+            for d in range(D):
+                row = np.zeros(nvar)
+                row[fi(d, e, r)] = 1.0
+                row[xi(e, r)] = -1.0
+                A_ub.append(row)
+                b_ub.append(0.0)
 
-    in_edges: List[List[int]] = [[] for _ in range(n)]
-    out_edges: List[List[int]] = [[] for _ in range(n)]
-    for e, (u, v) in enumerate(edges):
-        out_edges[u].append(e)
-        in_edges[v].append(e)
+    # store-and-forward per commodity, as time-expanded flow conservation:
+    # everything v sent *through round r* is bounded by everything it
+    # received *before round r*.  Bounding only the single round's sends
+    # (instead of the cumulative) would let v re-send the same data every
+    # round — combined with a cycle that amplifies flow without touching
+    # the source.  Never applies to the source, which originates the data.
+    for d in range(D):
+        for v in range(n):
+            if v == source:
+                continue
+            for r in range(R):
+                row = np.zeros(nvar)
+                for rp in range(r + 1):
+                    for e in out_edges[v]:
+                        row[fi(d, e, rp)] = 1.0
+                for rp in range(r):
+                    for e in in_edges[v]:
+                        row[fi(d, e, rp)] -= 1.0
+                A_ub.append(row)
+                b_ub.append(0.0)
 
-    # forwarding: what v sends in round r is bounded by what it held before
-    for v in range(n):
-        if v == source:
-            continue
-        for r in range(R):
-            row = np.zeros(nvar)
-            for e in out_edges[v]:
-                row[fi(e, r)] = 1.0
-            for rp in range(r):
-                for e in in_edges[v]:
-                    row[fi(e, rp)] -= 1.0
-            A_ub.append(row)
-            b_ub.append(0.0)
-
-    # delivery: every non-source node receives ≥ 1 in total
-    for v in range(n):
-        if v == source:
-            continue
+    # delivery: NET inflow of commodity d at its receiver ≥ 1 (gross inflow
+    # would be satisfiable by recirculating data around a cycle)
+    for d, dest in enumerate(receivers):
         row = np.zeros(nvar)
         for r in range(R):
-            for e in in_edges[v]:
-                row[fi(e, r)] = -1.0
+            for e in in_edges[dest]:
+                row[fi(d, e, r)] -= 1.0
+            for e in out_edges[dest]:
+                row[fi(d, e, r)] += 1.0
         A_ub.append(row)
         b_ub.append(-1.0)
 
@@ -160,12 +227,12 @@ def solve_broadcast_lp(
     if not res.success:
         raise ValueError(f"broadcast LP infeasible: {res.message}")
 
-    x = res.x
+    sol = res.x
     rounds = [
-        {edges[e]: float(x[fi(e, r)]) for e in range(E) if x[fi(e, r)] > 1e-9}
+        {edges[e]: float(sol[xi(e, r)]) for e in range(E) if sol[xi(e, r)] > 1e-9}
         for r in range(R)
     ]
-    durations = [float(t) for t in x[nf:]]
+    durations = [float(t) for t in sol[nf + nx :]]
     return FlowSolution(
         num_nodes=n,
         source=source,

@@ -1,0 +1,111 @@
+"""Accuracy-benchmark workload + committed strategy XML fixtures."""
+
+import glob
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adapcc_tpu.workloads.accuracy_benchmark import (
+    batches,
+    build_parser,
+    make_blob_dataset,
+    run,
+    topk_accuracy,
+    validate,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "strategy")
+
+
+def test_topk_accuracy_exact():
+    logits = jnp.asarray(
+        [[0.1, 0.9, 0.0, 0.0], [0.9, 0.1, 0.0, 0.0], [0.0, 0.1, 0.2, 0.7]]
+    )
+    labels = jnp.asarray([1, 1, 1])
+    top1, top2 = topk_accuracy(logits, labels, ks=(1, 2))
+    assert float(top1) == pytest.approx(100 / 3)  # only row 0 ranks label first
+    assert float(top2) == pytest.approx(200 / 3)  # row 2's label outside top-2
+    # k larger than the class count degrades gracefully to 100%
+    (topbig,) = topk_accuracy(logits, labels, ks=(10,))
+    assert float(topbig) == 100.0
+
+
+def test_blob_dataset_learnable_and_deterministic():
+    x1, y1 = make_blob_dataset(64, 4, image_size=4, seed=3)
+    x2, y2 = make_blob_dataset(64, 4, image_size=4, seed=3)
+    assert np.array_equal(x1, x2) and np.array_equal(y1, y2)
+    assert x1.shape == (64, 4, 4, 3) and set(np.unique(y1)) <= set(range(4))
+
+
+def test_batches_full_and_shuffled():
+    x = np.arange(10)[:, None].astype(np.float32)
+    y = np.arange(10).astype(np.int32)
+    got = list(batches(x, y, batch=4, seed=0))
+    assert len(got) == 2  # ragged tail dropped
+    all_labels = np.concatenate([b[1] for b in got])
+    assert len(set(all_labels.tolist())) == 8  # no duplicates
+
+
+def test_accuracy_benchmark_learns(tmp_path):
+    """3 epochs on the blob dataset must lift top-1 well above chance —
+    end-to-end learning through the adaptive DDP stack."""
+    trace = str(tmp_path / "accuracy.txt")
+    args = build_parser().parse_args(
+        [
+            "--epochs", "3", "--batch", "64", "--train-size", "256",
+            "--val-size", "128", "--num-classes", "4", "--world", "4",
+            "--lr", "3e-3", "--model", "mlp", "--accuracy-trace", trace,
+        ]
+    )
+    top1, top5 = run(args)
+    assert top1 > 50.0  # chance is 25%
+    assert top5 == 100.0  # 4 classes: top-5 saturates
+    lines = open(trace).read().splitlines()
+    assert len(lines) == 3
+    epoch, t1, t5 = lines[-1].split()
+    assert int(epoch) == 2 and float(t1) == pytest.approx(top1, abs=1e-3)
+
+
+# --- committed strategy fixtures (reference strategy/*.xml) -------------------
+
+
+def test_fixtures_present():
+    files = glob.glob(os.path.join(FIXTURES, "*.xml"))
+    assert len(files) >= 9
+
+
+@pytest.mark.parametrize(
+    "name", ["4", "8", "8_ring", "8_binary", "4-4_1", "4-4-4-4", "6-6", "8-8-8", "16_milp"]
+)
+def test_fixture_parses_with_sane_roles(name):
+    from adapcc_tpu.strategy.xml_io import parse_strategy_xml
+
+    s = parse_strategy_xml(os.path.join(FIXTURES, f"{name}.xml"))
+    assert s.trees
+    for tree in s.trees:
+        # spanning: every rank reachable, exactly one parentless rank (root)
+        ranks = {tree.root} | set(tree.parent)
+        assert ranks == set(range(s.world_size))
+        assert tree.root not in tree.parent
+        for r in ranks - {tree.root}:
+            assert r in tree.parent
+
+
+@pytest.mark.parametrize("name,world", [("4", 4), ("8", 8), ("8_ring", 8)])
+def test_fixture_strategy_allreduce_oracle(name, world, mesh8):
+    """ones*i over w ranks -> i*w everywhere (adapcc.py:106-115 oracle),
+    running the committed fixture through the real engine."""
+    import jax
+
+    from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.comm.mesh import build_world_mesh
+    from adapcc_tpu.strategy.xml_io import parse_strategy_xml
+
+    s = parse_strategy_xml(os.path.join(FIXTURES, f"{name}.xml"))
+    mesh = build_world_mesh(world, jax.devices()[:world])
+    eng = CollectiveEngine(mesh, s, use_xla_fastpath=False)
+    for i in (1.0, 3.0):
+        out = eng.all_reduce(jnp.ones((world, 8)) * i)
+        assert np.allclose(np.asarray(out), i * world)

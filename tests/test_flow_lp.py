@@ -23,9 +23,10 @@ def test_line_graph_two_rounds():
     assert two.makespan == pytest.approx(2.0, abs=1e-6)
 
     # extra rounds let the LP pipeline chunks (the reference's chunked-tree
-    # insight): 3 rounds reach 4/3, and more rounds approach 1 asymptotically
+    # insight): 3 rounds reach 1.5 (half crosses hop 0 while the other half
+    # is in flight on hop 1), and more rounds approach 1 asymptotically
     three = solve_broadcast_lp(3, edges, [1.0, 1.0], source=0, num_rounds=3)
-    assert three.makespan == pytest.approx(4.0 / 3.0, abs=1e-6)
+    assert three.makespan == pytest.approx(1.5, abs=1e-6)
     six = solve_broadcast_lp(3, edges, [1.0, 1.0], source=0, num_rounds=6)
     assert six.makespan < three.makespan
     sol = solve_broadcast_lp(3, edges, [1.0, 1.0], source=0)
@@ -112,3 +113,26 @@ def test_input_validation():
         solve_broadcast_lp(3, [(0, 1)], [1.0], source=7)
     with pytest.raises(ValueError, match="bandwidth"):
         solve_broadcast_lp(3, [(0, 1)], [1.0, 2.0], source=0)
+    with pytest.raises(ValueError, match="edges"):
+        solve_broadcast_lp(3, [(0, 1), (-1, 2)], [1.0, 1.0], source=0)
+    with pytest.raises(ValueError, match="edges"):
+        solve_broadcast_lp(3, [(0, 1), (1, 1)], [1.0, 1.0], source=0)
+
+
+def test_no_recirculation_shortcut():
+    """Regression: a fast cycle among non-source nodes must not satisfy
+    delivery by bouncing data — everything real crosses the slow source
+    uplink, so the makespan is bounded below by 1/0.1 = 10."""
+    sol = solve_broadcast_lp(
+        3, [(0, 1), (1, 2), (2, 1)], [0.1, 10.0, 10.0], source=0, num_rounds=6
+    )
+    assert sol.makespan >= 10.0 - 1e-6
+
+
+def test_default_rounds_cover_sparse_diameter():
+    """A 9-node line is feasible with default rounds (eccentricity 8 > log2)."""
+    n = 9
+    edges = [(i, i + 1) for i in range(n - 1)]
+    sol = solve_broadcast_lp(n, edges, [1.0] * len(edges), source=0)
+    assert sol.makespan >= float(n - 1) - 1e-6  # diameter lower bound-ish
+    assert len(sol.rounds) >= n - 1

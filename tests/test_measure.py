@@ -73,6 +73,22 @@ def test_wait_time_csv(tmp_path):
     assert float(rows[1][1]) == pytest.approx(0.5)
 
 
+def test_emulation_propagates_worker_errors():
+    """A failing worker must surface as an exception, not as fabricated
+    all-zero wait times."""
+
+    class Exploding(WaitTimeProbe):
+        def hook_arrive(self, step, rank):
+            if rank == 1:
+                raise RuntimeError("boom")
+            return super().hook_arrive(step, rank)
+
+    with pytest.raises(RuntimeError, match="boom"):
+        emulate_heterogeneous_steps(
+            Exploding(), world_size=3, num_steps=2, base_compute_s=0.001
+        )
+
+
 # --- throughput ---------------------------------------------------------------
 
 
