@@ -64,6 +64,14 @@ def _load() -> Optional[ctypes.CDLL]:
             getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_int, u8p, i32p, i32p, ctypes.c_int, ctypes.c_int]
         lib.adapcc_relay_role.restype = ctypes.c_int
         lib.adapcc_relay_role.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, u8p]
+        lib.adapcc_synthesize_partrees.restype = ctypes.c_void_p
+        lib.adapcc_synthesize_partrees.argtypes = [
+            ctypes.c_char_p, i32p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int,
+        ]
+        lib.adapcc_tree_ip.restype = ctypes.c_char_p
+        lib.adapcc_tree_ip.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
         _lib = lib
         break
     return _lib
@@ -76,18 +84,48 @@ def available() -> bool:
 class NativeStrategy:
     """A strategy parsed and lowered by the native engine."""
 
-    def __init__(self, xml_text: str):
+    def __init__(self, xml_text: Optional[str], _handle=None):
         lib = _load()
         if lib is None:
             raise RuntimeError("libadapcc_rt.so not built; run `make native`")
         self._lib = lib
-        self._h = lib.adapcc_parse_strategy(xml_text.encode())
+        self._h = _handle if _handle is not None else lib.adapcc_parse_strategy(xml_text.encode())
         err = lib.adapcc_error(self._h)
         if err:
             msg = err.decode()
             lib.adapcc_free_strategy(self._h)
             self._h = None
-            raise ValueError(f"native strategy parse failed: {msg}")
+            raise ValueError(f"native strategy failed: {msg}")
+
+    @classmethod
+    def synthesize_partrees(
+        cls,
+        ip_table: Sequence[str],
+        local_rank0_list: Sequence[int],
+        parallel_degree: int,
+        bandwidth_graph: Sequence[Sequence[float]],
+        latency_graph: Sequence[Sequence[float]],
+    ) -> "NativeStrategy":
+        """Native ParTrees synthesis (parity with
+        :class:`adapcc_tpu.strategy.partrees.ParTrees.synthesize`)."""
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("libadapcc_rt.so not built; run `make native`")
+        import numpy as np
+
+        world = len(ip_table)
+        masters = (ctypes.c_int32 * len(local_rank0_list))(*local_rank0_list)
+        # marshal matrices through numpy buffers: per-element Python indexing
+        # would cost O(world²) interpreter time per synthesis call
+        dp = ctypes.POINTER(ctypes.c_double)
+        flat_bw = np.ascontiguousarray(bandwidth_graph, dtype=np.float64)
+        flat_lat = np.ascontiguousarray(latency_graph, dtype=np.float64)
+        handle = lib.adapcc_synthesize_partrees(
+            "\n".join(ip_table).encode(), masters, len(local_rank0_list),
+            parallel_degree, flat_bw.ctypes.data_as(dp), flat_lat.ctypes.data_as(dp),
+            world,
+        )
+        return cls(None, _handle=handle)
 
     def __del__(self):
         if getattr(self, "_h", None):
@@ -141,6 +179,32 @@ class NativeStrategy:
 
     def prune_broadcast_rounds(self, t: int, active: Sequence[int]) -> List[CommRound]:
         return self._rounds(self._lib.adapcc_prune_broadcast_rounds, t, active)
+
+    def to_strategy(self, chunk_bytes: Optional[int] = None):
+        """Rebuild a Python :class:`~adapcc_tpu.strategy.ir.Strategy` from the
+        native handle (parent edges recovered from the broadcast lowering), so
+        natively synthesized strategies plug into the collective engine."""
+        from adapcc_tpu.primitives import DEFAULT_CHUNK_BYTES
+        from adapcc_tpu.strategy.ir import Strategy, Tree
+
+        trees = []
+        for t in range(self.num_trees):
+            children: dict = {}
+            ranks = {self.tree_root(t)}
+            for rnd in self.broadcast_rounds(t):
+                for parent, child in rnd.edges:
+                    children.setdefault(parent, []).append(child)
+                    ranks.update((parent, child))
+            ips = {}
+            for r in ranks:
+                ip = self._lib.adapcc_tree_ip(self._h, t, r)
+                if ip is not None:
+                    ips[r] = ip.decode()
+            trees.append(Tree(self.tree_root(t), children, ips))
+        return Strategy(
+            trees, self.world_size,
+            DEFAULT_CHUNK_BYTES if chunk_bytes is None else chunk_bytes,
+        )
 
     def relay_role(self, t: int, rank: int, active: Sequence[int]) -> RelayRole:
         act = set(active)

@@ -12,6 +12,7 @@
 // (adapcc_tpu/strategy/ir.py, adapcc_tpu/comm/relay.py); the pytest suite
 // asserts parity on every fixture.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -250,6 +251,81 @@ void walk_gpu(const XmlNode& node, int parent_rank, Tree* tree, Strategy* s,
   }
 }
 
+// --------------------------------------------------------------------------
+// ParTrees synthesis (parity with strategy/partrees.py)
+// --------------------------------------------------------------------------
+
+struct MasterInfo {
+  int rank;
+  std::vector<int> group;  // all ranks on this host, master first
+  double bdp;              // bandwidth-delay product of the outbound link
+};
+
+// '\n'-joined list → entries.  N entries arrive with N−1 separators, so the
+// final (possibly empty) entry is always emitted — dropping it would reject
+// legal empty-string ips with a wrong "size mismatch" diagnosis.
+std::vector<std::string> split_lines(const char* joined) {
+  std::vector<std::string> out;
+  if (!joined) return out;
+  std::string cur;
+  for (const char* p = joined; *p; ++p) {
+    if (*p == '\n') {
+      out.push_back(cur);
+      cur.clear();
+    } else {
+      cur.push_back(*p);
+    }
+  }
+  out.push_back(cur);
+  return out;
+}
+
+// Consecutive ranks sharing the master's ip form its host group; a group
+// also ends at the next master (partrees.py::_host_groups).
+std::map<int, std::vector<int>> host_groups(const std::vector<std::string>& ips,
+                                            const std::vector<int>& masters) {
+  std::set<int> master_set(masters.begin(), masters.end());
+  std::map<int, std::vector<int>> groups;
+  for (int m : masters) {
+    std::vector<int> group{m};
+    for (int r = m + 1; r < (int)ips.size() && ips[r] == ips[m] && !master_set.count(r); ++r)
+      group.push_back(r);
+    groups[m] = std::move(group);
+  }
+  return groups;
+}
+
+Tree build_partree(const std::vector<MasterInfo>& order,
+                   const std::map<int, std::vector<int>>& groups,
+                   const std::vector<std::string>& ips) {
+  Tree t;
+  t.root = order[0].rank;
+  // array-heap binary tree over the masters (partrees.py::_heap_tree_edges)
+  for (size_t i = 0; i < order.size(); ++i) {
+    for (size_t j : {2 * i + 1, 2 * i + 2}) {
+      if (j < order.size()) {
+        t.children[order[i].rank].push_back(order[j].rank);
+        t.parent[order[j].rank] = order[i].rank;
+      }
+    }
+  }
+  // chain policy: intra-host ranks beneath their master, chain head FIRST so
+  // the sibling index favors the fast local edge (partrees.py::_attach_chains)
+  for (const auto& m : order) {
+    const auto& group = groups.at(m.rank);
+    if (group.size() < 2) continue;
+    auto& kids = t.children[m.rank];
+    kids.insert(kids.begin(), group[1]);
+    t.parent[group[1]] = m.rank;
+    for (size_t i = 1; i + 1 < group.size(); ++i) {
+      t.children[group[i]].push_back(group[i + 1]);
+      t.parent[group[i + 1]] = group[i];
+    }
+  }
+  for (size_t r = 0; r < ips.size(); ++r) t.ips[(int)r] = ips[r];
+  return t;
+}
+
 }  // namespace
 
 // --------------------------------------------------------------------------
@@ -279,6 +355,64 @@ void* adapcc_parse_strategy(const char* xml_text) {
   return s.release();
 }
 
+// ParTrees synthesis: ip_table is '\n'-joined (world entries); bw/lat are
+// world×world row-major.  Returns a Strategy handle compatible with every
+// query/lowering entry point below; check adapcc_error before use.
+void* adapcc_synthesize_partrees(const char* ip_table_joined, const int32_t* masters,
+                                 int n_masters, int parallel_degree, const double* bw,
+                                 const double* lat, int world) {
+  auto s = std::make_unique<Strategy>();
+  auto ips = split_lines(ip_table_joined);
+  if ((int)ips.size() != world || world <= 0) {
+    s->error = "ip table size does not match world";
+    return s.release();
+  }
+  if (n_masters <= 0) {
+    s->error = "need at least one master";
+    return s.release();
+  }
+  std::vector<int> master_ranks;
+  std::set<int> seen_masters;
+  for (int i = 0; i < n_masters; ++i) {
+    int m = masters[i];
+    if (m < 0 || m >= world) {
+      s->error = "master rank out of range";
+      return s.release();
+    }
+    // a duplicate would build a self-parenting tree and hang any lowering
+    if (!seen_masters.insert(m).second) {
+      s->error = "duplicate master rank";
+      return s.release();
+    }
+    master_ranks.push_back(m);
+  }
+  auto groups = host_groups(ips, master_ranks);
+
+  std::vector<MasterInfo> infos;
+  for (int m : master_ranks) {
+    // probe target: first rank of the "next" host around the ring —
+    // this master's representative outbound inter-host link
+    int peer = (m + (int)groups[m].size()) % world;
+    MasterInfo mi;
+    mi.rank = m;
+    mi.group = groups[m];
+    mi.bdp = bw[m * world + peer] * lat[m * world + peer];
+    infos.push_back(std::move(mi));
+  }
+  // best-provisioned first; stable to match Python's tie behavior
+  std::stable_sort(infos.begin(), infos.end(),
+                   [](const MasterInfo& a, const MasterInfo& b) { return a.bdp > b.bdp; });
+
+  int degree = std::min((int)infos.size(), std::max(1, parallel_degree));
+  std::vector<MasterInfo> rotation = infos;
+  for (int t = 0; t < degree; ++t) {
+    if (t > 0) std::rotate(rotation.begin(), rotation.begin() + 1, rotation.end());
+    s->trees.push_back(build_partree(rotation, groups, ips));
+  }
+  s->world_size = world;
+  return s.release();
+}
+
 void adapcc_free_strategy(void* h) { delete static_cast<Strategy*>(h); }
 
 const char* adapcc_error(void* h) {
@@ -293,6 +427,16 @@ int adapcc_tree_root(void* h, int t) {
   auto* s = static_cast<Strategy*>(h);
   if (t < 0 || t >= (int)s->trees.size()) return -1;
   return s->trees[t].root;
+}
+
+// rank→ip for tree t; NULL for unknown tree/rank.  The pointer stays valid
+// until adapcc_free_strategy.
+const char* adapcc_tree_ip(void* h, int t, int rank) {
+  auto* s = static_cast<Strategy*>(h);
+  if (t < 0 || t >= (int)s->trees.size()) return nullptr;
+  auto& ips = s->trees[t].ips;
+  auto it = ips.find(rank);
+  return it == ips.end() ? nullptr : it->second.c_str();
 }
 
 // Lower rounds into caller buffers.  edges_out receives (src, dst) pairs

@@ -117,3 +117,114 @@ def test_tree_lowering_delegates_to_native_at_scale():
     edges = [(r, tree.parent[r]) for r in tree._topo_leaves_first()]
     expect = _pack_rounds(edges, after_all_incoming_of_src=True)
     assert [r.edges for r in rounds] == [r.edges for r in expect]
+
+
+# --- native ParTrees synthesis parity -----------------------------------------
+
+
+def _partrees_cases():
+    import numpy as np
+
+    shapes = [
+        (["h0"] * 4, [0], 1),
+        (["h0"] * 4 + ["h1"] * 4, [0, 4], 2),
+        (["h0"] * 2 + ["h1"] * 3 + ["h2"] * 3, [0, 2, 5], 3),
+        (["h0"] * 4 + ["h0"] * 4, [0, 4], 2),  # two masters sharing one ip
+        (["h0"] * 6 + ["h1"] * 6 + ["h2"] * 6 + ["h3"] * 6, [0, 6, 12, 18], 4),
+    ]
+    for seed, (ips, masters, degree) in enumerate(shapes):
+        world = len(ips)
+        rng = np.random.default_rng(seed)
+        bw = rng.uniform(1, 50, size=(world, world))
+        lat = rng.uniform(1e-5, 1e-3, size=(world, world))
+        yield ips, masters, degree, bw.tolist(), lat.tolist()
+
+
+def test_native_partrees_matches_python():
+    from adapcc_tpu.strategy.partrees import ParTrees
+
+    for ips, masters, degree, bw, lat in _partrees_cases():
+        py = ParTrees().synthesize(ips, masters, degree, bw, lat)
+        nat = native.NativeStrategy.synthesize_partrees(ips, masters, degree, bw, lat)
+        assert nat.world_size == py.world_size
+        assert nat.num_trees == len(py.trees)
+        for t, tree in enumerate(py.trees):
+            assert nat.tree_root(t) == tree.root
+            assert [r.edges for r in nat.reduce_rounds(t)] == [
+                r.edges for r in tree.reduce_rounds()
+            ]
+            assert [r.edges for r in nat.broadcast_rounds(t)] == [
+                r.edges for r in tree.broadcast_rounds()
+            ]
+
+
+def test_native_partrees_relay_parity():
+    from adapcc_tpu.strategy.partrees import ParTrees
+
+    ips, masters, degree, bw, lat = next(
+        c for c in _partrees_cases() if len(c[0]) == 8
+    )
+    py = ParTrees().synthesize(ips, masters, degree, bw, lat)
+    nat = native.NativeStrategy.synthesize_partrees(ips, masters, degree, bw, lat)
+    active = [0, 3, 5]
+    for t, tree in enumerate(py.trees):
+        assert [r.edges for r in nat.prune_reduce_rounds(t, active)] == [
+            r.edges for r in prune_reduce_rounds(tree, active)
+        ]
+        for rank in range(py.world_size):
+            assert nat.relay_role(t, rank, active) == compute_role(
+                tree, rank, frozenset(active)
+            )
+
+
+def test_native_partrees_to_strategy_roundtrip():
+    """Natively synthesized strategies convert back to engine-usable Python
+    strategies with identical lowering."""
+    from adapcc_tpu.strategy.partrees import ParTrees
+
+    for ips, masters, degree, bw, lat in _partrees_cases():
+        py = ParTrees().synthesize(ips, masters, degree, bw, lat)
+        nat = native.NativeStrategy.synthesize_partrees(ips, masters, degree, bw, lat)
+        back = nat.to_strategy()
+        assert back.world_size == py.world_size
+        for bt, pt in zip(back.trees, py.trees):
+            assert bt.root == pt.root
+            assert [r.edges for r in bt.reduce_rounds()] == [
+                r.edges for r in pt.reduce_rounds()
+            ]
+
+
+def test_native_partrees_validates():
+    with pytest.raises(ValueError, match="ip table"):
+        native.NativeStrategy.synthesize_partrees([], [0], 1, [], [])
+    with pytest.raises(ValueError, match="master"):
+        native.NativeStrategy.synthesize_partrees(
+            ["h0"] * 2, [5], 1, [[1.0] * 2] * 2, [[1.0] * 2] * 2
+        )
+
+
+def test_native_partrees_rejects_duplicate_masters():
+    with pytest.raises(ValueError, match="duplicate master"):
+        native.NativeStrategy.synthesize_partrees(
+            ["h0"] * 4, [0, 0], 1, [[1.0] * 4] * 4, [[1.0] * 4] * 4
+        )
+
+
+def test_native_partrees_accepts_empty_ips():
+    nat = native.NativeStrategy.synthesize_partrees(
+        ["", ""], [0, 1], 1, [[1.0, 1.0]] * 2, [[1.0, 1.0]] * 2
+    )
+    assert nat.world_size == 2
+
+
+def test_to_strategy_preserves_ips():
+    ips = ["h0"] * 4 + ["h1"] * 4
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    bw = rng.uniform(1, 50, size=(8, 8)).tolist()
+    lat = rng.uniform(1e-5, 1e-3, size=(8, 8)).tolist()
+    nat = native.NativeStrategy.synthesize_partrees(ips, [0, 4], 2, bw, lat)
+    back = nat.to_strategy()
+    for tree in back.trees:
+        assert tree.ips[0] == "h0" and tree.ips[4] == "h1"
