@@ -130,3 +130,58 @@ class AdapCC:
     def clear(cls, prim: int) -> None:
         cls.communicator.exit_threads(prim)
         cls.communicator.clear()
+
+
+def smoke_benchmark(world: int = 4) -> None:
+    """The reference's ``__main__`` smoke benchmark (adapcc.py:81-117): full
+    adaptive bootstrap, then 16-float allreduces of ``ones*i`` over ``world``
+    ranks — every rank must print ``i*world`` — plus a subset (relay)
+    allreduce.  Output is deterministic; ``log/primitive`` holds the golden
+    copy (README.md:104 analog), asserted by the test suite.
+    """
+    import tempfile
+
+    from adapcc_tpu.launch import maybe_initialize_distributed
+
+    # re-pin jax_platforms from the env before any device use (site
+    # customizations override the env var at interpreter startup)
+    maybe_initialize_distributed()
+
+    import jax
+    import numpy as np
+
+    from adapcc_tpu.comm.mesh import build_world_mesh
+    from adapcc_tpu.primitives import ALLREDUCE
+
+    mesh = build_world_mesh(min(world, len(jax.devices())))
+    w = int(mesh.devices.size)
+    workdir = tempfile.mkdtemp(prefix="adapcc_smoke_")
+    args = CommArgs(
+        strategy_file=f"{workdir}/strategy.xml",
+        logical_graph=f"{workdir}/logical_graph.xml",
+        topology_dir=workdir,
+        entry_point=DETECT,
+        parallel_degree=2,
+    )
+    AdapCC.init(args, mesh=mesh)
+    AdapCC.setup(ALLREDUCE)
+
+    for i in (1, 2, 3):
+        x = jnp.stack([jnp.ones(16) * i for _ in range(w)])
+        out = np.asarray(AdapCC.allreduce(x, size=16, chunk_bytes=8))
+        for r in range(w):
+            vals = out[r].astype(int).tolist()
+            print(f"rank {r} allreduce(ones*{i}) -> {vals}")
+
+    # subset collective: the last rank is a relay; active ranks still sum
+    x = jnp.stack([jnp.ones(16) * (r + 1) for r in range(w)])
+    active = list(range(w - 1))
+    out = np.asarray(AdapCC.allreduce(x, active_gpus=active))
+    print(f"partial allreduce over active {active} -> {int(out[0][0])}")
+
+    AdapCC.clear(ALLREDUCE)
+    print("smoke benchmark complete")
+
+
+if __name__ == "__main__":
+    smoke_benchmark()

@@ -42,39 +42,45 @@ def _load() -> Optional[ctypes.CDLL]:
             continue
         try:
             lib = ctypes.CDLL(path)
-        except OSError:
+            _bind_symbols(lib)
+        except (OSError, AttributeError):
+            # unloadable, or a stale build missing newer entry points —
+            # skip it so available() degrades to the Python implementations
             continue
-        lib.adapcc_parse_strategy.restype = ctypes.c_void_p
-        lib.adapcc_parse_strategy.argtypes = [ctypes.c_char_p]
-        lib.adapcc_free_strategy.argtypes = [ctypes.c_void_p]
-        lib.adapcc_error.restype = ctypes.c_char_p
-        lib.adapcc_error.argtypes = [ctypes.c_void_p]
-        for fn in ("adapcc_world_size", "adapcc_num_trees"):
-            getattr(lib, fn).restype = ctypes.c_int
-            getattr(lib, fn).argtypes = [ctypes.c_void_p]
-        lib.adapcc_tree_root.restype = ctypes.c_int
-        lib.adapcc_tree_root.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        i32p = ctypes.POINTER(ctypes.c_int32)
-        u8p = ctypes.POINTER(ctypes.c_uint8)
-        for fn in ("adapcc_reduce_rounds", "adapcc_broadcast_rounds"):
-            getattr(lib, fn).restype = ctypes.c_int
-            getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_int, i32p, i32p, ctypes.c_int, ctypes.c_int]
-        for fn in ("adapcc_prune_reduce_rounds", "adapcc_prune_broadcast_rounds"):
-            getattr(lib, fn).restype = ctypes.c_int
-            getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_int, u8p, i32p, i32p, ctypes.c_int, ctypes.c_int]
-        lib.adapcc_relay_role.restype = ctypes.c_int
-        lib.adapcc_relay_role.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, u8p]
-        lib.adapcc_synthesize_partrees.restype = ctypes.c_void_p
-        lib.adapcc_synthesize_partrees.argtypes = [
-            ctypes.c_char_p, i32p, ctypes.c_int, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
-            ctypes.c_int,
-        ]
-        lib.adapcc_tree_ip.restype = ctypes.c_char_p
-        lib.adapcc_tree_ip.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
         _lib = lib
         break
     return _lib
+
+
+def _bind_symbols(lib: ctypes.CDLL) -> None:
+    lib.adapcc_parse_strategy.restype = ctypes.c_void_p
+    lib.adapcc_parse_strategy.argtypes = [ctypes.c_char_p]
+    lib.adapcc_free_strategy.argtypes = [ctypes.c_void_p]
+    lib.adapcc_error.restype = ctypes.c_char_p
+    lib.adapcc_error.argtypes = [ctypes.c_void_p]
+    for fn in ("adapcc_world_size", "adapcc_num_trees"):
+        getattr(lib, fn).restype = ctypes.c_int
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.adapcc_tree_root.restype = ctypes.c_int
+    lib.adapcc_tree_root.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    for fn in ("adapcc_reduce_rounds", "adapcc_broadcast_rounds"):
+        getattr(lib, fn).restype = ctypes.c_int
+        getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_int, i32p, i32p, ctypes.c_int, ctypes.c_int]
+    for fn in ("adapcc_prune_reduce_rounds", "adapcc_prune_broadcast_rounds"):
+        getattr(lib, fn).restype = ctypes.c_int
+        getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_int, u8p, i32p, i32p, ctypes.c_int, ctypes.c_int]
+    lib.adapcc_relay_role.restype = ctypes.c_int
+    lib.adapcc_relay_role.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int, u8p]
+    lib.adapcc_synthesize_partrees.restype = ctypes.c_void_p
+    lib.adapcc_synthesize_partrees.argtypes = [
+        ctypes.c_char_p, i32p, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        ctypes.c_int,
+    ]
+    lib.adapcc_tree_ip.restype = ctypes.c_char_p
+    lib.adapcc_tree_ip.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
 
 
 def available() -> bool:
@@ -114,12 +120,19 @@ class NativeStrategy:
         import numpy as np
 
         world = len(ip_table)
+        if world == 0:
+            raise ValueError("ip table is empty")
         masters = (ctypes.c_int32 * len(local_rank0_list))(*local_rank0_list)
         # marshal matrices through numpy buffers: per-element Python indexing
         # would cost O(world²) interpreter time per synthesis call
         dp = ctypes.POINTER(ctypes.c_double)
         flat_bw = np.ascontiguousarray(bandwidth_graph, dtype=np.float64)
         flat_lat = np.ascontiguousarray(latency_graph, dtype=np.float64)
+        # shape check before raw pointers cross the boundary: a wrong-sized
+        # matrix would be an out-of-bounds native read, not a clean error
+        for name, m in (("bandwidth_graph", flat_bw), ("latency_graph", flat_lat)):
+            if m.shape != (world, world):
+                raise ValueError(f"{name} must be {world}x{world}, got {m.shape}")
         handle = lib.adapcc_synthesize_partrees(
             "\n".join(ip_table).encode(), masters, len(local_rank0_list),
             parallel_degree, flat_bw.ctypes.data_as(dp), flat_lat.ctypes.data_as(dp),

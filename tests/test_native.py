@@ -228,3 +228,10 @@ def test_to_strategy_preserves_ips():
     back = nat.to_strategy()
     for tree in back.trees:
         assert tree.ips[0] == "h0" and tree.ips[4] == "h1"
+
+
+def test_native_partrees_rejects_bad_matrix_shapes():
+    with pytest.raises(ValueError, match="8x8"):
+        native.NativeStrategy.synthesize_partrees(
+            ["h0"] * 8, [0, 4], 2, [[1.0] * 4] * 4, [[1.0] * 4] * 4
+        )

@@ -1,0 +1,31 @@
+"""Facade smoke benchmark vs the committed golden log (log/primitive).
+
+The reference documents its expected smoke output in log/primitive
+(README.md:104); this pins ours the same way — any change to collective
+semantics or the bootstrap that alters results shows up as a golden diff.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_smoke_benchmark_matches_golden():
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    }
+    out = subprocess.run(
+        [sys.executable, "-m", "adapcc_tpu.api"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=570,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    got = [l for l in out.stdout.splitlines() if l.strip()]
+    golden = [
+        l for l in open(os.path.join(REPO, "log", "primitive")).read().splitlines()
+        if l.strip()
+    ]
+    assert got == golden
