@@ -155,31 +155,31 @@ def smoke_benchmark(world: int = 4) -> None:
 
     mesh = build_world_mesh(min(world, len(jax.devices())))
     w = int(mesh.devices.size)
-    workdir = tempfile.mkdtemp(prefix="adapcc_smoke_")
-    args = CommArgs(
-        strategy_file=f"{workdir}/strategy.xml",
-        logical_graph=f"{workdir}/logical_graph.xml",
-        topology_dir=workdir,
-        entry_point=DETECT,
-        parallel_degree=2,
-    )
-    AdapCC.init(args, mesh=mesh)
-    AdapCC.setup(ALLREDUCE)
+    with tempfile.TemporaryDirectory(prefix="adapcc_smoke_") as workdir:
+        args = CommArgs(
+            strategy_file=f"{workdir}/strategy.xml",
+            logical_graph=f"{workdir}/logical_graph.xml",
+            topology_dir=workdir,
+            entry_point=DETECT,
+            parallel_degree=2,
+        )
+        AdapCC.init(args, mesh=mesh)
+        AdapCC.setup(ALLREDUCE)
 
-    for i in (1, 2, 3):
-        x = jnp.stack([jnp.ones(16) * i for _ in range(w)])
-        out = np.asarray(AdapCC.allreduce(x, size=16, chunk_bytes=8))
-        for r in range(w):
-            vals = out[r].astype(int).tolist()
-            print(f"rank {r} allreduce(ones*{i}) -> {vals}")
+        for i in (1, 2, 3):
+            x = jnp.stack([jnp.ones(16) * i for _ in range(w)])
+            out = np.asarray(AdapCC.allreduce(x, size=16, chunk_bytes=8))
+            for r in range(w):
+                vals = out[r].astype(int).tolist()
+                print(f"rank {r} allreduce(ones*{i}) -> {vals}")
 
-    # subset collective: the last rank is a relay; active ranks still sum
-    x = jnp.stack([jnp.ones(16) * (r + 1) for r in range(w)])
-    active = list(range(w - 1))
-    out = np.asarray(AdapCC.allreduce(x, active_gpus=active))
-    print(f"partial allreduce over active {active} -> {int(out[0][0])}")
+        # subset collective: the last rank is a relay; active ranks still sum
+        x = jnp.stack([jnp.ones(16) * (r + 1) for r in range(w)])
+        active = list(range(w - 1))
+        out = np.asarray(AdapCC.allreduce(x, active_gpus=active))
+        print(f"partial allreduce over active {active} -> {int(out[0][0])}")
 
-    AdapCC.clear(ALLREDUCE)
+        AdapCC.clear(ALLREDUCE)
     print("smoke benchmark complete")
 
 

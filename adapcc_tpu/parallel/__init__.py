@@ -8,6 +8,7 @@ strategy here is expressed as shardings + collectives over a
 ``jax.sharding.Mesh`` axis so XLA schedules the ICI traffic.
 """
 
+from adapcc_tpu.parallel.ulysses import ulysses_attention, ulysses_attention_shard
 from adapcc_tpu.parallel.ring_attention import (
     ring_attention,
     ring_attention_shard,
@@ -24,6 +25,8 @@ from adapcc_tpu.parallel.expert import expert_parallel_moe
 __all__ = [
     "ring_attention",
     "ring_attention_shard",
+    "ulysses_attention",
+    "ulysses_attention_shard",
     "column_parallel_dense",
     "row_parallel_dense",
     "gpt2_tp_rules",
