@@ -63,8 +63,224 @@ def _tree_cost_coeffs(
     return lat_sum, inv_bw_sum
 
 
+#: above this many masters the routing MILP (O(M·n²) binaries) is skipped in
+#: favor of the rotation model, which only chooses roots and shares
+ROUTING_MILP_MAX_MASTERS = 12
+
+#: branch-and-bound budget for the routing MILP; on timeout HiGHS reports
+#: failure and synthesis falls back to the rotation model, bounding the
+#: topology-reconstruction stall a hard instance could cause
+ROUTING_MILP_TIME_LIMIT_S = 10.0
+
+
 class MilpSolver:
     def synthesize(
+        self,
+        ip_table: Sequence[str],
+        local_rank0_list: Sequence[int],
+        prim: int,
+        parallel_degree: int,
+        transmission_size: int,
+        bandwidth_graph: Sequence[Sequence[float]],
+        latency_graph: Sequence[Sequence[float]],
+    ) -> Strategy:
+        """Routing MILP when the master count permits, else the rotation
+        model; both fall back to ParTrees on solver failure."""
+        if 1 < len(local_rank0_list) <= ROUTING_MILP_MAX_MASTERS:
+            strategy = self._synthesize_routing(
+                ip_table, local_rank0_list, prim, parallel_degree,
+                transmission_size, bandwidth_graph, latency_graph,
+            )
+            if strategy is not None:
+                return strategy
+        return self._synthesize_rotation(
+            ip_table, local_rank0_list, prim, parallel_degree,
+            transmission_size, bandwidth_graph, latency_graph,
+        )
+
+    # -- full routing formulation (reference solver.py x_ijf + flow) -----------
+
+    def _synthesize_routing(
+        self,
+        ip_table: Sequence[str],
+        local_rank0_list: Sequence[int],
+        prim: int,
+        parallel_degree: int,
+        transmission_size: int,
+        bandwidth_graph: Sequence[Sequence[float]],
+        latency_graph: Sequence[Sequence[float]],
+    ) -> "Strategy | None":
+        """Choose the actual inter-host tree edges, not just the root.
+
+        Per tree m over the n masters:
+
+            r[m,g]   binary   g roots tree m        (Σ_g r = 1; Σ_m r_mg ≤ 1)
+            e[m,i,j] binary   i parents j           (Σ_i e_mij = 1 − r_mj)
+            f[m,i,j] ≥ 0      flow, conservation    (in − out = 1 − n·r_mj)
+                              f ≤ (n−1)·e           (flow rides chosen edges)
+            s[m] ≥ 0          tensor share          (Σ s = 1; a share may be
+                              0 — that tree then carries nothing)
+            T ≥ lat_ij·e + size·s_m/bw_ij − M_ij(1−e)   per (m,i,j)
+
+        The flow system forces each tree to be a spanning arborescence (the
+        reference's flow-conservation big-M constraints, solver.py:143-176);
+        the per-edge T bound is the pipeline-aware bottleneck objective
+        (chunks pipeline, so completion tracks the slowest active link;
+        solver.py:190-208).  ``M_ij`` is per-edge (the edge's own worst cost)
+        — one global M derived from a near-dead profiled link would dwarf
+        every real coefficient and let tolerance-sized violations erase the
+        objective.  Returns None when HiGHS fails or times out.
+        """
+        from scipy.optimize import Bounds, LinearConstraint, milp
+        from scipy.sparse import csr_matrix
+
+        world = len(ip_table)
+        masters = list(local_rank0_list)
+        n = len(masters)
+        m_trees = min(max(1, parallel_degree), n)
+        size = float(max(transmission_size, 1))
+        bw = np.asarray(bandwidth_graph, dtype=float)
+        lat = np.asarray(latency_graph, dtype=float)
+
+        # variable layout per tree m: r[g] (n), e[i,j] (n²), f[i,j] (n²);
+        # then s[m] (m_trees) and T
+        per_tree = n + 2 * n * n
+        nvar = m_trees * per_tree + m_trees + 1
+        Ti = nvar - 1
+
+        def ri(m, g):
+            return m * per_tree + g
+
+        def ei(m, i, j):
+            return m * per_tree + n + i * n + j
+
+        def fi(m, i, j):
+            return m * per_tree + n + n * n + i * n + j
+
+        def si(m):
+            return m_trees * per_tree + m
+
+        c = np.zeros(nvar)
+        c[Ti] = 1.0
+
+        # sparse triplet assembly: dense length-nvar rows would be >99% zeros
+        # and cost ~100 MB at the size guard
+        rows_i: List[int] = []
+        cols: List[int] = []
+        vals: List[float] = []
+        lb: List[float] = []
+        ub: List[float] = []
+
+        def add(entries, lo, hi):
+            r = len(lb)
+            for col, val in entries:
+                rows_i.append(r)
+                cols.append(col)
+                vals.append(val)
+            lb.append(lo)
+            ub.append(hi)
+
+        for m in range(m_trees):
+            # one root
+            add([(ri(m, g), 1.0) for g in range(n)], 1.0, 1.0)
+            for j in range(n):
+                # parent count: Σ_i e[i,j] + r[j] = 1
+                add(
+                    [(ri(m, j), 1.0)]
+                    + [(ei(m, i, j), 1.0) for i in range(n) if i != j],
+                    1.0, 1.0,
+                )
+                # flow conservation: Σ_i f[i,j] − Σ_k f[j,k] = 1 − n·r[j]
+                add(
+                    [(ri(m, j), float(n))]
+                    + [(fi(m, i, j), 1.0) for i in range(n) if i != j]
+                    + [(fi(m, j, k), -1.0) for k in range(n) if k != j],
+                    1.0, 1.0,
+                )
+            for i in range(n):
+                for j in range(n):
+                    if i != j:
+                        # flow rides chosen edges: f ≤ (n−1)·e
+                        add(
+                            [(fi(m, i, j), 1.0), (ei(m, i, j), -(n - 1.0))],
+                            -np.inf, 0.0,
+                        )
+
+        # root diversity across trees
+        for g in range(n):
+            add([(ri(m, g), 1.0) for m in range(m_trees)], 0.0, 1.0)
+
+        # shares cover the tensor
+        add([(si(m), 1.0) for m in range(m_trees)], 1.0, 1.0)
+
+        # pipeline-aware bottleneck: T ≥ lat·e + size·s/bw − M_ij(1−e), with
+        # the big-M per edge (that edge's own worst-case cost)
+        inv_bw = np.zeros((n, n))
+        for a in range(n):
+            for b in range(n):
+                if a != b:
+                    inv_bw[a][b] = 1.0 / max(bw[masters[a]][masters[b]], 1e-9)
+        for m in range(m_trees):
+            for i in range(n):
+                for j in range(n):
+                    if i == j:
+                        continue
+                    lat_ij = lat[masters[i]][masters[j]]
+                    m_ij = lat_ij + size * inv_bw[i][j] + 1.0
+                    add(
+                        [
+                            (Ti, 1.0),
+                            (ei(m, i, j), -(lat_ij + m_ij)),
+                            (si(m), -size * inv_bw[i][j]),
+                        ],
+                        -m_ij, np.inf,
+                    )
+
+        integrality = np.zeros(nvar)
+        bounds_lb = np.zeros(nvar)
+        bounds_ub = np.full(nvar, np.inf)
+        for m in range(m_trees):
+            for g in range(n):
+                integrality[ri(m, g)] = 1
+                bounds_ub[ri(m, g)] = 1.0
+            for i in range(n):
+                for j in range(n):
+                    integrality[ei(m, i, j)] = 1
+                    bounds_ub[ei(m, i, j)] = 1.0 if i != j else 0.0
+                    bounds_ub[fi(m, i, j)] = float(n - 1) if i != j else 0.0
+
+        A = csr_matrix(
+            (vals, (rows_i, cols)), shape=(len(lb), nvar), dtype=float
+        )
+        res = milp(
+            c=c,
+            constraints=LinearConstraint(A, np.array(lb), np.array(ub)),
+            integrality=integrality,
+            bounds=Bounds(bounds_lb, bounds_ub),
+            options={"time_limit": ROUTING_MILP_TIME_LIMIT_S},
+        )
+        if not res.success or res.x is None:
+            return None
+
+        groups = _host_groups(ip_table, masters)
+        ips = {r: ip for r, ip in enumerate(ip_table)}
+        trees: List[Tree] = []
+        shares: List[float] = []
+        for m in range(m_trees):
+            children: Dict[int, List[int]] = {}
+            root = masters[int(np.argmax([res.x[ri(m, g)] for g in range(n)]))]
+            for i in range(n):
+                for j in range(n):
+                    if i != j and res.x[ei(m, i, j)] > 0.5:
+                        children.setdefault(masters[i], []).append(masters[j])
+            _attach_chains(children, masters, groups)
+            trees.append(Tree(root, children, ips))
+            shares.append(float(res.x[si(m)]))
+        return Strategy(trees, world, DEFAULT_CHUNK_BYTES, shares=shares)
+
+    # -- rotation formulation (roots + shares over ParTrees shapes) ------------
+
+    def _synthesize_rotation(
         self,
         ip_table: Sequence[str],
         local_rank0_list: Sequence[int],

@@ -101,3 +101,63 @@ def test_milp_solver_splits_shares_across_trees():
     s = syn.synthesize(ALLREDUCE, 2, 1 << 26, bw, lat)
     assert s.num_trans == 2
     assert {t.root for t in s.trees} == {0, 4}
+
+
+def test_routing_milp_avoids_slow_link():
+    """The routing formulation chooses tree edges, not just roots: with one
+    pathologically slow link, no chosen inter-host edge crosses it (the
+    rotation model cannot express this)."""
+    ip_table = ["a", "b", "c", "d"]
+    bw = np.full((4, 4), 100.0)
+    lat = np.full((4, 4), 1e-4)
+    bw[0, 1] = bw[1, 0] = 0.001  # the poisoned link
+    syn = Synthesizer(None, ip_table, policy="milp")
+    s = syn.synthesize(ALLREDUCE, 1, 1 << 26, bw, lat)
+    tree = s.trees[0]
+    for child, parent in tree.parent.items():
+        assert {child, parent} != {0, 1}, "tree routed through the slow link"
+    # still a spanning tree over all masters
+    assert tree.ranks == frozenset(range(4))
+
+
+def test_routing_milp_trees_are_valid_arborescences():
+    rng = np.random.default_rng(5)
+    ip_table = ["a"] * 2 + ["b"] * 2 + ["c"] * 2 + ["d"] * 2
+    world = len(ip_table)
+    bw = rng.uniform(1, 50, size=(world, world))
+    lat = rng.uniform(1e-5, 1e-3, size=(world, world))
+    syn = Synthesizer(None, ip_table, policy="milp")
+    s = syn.synthesize(ALLREDUCE, 3, 1 << 24, bw, lat)
+    assert s.num_trans == 3
+    assert len({t.root for t in s.trees}) == 3  # root diversity
+    assert sum(s.tree_shares()) == pytest.approx(1.0)
+    for tree in s.trees:
+        # Tree's constructor validates single-parent/acyclic; check spanning
+        assert tree.ranks == frozenset(range(world))
+
+
+def test_routing_milp_routes_through_fast_hub():
+    """With only node 2's links fast, every tree must run through the hub —
+    no tree may use the slow 0↔1 edge, whatever its root."""
+    ip_table = ["a", "b", "c"]
+    bw = np.full((3, 3), 1.0)
+    lat = np.full((3, 3), 1e-3)
+    bw[2, :] = bw[:, 2] = 50.0
+    syn = Synthesizer(None, ip_table, policy="milp")
+    s = syn.synthesize(ALLREDUCE, 2, 1 << 26, bw, lat)
+    assert sum(s.tree_shares()) == pytest.approx(1.0)
+    for tree in s.trees:
+        for child, parent in tree.parent.items():
+            assert {child, parent} != {0, 1}, "tree used the slow edge"
+
+
+def test_routing_milp_falls_back_beyond_size_guard(monkeypatch):
+    from adapcc_tpu.strategy import solver as solver_mod
+
+    monkeypatch.setattr(solver_mod, "ROUTING_MILP_MAX_MASTERS", 2)
+    ip_table = ["a", "b", "c"]
+    bw = np.ones((3, 3)) * 10.0
+    lat = np.ones((3, 3)) * 1e-4
+    syn = Synthesizer(None, ip_table, policy="milp")
+    s = syn.synthesize(ALLREDUCE, 1, 1 << 20, bw, lat)  # 3 masters > guard of 2
+    assert s.trees[0].ranks == frozenset(range(3))
