@@ -1,0 +1,105 @@
+"""Bring-up smoke checks — units-test/{check_mpi_connect,check-p2p} analogs.
+
+The reference ships minimal scripts to validate a cluster before real runs:
+an mpirun echo sanity check and a CUDA-aware MPI point-to-point test
+(SURVEY §4.2).  The TPU analogs, runnable standalone or via launcher
+``--exec-file "-m adapcc_tpu.launch.check_connect"``:
+
+1. **world check**: the process joins the jax.distributed world (or the
+   local/virtual device set) and reports device count + process indices —
+   the ``echo HELLO`` analog.
+2. **p2p check**: a one-hop ``ppermute`` ring pass with per-rank payloads
+   verifying every neighbor link delivers intact data — the
+   ``check_mpi_p2p.cu`` analog.
+3. **collective check**: the ``ones*i → i*w`` allreduce oracle.
+
+Exit code 0 iff every check passes.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional, Sequence
+
+
+def check_world(world: Optional[int] = None):
+    """Join the world; return (mesh, report string)."""
+    from adapcc_tpu.launch import maybe_initialize_distributed
+
+    distributed = maybe_initialize_distributed()
+
+    import jax
+
+    from adapcc_tpu.comm.mesh import build_world_mesh
+
+    mesh = build_world_mesh(world)
+    report = (
+        f"world: {int(mesh.devices.size)} devices over "
+        f"{jax.process_count()} process(es), platform "
+        f"{jax.devices()[0].platform}, distributed={distributed}"
+    )
+    return mesh, report
+
+
+def check_p2p(mesh) -> bool:
+    """Every rank sends its rank-stamped payload one hop; each must receive
+    exactly its left neighbor's."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    world = int(mesh.devices.size)
+    perm = [(i, (i + 1) % world) for i in range(world)]
+
+    def shard(x):
+        return lax.ppermute(x, "ranks", perm)
+
+    fn = jax.jit(
+        jax.shard_map(shard, mesh=mesh, in_specs=P("ranks"), out_specs=P("ranks"))
+    )
+    payload = jnp.stack([jnp.full((8,), r, jnp.float32) for r in range(world)])
+    out = np.asarray(fn(payload))
+    expect = np.stack([np.full((8,), (r - 1) % world) for r in range(world)])
+    return bool((out == expect).all())
+
+
+def check_allreduce(mesh) -> bool:
+    """ones*i over w ranks must equal i*w everywhere (adapcc.py oracle)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.strategy.ir import Strategy
+
+    world = int(mesh.devices.size)
+    engine = CollectiveEngine(mesh, Strategy.ring(world))
+    for i in (1.0, 3.0):
+        out = np.asarray(engine.all_reduce(jnp.ones((world, 8)) * i))
+        if not np.allclose(out, i * world):
+            return False
+    return True
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--world", type=int, default=None)
+    # accepted for launcher flag-contract compat; unused by the checks
+    for flag in ("--port", "--entry_point", "--strategy_file", "--logical_graph",
+                 "--parallel_degree", "--profile_freq"):
+        ap.add_argument(flag, default=None)
+    args = ap.parse_args(argv)
+
+    mesh, report = check_world(int(args.world) if args.world else None)
+    print(report)
+    ok = True
+    for name, check in (("p2p", check_p2p), ("allreduce", check_allreduce)):
+        passed = check(mesh)
+        print(f"{name} check: {'OK' if passed else 'FAILED'}")
+        ok = ok and passed
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,41 @@
+"""Bring-up smoke checks (check_mpi_connect / check-p2p analogs)."""
+
+import os
+import subprocess
+import sys
+
+from adapcc_tpu.launch.check_connect import check_allreduce, check_p2p, check_world
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_checks_pass_on_virtual_pod(mesh8):
+    assert check_p2p(mesh8)
+    assert check_allreduce(mesh8)
+
+
+def test_check_world_reports(mesh4):
+    mesh, report = check_world(4)
+    assert int(mesh.devices.size) == 4
+    assert "4 devices" in report
+
+
+def test_cli_exit_code_and_flag_contract():
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    }
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "adapcc_tpu.launch.check_connect",
+            "--world", "8",
+            # the launcher forwards these to every exec-file; they must parse
+            "--port=5000", "--entry_point=-1", "--strategy_file=s.xml",
+            "--logical_graph=g.xml", "--parallel_degree=2", "--profile_freq=0",
+        ],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=570,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "p2p check: OK" in out.stdout
+    assert "allreduce check: OK" in out.stdout
