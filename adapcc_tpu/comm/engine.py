@@ -331,7 +331,7 @@ class CollectiveEngine:
                 op=op,
             )
             key = ("allreduce", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name, op)
-        self._record("allreduce", key[0], stacked)
+        self._record("allreduce", "xla" if key[0] == "psum" else "schedule", stacked)
         return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
     def _psum_shard(self, x: jnp.ndarray, mask: jnp.ndarray, op: ReduceOp) -> jnp.ndarray:
@@ -361,7 +361,9 @@ class CollectiveEngine:
             broadcast_shard, strategy=self.strategy, axis_name=self.axis_name
         )
         key = ("broadcast", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name)
-        self._record("boardcast", "schedule", stacked)
+        # trace vocabulary is normalized ("broadcast"); only the API keeps
+        # the reference's "boardcast" spelling
+        self._record("broadcast", "schedule", stacked)
         return self._shard_mapped(key, per_shard, 1)(stacked)
 
     broadcast = boardcast

@@ -107,6 +107,10 @@ class Communicator:
         self._controller_thread = None
         self._step_queue = None
         self._active_by_step: Dict[int, List[int]] = {}
+        # per-step negotiate() round-trip cost (reference instruments its
+        # hook with rpc latency prints + latency_0.0.txt, commu.py:37,387-394)
+        self.rpc_latencies: List[tuple] = []  # (step, seconds)
+        self.metrics = None  # optional MetricsRegistry; timings under "negotiate"
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -352,7 +356,17 @@ class Communicator:
         import grpc as _grpc
 
         try:
-            return self._hooker.send_ready_request(step, self.process_rank)
+            import time as _time
+
+            t0 = _time.perf_counter()
+            active = self._hooker.send_ready_request(step, self.process_rank)
+            dt = _time.perf_counter() - t0
+            self.rpc_latencies.append((step, dt))
+            if len(self.rpc_latencies) > 100_000:  # bound long-run memory
+                del self.rpc_latencies[: 50_000]
+            if self.metrics is not None:
+                self.metrics.observe("negotiate", dt)
+            return active
         except _grpc.RpcError as e:
             if self.num_processes <= 1:
                 # sole participant: falling back to "just me" cannot diverge
@@ -367,6 +381,19 @@ class Communicator:
                 "coordinator unreachable during hook negotiation; cannot pick an "
                 "active set unilaterally in a multi-process world"
             ) from e
+
+    def write_rpc_latency(self, path: Optional[str] = None) -> str:
+        """Dump per-step negotiate() round-trip latencies, one float per
+        line — the reference's ``proto/latency_0.0.txt`` artifact
+        (commu.py:37,387-394 wrote ``format(rpc_end - rpc_start, 'f')``)."""
+        if path is None:
+            path = os.path.join(
+                self.args.topology_dir, f"latency_{self.process_rank}.0.txt"
+            )
+        with open(path, "w") as f:
+            for _, dt in self.rpc_latencies:
+                f.write(format(dt, "f") + "\n")
+        return path
 
     def relay_active_list(self, step: int) -> Optional[List[int]]:
         return self._active_by_step.get(step)

@@ -93,10 +93,18 @@ class CoordinatorLogic:
                     self._cond.wait()
                 return list(self._frozen[step])
 
-            # leader: rent-or-buy wait loop
+            # leader: rent-or-buy wait loop.  Unlike the reference
+            # (rpc_server.py:69-96, which can wait forever when no peer ever
+            # arrives), a sole leader escapes after the fault timeout and
+            # freezes its singleton list — dead peers are the controller
+            # phase's problem, not a reason to hang the hook phase.  Rent is
+            # wall time actually waited (a condition variable wakes early on
+            # any notify — heartbeats, other steps' arrivals — so counting a
+            # full slot per wakeup would inflate rent arbitrarily).
             initial_rent = self._initial_rent_cost()
-            accumulated_rent = 0.0
+            t0 = time.monotonic()
             while True:
+                accumulated_rent = time.monotonic() - t0
                 num_ready = len(self._ready[step])
                 if num_ready == self.world_size:
                     break
@@ -106,8 +114,9 @@ class CoordinatorLogic:
                         or accumulated_rent > self.relay_threshold
                     ):
                         break
+                elif accumulated_rent > self.fault_timeout:
+                    break
                 self._cond.wait(timeout=self.time_slot)
-                accumulated_rent += self.time_slot
 
             self._frozen[step] = list(self._ready[step])
             self._cond.notify_all()

@@ -140,6 +140,31 @@ class GradSyncHook:
         ]
         return unflatten_from_buckets(self._plan, synced)
 
+    def sync_deferred(
+        self, grads: Any, deferred: Any, active_mask: jnp.ndarray
+    ) -> tuple:
+        """Async (non-BSP) relay sync; call inside shard_map.
+
+        The reference's non-BSP mode replays a straggler's recorded buckets
+        through relay ranks so its gradients still land
+        (commu.py:160-170,427-431 + run.cu updateActive).  Under one SPMD
+        program the replay becomes a carried per-rank buffer: a rank masked
+        out of this step banks ``grads + deferred`` locally and contributes
+        the accumulated sum at its next active step, when the masked
+        allreduce folds it into the average.  Returns
+        ``(synced, new_deferred)``; active ranks leave with a cleared buffer.
+        """
+        import jax as _jax
+        from jax import lax as _lax
+
+        contrib = _jax.tree_util.tree_map(lambda g, d: g + d, grads, deferred)
+        synced = self.sync(contrib, active_mask)
+        my_active = active_mask[_lax.axis_index(self.axis_name)]
+        new_deferred = _jax.tree_util.tree_map(
+            lambda c: jnp.where(my_active, jnp.zeros_like(c), c), contrib
+        )
+        return synced, new_deferred
+
     def reset_plan(self) -> None:
         """Drop the recorded bucket table (model structure changed)."""
         self._plan = None

@@ -63,6 +63,15 @@ class DDPTrainer:
         donate_state: bool = False,
         sync_mode: str = "auto",
         measure_gns: bool = False,
+        # BSP mode (reference is_bsp, commu.py:107): a straggler's gradients
+        # are dropped from its missed step.  bsp=False is the async relay
+        # mode — stragglers bank their gradients in a per-rank deferred
+        # buffer that folds into their next active step's allreduce
+        # (commu.py:160-170, 427-431).
+        bsp: bool = True,
+        # force the compiled step to take a runtime active mask even without
+        # a communicator (workloads injecting their own skew signal; tests)
+        dynamic_mask: Optional[bool] = None,
     ) -> None:
         self.loss_fn = loss_fn
         self.tx = tx
@@ -77,6 +86,16 @@ class DDPTrainer:
             communicator=communicator,
             mode=sync_mode,
         )
+        self.bsp = bsp
+        self._dynamic_mask = (
+            dynamic_mask
+            if dynamic_mask is not None
+            else (communicator is not None or not bsp)
+        )
+        if not bsp and not self._dynamic_mask:
+            raise ValueError("async relay (bsp=False) needs a runtime active mask")
+        self._deferred: Optional[Any] = None
+        self._bank_dirty = False  # some rank holds banked (deferred) grads
         self._compiled: Optional[Callable] = None
         self._host_step = 0
         # optional gradient-noise-scale measurement (units-test/get_gns.py):
@@ -95,13 +114,24 @@ class DDPTrainer:
     # -- step program ----------------------------------------------------------
 
     def _build(self) -> Callable:
-        # without a coordinator the active set is statically full-world, so
-        # the compiled program takes no mask input and the masking folds away
-        dynamic_mask = self.hook.communicator is not None
+        # without a coordinator (or an explicit dynamic_mask request) the
+        # active set is statically full-world, so the compiled program takes
+        # no mask input and the masking folds away
+        dynamic_mask = self._dynamic_mask
+        deferred_relay = not self.bsp
 
-        def per_shard(state: TrainState, batch: Any, *mask: jnp.ndarray):
+        def per_shard(state: TrainState, batch: Any, *extra: Any):
             loss, grads = jax.value_and_grad(self.loss_fn)(state.params, batch)
-            synced = self.hook.sync(grads, mask[0] if mask else None)
+            mask = extra[0] if dynamic_mask else None
+            outs = []
+            if deferred_relay:
+                # deferred rides in/out with a sharded [world] leading dim;
+                # strip the per-shard [1] so it matches the grads tree
+                deferred = jax.tree_util.tree_map(lambda d: d[0], extra[-1])
+                synced, new_deferred = self.hook.sync_deferred(grads, deferred, mask)
+                outs.append(jax.tree_util.tree_map(lambda d: d[None], new_deferred))
+            else:
+                synced = self.hook.sync(grads, mask)
             updates, opt_state = self.tx.update(synced, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
             new_state = TrainState(params=params, opt_state=opt_state, step=state.step + 1)
@@ -109,11 +139,20 @@ class DDPTrainer:
                 from adapcc_tpu.measure.gns import ddp_grad_sq_norms
 
                 small, big = ddp_grad_sq_norms(grads, synced, self.axis_name)
-                return new_state, loss[None], jnp.stack([small, big])
-            return new_state, loss[None]  # [1] per rank → stacked [world]
+                outs.insert(0, jnp.stack([small, big]))
+            # [1] per rank → stacked [world] losses
+            return (new_state, loss[None], *outs)
 
-        in_specs = (P(), P(self.axis_name)) + ((P(),) if dynamic_mask else ())
-        out_specs = (P(), P(self.axis_name)) + ((P(),) if self.measure_gns else ())
+        in_specs = (
+            (P(), P(self.axis_name))
+            + ((P(),) if dynamic_mask else ())
+            + ((P(self.axis_name),) if deferred_relay else ())
+        )
+        out_specs = (
+            (P(), P(self.axis_name))
+            + ((P(),) if self.measure_gns else ())
+            + ((P(self.axis_name),) if deferred_relay else ())
+        )
         fn = jax.shard_map(
             per_shard,
             mesh=self.mesh,
@@ -124,27 +163,55 @@ class DDPTrainer:
             check_vma=False,
         )
         donate = (0,) if self.donate_state else ()
+        if deferred_relay:
+            # the deferred bank is replaced wholesale every step; donating it
+            # avoids holding two world-sized gradient copies per dispatch
+            donate = donate + (len(in_specs) - 1,)
         return jax.jit(fn, donate_argnums=donate)
 
     def step(
-        self, state: TrainState, batch: Any, step_idx: Optional[int] = None
+        self,
+        state: TrainState,
+        batch: Any,
+        step_idx: Optional[int] = None,
+        active_mask: Optional[jnp.ndarray] = None,
     ) -> Tuple[TrainState, jnp.ndarray]:
         """One training step.  ``batch`` leading dim is the global batch,
-        sharded over the mesh axis.  Returns (new_state, per-rank losses)."""
+        sharded over the mesh axis.  Returns (new_state, per-rank losses).
+
+        ``active_mask`` overrides the coordinator's negotiation (workloads
+        injecting their own skew signal; requires a dynamic-mask trainer).
+        """
         if self._compiled is None:
             self._compiled = self._build()
         # host-side counter: reading state.step would force a device sync on
         # every dispatch, serializing the loop
         idx = self._host_step if step_idx is None else step_idx
         self._host_step = idx + 1
-        if self.hook.communicator is None:
-            active_mask = None
-            out = self._compiled(state, batch)
-        else:
+        if active_mask is not None and not self._dynamic_mask:
+            raise ValueError(
+                "this trainer compiled a static full-world step; pass "
+                "dynamic_mask=True to drive explicit active masks"
+            )
+        if active_mask is None and self.hook.communicator is not None:
             active_mask = self.hook.negotiate(idx)
-            out = self._compiled(state, batch, active_mask)
+        args = [state, batch]
+        if self._dynamic_mask:
+            if active_mask is None:
+                active_mask = jnp.ones((self.mesh.devices.size,), dtype=jnp.bool_)
+            args.append(active_mask)
+        if not self.bsp:
+            if self._deferred is None:
+                world = self.mesh.devices.size
+                self._deferred = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros((world,) + p.shape, p.dtype), state.params
+                )
+            args.append(self._deferred)
+        out = self._compiled(*args)
+        if not self.bsp:
+            *out, self._deferred = out
         if not self.measure_gns:
-            return out
+            return tuple(out) if isinstance(out, list) else out
         new_state, loss, norms = out
         self._record_gns(batch, norms, active_mask)
         return new_state, loss
@@ -158,9 +225,16 @@ class DDPTrainer:
             self._gns = GNSEstimator(b_small=max(1, b_big // world), b_big=b_big)
         # partial-world steps break the estimator's batch-size accounting
         # (synced averages only the active ranks), so only full-world steps
-        # contribute; norms stay on device until someone reads `gns`, keeping
-        # async dispatch intact (see the host-step comment above)
-        if active_mask is None or bool(np.asarray(active_mask).all()):
+        # contribute; in async relay mode the first step after a miss is
+        # contaminated too (synced folds in the stragglers' banked previous-
+        # batch gradients), so it is skipped and the bank marked drained.
+        # Norms stay on device until someone reads `gns`, keeping async
+        # dispatch intact (see the host-step comment above).
+        full = active_mask is None or bool(np.asarray(active_mask).all())
+        contaminated = (not self.bsp) and self._bank_dirty
+        if not self.bsp:
+            self._bank_dirty = not full
+        if full and not contaminated:
             self._gns_pending.append(norms)
             # bound retained device buffers on runs that never read `gns`
             if len(self._gns_pending) > 256:

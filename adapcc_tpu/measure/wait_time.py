@@ -35,16 +35,25 @@ class WaitTimeProbe:
         self.logic = logic
         self._lock = threading.Lock()
         self._stamps: Dict[int, Dict[int, float]] = defaultdict(dict)
+        # negotiate() round-trip per (step, rank): the coordinator-overhead
+        # component the reference logs to proto/latency_0.0.txt, distinct
+        # from worker skew
+        self._rpc: Dict[int, Dict[int, float]] = defaultdict(dict)
 
     def stamp(self, step: int, rank: int, t: Optional[float] = None) -> None:
         with self._lock:
             self._stamps[step][rank] = time.monotonic() if t is None else t
 
     def hook_arrive(self, step: int, rank: int) -> List[int]:
-        """Stamp, then forward to the wrapped coordinator (if any)."""
+        """Stamp, then forward to the wrapped coordinator (if any), timing
+        the negotiation round-trip."""
         self.stamp(step, rank)
         if self.logic is not None:
-            return self.logic.hook_arrive(step, rank)
+            t0 = time.perf_counter()
+            active = self.logic.hook_arrive(step, rank)
+            with self._lock:
+                self._rpc[step][rank] = time.perf_counter() - t0
+            return active
         return []
 
     def wait_time(self, step: int) -> float:
@@ -55,17 +64,35 @@ class WaitTimeProbe:
             return 0.0
         return max(stamps) - min(stamps)
 
+    def rpc_overhead(self, step: int) -> float:
+        """Worst per-rank negotiate() round-trip for ``step``.
+
+        Note the leader's rent-or-buy wait is *inside* its round-trip, so
+        this upper-bounds pure RPC cost the same way the reference's hook
+        timestamps do (commu.py:387-394 time the send_ready_request call).
+        """
+        with self._lock:
+            vals = list(self._rpc.get(step, {}).values())
+        return max(vals) if vals else 0.0
+
     def steps(self) -> List[int]:
         with self._lock:
-            return sorted(self._stamps)
+            return sorted(set(self._stamps) | set(self._rpc))
 
     def write_csv(self, path: str) -> None:
-        """``step,wait_time_s`` rows — the reference's CSV shape."""
+        """``step,wait_time_s,rpc_overhead_s`` rows — the reference's wait
+        CSV shape plus the coordinator-overhead column."""
         with open(path, "w", newline="") as f:
             w = csv.writer(f)
-            w.writerow(["step", "wait_time_s"])
+            w.writerow(["step", "wait_time_s", "rpc_overhead_s"])
             for step in self.steps():
-                w.writerow([step, f"{self.wait_time(step):.6f}"])
+                w.writerow(
+                    [
+                        step,
+                        f"{self.wait_time(step):.6f}",
+                        f"{self.rpc_overhead(step):.6f}",
+                    ]
+                )
 
 
 def emulate_heterogeneous_steps(

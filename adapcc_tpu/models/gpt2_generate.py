@@ -110,7 +110,10 @@ def generate(
         rng, sub = jax.random.split(rng)
         nxt = sample_token(sub, logits[:, 0], temperature, top_k, top_p)
         if eos_id is not None:
-            done = done | (tok_in[:, 0] == eos_id)
+            # only sampled tokens can latch EOS: positions t < prompt_len are
+            # forced prompt tokens (which may legitimately contain eos as a
+            # separator, e.g. PersonaChat dialogue turns)
+            done = done | ((tok_in[:, 0] == eos_id) & (t >= prompt_len))
             nxt = jnp.where(done, eos_id, nxt)
         # prompt positions are forced, generated positions sampled
         forced = t + 1 < prompt_len

@@ -27,9 +27,12 @@ cycle cancels out — gross-inflow formulations are unsound on any graph with
 a cycle among non-source nodes (data bounced around a fast cycle would
 satisfy them without ever crossing the source's slow uplink).
 
-The optimal per-round transmissions ``x`` lower to
-:class:`~adapcc_tpu.strategy.ir` ``CommRound`` edge lists (an edge
-participates in round r when it carries non-negligible flow), giving a
+The schedule lowers from the **commodity flows** (per edge and round, the
+max over commodities riding it), not the physical ``x``: the LP only bounds
+``x`` between the commodity max and the capacity, so alternate optima can
+park ``x`` mass on edges that carry no commodity at all — lowering from
+``x`` could emit sends of data the sender never received.  The commodity
+flows are exactly the traffic the broadcast semantics require, giving a
 broadcast schedule for irregular topologies that tree synthesis cannot
 express.
 """
@@ -50,7 +53,10 @@ class FlowSolution:
 
     num_nodes: int
     source: int
-    rounds: List[Dict[Edge, float]]  # flow per edge, per round
+    # per round: max commodity flow per edge (the data the broadcast actually
+    # needs on that edge — NOT the LP's physical x, which alternate optima
+    # can inflate on edges carrying no commodity)
+    rounds: List[Dict[Edge, float]]
     durations: List[float]
     makespan: float
 
@@ -228,10 +234,14 @@ def solve_broadcast_lp(
         raise ValueError(f"broadcast LP infeasible: {res.message}")
 
     sol = res.x
-    rounds = [
-        {edges[e]: float(sol[xi(e, r)]) for e in range(E) if sol[xi(e, r)] > 1e-9}
-        for r in range(R)
-    ]
+    rounds = []
+    for r in range(R):
+        flows: Dict[Edge, float] = {}
+        for e in range(E):
+            need = max((sol[fi(d, e, r)] for d in range(D)), default=0.0)
+            if need > 1e-9:
+                flows[edges[e]] = float(need)
+        rounds.append(flows)
     durations = [float(t) for t in sol[nf + nx :]]
     return FlowSolution(
         num_nodes=n,

@@ -296,6 +296,11 @@ class Strategy:
     #: by the MILP solver when it optimizes unequal shares (the reference's
     #: per-tree sizes s_m, gurobi/solver.py objective).
     shares: Optional[List[float]] = None
+    #: which formulation produced this strategy ("milp-routing",
+    #: "milp-rotation", "partrees", "partrees-fallback", "ring", "binary",
+    #: …).  Recorded into the emitted XML so a production fallback is
+    #: distinguishable from an optimized result.
+    synthesis: Optional[str] = None
 
     def __post_init__(self) -> None:
         if not self.trees:

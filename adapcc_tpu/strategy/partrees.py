@@ -144,7 +144,7 @@ class ParTrees:
             if t > 0:
                 rotation = rotation[1:] + rotation[:1]
             trees.append(self._build_tree(rotation, groups, ips))
-        return Strategy(trees, world, DEFAULT_CHUNK_BYTES)
+        return Strategy(trees, world, DEFAULT_CHUNK_BYTES, synthesis="partrees")
 
     @staticmethod
     def _build_tree(

@@ -28,7 +28,12 @@ from typing import Dict, List, Sequence
 
 import numpy as np
 
-from adapcc_tpu.primitives import DEFAULT_CHUNK_BYTES
+from adapcc_tpu.primitives import (
+    ALLTOALL,
+    BOARDCAST,
+    DEFAULT_CHUNK_BYTES,
+    REDUCE,
+)
 from adapcc_tpu.strategy.ir import Strategy, Tree
 from adapcc_tpu.strategy.partrees import (
     ParTrees,
@@ -38,14 +43,63 @@ from adapcc_tpu.strategy.partrees import (
 )
 
 
+def _edge_lat_invbw(
+    prim: int,
+    lat: "np.ndarray",
+    inv_bw: "np.ndarray",
+    i: int,
+    j: int,
+    load: float = 1.0,
+):
+    """Effective (latency, 1/bandwidth·load) of tree edge ``i parents j``.
+
+    The reference differentiates per-primitive link loads N_mij
+    (gurobi/solver.py:143-176): broadcast traffic rides parent→child once,
+    reduce rides child→parent once (aggregation keeps it one payload on a
+    tree), allreduce serializes both directions, and alltoall carries one
+    distinct flow per destination behind the edge (``load`` = that
+    multiplicity; each flow is 1/n of the payload, scaled by the caller).
+    """
+    if prim == BOARDCAST:
+        return lat[i][j], inv_bw[i][j]
+    if prim == REDUCE:
+        return lat[j][i], inv_bw[j][i]
+    if prim == ALLTOALL:
+        # per-pair payloads cross in both directions; multiplicity = load
+        return lat[i][j] + lat[j][i], (inv_bw[i][j] + inv_bw[j][i]) * load
+    # ALLREDUCE (and anything tree-shaped by default): reduce up + broadcast
+    # down, each direction carrying the tree's share once
+    return lat[i][j] + lat[j][i], inv_bw[i][j] + inv_bw[j][i]
+
+
+def _subtree_sizes(children: Dict[int, List[int]], root: int) -> Dict[int, int]:
+    sizes: Dict[int, int] = {}
+    order: List[int] = []
+    stack = [root]
+    while stack:
+        r = stack.pop()
+        order.append(r)
+        stack.extend(children.get(r, ()))
+    for r in reversed(order):
+        sizes[r] = 1 + sum(sizes[c] for c in children.get(r, ()))
+    return sizes
+
+
 def _tree_cost_coeffs(
     order: Sequence[int],
     bw: Sequence[Sequence[float]],
     lat: Sequence[Sequence[float]],
+    prim: int = -1,
 ):
-    """(summed per-level latency, summed per-level max 1/bw) for the heap tree
-    over ``order``."""
+    """(summed per-level latency, summed per-level max 1/bw·load) for the
+    heap tree over ``order``, with the per-primitive edge model of
+    :func:`_edge_lat_invbw`."""
     children = _heap_tree_edges(order)
+    n = len(order)
+    sizes = _subtree_sizes(children, order[0])
+    lat_m = np.asarray(lat, dtype=float)
+    bw_m = np.asarray(bw, dtype=float)
+    inv_bw = 1.0 / np.maximum(bw_m, 1e-9)
     depth = {order[0]: 0}
     levels: Dict[int, List[tuple]] = {}
     stack = [order[0]]
@@ -58,8 +112,15 @@ def _tree_cost_coeffs(
     lat_sum, inv_bw_sum = 0.0, 0.0
     for lvl in sorted(levels):
         edges = levels[lvl]
-        lat_sum += max(lat[p][c] for p, c in edges)
-        inv_bw_sum += max(1.0 / max(bw[p][c], 1e-9) for p, c in edges)
+        costs = [
+            _edge_lat_invbw(
+                prim, lat_m, inv_bw, p, c,
+                load=sizes[c] / n if prim == ALLTOALL else 1.0,
+            )
+            for p, c in edges
+        ]
+        lat_sum += max(l for l, _ in costs)
+        inv_bw_sum += max(k for _, k in costs)
     return lat_sum, inv_bw_sum
 
 
@@ -120,14 +181,21 @@ class MilpSolver:
                               f ≤ (n−1)·e           (flow rides chosen edges)
             s[m] ≥ 0          tensor share          (Σ s = 1; a share may be
                               0 — that tree then carries nothing)
-            T ≥ lat_ij·e + size·s_m/bw_ij − M_ij(1−e)   per (m,i,j)
+            u[m]     binary   tree m is used        (s_m ≤ u_m)
+            T ≥ lat·e + size·k·s_m − M_ij(1−e) − M_ij(1−u_m)   per (m,i,j)
 
         The flow system forces each tree to be a spanning arborescence (the
         reference's flow-conservation big-M constraints, solver.py:143-176);
         the per-edge T bound is the pipeline-aware bottleneck objective
         (chunks pipeline, so completion tracks the slowest active link;
-        solver.py:190-208).  ``M_ij`` is per-edge (the edge's own worst cost)
-        — one global M derived from a near-dead profiled link would dwarf
+        solver.py:190-208).  The ``u`` gate keeps a zero-share tree's edges
+        from bounding T (its latencies would otherwise inflate the optimum).
+        ``(lat, k)`` per edge follow the per-primitive link-load model of
+        :func:`_edge_lat_invbw` (reference N_mij, solver.py:143-176); for
+        ALLTOALL the multiplicity is the flow variable itself (number of
+        destinations behind the edge) with shares pinned uniform so the term
+        stays linear.  ``M_ij`` is per-edge (the edge's own worst cost) —
+        one global M derived from a near-dead profiled link would dwarf
         every real coefficient and let tolerance-sized violations erase the
         objective.  Returns None when HiGHS fails or times out.
         """
@@ -143,9 +211,9 @@ class MilpSolver:
         lat = np.asarray(latency_graph, dtype=float)
 
         # variable layout per tree m: r[g] (n), e[i,j] (n²), f[i,j] (n²);
-        # then s[m] (m_trees) and T
+        # then s[m] (m_trees), u[m] (m_trees) and T
         per_tree = n + 2 * n * n
-        nvar = m_trees * per_tree + m_trees + 1
+        nvar = m_trees * per_tree + 2 * m_trees + 1
         Ti = nvar - 1
 
         def ri(m, g):
@@ -159,6 +227,9 @@ class MilpSolver:
 
         def si(m):
             return m_trees * per_tree + m
+
+        def ui(m):
+            return m_trees * per_tree + m_trees + m
 
         c = np.zeros(nvar)
         c[Ti] = 1.0
@@ -210,36 +281,66 @@ class MilpSolver:
         for g in range(n):
             add([(ri(m, g), 1.0) for m in range(m_trees)], 0.0, 1.0)
 
-        # shares cover the tensor
+        # shares cover the tensor; a tree's share only counts when it is used
         add([(si(m), 1.0) for m in range(m_trees)], 1.0, 1.0)
+        for m in range(m_trees):
+            add([(si(m), 1.0), (ui(m), -1.0)], -np.inf, 0.0)
 
-        # pipeline-aware bottleneck: T ≥ lat·e + size·s/bw − M_ij(1−e), with
-        # the big-M per edge (that edge's own worst-case cost)
+        # pipeline-aware bottleneck with per-primitive link loads
+        # (_edge_lat_invbw; reference N_mij solver.py:143-176):
+        #   T ≥ lat·e + size·k·s − M_ij(1−e) − M_ij(1−u)
+        # with the big-M per edge (that edge's own worst-case cost).  For
+        # ALLTOALL the bandwidth term rides the flow variable (multiplicity =
+        # destinations behind the edge, each a 1/n payload) with shares
+        # pinned uniform so the product stays linear.
+        is_a2a = prim == ALLTOALL
+        lat_mx = np.zeros((n, n))
         inv_bw = np.zeros((n, n))
         for a in range(n):
             for b in range(n):
                 if a != b:
+                    lat_mx[a][b] = lat[masters[a]][masters[b]]
                     inv_bw[a][b] = 1.0 / max(bw[masters[a]][masters[b]], 1e-9)
         for m in range(m_trees):
             for i in range(n):
                 for j in range(n):
                     if i == j:
                         continue
-                    lat_ij = lat[masters[i]][masters[j]]
-                    m_ij = lat_ij + size * inv_bw[i][j] + 1.0
-                    add(
-                        [
-                            (Ti, 1.0),
-                            (ei(m, i, j), -(lat_ij + m_ij)),
-                            (si(m), -size * inv_bw[i][j]),
-                        ],
-                        -m_ij, np.inf,
-                    )
+                    lat_eff, k_eff = _edge_lat_invbw(prim, lat_mx, inv_bw, i, j)
+                    if is_a2a:
+                        per_flow = size * k_eff / (n * m_trees)
+                        m_ij = lat_eff + per_flow * (n - 1.0) + 1.0
+                        add(
+                            [
+                                (Ti, 1.0),
+                                (ei(m, i, j), -(lat_eff + m_ij)),
+                                (fi(m, i, j), -per_flow),
+                                (ui(m), -m_ij),
+                            ],
+                            -2.0 * m_ij, np.inf,
+                        )
+                    else:
+                        m_ij = lat_eff + size * k_eff + 1.0
+                        add(
+                            [
+                                (Ti, 1.0),
+                                (ei(m, i, j), -(lat_eff + m_ij)),
+                                (si(m), -size * k_eff),
+                                (ui(m), -m_ij),
+                            ],
+                            -2.0 * m_ij, np.inf,
+                        )
 
         integrality = np.zeros(nvar)
         bounds_lb = np.zeros(nvar)
         bounds_ub = np.full(nvar, np.inf)
         for m in range(m_trees):
+            integrality[ui(m)] = 1
+            bounds_ub[ui(m)] = 1.0
+            if is_a2a:
+                # alltoall payloads are per-pair, not a shardable tensor:
+                # every tree carries an equal slice of the pairs
+                bounds_lb[si(m)] = bounds_ub[si(m)] = 1.0 / m_trees
             for g in range(n):
                 integrality[ri(m, g)] = 1
                 bounds_ub[ri(m, g)] = 1.0
@@ -276,7 +377,10 @@ class MilpSolver:
             _attach_chains(children, masters, groups)
             trees.append(Tree(root, children, ips))
             shares.append(float(res.x[si(m)]))
-        return Strategy(trees, world, DEFAULT_CHUNK_BYTES, shares=shares)
+        return Strategy(
+            trees, world, DEFAULT_CHUNK_BYTES, shares=shares,
+            synthesis="milp-routing",
+        )
 
     # -- rotation formulation (roots + shares over ParTrees shapes) ------------
 
@@ -305,13 +409,16 @@ class MilpSolver:
         lat_c = np.zeros(n)
         bw_c = np.zeros(n)
         for i, g in enumerate(masters):
-            lat_c[i], bw_c[i] = _tree_cost_coeffs(rotations[g], bandwidth_graph, latency_graph)
+            lat_c[i], bw_c[i] = _tree_cost_coeffs(
+                rotations[g], bandwidth_graph, latency_graph, prim
+            )
 
-        # variables: x[m,g] (n*m_trees binaries), s[m] (m_trees), T
+        # variables: x[m,g] (n*m_trees binaries), s[m], u[m] (m_trees each), T
         nx = m_trees * n
-        nvar = nx + m_trees + 1
+        nvar = nx + 2 * m_trees + 1
         xi = lambda m, g: m * n + g
         si = lambda m: nx + m
+        ui = lambda m: nx + m_trees + m
         Ti = nvar - 1
 
         c = np.zeros(nvar)
@@ -333,21 +440,38 @@ class MilpSolver:
         for m in range(m_trees):
             row[si(m)] = 1.0
         A_rows.append(row); lb.append(1.0); ub.append(1.0)
+        for m in range(m_trees):  # s_m ≤ u_m (share only on used trees)
+            row = np.zeros(nvar)
+            row[si(m)] = 1.0
+            row[ui(m)] = -1.0
+            A_rows.append(row); lb.append(-np.inf); ub.append(0.0)
 
         big_m = float(lat_c.max() + size * bw_c.max()) + 1.0
-        for m in range(m_trees):  # T ≥ lat_g·x + size·k_g·s − M(1−x)
+        # T ≥ lat_g·x + size·k_g·s − M(1−x) − M(1−u): an unused (share-0)
+        # tree's rotation latency must not bound T (same gate as the routing
+        # formulation)
+        for m in range(m_trees):
             for g in range(n):
                 row = np.zeros(nvar)
                 row[Ti] = 1.0
                 row[xi(m, g)] = -(lat_c[g] + big_m)
                 row[si(m)] = -size * bw_c[g]
-                A_rows.append(row); lb.append(-big_m); ub.append(np.inf)
+                row[ui(m)] = -big_m
+                A_rows.append(row); lb.append(-2.0 * big_m); ub.append(np.inf)
 
         integrality = np.zeros(nvar)
         integrality[:nx] = 1
         bounds_lb = np.zeros(nvar)
         bounds_ub = np.full(nvar, np.inf)
         bounds_ub[:nx] = 1.0
+        for m in range(m_trees):
+            integrality[ui(m)] = 1
+            bounds_ub[ui(m)] = 1.0
+            if prim == ALLTOALL:
+                # same invariant as the routing formulation: alltoall
+                # payloads are per-pair, not a shardable tensor — shares
+                # stay uniform (the per-flow cost model assumes it)
+                bounds_lb[si(m)] = bounds_ub[si(m)] = 1.0 / m_trees
 
         from scipy.optimize import Bounds
 
@@ -358,10 +482,13 @@ class MilpSolver:
             bounds=Bounds(bounds_lb, bounds_ub),
         )
         if not res.success:
-            # solver hiccup → fall back to the heuristic
-            return ParTrees().synthesize(
+            # solver hiccup → fall back to the heuristic, and say so in the
+            # strategy provenance
+            fallback = ParTrees().synthesize(
                 ip_table, local_rank0_list, parallel_degree, bandwidth_graph, latency_graph
             )
+            fallback.synthesis = "partrees-fallback"
+            return fallback
 
         groups = _host_groups(ip_table, masters)
         ips = {r: ip for r, ip in enumerate(ip_table)}
@@ -374,4 +501,7 @@ class MilpSolver:
             _attach_chains(children, order, groups)
             trees.append(Tree(order[0], children, ips))
             shares.append(float(res.x[si(m)]))
-        return Strategy(trees, world, DEFAULT_CHUNK_BYTES, shares=shares)
+        return Strategy(
+            trees, world, DEFAULT_CHUNK_BYTES, shares=shares,
+            synthesis="milp-rotation",
+        )

@@ -73,10 +73,13 @@ class Synthesizer:
             )
         ips = {r: ip for r, ip in enumerate(self.ip_table)}
         if self.policy == "ring":
-            return Strategy.ring(world, max(1, parallel_degree), ips)
-        if self.policy == "binary":
-            return Strategy.binary(world, max(1, parallel_degree), ips)
-        raise ValueError(f"unknown synthesis policy {self.policy!r}")
+            s = Strategy.ring(world, max(1, parallel_degree), ips)
+        elif self.policy == "binary":
+            s = Strategy.binary(world, max(1, parallel_degree), ips)
+        else:
+            raise ValueError(f"unknown synthesis policy {self.policy!r}")
+        s.synthesis = self.policy
+        return s
 
 
 def _infer_local_rank0s(ip_table: Sequence[str]) -> List[int]:

@@ -72,12 +72,18 @@ def parse_strategy_xml(text_or_path: str, chunk_bytes: int = 4 * 1024 * 1024) ->
         all_ranks |= trees[-1].ranks
 
     world_size = max(all_ranks) + 1 if all_ranks else 0
-    return Strategy(trees, world_size, chunk_bytes)
+    return Strategy(
+        trees, world_size, chunk_bytes, synthesis=doc.attrib.get("synthesis") or None
+    )
 
 
 def emit_strategy_xml(strategy: Strategy, path: Optional[str] = None) -> str:
     """Serialize a :class:`Strategy` back to the reference XML schema."""
     doc = ET.Element("trees")
+    if strategy.synthesis:
+        # provenance: which formulation produced this strategy (a solver
+        # fallback in production must be distinguishable from an optimum)
+        doc.set("synthesis", strategy.synthesis)
     for tree in strategy.trees:
         def build(rank: int, parent_el: ET.Element, tag: str) -> None:
             el = ET.SubElement(parent_el, tag)

@@ -95,9 +95,12 @@ class MetricsRegistry:
         try:
             yield
         finally:
-            dt = time.perf_counter() - t0
-            with self._lock:
-                self._timings[name].append(dt)
+            self.observe(name, time.perf_counter() - t0)
+
+    def observe(self, name: str, seconds: float) -> None:
+        """Record an externally measured duration into the ``name`` timing."""
+        with self._lock:
+            self._timings[name].append(float(seconds))
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:

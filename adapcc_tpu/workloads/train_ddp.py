@@ -45,6 +45,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--world", type=int, default=None, help="mesh size (default: all devices)")
     p.add_argument("--coordinator", action="store_true", help="enable the relay/fault coordinator")
+    p.add_argument(
+        "--no-bsp", dest="is_bsp", action="store_false", default=True,
+        help="async relay mode: straggler gradients are buffered and folded "
+        "into their next active step instead of dropped (reference is_bsp)",
+    )
     return p
 
 
@@ -138,6 +143,7 @@ def main(argv=None) -> None:
         AdapCC.communicator.strategy,
         communicator=AdapCC.communicator,
         use_xla_fastpath=comm_args.use_xla_fastpath,
+        bsp=comm_args.is_bsp,
     )
     state = TrainState.create(params, tx)
 

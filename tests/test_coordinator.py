@@ -83,6 +83,18 @@ def test_leader_waits_for_near_arrivals():
     assert sorted(out[0]) == [0, 1]
 
 
+def test_sole_leader_escapes_after_fault_timeout():
+    # world of 3 but only rank 0 ever arrives: the rent-or-buy conditions are
+    # all gated on num_ready > 1, so without a fault-timeout escape the
+    # leader would wait forever (the reference's rpc_server.py:69-96 does)
+    logic = fast_logic(3, fault_timeout=0.05)
+    start = time.monotonic()
+    active = logic.hook_arrive(step=0, rank=0)
+    elapsed = time.monotonic() - start
+    assert active == [0]
+    assert elapsed < 5, "sole leader failed to escape promptly"
+
+
 def test_controller_barrier_all_alive():
     logic = fast_logic(3)
     # hook phase freezes the active list first

@@ -151,6 +151,77 @@ def test_ddp_matches_single_device_sgd(mesh8):
     )
 
 
+def test_async_relay_folds_straggler_gradients(mesh8):
+    """Async (non-BSP) relay mode, reference commu.py:160-170,427-431: a rank
+    masked out of step k must still deliver its step-k gradients — they fold
+    into the step-k+1 allreduce.  BSP mode keeps the drop semantics."""
+    # loss p·mean(b) per rank → grad = mean of the rank's batch shard,
+    # independent of p, so the SGD trajectory is computable by hand
+    def loss_fn(p, b):
+        return p["w"] * jnp.mean(b)
+
+    world, lr = 8, 1.0
+    rng = np.random.default_rng(0)
+    batch0 = jnp.asarray(rng.normal(size=(world, 4)), jnp.float32)
+    batch1 = jnp.asarray(rng.normal(size=(world, 4)), jnp.float32)
+    params = {"w": jnp.zeros(())}
+    mask_k = jnp.asarray([True] * 7 + [False])  # rank 7 misses step 0
+    full = jnp.ones((world,), dtype=bool)
+
+    shard_means = np.asarray(batch0).reshape(world, -1).mean(axis=1)
+    shard_means1 = np.asarray(batch1).reshape(world, -1).mean(axis=1)
+
+    def run(bsp):
+        tx = optax.sgd(lr)
+        tr = DDPTrainer(
+            loss_fn, tx, mesh8, Strategy.ring(world), use_xla_fastpath=False,
+            bsp=bsp, dynamic_mask=True,
+        )
+        st = TrainState.create(params, tx)
+        st, _ = tr.step(st, batch0, active_mask=mask_k)
+        st, _ = tr.step(st, batch1, active_mask=full)
+        return float(st.params["w"])
+
+    # step 0: active ranks average their 7 shard-mean grads
+    g0 = shard_means[:7].mean()
+    # step 1 async: all 8 grads plus rank 7's banked step-0 grad, /8
+    g1_async = (shard_means1.sum() + shard_means[7]) / world
+    g1_bsp = shard_means1.mean()
+
+    np.testing.assert_allclose(run(bsp=False), -lr * (g0 + g1_async), rtol=1e-5)
+    np.testing.assert_allclose(run(bsp=True), -lr * (g0 + g1_bsp), rtol=1e-5)
+
+
+def test_async_relay_accumulates_across_consecutive_misses(mesh8):
+    """A rank masked out twice banks both steps' gradients and delivers the
+    sum when readmitted."""
+    def loss_fn(p, b):
+        return p["w"] * jnp.mean(b)
+
+    world, lr = 8, 1.0
+    rng = np.random.default_rng(3)
+    batches = [jnp.asarray(rng.normal(size=(world, 2)), jnp.float32) for _ in range(3)]
+    params = {"w": jnp.zeros(())}
+    tx = optax.sgd(lr)
+    tr = DDPTrainer(
+        loss_fn, tx, mesh8, Strategy.ring(world), use_xla_fastpath=False,
+        bsp=False, dynamic_mask=True,
+    )
+    st = TrainState.create(params, tx)
+    miss = jnp.asarray([True] * 7 + [False])
+    st, _ = tr.step(st, batches[0], active_mask=miss)
+    st, _ = tr.step(st, batches[1], active_mask=miss)
+    st, _ = tr.step(st, batches[2])  # full world by default
+
+    m = [np.asarray(b).reshape(world, -1).mean(axis=1) for b in batches]
+    g0 = m[0][:7].mean()
+    g1 = m[1][:7].mean()
+    g2 = (m[2].sum() + m[0][7] + m[1][7]) / world
+    np.testing.assert_allclose(
+        float(st.params["w"]), -lr * (g0 + g1 + g2), rtol=1e-5
+    )
+
+
 def test_trainer_rebuild_recompiles(mesh8):
     model = MLP(features=(4, 1))
     x, y = make_regression_task(n=64)

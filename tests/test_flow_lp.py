@@ -103,6 +103,39 @@ def test_lowering_to_comm_rounds():
     assert first_12 >= first_01
 
 
+def _replay_reaches_all(sol):
+    """Execute the lowered rounds sequentially; assert no node ever sends
+    before it holds data, and that every node ends up reached."""
+    have = {sol.source}
+    for r in sol.comm_rounds():
+        received = set()
+        for u, v in r.edges:
+            assert u in have, f"{u} sends before receiving (rounds unsound)"
+            received.add(v)
+        have |= received
+    assert have == set(range(sol.num_nodes)), f"unreached: {set(range(sol.num_nodes)) - have}"
+
+
+@pytest.mark.parametrize(
+    "n,edges,bw,rounds",
+    [
+        (3, [(0, 1), (1, 2)], [1.0, 1.0], 0),
+        (4, _ring_edges(4), [1.0] * 8, 0),
+        # asymmetric bandwidths + a cycle: the config where x-based lowering
+        # can emit phantom sends from alternate optima
+        (3, [(0, 1), (1, 2), (2, 1)], [0.1, 10.0, 10.0], 6),
+        (5, [(0, 1), (0, 2), (1, 3), (2, 4), (3, 4), (4, 3)],
+         [1.0, 2.0, 1.0, 0.5, 3.0, 3.0], 0),
+    ],
+)
+def test_lowered_rounds_replay_soundly(n, edges, bw, rounds):
+    """Regression for the x-vs-commodity lowering bug: replaying the lowered
+    schedule must reach every node, and no node may forward data it has not
+    yet received."""
+    sol = solve_broadcast_lp(n, edges, bw, source=0, num_rounds=rounds)
+    _replay_reaches_all(sol)
+
+
 def test_infeasible_disconnected():
     with pytest.raises(ValueError, match="infeasible"):
         solve_broadcast_lp(3, [(0, 1)], [1.0], source=0)  # node 2 unreachable

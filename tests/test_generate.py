@@ -58,15 +58,35 @@ def test_generate_batched_and_seeded(tiny_model):
     assert (np.asarray(a) != np.asarray(c)).any()  # different seed differs
 
 
-def test_generate_eos_latches(tiny_model):
-    """Once a row hits EOS, every later token in that row is EOS."""
+def test_generate_eos_latches_after_sampling(tiny_model):
+    """Once a row *samples* EOS, every later token in that row is EOS."""
+    model, params = tiny_model
+    prompt = jnp.asarray([[5, 17, 3]], jnp.int32)
+    P, N = 3, 8
+    base = np.asarray(generate(model, params, prompt, P, N, temperature=0.0))[0]
+    eos = int(base[P])  # declare the first greedily generated token to be EOS
+    out = np.asarray(
+        generate(model, params, prompt, P, N, temperature=0.0, eos_id=eos)
+    )[0]
+    assert (out[P:] == eos).all()
+
+
+def test_eos_in_prompt_does_not_latch(tiny_model):
+    """EOS tokens inside the forced prompt (dialogue separators) must not
+    collapse the generation — only sampled EOS starts the latch."""
     model, params = tiny_model
     eos = 0
     prompt = jnp.asarray([[eos, 1]], jnp.int32)  # EOS already inside the prompt
-    out = np.asarray(
-        generate(model, params, prompt, 2, 6, temperature=0.0, eos_id=eos)
-    )
-    assert (out[0, 2:] == eos).all()
+    kw = dict(prompt_len=2, max_new_tokens=6, temperature=0.0)
+    with_eos = np.asarray(generate(model, params, prompt, eos_id=eos, **kw))[0]
+    without = np.asarray(generate(model, params, prompt, **kw))[0]
+    gen = without[2:].tolist()
+    if eos in gen:
+        k = 2 + gen.index(eos)
+        assert np.array_equal(with_eos[: k + 1], without[: k + 1])
+        assert (with_eos[k + 1 :] == eos).all()
+    else:
+        assert np.array_equal(with_eos, without)
 
 
 def test_generate_rejects_overflow(tiny_model):

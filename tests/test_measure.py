@@ -69,8 +69,53 @@ def test_wait_time_csv(tmp_path):
     path = str(tmp_path / "wait_time_homo_bc128.csv")
     probe.write_csv(path)
     rows = list(csv.reader(open(path)))
-    assert rows[0] == ["step", "wait_time_s"]
+    assert rows[0] == ["step", "wait_time_s", "rpc_overhead_s"]
     assert float(rows[1][1]) == pytest.approx(0.5)
+
+
+def test_wait_time_records_rpc_overhead():
+    """The probe times each negotiate round-trip through the wrapped
+    coordinator (the reference's latency_0.0.txt measurement point)."""
+    from adapcc_tpu.coordinator import CoordinatorLogic
+
+    logic = CoordinatorLogic(2, relay_threshold=0.05, time_slot=0.002, fault_timeout=0.5)
+    probe = WaitTimeProbe(logic)
+    import threading
+
+    ts = [threading.Thread(target=probe.hook_arrive, args=(0, r)) for r in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert probe.rpc_overhead(0) > 0.0
+    assert probe.rpc_overhead(7) == 0.0
+
+
+def test_communicator_negotiate_latency_artifact(tmp_path, mesh4):
+    """hook_ready records per-step rpc latency and dumps the reference-style
+    latency_<rank>.0.txt artifact (commu.py:37,387-394)."""
+    from adapcc_tpu.communicator import Communicator
+    from adapcc_tpu.config import CommArgs
+    from adapcc_tpu.utils.observability import MetricsRegistry
+
+    args = CommArgs(
+        topology_dir=str(tmp_path / "topo"),
+        strategy_file=str(tmp_path / "topo" / "strategy.xml"),
+        logical_graph=str(tmp_path / "topo" / "lg.xml"),
+    )
+    comm = Communicator(args, mesh=mesh4)
+    comm.metrics = MetricsRegistry()
+    comm.enable_coordinator(is_master=True, process_rank=0, num_processes=1, port=0)
+    comm.hook_ready(0)
+    comm.hook_ready(1)
+    assert [s for s, _ in comm.rpc_latencies] == [0, 1]
+    assert all(dt >= 0.0 for _, dt in comm.rpc_latencies)
+    snap = comm.metrics.snapshot()
+    assert snap["timings"]["negotiate"]["count"] == 2
+    path = comm.write_rpc_latency()
+    lines = open(path).read().splitlines()
+    assert len(lines) == 2 and all(float(x) >= 0 for x in lines)
+    comm.clear()
 
 
 def test_emulation_propagates_worker_errors():

@@ -85,9 +85,9 @@ def test_engine_records_dispatches(mesh4):
     eng.all_gather(x)
     prims = [(e.primitive, e.impl) for e in tr.events()]
     assert prims == [
-        ("allreduce", "psum"),
-        ("allreduce", "allreduce"),
-        ("boardcast", "schedule"),
+        ("allreduce", "xla"),
+        ("allreduce", "schedule"),
+        ("broadcast", "schedule"),
         ("all_gather", "xla"),
     ]
     assert tr.events()[0].nbytes == 4 * 8 * 4
