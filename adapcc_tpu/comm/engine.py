@@ -259,7 +259,18 @@ class CollectiveEngine:
             )
         self.mesh = mesh
         self.strategy = strategy
-        self.axis_name = axis_name
+        # two-level world: a ("dcn", "ici") mesh executes strategies
+        # hierarchically — intra-slice traffic on the ICI axis, master trees
+        # on the DCN axis (comm/two_level.py); flat meshes keep the single
+        # ``ranks`` axis.  XLA-native primitives reduce over all mesh axes.
+        from adapcc_tpu.comm.two_level import is_two_level
+
+        self.two_level = is_two_level(mesh)
+        if self.two_level:
+            self.num_slices, self.ici_size = (int(s) for s in mesh.devices.shape)
+            self.axis_name = tuple(mesh.axis_names)
+        else:
+            self.axis_name = axis_name
         self.use_xla_fastpath = use_xla_fastpath
         #: optional CollectiveTrace recording every dispatch (track.txt analog)
         self.trace = trace
@@ -323,6 +334,17 @@ class CollectiveEngine:
         if self.use_xla_fastpath and active_gpus is None and op is not ReduceOp.MAX:
             per_shard = functools.partial(self._psum_shard, op=op)
             key = ("psum", stacked.shape, stacked.dtype.name, op)
+        elif self.two_level:
+            from adapcc_tpu.comm.two_level import allreduce_two_level_shard
+
+            per_shard = functools.partial(
+                allreduce_two_level_shard,
+                strategy=self.strategy,
+                num_slices=self.num_slices,
+                ici_size=self.ici_size,
+                op=op,
+            )
+            key = ("allreduce2l", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name, op)
         else:
             per_shard = functools.partial(
                 allreduce_shard,
@@ -347,20 +369,43 @@ class CollectiveEngine:
         op: ReduceOp = ReduceOp.SUM,
     ) -> jnp.ndarray:
         self._check_world_dim(stacked, "reduce")
-        per_shard = functools.partial(
-            reduce_shard, strategy=self.strategy, axis_name=self.axis_name, op=op
-        )
-        key = ("reduce", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name, op)
+        if self.two_level:
+            from adapcc_tpu.comm.two_level import reduce_two_level_shard
+
+            per_shard = functools.partial(
+                reduce_two_level_shard,
+                strategy=self.strategy,
+                num_slices=self.num_slices,
+                ici_size=self.ici_size,
+                op=op,
+            )
+            key = ("reduce2l", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name, op)
+        else:
+            per_shard = functools.partial(
+                reduce_shard, strategy=self.strategy, axis_name=self.axis_name, op=op
+            )
+            key = ("reduce", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name, op)
         self._record("reduce", "schedule", stacked)
         return self._shard_mapped(key, per_shard, 2)(stacked, self._active_to_mask(active_gpus))
 
     def boardcast(self, stacked: jnp.ndarray) -> jnp.ndarray:
         """Reference spelling kept for API parity (adapcc.py:55-57)."""
         self._check_world_dim(stacked, "boardcast")
-        per_shard = functools.partial(
-            broadcast_shard, strategy=self.strategy, axis_name=self.axis_name
-        )
-        key = ("broadcast", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name)
+        if self.two_level:
+            from adapcc_tpu.comm.two_level import broadcast_two_level_shard
+
+            per_shard = functools.partial(
+                broadcast_two_level_shard,
+                strategy=self.strategy,
+                num_slices=self.num_slices,
+                ici_size=self.ici_size,
+            )
+            key = ("broadcast2l", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name)
+        else:
+            per_shard = functools.partial(
+                broadcast_shard, strategy=self.strategy, axis_name=self.axis_name
+            )
+            key = ("broadcast", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name)
         # trace vocabulary is normalized ("broadcast"); only the API keeps
         # the reference's "boardcast" spelling
         self._record("broadcast", "schedule", stacked)
@@ -414,6 +459,11 @@ class CollectiveEngine:
         the interpreter off-TPU so the same call works on the virtual pod."""
         from adapcc_tpu.comm.pallas_ring import ring_allreduce_shard
 
+        if self.two_level:
+            raise ValueError(
+                "ring_allreduce needs a flat ranks mesh (a single ICI ring); "
+                "two-level worlds use the strategy allreduce"
+            )
         self._check_world_dim(stacked, "ring_allreduce")
         if interpret is None:
             interpret = jax.devices()[0].platform != "tpu"

@@ -37,10 +37,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 from adapcc_tpu.comm.mesh import RANKS_AXIS
 
-#: fp32 VMEM tile = (8, 128); chunks are padded to whole tiles
+#: VMEM tiles are (sublanes, 128) with sublanes scaling inversely with item
+#: width: fp32 → (8, 128), bf16 → (16, 128), int8/fp8 → (32, 128).  Chunks
+#: are padded to whole tiles of the payload dtype (``_tile_elems``).
 _LANES = 128
-_SUBLANES = 8
-_TILE = _LANES * _SUBLANES
+
+
+def _tile_elems(dtype) -> int:
+    itemsize = jnp.dtype(dtype).itemsize
+    sublanes = {4: 8, 2: 16, 1: 32}.get(itemsize, 8)
+    return _LANES * sublanes
 
 
 def _interpret_params(interpret):
@@ -139,9 +145,10 @@ def _ring_kernel(
 # --------------------------------------------------------------------------- #
 
 def _pad_chunks(flat: jnp.ndarray, world: int):
-    """Pad to world × (whole fp32 tiles) and reshape chunk-major."""
+    """Pad to world × (whole dtype-native tiles) and reshape chunk-major."""
+    tile = _tile_elems(flat.dtype)
     chunk = -(-flat.size // world)          # ceil
-    chunk = -(-chunk // _TILE) * _TILE      # round up to full tiles
+    chunk = -(-chunk // tile) * tile        # round up to full tiles
     padded = jnp.zeros((world * chunk,), flat.dtype).at[: flat.size].set(flat)
     return padded.reshape(world, chunk // _LANES, _LANES), chunk
 
@@ -227,8 +234,9 @@ def ring_all_gather_shard(
     payload (tile-aligned), output is ``[world, chunk]`` in rank order."""
     if world == 1:
         return x.reshape(1, -1)
-    if x.size % _TILE:
-        raise ValueError(f"all-gather payload must be tile-aligned ({_TILE} elems), got {x.size}")
+    tile = _tile_elems(x.dtype)
+    if x.size % tile:
+        raise ValueError(f"all-gather payload must be tile-aligned ({tile} elems), got {x.size}")
     my_id = lax.axis_index(axis_name)
     chunks = jnp.zeros((world, x.size), x.dtype)
     # place the local payload in the row this rank owns; the ring walk

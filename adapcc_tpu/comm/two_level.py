@@ -1,0 +1,204 @@
+"""Two-level (DCN × ICI) collective execution.
+
+The reference expresses hierarchy *inside* one flat rank world: ParTrees
+attaches intra-host chains under per-host masters and the CUDA contexts walk
+the whole tree over whatever transport each edge happens to cross
+(gurobi/trees.py chain policy; csrc/allreduce.cu edge classification by ip,
+allreduce.cu:473-522).  On TPU the hierarchy is a *mesh axis*: a multi-slice
+world is a ``("dcn", "ici")`` mesh, intra-slice traffic rides the ICI torus
+and inter-slice traffic rides DCN.  A synthesized strategy executes as
+
+1. **slice-local reduce** over the ``ici`` axis — the strategy's intra-host
+   chains collapse into the XLA collective, which is already the optimal ICI
+   program (the chain shape is the reference's PCIe pattern, not a TPU one);
+2. **master-tree rounds** over the ``dcn`` axis — the strategy's inter-host
+   edges, collapsed to slice indices by :func:`slice_tree`, run as masked
+   ``ppermute`` reduce/broadcast rounds exactly like the flat engine, but on
+   the DCN axis only;
+3. the broadcast down the master tree lands on every ``ici`` lane at once,
+   so the result is already replicated intra-slice.
+
+This keeps the synthesizer's decision surface (which inter-host links carry
+data, rooted where, with what shares) while guaranteeing — by construction,
+not by device ordering — that intra-host edges never touch DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+
+from adapcc_tpu.comm.engine import (
+    _avg_normalize,
+    _identity_for,
+    _run_broadcast_rounds,
+    _run_reduce_rounds,
+    _run_segments,
+)
+from adapcc_tpu.primitives import ReduceOp
+from adapcc_tpu.strategy.ir import Strategy, Tree
+
+#: canonical axis names for a two-level world mesh
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
+
+def build_two_level_mesh(
+    num_slices: int,
+    ici_size: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """A ``(num_slices, ici_size)`` mesh with axes ``("dcn", "ici")``.
+
+    Flat rank ``r`` (the strategy/ip-table world rank) sits at mesh position
+    ``(r // ici_size, r % ici_size)`` — the same slice grouping the detector
+    writes into the logical graph (hosts = slices).
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if ici_size is None:
+        if len(devs) % num_slices:
+            raise ValueError(f"{len(devs)} devices do not split into {num_slices} slices")
+        ici_size = len(devs) // num_slices
+    need = num_slices * ici_size
+    if len(devs) < need:
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    grid = np.array(devs[:need]).reshape(num_slices, ici_size)
+    return Mesh(grid, (DCN_AXIS, ICI_AXIS))
+
+
+def is_two_level(mesh: Mesh) -> bool:
+    return tuple(mesh.axis_names) == (DCN_AXIS, ICI_AXIS)
+
+
+def slice_tree(tree: Tree, rank_slice: Sequence[int], num_slices: int) -> Tree:
+    """Collapse a world tree to its inter-slice master tree.
+
+    ``rank_slice[r]`` is the slice of world rank ``r``.  Every tree edge
+    whose endpoints share a slice is an intra-slice edge (executed by the ICI
+    collective); the remaining edges must form a spanning tree over slice
+    indices — one inbound DCN edge per non-root slice, the condition the
+    ParTrees chain construction guarantees (masters parent other masters,
+    chains stay under their own master).
+    """
+    children: Dict[int, List[int]] = {}
+    inbound: Dict[int, int] = {}
+    for c, p in tree.parent.items():
+        sp, sc = rank_slice[p], rank_slice[c]
+        if sp == sc:
+            continue
+        if sc in inbound:
+            raise ValueError(
+                f"slice {sc} has two inbound inter-slice edges (from {inbound[sc]} "
+                f"and {sp}); strategy is not slice-hierarchical"
+            )
+        inbound[sc] = sp
+        children.setdefault(sp, []).append(sc)
+    root_slice = rank_slice[tree.root]
+    st = Tree(root_slice, children)
+    missing = set(range(num_slices)) - st.ranks
+    if missing:
+        raise ValueError(f"slices {sorted(missing)} unreachable in the master tree")
+    return st
+
+
+def mesh_rank_slice(num_slices: int, ici_size: int) -> List[int]:
+    return [r // ici_size for r in range(num_slices * ici_size)]
+
+
+def allreduce_two_level_shard(
+    x: jnp.ndarray,
+    active_mask: jnp.ndarray,
+    strategy: Strategy,
+    num_slices: int,
+    ici_size: int,
+    dcn_axis: str = DCN_AXIS,
+    ici_axis: str = ICI_AXIS,
+    op: ReduceOp = ReduceOp.SUM,
+) -> jnp.ndarray:
+    """Strategy allreduce on a ``(dcn, ici)`` mesh; call inside shard_map.
+
+    ``x`` is this rank's contribution, ``active_mask`` a ``[world]`` bool
+    array over flat ranks (``slice * ici_size + lane``).  Tensor segments
+    split across trees by share like the flat engine; each tree contributes
+    its master tree (via :func:`slice_tree`) for the DCN rounds.
+    """
+    rank_slice = mesh_rank_slice(num_slices, ici_size)
+    flat_rank = lax.axis_index(dcn_axis) * ici_size + lax.axis_index(ici_axis)
+    my_active = active_mask[flat_rank]
+
+    def per_segment(seg: jnp.ndarray, tree: Tree) -> jnp.ndarray:
+        contrib = jnp.where(my_active, seg, _identity_for(op, seg.dtype))
+        # level 1: slice-local reduction rides the ICI axis
+        if op is ReduceOp.MAX:
+            acc = lax.pmax(contrib, ici_axis)
+        else:
+            acc = lax.psum(contrib, ici_axis)
+        # level 2: master tree over slice indices rides the DCN axis
+        st = slice_tree(tree, rank_slice, num_slices)
+        acc = _run_reduce_rounds(acc, st.reduce_rounds(), dcn_axis, num_slices, op)
+        acc = _run_broadcast_rounds(acc, st.broadcast_rounds(), dcn_axis, num_slices)
+        return acc
+
+    result = _run_segments(x, strategy, per_segment)
+    return _avg_normalize(result, active_mask, op)
+
+
+def reduce_two_level_shard(
+    x: jnp.ndarray,
+    active_mask: jnp.ndarray,
+    strategy: Strategy,
+    num_slices: int,
+    ici_size: int,
+    dcn_axis: str = DCN_AXIS,
+    ici_axis: str = ICI_AXIS,
+    op: ReduceOp = ReduceOp.SUM,
+) -> jnp.ndarray:
+    """Two-level reduce: the total lands on every lane of each tree's *root
+    slice* (the slice-granular analog of the flat engine's root-holds-result
+    semantics, reference reduce.cu:258-269); other slices hold partials."""
+    rank_slice = mesh_rank_slice(num_slices, ici_size)
+    flat_rank = lax.axis_index(dcn_axis) * ici_size + lax.axis_index(ici_axis)
+    my_active = active_mask[flat_rank]
+
+    def per_segment(seg: jnp.ndarray, tree: Tree) -> jnp.ndarray:
+        contrib = jnp.where(my_active, seg, _identity_for(op, seg.dtype))
+        acc = lax.pmax(contrib, ici_axis) if op is ReduceOp.MAX else lax.psum(contrib, ici_axis)
+        st = slice_tree(tree, rank_slice, num_slices)
+        return _run_reduce_rounds(acc, st.reduce_rounds(), dcn_axis, num_slices, op)
+
+    result = _run_segments(x, strategy, per_segment)
+    return _avg_normalize(result, active_mask, op)
+
+
+def broadcast_two_level_shard(
+    x: jnp.ndarray,
+    strategy: Strategy,
+    num_slices: int,
+    ici_size: int,
+    dcn_axis: str = DCN_AXIS,
+    ici_axis: str = ICI_AXIS,
+) -> jnp.ndarray:
+    """Two-level broadcast: each tree's root *rank* value replicates across
+    its slice's ICI lanes (masked psum — one nonzero contributor), then
+    streams down the master tree over DCN."""
+    rank_slice = mesh_rank_slice(num_slices, ici_size)
+    my_dcn = lax.axis_index(dcn_axis)
+    my_lane = lax.axis_index(ici_axis)
+
+    def per_segment(seg: jnp.ndarray, tree: Tree) -> jnp.ndarray:
+        root_slice = rank_slice[tree.root]
+        root_lane = tree.root % ici_size
+        # replicate the root rank's segment across its slice (everyone else
+        # contributes zero; slices other than the root's hold garbage until
+        # the DCN broadcast overwrites them)
+        is_root_rank = (my_dcn == root_slice) & (my_lane == root_lane)
+        acc = lax.psum(jnp.where(is_root_rank, seg, jnp.zeros_like(seg)), ici_axis)
+        st = slice_tree(tree, rank_slice, num_slices)
+        return _run_broadcast_rounds(acc, st.broadcast_rounds(), dcn_axis, num_slices)
+
+    return _run_segments(x, strategy, per_segment)

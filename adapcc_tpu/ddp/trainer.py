@@ -94,6 +94,12 @@ class DDPTrainer:
         )
         if not bsp and not self._dynamic_mask:
             raise ValueError("async relay (bsp=False) needs a runtime active mask")
+        if communicator is not None and not self._dynamic_mask:
+            raise ValueError(
+                "a coordinator-attached trainer must compile a dynamic-mask "
+                "step: dynamic_mask=False would silently discard the "
+                "negotiated active set"
+            )
         self._deferred: Optional[Any] = None
         self._bank_dirty = False  # some rank holds banked (deferred) grads
         self._compiled: Optional[Callable] = None

@@ -1,6 +1,7 @@
 """Headline benchmark: GPT-2 DDP training throughput with the adaptive stack.
 
-Prints ONE JSON line: ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+Prints ONE JSON line: ``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+"mfu": ..., "step_ms": ..., ...}``.
 
 The flagship workload (GPT-2 under data parallelism with the AdapCC gradient
 hook — the reference's train_ddp GPT-2 configuration, BASELINE.md north star)
@@ -8,9 +9,16 @@ is timed against a plain-JAX DDP baseline (jit + psum gradient mean, no
 framework) on the same devices.  ``vs_baseline`` = framework tokens/s ÷
 plain-JAX tokens/s: ≥1.0 means the adaptive machinery costs nothing.
 
-Size knobs via env (defaults fit a single v5e chip and compile in ~1 min):
+``mfu`` is analytic model FLOPs (matmuls + attention, ×3 for the backward)
+per wall-second over the chip's advertised bf16 peak — the utilization
+statement the raw tokens/s number lacks.  Timing is forced-sync: a scalar
+``device_get`` closes every measured window, because on remote-tunnel
+backends ``block_until_ready`` can return before execution completes
+(PERFORMANCE.md "measurement methodology").
+
+Size knobs via env (defaults target a single v5e chip):
     BENCH_LAYERS, BENCH_DMODEL, BENCH_HEADS, BENCH_SEQ, BENCH_BATCH,
-    BENCH_STEPS, BENCH_WORLD
+    BENCH_STEPS, BENCH_WORLD, BENCH_PEAK_TFLOPS
 """
 
 from __future__ import annotations
@@ -29,6 +37,41 @@ def _env_int(name: str, default: int) -> int:
     return int(os.environ.get(name, default))
 
 
+#: advertised bf16 peak TFLOP/s per chip, by device_kind substring
+_PEAK_TFLOPS = (
+    ("v5 lite", 197.0),  # v5e
+    ("v5litepod", 197.0),
+    ("v5e", 197.0),
+    ("v5p", 459.0),
+    ("v4", 275.0),
+    ("v6", 918.0),  # trillium
+)
+
+
+def chip_peak_tflops() -> float:
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    for sub, peak in _PEAK_TFLOPS:
+        if sub in kind:
+            return peak
+    return 197.0  # assume v5e when unrecognizable
+
+
+def train_flops_per_token(cfg) -> float:
+    """Analytic matmul+attention FLOPs per trained token (fwd + 2×bwd)."""
+    d, L, T, V = cfg.d_model, cfg.n_layer, cfg.max_seq, cfg.vocab_size
+    per_layer = (
+        2 * d * 3 * d        # qkv projection
+        + 2 * d * d          # output projection
+        + 2 * 2 * d * 4 * d  # mlp up + down
+        + 2 * 2 * T * d      # attention scores + values (2·T·d each per token)
+    )
+    fwd = L * per_layer + 2 * d * V  # + logits matmul
+    return 3.0 * fwd
+
+
 def main() -> None:
     from adapcc_tpu.comm.mesh import build_world_mesh
     from adapcc_tpu.ddp import DDPTrainer, TrainState
@@ -41,11 +84,11 @@ def main() -> None:
     cfg = GPT2Config(
         vocab_size=16384,
         max_seq=_env_int("BENCH_SEQ", 512),
-        n_layer=_env_int("BENCH_LAYERS", 8),
-        n_head=_env_int("BENCH_HEADS", 8),
-        d_model=_env_int("BENCH_DMODEL", 512),
+        n_layer=_env_int("BENCH_LAYERS", 12),
+        n_head=_env_int("BENCH_HEADS", 16),
+        d_model=_env_int("BENCH_DMODEL", 1024),
     )
-    per_rank_batch = _env_int("BENCH_BATCH", 8)
+    per_rank_batch = _env_int("BENCH_BATCH", 16)
     batch = per_rank_batch * world
     steps = _env_int("BENCH_STEPS", 10)
 
@@ -60,12 +103,15 @@ def main() -> None:
     tx = optax.adamw(3e-4)
 
     def time_steps(step_fn, state):
-        state = step_fn(state)  # compile + warmup
-        jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+        """Mean step seconds with a forced host sync closing the window."""
+        state, loss = step_fn(state)  # compile + warmup
+        _ = float(jax.device_get(jnp.mean(loss)))
         t0 = time.perf_counter()
         for _ in range(steps):
-            state = step_fn(state)
-        jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+            state, loss = step_fn(state)
+        # a scalar host read forces the whole dispatched chain to finish;
+        # block_until_ready alone is not trustworthy through remote tunnels
+        _ = float(jax.device_get(jnp.mean(loss)))
         return (time.perf_counter() - t0) / steps
 
     # --- framework path: DDPTrainer with the adaptive gradient hook -----------
@@ -74,12 +120,7 @@ def main() -> None:
     )
     # both paths donate their state; give each its own param buffers
     fw_state = TrainState.create(jax.tree_util.tree_map(jnp.array, params), tx)
-
-    def fw_step(state):
-        state, _ = trainer.step(state, tokens)  # host-side step counter, async dispatch
-        return state
-
-    fw_time = time_steps(fw_step, fw_state)
+    fw_time = time_steps(lambda s: trainer.step(s, tokens), fw_state)
 
     # --- baseline: plain jit + psum DDP (no framework) -------------------------
     from jax.sharding import PartitionSpec as P
@@ -89,14 +130,14 @@ def main() -> None:
         grads = jax.tree_util.tree_map(lambda g: jax.lax.pmean(g, "ranks"), grads)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params2 = optax.apply_updates(state.params, updates)
-        return TrainState(params=params2, opt_state=opt_state, step=state.step + 1)
+        return TrainState(params=params2, opt_state=opt_state, step=state.step + 1), loss[None]
 
     base_fn = jax.jit(
         jax.shard_map(
             base_step_shard,
             mesh=mesh,
             in_specs=(P(), P("ranks")),
-            out_specs=P(),
+            out_specs=(P(), P("ranks")),
             check_vma=False,
         ),
         donate_argnums=(0,),
@@ -107,6 +148,9 @@ def main() -> None:
     tokens_per_step = batch * cfg.max_seq
     value = tokens_per_step / fw_time
     baseline = tokens_per_step / base_time
+    flops_per_tok = train_flops_per_token(cfg)
+    peak = chip_peak_tflops() * 1e12 * world
+    mfu = value * flops_per_tok / peak
 
     print(
         json.dumps(
@@ -115,6 +159,11 @@ def main() -> None:
                 "value": round(value, 1),
                 "unit": "tokens/s",
                 "vs_baseline": round(value / baseline, 4),
+                "mfu": round(mfu, 4),
+                "step_ms": round(fw_time * 1e3, 2),
+                "baseline_step_ms": round(base_time * 1e3, 2),
+                "model_flops_per_token": round(flops_per_tok / 1e6, 1),
+                "world": world,
             }
         )
     )

@@ -222,6 +222,20 @@ def test_async_relay_accumulates_across_consecutive_misses(mesh8):
     )
 
 
+def test_trainer_rejects_mask_misconfigurations(mesh8):
+    loss = lambda p, b: jnp.zeros(())  # noqa: E731
+    with pytest.raises(ValueError, match="dynamic-mask"):
+        DDPTrainer(
+            loss, optax.sgd(0.1), mesh8, Strategy.ring(8),
+            communicator=object(), dynamic_mask=False,
+        )
+    with pytest.raises(ValueError, match="active mask"):
+        DDPTrainer(
+            loss, optax.sgd(0.1), mesh8, Strategy.ring(8),
+            bsp=False, dynamic_mask=False,
+        )
+
+
 def test_trainer_rebuild_recompiles(mesh8):
     model = MLP(features=(4, 1))
     x, y = make_regression_task(n=64)

@@ -14,12 +14,14 @@ from jax.sharding import PartitionSpec as P
 from adapcc_tpu.comm.engine import CollectiveEngine
 from adapcc_tpu.comm.mesh import RANKS_AXIS
 from adapcc_tpu.comm.pallas_ring import (
-    _TILE,
+    _tile_elems,
     ring_all_gather_shard,
     ring_allreduce_shard,
     ring_reduce_scatter_shard,
 )
 from adapcc_tpu.strategy.ir import Strategy
+
+_TILE = _tile_elems(jnp.float32)  # fp32 tile, the payload dtype below
 
 
 def run_shard(fn, mesh, *args):
@@ -102,6 +104,36 @@ def test_ring_all_gather_rejects_ragged(mesh4):
 
     with pytest.raises(ValueError):
         run_shard(per_shard, mesh4, jnp.ones((4, 100)))
+
+
+def test_ring_allreduce_bf16_tiling(mesh4):
+    """bf16 payloads pad to the native (16, 128) tile and round-trip exactly
+    (sums of small integers are representable in bf16)."""
+    from adapcc_tpu.comm.pallas_ring import _tile_elems  # noqa
+
+    assert _tile_elems(jnp.bfloat16) == 16 * 128
+    assert _tile_elems(jnp.float32) == 8 * 128
+    assert _tile_elems(jnp.int8) == 32 * 128
+    world = 4
+    for n in (16 * 128, 1000):  # aligned and ragged
+        xs = jnp.stack(
+            [jnp.full((n,), float(r + 1), jnp.bfloat16) for r in range(world)]
+        )
+
+        def per_shard(x):
+            return ring_allreduce_shard(x[0], world, interpret=True)[None]
+
+        out = np.asarray(run_shard(per_shard, mesh4, xs).astype(jnp.float32))
+        np.testing.assert_allclose(out, np.full((world, n), 10.0))
+
+
+def test_ring_all_gather_bf16_alignment(mesh4):
+    # 8*128 elems is tile-aligned for fp32 but NOT for bf16 (needs 16*128)
+    def per_shard(x):
+        return ring_all_gather_shard(x[0], 4, interpret=True)[None]
+
+    with pytest.raises(ValueError, match="2048"):
+        run_shard(per_shard, mesh4, jnp.ones((4, 8 * 128), jnp.bfloat16))
 
 
 def test_engine_ring_allreduce_entry(mesh8):

@@ -1,0 +1,75 @@
+"""Real-TPU smoke: the Pallas ring kernel must lower through Mosaic.
+
+The interpreter (tests/test_pallas_ring.py) validates semantics but not the
+Mosaic TPU lowering — memory-space placement, semaphore allocation, and the
+remote-copy plumbing can fail on the real target where the interpreter
+passes.  With one chip a multi-device ring cannot execute, so this compiles
+and runs the world=1-degenerate kernel (barrier + VMEM staging + scratch
+semaphores, zero RDMA steps) on the TPU target in a subprocess — the suite's
+conftest pins every in-process test to the virtual CPU pod.
+
+Skipped (not failed) when no TPU is reachable or the tunnel is wedged.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = textwrap.dedent(
+    """
+    import functools
+    import jax, jax.numpy as jnp, numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        print("NO_TPU"); raise SystemExit(0)
+
+    from adapcc_tpu.comm.pallas_ring import _run_ring_chunks, _tile_elems
+    from adapcc_tpu.comm.mesh import RANKS_AXIS
+
+    mesh = Mesh(np.array([dev]), (RANKS_AXIS,))
+    for dtype in (jnp.float32, jnp.bfloat16):
+        sub = _tile_elems(dtype) // 128
+        chunks = jnp.ones((1, sub, 128), dtype)
+        fn = jax.jit(
+            jax.shard_map(
+                functools.partial(
+                    _run_ring_chunks,
+                    world=1, axis_name=RANKS_AXIS, rs=True, ag=True,
+                    interpret=False,
+                ),
+                mesh=mesh, in_specs=P(RANKS_AXIS), out_specs=P(RANKS_AXIS),
+                check_vma=False,
+            )
+        )
+        lowered = fn.lower(jnp.ones((1, 1, sub, 128), dtype))
+        compiled = lowered.compile()  # Mosaic lowering happens here
+        out = np.asarray(compiled(jnp.ones((1, 1, sub, 128), dtype)).astype(jnp.float32))
+        assert np.allclose(out, 1.0), out
+        print(f"MOSAIC_OK {jnp.dtype(dtype).name}")
+    """
+)
+
+
+def test_pallas_ring_lowers_through_mosaic():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the axon TPU backend load
+    env.pop("XLA_FLAGS", None)
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", CHILD],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=300,
+        )
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU unreachable (tunnel timeout)")
+    if "NO_TPU" in out.stdout:
+        pytest.skip("no TPU in this environment")
+    assert out.returncode == 0, out.stderr[-3000:]
+    assert "MOSAIC_OK float32" in out.stdout
+    assert "MOSAIC_OK bfloat16" in out.stdout

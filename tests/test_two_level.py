@@ -1,0 +1,145 @@
+"""Two-level (DCN × ICI) strategy execution on a virtual 2×4 pod."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adapcc_tpu.comm.engine import CollectiveEngine
+from adapcc_tpu.comm.two_level import (
+    DCN_AXIS,
+    ICI_AXIS,
+    build_two_level_mesh,
+    is_two_level,
+    mesh_rank_slice,
+    slice_tree,
+)
+from adapcc_tpu.primitives import ReduceOp
+from adapcc_tpu.strategy.ir import Strategy, Tree
+
+
+@pytest.fixture(scope="module")
+def mesh2x4():
+    return build_two_level_mesh(2, 4)
+
+
+def hier_strategy(num_trans=1):
+    """8 ranks on 2 hosts of 4: masters 0 and 4, chains under each master —
+    the shape ParTrees emits for a 2-host world (reference strategy/4-4_1.xml
+    is the same layout at 4+4 GPUs)."""
+    ips = {r: ("a" if r < 4 else "b") for r in range(8)}
+    trees = []
+    for t in range(num_trans):
+        if t % 2 == 0:
+            children = {0: [1, 4], 1: [2], 2: [3], 4: [5], 5: [6], 6: [7]}
+            root = 0
+        else:  # rotated root for the second parallel transmission
+            children = {4: [5, 0], 5: [6], 6: [7], 0: [1], 1: [2], 2: [3]}
+            root = 4
+        trees.append(Tree(root, children, ips))
+    return Strategy(trees, 8)
+
+
+def test_build_two_level_mesh_shape(mesh2x4):
+    assert is_two_level(mesh2x4)
+    assert mesh2x4.devices.shape == (2, 4)
+    assert mesh2x4.axis_names == (DCN_AXIS, ICI_AXIS)
+
+
+def test_slice_tree_keeps_only_inter_slice_edges():
+    """The master tree contains exactly the strategy's inter-host edges —
+    intra-host chain edges never appear, so by construction they cannot ride
+    DCN (they execute as the ICI-axis collective instead)."""
+    s = hier_strategy()
+    rank_slice = mesh_rank_slice(2, 4)
+    st = slice_tree(s.trees[0], rank_slice, 2)
+    assert st.root == 0
+    edges = [(p, c) for c, p in st.parent.items()]
+    assert edges == [(0, 1)]  # the single master edge 0→4, as slice ids
+    # every executed DCN round is over slice indices < num_slices
+    for rnd in st.reduce_rounds() + st.broadcast_rounds():
+        for u, v in rnd.edges:
+            assert 0 <= u < 2 and 0 <= v < 2
+
+
+def test_slice_tree_rejects_non_hierarchical():
+    # rank 5 (slice 1) parented by rank 1 (slice 0) alongside 0→4: slice 1
+    # would have two inbound DCN edges
+    ips = {r: ("a" if r < 4 else "b") for r in range(8)}
+    children = {0: [1, 4], 1: [2, 5], 2: [3], 4: [], 5: [6], 6: [7]}
+    tree = Tree(0, children, ips)
+    with pytest.raises(ValueError, match="two inbound"):
+        slice_tree(tree, mesh_rank_slice(2, 4), 2)
+
+
+def test_two_level_allreduce_matches_oracle(mesh2x4):
+    eng = CollectiveEngine(mesh2x4, hier_strategy(), use_xla_fastpath=False)
+    x = jnp.stack([jnp.full((6,), float(r)) for r in range(8)])
+    out = np.asarray(eng.all_reduce(x))
+    assert np.allclose(out, float(sum(range(8))))
+
+
+def test_two_level_allreduce_multi_tree_shares(mesh2x4):
+    strategy = hier_strategy(num_trans=2)
+    strategy.shares = [0.75, 0.25]
+    eng = CollectiveEngine(mesh2x4, strategy, use_xla_fastpath=False)
+    x = jnp.stack([jnp.arange(8.0) + r for r in range(8)])
+    out = np.asarray(eng.all_reduce(x))
+    expect = np.asarray(sum(np.arange(8.0) + r for r in range(8)))
+    assert np.allclose(out, np.broadcast_to(expect, (8, 8)))
+
+
+def test_two_level_subset_and_avg(mesh2x4):
+    eng = CollectiveEngine(mesh2x4, hier_strategy(), use_xla_fastpath=False)
+    x = jnp.stack([jnp.full((4,), float(r + 1)) for r in range(8)])
+    # ranks 2 and 7 are stragglers (one per slice)
+    active = [0, 1, 3, 4, 5, 6]
+    out = np.asarray(eng.all_reduce(x, active_gpus=active))
+    assert np.allclose(out, sum(r + 1 for r in active))
+    avg = np.asarray(eng.all_reduce(x, active_gpus=active, op=ReduceOp.AVG))
+    assert np.allclose(avg, sum(r + 1 for r in active) / len(active))
+
+
+def test_two_level_max(mesh2x4):
+    eng = CollectiveEngine(mesh2x4, hier_strategy(), use_xla_fastpath=False)
+    x = jnp.stack([jnp.full((3,), float(r)) for r in range(8)])
+    out = np.asarray(eng.all_reduce(x, active_gpus=list(range(8)), op=ReduceOp.MAX))
+    assert np.allclose(out, 7.0)
+
+
+def test_two_level_psum_fastpath(mesh2x4):
+    eng = CollectiveEngine(mesh2x4, hier_strategy(), use_xla_fastpath=True)
+    x = jnp.stack([jnp.full((5,), float(r)) for r in range(8)])
+    out = np.asarray(eng.all_reduce(x))
+    assert np.allclose(out, float(sum(range(8))))
+
+
+def test_two_level_reduce_root_slice_holds_total(mesh2x4):
+    eng = CollectiveEngine(mesh2x4, hier_strategy(), use_xla_fastpath=False)
+    x = jnp.stack([jnp.full((4,), float(r + 1)) for r in range(8)])
+    out = np.asarray(eng.reduce(x))
+    # tree rooted at rank 0 → root slice 0: lanes 0-3 hold the total
+    assert np.allclose(out[:4], 36.0)
+
+
+def test_two_level_broadcast_root_value_everywhere(mesh2x4):
+    eng = CollectiveEngine(mesh2x4, hier_strategy(), use_xla_fastpath=False)
+    x = jnp.stack([jnp.full((4,), float(10 * (r + 1))) for r in range(8)])
+    out = np.asarray(eng.boardcast(x))
+    assert np.allclose(out, 10.0)  # root rank 0's value lands on all 8 ranks
+
+
+def test_two_level_xla_native_primitives(mesh2x4):
+    eng = CollectiveEngine(mesh2x4, hier_strategy())
+    x = jnp.stack([jnp.full((2,), float(r)) for r in range(8)])
+    gathered = np.asarray(eng.all_gather(x))
+    for r in range(8):
+        assert np.allclose(gathered[r, :, 0], np.arange(8.0))
+    rs = np.asarray(eng.reduce_scatter(jnp.stack([jnp.arange(8.0)] * 8)))
+    assert np.allclose(rs.reshape(-1), np.arange(8.0) * 8)
+
+
+def test_two_level_rejects_pallas_ring(mesh2x4):
+    eng = CollectiveEngine(mesh2x4, hier_strategy())
+    with pytest.raises(ValueError, match="flat ranks mesh"):
+        eng.ring_allreduce(jnp.zeros((8, 4)))
