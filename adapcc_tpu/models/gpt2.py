@@ -34,6 +34,11 @@ class GPT2Config:
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
+    #: "xla" materializes [T, T] scores and lets XLA fuse; "flash" runs the
+    #: blockwise Pallas kernel (ops/flash_attention.py) — O(T) memory, MXU
+    #: tiles, no attention-matrix HBM traffic.  Training path only (decode
+    #: uses the KV cache) and requires dropout == 0.
+    attention: str = "xla"
 
     @staticmethod
     def small() -> "GPT2Config":
@@ -95,6 +100,14 @@ class CausalSelfAttention(nn.Module):
                 att = jnp.where(valid[None, None, None], att, -1e30)
             else:
                 att = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+        elif cfg.attention == "flash" and cfg.dropout == 0.0:
+            from adapcc_tpu.ops import flash_attention
+
+            out = flash_attention(
+                q.astype(cfg.dtype), k.astype(cfg.dtype), v.astype(cfg.dtype),
+                causal=True, scale=scale,
+            )
+            return self._project(out.reshape(B, T, cfg.d_model), deterministic)
         else:
             att = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
             causal = jnp.tril(jnp.ones((T, T), dtype=bool))
@@ -103,6 +116,10 @@ class CausalSelfAttention(nn.Module):
         att = nn.Dropout(cfg.dropout)(att, deterministic=deterministic)
 
         out = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(B, T, cfg.d_model)
+        return self._project(out, deterministic)
+
+    def _project(self, out: jnp.ndarray, deterministic: bool) -> jnp.ndarray:
+        cfg = self.cfg
         # scaled init on the residual projection (GPT-2 scheme)
         proj = nn.Dense(
             cfg.d_model,
