@@ -16,25 +16,106 @@ statement the raw tokens/s number lacks.  Timing is forced-sync: a scalar
 backends ``block_until_ready`` can return before execution completes
 (PERFORMANCE.md "measurement methodology").
 
+Robustness (the round-2 failure was one transient tunnel error zeroing the
+whole round's evidence): a subprocess *preflight* proves the backend can
+compile a tiny program within a hard deadline (bounded retries) before the
+main process ever initializes it; a *watchdog* emits whatever was measured
+plus an ``error`` field if a phase hangs past ``BENCH_DEADLINE``; each phase
+records its partial results as soon as they exist, so a late failure (e.g.
+in the baseline path) still leaves the framework numbers in the JSON with
+``error`` naming the dead phase and a nonzero exit code.
+
 Size knobs via env (defaults target a single v5e chip):
     BENCH_LAYERS, BENCH_DMODEL, BENCH_HEADS, BENCH_SEQ, BENCH_BATCH,
-    BENCH_STEPS, BENCH_WORLD, BENCH_PEAK_TFLOPS
+    BENCH_STEPS, BENCH_WORLD, BENCH_PEAK_TFLOPS, BENCH_ATTN (flash|xla),
+    BENCH_PARAM_DTYPE (bf16|f32), BENCH_PREFLIGHT_S, BENCH_ATTEMPTS,
+    BENCH_DEADLINE
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
+import threading
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
+_RESULT = {
+    "metric": "gpt2_ddp_train_throughput",
+    "value": None,
+    "unit": "tokens/s",
+    "vs_baseline": None,
+}
+_PHASE = {"name": "startup"}
 
 
 def _env_int(name: str, default: int) -> int:
     return int(os.environ.get(name, default))
+
+
+def _progress(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def _phase_begin(name: str) -> None:
+    _PHASE["name"] = name
+    _progress(f"phase: {name}")
+
+
+def _emit(rc: int) -> None:
+    print(json.dumps(_RESULT), flush=True)
+    sys.exit(rc)
+
+
+def _arm_watchdog() -> None:
+    """Emit partial JSON and die if the bench hangs past its deadline —
+    a hung phase must still leave an attributable artifact."""
+    deadline = _env_int("BENCH_DEADLINE", 1500)
+
+    def fire() -> None:
+        _RESULT["error"] = f"watchdog: deadline {deadline}s exceeded in phase {_PHASE['name']}"
+        print(json.dumps(_RESULT), flush=True)
+        os._exit(3)
+
+    t = threading.Timer(deadline, fire)
+    t.daemon = True
+    t.start()
+
+
+def preflight() -> str:
+    """Prove the backend compiles a tiny program, in a *subprocess* with a
+    hard per-attempt deadline — backend init against a wedged tunnel can hang
+    for minutes, and it must not take the main process down with it."""
+    attempts = _env_int("BENCH_ATTEMPTS", 3)
+    per_attempt = _env_int("BENCH_PREFLIGHT_S", 90)
+    # the axon sitecustomize overrides JAX_PLATFORMS at interpreter startup,
+    # so the env pin must be re-applied via jax.config before backend init
+    code = (
+        "import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+        "p and jax.config.update('jax_platforms', p); "
+        "import jax.numpy as jnp; d = jax.devices(); "
+        "jax.jit(lambda a: a + 1)(jnp.ones(8)).block_until_ready(); "
+        "print('PREFLIGHT_OK', d[0].platform, getattr(d[0], 'device_kind', '?'))"
+    )
+    last = ""
+    for i in range(attempts):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True, text=True, timeout=per_attempt,
+            )
+            if out.returncode == 0 and "PREFLIGHT_OK" in out.stdout:
+                line = [l for l in out.stdout.splitlines() if "PREFLIGHT_OK" in l][0]
+                _progress(f"preflight: {line}")
+                return line
+            last = (out.stderr or out.stdout)[-300:].replace("\n", " | ")
+        except subprocess.TimeoutExpired:
+            last = f"no response within {per_attempt}s"
+        _progress(f"preflight attempt {i + 1}/{attempts} failed: {last}")
+        if i + 1 < attempts:
+            time.sleep(5)
+    raise RuntimeError(f"backend unreachable after {attempts} attempts: {last}")
 
 
 #: advertised bf16 peak TFLOP/s per chip, by device_kind substring
@@ -49,6 +130,8 @@ _PEAK_TFLOPS = (
 
 
 def chip_peak_tflops() -> float:
+    import jax
+
     env = os.environ.get("BENCH_PEAK_TFLOPS")
     if env:
         return float(env)
@@ -72,101 +155,172 @@ def train_flops_per_token(cfg) -> float:
     return 3.0 * fwd
 
 
+def _pick_attention() -> str:
+    """Probe-compile the flash path on the live backend; fall back to the XLA
+    attention (recording why) rather than failing the whole bench."""
+    import jax
+    import jax.numpy as jnp
+
+    want = os.environ.get("BENCH_ATTN", "flash")
+    if want != "flash":
+        return want
+    try:
+        from adapcc_tpu.ops import flash_attention
+
+        x = jnp.ones((1, 256, 2, 64), jnp.bfloat16)
+        jax.block_until_ready(jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, causal=True)
+        )(x, x, x))
+        return "flash"
+    except Exception as e:  # noqa: BLE001 — any lowering failure falls back
+        _RESULT["flash_error"] = f"{type(e).__name__}: {e}"[:300]
+        _progress(f"flash probe failed, falling back to xla attention: {e}")
+        return "xla"
+
+
 def main() -> None:
-    from adapcc_tpu.comm.mesh import build_world_mesh
-    from adapcc_tpu.ddp import DDPTrainer, TrainState
-    from adapcc_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
-    from adapcc_tpu.strategy.ir import Strategy
+    _arm_watchdog()
+    _phase_begin("preflight")
+    try:
+        _RESULT["backend"] = preflight()
+    except Exception as e:  # noqa: BLE001
+        _RESULT["error"] = f"preflight: {e}"
+        _emit(2)
 
-    world = _env_int("BENCH_WORLD", 0) or len(jax.devices())
-    mesh = build_world_mesh(world)
+    _phase_begin("setup")
+    try:
+        import jax
 
-    cfg = GPT2Config(
-        vocab_size=16384,
-        max_seq=_env_int("BENCH_SEQ", 512),
-        n_layer=_env_int("BENCH_LAYERS", 12),
-        n_head=_env_int("BENCH_HEADS", 16),
-        d_model=_env_int("BENCH_DMODEL", 1024),
-    )
-    per_rank_batch = _env_int("BENCH_BATCH", 16)
-    batch = per_rank_batch * world
-    steps = _env_int("BENCH_STEPS", 10)
+        from adapcc_tpu.launch.launcher import apply_platform_env
 
-    model = GPT2(cfg)
-    rng = np.random.default_rng(0)
-    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(batch, cfg.max_seq)), jnp.int32)
-    params = model.init(jax.random.PRNGKey(0), tokens[:1])
+        apply_platform_env()  # honor JAX_PLATFORMS despite site customizations
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
 
-    def loss_fn(p, b):
-        return lm_loss(model.apply(p, b), b)
+        from adapcc_tpu.comm.mesh import build_world_mesh
+        from adapcc_tpu.ddp import DDPTrainer, TrainState
+        from adapcc_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
+        from adapcc_tpu.strategy.ir import Strategy
 
-    tx = optax.adamw(3e-4)
+        world = _env_int("BENCH_WORLD", 0) or len(jax.devices())
+        mesh = build_world_mesh(world)
 
-    def time_steps(step_fn, state):
-        """Mean step seconds with a forced host sync closing the window."""
-        state, loss = step_fn(state)  # compile + warmup
-        _ = float(jax.device_get(jnp.mean(loss)))
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, loss = step_fn(state)
-        # a scalar host read forces the whole dispatched chain to finish;
-        # block_until_ready alone is not trustworthy through remote tunnels
-        _ = float(jax.device_get(jnp.mean(loss)))
-        return (time.perf_counter() - t0) / steps
-
-    # --- framework path: DDPTrainer with the adaptive gradient hook -----------
-    trainer = DDPTrainer(
-        loss_fn, tx, mesh, Strategy.ring(world), donate_state=True, use_xla_fastpath=True
-    )
-    # both paths donate their state; give each its own param buffers
-    fw_state = TrainState.create(jax.tree_util.tree_map(jnp.array, params), tx)
-    fw_time = time_steps(lambda s: trainer.step(s, tokens), fw_state)
-
-    # --- baseline: plain jit + psum DDP (no framework) -------------------------
-    from jax.sharding import PartitionSpec as P
-
-    def base_step_shard(state, b):
-        loss, grads = jax.value_and_grad(loss_fn)(state.params, b)
-        grads = jax.tree_util.tree_map(lambda g: jax.lax.pmean(g, "ranks"), grads)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params2 = optax.apply_updates(state.params, updates)
-        return TrainState(params=params2, opt_state=opt_state, step=state.step + 1), loss[None]
-
-    base_fn = jax.jit(
-        jax.shard_map(
-            base_step_shard,
-            mesh=mesh,
-            in_specs=(P(), P("ranks")),
-            out_specs=(P(), P("ranks")),
-            check_vma=False,
-        ),
-        donate_argnums=(0,),
-    )
-    base_state = TrainState.create(jax.tree_util.tree_map(jnp.array, params), tx)
-    base_time = time_steps(lambda s: base_fn(s, tokens), base_state)
-
-    tokens_per_step = batch * cfg.max_seq
-    value = tokens_per_step / fw_time
-    baseline = tokens_per_step / base_time
-    flops_per_tok = train_flops_per_token(cfg)
-    peak = chip_peak_tflops() * 1e12 * world
-    mfu = value * flops_per_tok / peak
-
-    print(
-        json.dumps(
-            {
-                "metric": "gpt2_ddp_train_throughput",
-                "value": round(value, 1),
-                "unit": "tokens/s",
-                "vs_baseline": round(value / baseline, 4),
-                "mfu": round(mfu, 4),
-                "step_ms": round(fw_time * 1e3, 2),
-                "baseline_step_ms": round(base_time * 1e3, 2),
-                "model_flops_per_token": round(flops_per_tok / 1e6, 1),
-                "world": world,
-            }
+        attention = _pick_attention()
+        cfg = GPT2Config(
+            vocab_size=16384,
+            max_seq=_env_int("BENCH_SEQ", 512),
+            n_layer=_env_int("BENCH_LAYERS", 12),
+            n_head=_env_int("BENCH_HEADS", 16),
+            d_model=_env_int("BENCH_DMODEL", 1024),
+            attention=attention,
         )
-    )
+        per_rank_batch = _env_int("BENCH_BATCH", 16)
+        batch = per_rank_batch * world
+        steps = _env_int("BENCH_STEPS", 10)
+
+        model = GPT2(cfg)
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, size=(batch, cfg.max_seq)), jnp.int32
+        )
+        params = model.init(jax.random.PRNGKey(0), tokens[:1])
+        param_dtype = os.environ.get("BENCH_PARAM_DTYPE", "bf16")
+        if param_dtype == "bf16":
+            params = jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.bfloat16)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                params,
+            )
+        _RESULT["attention"] = attention
+        _RESULT["param_dtype"] = param_dtype
+
+        def loss_fn(p, b):
+            return lm_loss(model.apply(p, b), b)
+
+        tx = optax.adamw(3e-4)
+
+        def time_steps(step_fn, state):
+            """Mean step seconds with a forced host sync closing the window."""
+            state, loss = step_fn(state)  # compile + warmup
+            _ = float(jax.device_get(jnp.mean(loss)))
+            t0 = time.perf_counter()
+            for _i in range(steps):
+                state, loss = step_fn(state)
+            # a scalar host read forces the whole dispatched chain to finish;
+            # block_until_ready alone is not trustworthy through remote tunnels
+            _ = float(jax.device_get(jnp.mean(loss)))
+            return (time.perf_counter() - t0) / steps
+
+        tokens_per_step = batch * cfg.max_seq
+        flops_per_tok = train_flops_per_token(cfg)
+        _RESULT["model_flops_per_token"] = round(flops_per_tok / 1e6, 1)
+        _RESULT["world"] = world
+    except Exception as e:  # noqa: BLE001
+        _RESULT["error"] = f"setup: {type(e).__name__}: {e}"[:500]
+        _emit(1)
+
+    # --- framework path: DDPTrainer with the adaptive gradient hook ---------
+    _phase_begin("framework")
+    try:
+        trainer = DDPTrainer(
+            loss_fn, tx, mesh, Strategy.ring(world),
+            donate_state=True, use_xla_fastpath=True,
+        )
+        # both paths donate their state; give each its own param buffers
+        fw_state = TrainState.create(jax.tree_util.tree_map(jnp.array, params), tx)
+        fw_time = time_steps(lambda s: trainer.step(s, tokens), fw_state)
+
+        value = tokens_per_step / fw_time
+        peak = chip_peak_tflops() * 1e12 * world
+        _RESULT["value"] = round(value, 1)
+        _RESULT["step_ms"] = round(fw_time * 1e3, 2)
+        _RESULT["mfu"] = round(value * flops_per_tok / peak, 4)
+        _progress(
+            f"framework: {value:,.0f} tok/s, {fw_time * 1e3:.1f} ms/step, "
+            f"mfu {_RESULT['mfu']:.3f}"
+        )
+    except Exception as e:  # noqa: BLE001
+        _RESULT["error"] = f"framework: {type(e).__name__}: {e}"[:500]
+        _emit(1)
+
+    # --- baseline: plain jit + psum DDP (no framework) ----------------------
+    _phase_begin("baseline")
+    try:
+        from jax.sharding import PartitionSpec as P
+
+        def base_step_shard(state, b):
+            loss, grads = jax.value_and_grad(loss_fn)(state.params, b)
+            grads = jax.tree_util.tree_map(lambda g: jax.lax.pmean(g, "ranks"), grads)
+            updates, opt_state = tx.update(grads, state.opt_state, state.params)
+            params2 = optax.apply_updates(state.params, updates)
+            return (
+                TrainState(params=params2, opt_state=opt_state, step=state.step + 1),
+                loss[None],
+            )
+
+        base_fn = jax.jit(
+            jax.shard_map(
+                base_step_shard,
+                mesh=mesh,
+                in_specs=(P(), P("ranks")),
+                out_specs=(P(), P("ranks")),
+                check_vma=False,
+            ),
+            donate_argnums=(0,),
+        )
+        base_state = TrainState.create(jax.tree_util.tree_map(jnp.array, params), tx)
+        base_time = time_steps(lambda s: base_fn(s, tokens), base_state)
+        baseline = tokens_per_step / base_time
+        _RESULT["baseline_step_ms"] = round(base_time * 1e3, 2)
+        _RESULT["vs_baseline"] = round(_RESULT["value"] / baseline, 4)
+        _progress(f"baseline: {baseline:,.0f} tok/s, {base_time * 1e3:.1f} ms/step")
+    except Exception as e:  # noqa: BLE001
+        # the framework numbers above are already recorded — keep them
+        _RESULT["error"] = f"baseline: {type(e).__name__}: {e}"[:500]
+        _emit(1)
+
+    _emit(0)
 
 
 if __name__ == "__main__":
