@@ -1,0 +1,133 @@
+"""Oracle tests for the blockwise (flash) attention Pallas kernels.
+
+The dense oracle materializes the full ``[T, T]`` attention matrix — what
+the reference's HF GPT-2 does in HBM (SURVEY §2.4) and what
+ops/flash_attention.py exists to avoid.  Forward and all three gradients
+must match it; the Pallas interpreter runs on the CPU pod (Mosaic lowering
+is covered separately by tests/test_tpu_smoke.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adapcc_tpu.ops import flash_attention
+
+
+def _dense_attention(q, k, v, causal=True, scale=None):
+    B, T, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    att = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+        att = jnp.where(mask[None, None], att, -1e30)
+    p = jax.nn.softmax(att, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _qkv(T=128, B=2, H=2, D=16, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, T, H, D)) * 0.5, dtype)  # noqa: E731
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_dense_oracle(causal):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    ref = _dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_grads_match_dense_oracle(causal):
+    q, k, v = _qkv(T=64)
+    do = jnp.asarray(np.random.default_rng(9).normal(size=q.shape), jnp.float32)
+
+    def flash_loss(q, k, v):
+        return jnp.vdot(flash_attention(q, k, v, causal=causal, block_q=32, block_k=32), do)
+
+    def dense_loss(q, k, v):
+        return jnp.vdot(_dense_attention(q, k, v, causal=causal), do)
+
+    gf = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip("q k v".split(), gf, gd):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5, err_msg=f"d{name} mismatch"
+        )
+
+
+def test_bfloat16_forward_and_grads_close_to_fp32_oracle():
+    q, k, v = _qkv(T=64, dtype=jnp.bfloat16)
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    assert out.dtype == jnp.bfloat16
+    ref = _dense_attention(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), np.asarray(ref), atol=0.05
+    )
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+                       .astype(jnp.float32) ** 2)
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in grads:
+        assert g.dtype == jnp.bfloat16
+        assert np.isfinite(np.asarray(g, dtype=np.float32)).all()
+
+
+def test_uneven_block_split_raises():
+    q, k, v = _qkv(T=48)
+    with pytest.raises(ValueError, match="divide into blocks"):
+        flash_attention(q, k, v, block_q=32, block_k=32)
+
+
+def test_mismatched_shapes_raise():
+    q, k, v = _qkv(T=32)
+    with pytest.raises(ValueError, match="shapes differ"):
+        flash_attention(q, k[:, :16], v)
+
+
+def test_custom_scale_respected():
+    q, k, v = _qkv(T=32)
+    out = flash_attention(q, k, v, causal=True, scale=0.5, block_q=32, block_k=32)
+    ref = _dense_attention(q, k, v, causal=True, scale=0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
+def test_gpt2_flash_config_trains():
+    """The model-level flash branch (models/gpt2.py attention == "flash"):
+    one grad step, finite loss, and forward parity with the XLA-attention
+    config on identical params."""
+    from adapcc_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
+
+    base = dict(vocab_size=128, max_seq=32, n_layer=2, n_head=2, d_model=32,
+                dtype=jnp.float32)
+    cfg_flash = GPT2Config(**base, attention="flash")
+    cfg_xla = GPT2Config(**base, attention="xla")
+    tokens = jnp.asarray(
+        np.random.default_rng(3).integers(0, 128, size=(2, 32)), jnp.int32
+    )
+    model_f, model_x = GPT2(cfg_flash), GPT2(cfg_xla)
+    params = model_f.init(jax.random.PRNGKey(0), tokens)
+
+    out_f = model_f.apply(params, tokens)
+    out_x = model_x.apply(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out_f), np.asarray(out_x), atol=2e-4,
+        err_msg="flash and xla attention configs diverge on identical params",
+    )
+
+    loss, grads = jax.value_and_grad(
+        lambda p: lm_loss(model_f.apply(p, tokens), tokens)
+    )(params)
+    assert np.isfinite(float(loss))
+    finite = jax.tree_util.tree_all(
+        jax.tree_util.tree_map(lambda g: bool(np.isfinite(np.asarray(g)).all()), grads)
+    )
+    assert finite, "non-finite grads through the flash branch"
