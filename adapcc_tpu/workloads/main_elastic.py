@@ -37,6 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--checkpoint-file", type=str, default="/tmp/adapcc_elastic/checkpoint.ckpt")
     p.add_argument("--world", type=int, default=None)
+    p.add_argument("--model", choices=("vgg11", "mlp"), default="vgg11",
+                   help="vgg11 matches the reference workload; mlp compiles "
+                        "in seconds for restart-path tests")
     p.add_argument("--crash-at-epoch", type=int, default=None,
                    help="fault injection: die after checkpointing this epoch")
     p.add_argument("--supervise", action="store_true",
@@ -55,13 +58,23 @@ def worker(args) -> int:
 
     from adapcc_tpu.comm.mesh import build_world_mesh
     from adapcc_tpu.ddp import DDPTrainer, TrainState
-    from adapcc_tpu.models.vgg import VGG11
     from adapcc_tpu.strategy.ir import Strategy
 
     mesh = build_world_mesh(args.world)
     world = int(mesh.devices.size)
 
-    model = VGG11(num_classes=10, classifier_width=128, dtype=jnp.float32)
+    if args.model == "vgg11":
+        from adapcc_tpu.models.vgg import VGG11
+
+        model = VGG11(num_classes=10, classifier_width=128, dtype=jnp.float32)
+    else:
+        from adapcc_tpu.models.mlp import MLP
+
+        class _Flat(MLP):
+            def __call__(self, x):
+                return super().__call__(x.reshape(x.shape[0], -1))
+
+        model = _Flat(features=(16, 10))
     rng = np.random.default_rng(0)
     images = jnp.asarray(rng.normal(size=(args.batch, 32, 32, 3)), jnp.float32)
     labels = jnp.asarray(rng.integers(0, 10, size=(args.batch,)))
@@ -116,6 +129,7 @@ def main(argv=None) -> int:
             "--batch", str(args.batch),
             "--lr", str(args.lr),
             "--checkpoint-file", args.checkpoint_file,
+            "--model", args.model,
         ]
         if args.world:
             worker_argv += ["--world", str(args.world)]

@@ -155,6 +155,7 @@ def test_elastic_workload_survives_injected_crash(tmp_path):
             sys.executable, "-m", "adapcc_tpu.workloads.main_elastic",
             "--supervise", "--epochs", "2", "--steps-per-epoch", "2",
             "--world", "2", "--batch", "8", "--crash-at-epoch", "0",
+            "--model", "mlp",
             "--checkpoint-file", str(tmp_path / "checkpoint.ckpt"),
         ],
         capture_output=True, text=True, cwd="/root/repo", env=env, timeout=300,
