@@ -147,11 +147,9 @@ class Communicator:
             if jax.process_count() > 1 and jax.process_index() != 0:
                 import base64
 
-                from adapcc_tpu.launch.dispatcher import fetch_value
-
                 # empty payload = master's synthesis was skipped (no profile
                 # data); mirror the master and keep the current strategy
-                payload = fetch_value(round_key, timeout_ms=self.args.kv_timeout_ms)
+                payload = self._fetch_synthesis_value(round_key)
                 if payload:
                     os.makedirs(
                         os.path.dirname(self.args.strategy_file) or ".", exist_ok=True
@@ -160,7 +158,7 @@ class Communicator:
                         f.write(base64.b64decode(payload))
                     self._strategy = None  # force reload from the fetched XML
                 self.chunk_bytes = int(
-                    fetch_value(round_key + "/chunk_bytes", timeout_ms=self.args.kv_timeout_ms)
+                    self._fetch_synthesis_value(round_key + "/chunk_bytes")
                 )
             else:
                 self._synthesis_strategy()
@@ -176,6 +174,30 @@ class Communicator:
             eng = self._engines.pop(prim, None)
             if eng is not None:
                 eng.clear()
+
+    def _fetch_synthesis_value(self, key: str) -> str:
+        """KV fetch with a diagnosable failure: the master can die *between*
+        its strategy and chunk_bytes publishes, in which case the worker's
+        blocking get times out (or hands back nothing) — exactly the window
+        the fault machinery exists for, so name it instead of surfacing an
+        opaque timeout/``int(None)`` TypeError."""
+        from adapcc_tpu.launch.dispatcher import fetch_value
+
+        try:
+            value = fetch_value(key, timeout_ms=self.args.kv_timeout_ms)
+        except Exception as e:  # noqa: BLE001 — KV backend errors vary
+            raise RuntimeError(
+                f"master died during strategy synthesis (or is still "
+                f"synthesizing — raise kv_timeout_ms — or the coordinator is "
+                f"unreachable): no value published under {key!r} within "
+                f"{self.args.kv_timeout_ms} ms"
+            ) from e
+        if value is None:
+            raise RuntimeError(
+                f"master died during strategy synthesis: KV store returned "
+                f"nothing for {key!r}"
+            )
+        return value
 
     def clear(self) -> None:
         """Tear down contexts and the coordinator plane (reference clear

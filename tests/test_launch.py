@@ -298,3 +298,47 @@ def test_launcher_cli_dry_run(tmp_path):
     assert out.returncode == 0, out.stderr
     assert "train_ddp" in out.stdout
     assert os.path.exists(tmp_path / "ip_table.txt")
+
+
+def test_worker_names_master_death_between_synthesis_publishes(tmp_path, monkeypatch):
+    """The master can die *between* publishing the strategy and the chunk
+    size; the worker must surface 'master died during strategy synthesis'
+    with the missing key, not an opaque KV timeout / int(None) TypeError."""
+    import base64
+
+    import jax
+    import pytest
+
+    from adapcc_tpu.communicator import Communicator
+    from adapcc_tpu.config import CommArgs
+    from adapcc_tpu.primitives import PROFILE
+
+    jax.devices()
+    from jax._src import distributed
+
+    fake_kv = _FakeKVClient()
+    monkeypatch.setattr(distributed.global_state, "client", fake_kv)
+
+    args = CommArgs(
+        strategy_file=str(tmp_path / "strategy.xml"),
+        logical_graph=str(tmp_path / "logical_graph.xml"),
+        topology_dir=str(tmp_path),
+        kv_timeout_ms=50,
+    )
+    worker = Communicator(args, world_size=4)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+
+    import adapcc_tpu.communicator as comm_mod
+
+    monkeypatch.setattr(comm_mod, "_profile_round_counter", iter([7]))
+    # the strategy landed in the KV store, then the master died: chunk_bytes
+    # is never published and the worker's blocking get fails
+    fake_kv.store["adapcc/strategy/g0@r7"] = base64.b64encode(b"<trees/>").decode()
+
+    with pytest.raises(RuntimeError, match="master died during strategy synthesis"):
+        worker.exit_threads(PROFILE)
+    # the error names the missing key so the operator can see which publish died
+    with pytest.raises(RuntimeError, match="chunk_bytes"):
+        monkeypatch.setattr(comm_mod, "_profile_round_counter", iter([7]))
+        worker.exit_threads(PROFILE)
