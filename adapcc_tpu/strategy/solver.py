@@ -124,6 +124,48 @@ def _tree_cost_coeffs(
     return lat_sum, inv_bw_sum
 
 
+def modeled_makespan(
+    strategy,
+    masters: Sequence[int],
+    prim: int,
+    transmission_size: int,
+    bandwidth_graph: Sequence[Sequence[float]],
+    latency_graph: Sequence[Sequence[float]],
+) -> float:
+    """The routing MILP's pipeline-aware bottleneck objective, evaluated on
+    *any* synthesized strategy: max over used trees and their inter-master
+    edges of ``lat + size·(1/bw·load)·share`` (reference objective
+    gurobi/solver.py:190-208).  Puts the heuristic and the solver on one
+    scale — the property that justifies the solver's existence is
+    ``makespan(milp) ≤ makespan(partrees)`` on the same profile.
+    """
+    bw = np.asarray(bandwidth_graph, dtype=float)
+    lat = np.asarray(latency_graph, dtype=float)
+    inv_bw = 1.0 / np.maximum(bw, 1e-9)
+    mset = set(masters)
+    n = len(masters)
+    size = float(max(transmission_size, 1))
+    worst = 0.0
+    for tree, share in zip(strategy.trees, strategy.tree_shares()):
+        if share <= 0.0:
+            continue
+        # project to the inter-master edges (chains are intra-host, not
+        # modeled by the routing MILP) and count masters behind each edge
+        # for the ALLTOALL flow multiplicity
+        mchildren = {
+            p: [c for c in cs if c in mset]
+            for p, cs in tree.children.items()
+            if p in mset
+        }
+        sizes = _subtree_sizes(mchildren, tree.root) if tree.root in mset else {}
+        for p, cs in mchildren.items():
+            for c in cs:
+                load = sizes.get(c, 1) / n if prim == ALLTOALL else 1.0
+                l, k = _edge_lat_invbw(prim, lat, inv_bw, p, c, load=load)
+                worst = max(worst, l + size * k * share)
+    return worst
+
+
 #: above this many masters the routing MILP (O(M·n²) binaries) is skipped in
 #: favor of the rotation model, which only chooses roots and shares
 ROUTING_MILP_MAX_MASTERS = 12
