@@ -143,3 +143,68 @@ def test_two_level_rejects_pallas_ring(mesh2x4):
     eng = CollectiveEngine(mesh2x4, hier_strategy())
     with pytest.raises(ValueError, match="flat ranks mesh"):
         eng.ring_allreduce(jnp.zeros((8, 4)))
+
+
+# -- per-primitive oracles on the (dcn, ici) mesh ---------------------------
+# (VERDICT r2: all_gather/all_to_all/reduce_scatter reduce over BOTH axes via
+# the axis-name tuple — semantically flat-world, pinned here per primitive)
+
+
+def test_two_level_all_gather_oracle(mesh2x4):
+    eng = CollectiveEngine(mesh2x4, hier_strategy())
+    rng = np.random.default_rng(0)
+    shards = rng.normal(size=(8, 3)).astype(np.float32)
+    out = np.asarray(eng.all_gather(jnp.asarray(shards)))
+    assert out.shape == (8, 8, 3)
+    for r in range(8):
+        np.testing.assert_allclose(out[r], shards, atol=1e-6,
+                                   err_msg=f"rank {r} gathered stack wrong")
+
+
+def test_two_level_all_to_all_oracle(mesh2x4):
+    eng = CollectiveEngine(mesh2x4, hier_strategy())
+    # stacked[src, dst] = 100*src + dst; rank r must end with column r
+    stacked = jnp.asarray(
+        [[[100.0 * s + d] for d in range(8)] for s in range(8)], jnp.float32
+    )
+    out = np.asarray(eng.all_to_all(stacked))
+    assert out.shape == (8, 8, 1)
+    for r in range(8):
+        np.testing.assert_allclose(
+            out[r, :, 0], 100.0 * np.arange(8) + r,
+            err_msg=f"rank {r} holds wrong blocks after all_to_all",
+        )
+
+
+def test_two_level_reduce_scatter_oracle(mesh2x4):
+    eng = CollectiveEngine(mesh2x4, hier_strategy())
+    rng = np.random.default_rng(1)
+    rows = rng.normal(size=(8, 16)).astype(np.float32)
+    out = np.asarray(eng.reduce_scatter(jnp.asarray(rows)))
+    assert out.shape == (8, 2)
+    total = rows.sum(axis=0).reshape(8, 2)
+    np.testing.assert_allclose(out, total, atol=1e-5)
+    # AVG divides by the flat world size, not one axis's size
+    avg = np.asarray(eng.reduce_scatter(jnp.asarray(rows), op=ReduceOp.AVG))
+    np.testing.assert_allclose(avg, total / 8.0, atol=1e-5)
+
+
+def test_two_level_ring_attention_across_slices(mesh2x4):
+    """SP across slices: the K/V ring rides the DCN axis of the two-level
+    mesh (the placement where DCN latency actually bites)."""
+    from adapcc_tpu.parallel import ring_attention
+
+    rng = np.random.default_rng(2)
+    B, T, H, D = 1, 8, 2, 4
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(B, T, H, D)) * 0.5, jnp.float32)
+        for _ in range(3)
+    )
+    out = ring_attention(mesh2x4, q, k, v, axis_name=DCN_AXIS)
+
+    scale = 1.0 / np.sqrt(D)
+    att = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+    att = jnp.where(mask[None, None], att, -1e30)
+    ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(att, axis=-1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
