@@ -167,35 +167,88 @@ def load_tokenizer():
 
 
 def interact(argv: Optional[list] = None) -> None:
-    """REPL: prompt in, continuation out.  ``--checkpoint`` loads trained
-    params (TrainCheckpointState files from the checkpoint subsystem)."""
+    """REPL (or one-shot with ``--prompt``): prompt in, continuation out.
+
+    ``--ckpt`` loads trained params (TrainCheckpointState files written by
+    workloads/train_gpt2.py ``--checkpoint-file``); the model-shape flags
+    mirror train_gpt2's so the same command line that trained a model can
+    sample from it.
+    """
     import argparse
 
+    from adapcc_tpu.launch.launcher import apply_platform_env
+
+    apply_platform_env()  # honor JAX_PLATFORMS despite site customizations
+
     ap = argparse.ArgumentParser(description="GPT-2 interactive sampling")
-    ap.add_argument("--checkpoint", default=None)
-    ap.add_argument("--max-new-tokens", type=int, default=64)
+    ap.add_argument("--ckpt", "--checkpoint", dest="ckpt", default=None)
+    ap.add_argument("--prompt", default=None,
+                    help="one-shot mode: generate from this prompt and exit")
+    ap.add_argument("--max-new-tokens", type=int, default=32)
     ap.add_argument("--temperature", type=float, default=0.9)
     ap.add_argument("--top-k", type=int, default=40)
     ap.add_argument("--top-p", type=float, default=0.9)
     ap.add_argument("--seed", type=int, default=0)
+    # model shape: same flags and defaults as workloads/train_gpt2.py (except
+    # --vocab, which follows the tokenizer), so a default-trained checkpoint
+    # round-trips with a default generate command line
+    ap.add_argument("--vocab", type=int, default=None,
+                    help="default: tokenizer vocab size")
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--heads", type=int, default=2)
+    ap.add_argument("--dmodel", type=int, default=128)
     args = ap.parse_args(argv)
 
+    if args.max_new_tokens >= args.seq:
+        raise SystemExit(
+            f"--max-new-tokens {args.max_new_tokens} must be < --seq "
+            f"{args.seq}: the KV cache holds prompt + generation together"
+        )
+
     tok = load_tokenizer()
-    cfg = GPT2Config(vocab_size=max(getattr(tok, "vocab_size", 258), 258), max_seq=256,
-                     n_layer=4, n_head=4, d_model=256)
+    vocab = args.vocab or max(getattr(tok, "vocab_size", 258), 258)
+    cfg = GPT2Config(vocab_size=vocab, max_seq=args.seq,
+                     n_layer=args.layers, n_head=args.heads, d_model=args.dmodel)
     model = GPT2(cfg)
     params = model.init(
         jax.random.PRNGKey(args.seed), jnp.zeros((1, 8), jnp.int32)
     )["params"]
-    if args.checkpoint:
+    if args.ckpt:
         from adapcc_tpu.checkpoint import TrainCheckpointState, load_checkpoint
 
+        mismatch = (
+            f"checkpoint {args.ckpt!r} not found or incompatible with the "
+            f"model shape (--vocab/--seq/--layers/--heads/--dmodel must "
+            f"match training)"
+        )
         state = TrainCheckpointState(params={"params": params})
-        if load_checkpoint(state, args.checkpoint):
-            params = state.params["params"]
-            print(f"loaded checkpoint (epoch {state.epoch})")
+        try:
+            ok = load_checkpoint(state, args.ckpt)
+        except Exception as e:  # flax from_bytes raises on shape mismatch
+            raise SystemExit(f"{mismatch}\n  cause: {e}") from e
+        if not ok:
+            raise SystemExit(mismatch)
+        params = state.params["params"]
+        print(f"loaded checkpoint (epoch {state.epoch})")
 
     rng = jax.random.PRNGKey(args.seed)
+
+    def respond(text: str, rng: jax.Array) -> str:
+        ids = tok.encode(text)[-(cfg.max_seq - args.max_new_tokens):]
+        prompt = jnp.asarray(np.array(ids)[None], jnp.int32)
+        out = generate(
+            model, params, prompt, prompt_len=len(ids),
+            max_new_tokens=args.max_new_tokens, rng=rng,
+            temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
+            eos_id=getattr(tok, "eos_id", None),
+        )
+        return tok.decode(np.asarray(out[0])[len(ids):].tolist())
+
+    if args.prompt is not None:
+        print(respond(args.prompt, rng))
+        return
+
     while True:
         try:
             text = input(">>> ")
@@ -203,16 +256,8 @@ def interact(argv: Optional[list] = None) -> None:
             break
         if not text.strip():
             continue
-        ids = tok.encode(text)[-128:]
-        prompt = jnp.asarray(np.array(ids)[None], jnp.int32)
         rng, sub = jax.random.split(rng)
-        out = generate(
-            model, params, prompt, prompt_len=len(ids),
-            max_new_tokens=args.max_new_tokens, rng=sub,
-            temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
-            eos_id=getattr(tok, "eos_id", None),
-        )
-        print(tok.decode(np.asarray(out[0])[len(ids):].tolist()))
+        print(respond(text, sub))
 
 
 if __name__ == "__main__":

@@ -107,7 +107,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr", type=float, default=3e-4)
     p.add_argument("--warmup-steps", type=int, default=20)
     p.add_argument("--clip-norm", type=float, default=1.0, help="reference max_norm=1.0")
-    p.add_argument("--vocab", type=int, default=256)
+    # 258 = the generate CLI's ByteTokenizer vocab (bytes + BOS/EOS), so a
+    # default-trained checkpoint round-trips with a default generate command
+    p.add_argument("--vocab", type=int, default=258)
     p.add_argument("--seq", type=int, default=64)
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--heads", type=int, default=2)

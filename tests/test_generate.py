@@ -128,3 +128,65 @@ def test_byte_tokenizer_roundtrip():
     assert ids[0] == tok.bos_id
     assert tok.decode(ids) == "héllo"
     assert tok.decode(ids + [tok.eos_id]) == "héllo"
+
+
+def test_cli_one_shot_generates_from_trained_checkpoint(tmp_path):
+    """E2E (VERDICT r2 #10): train_gpt2 writes a checkpoint; the interact CLI
+    loads it with the matching shape flags and generates one-shot."""
+    import os
+    import subprocess
+    import sys
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    }
+    ckpt = str(tmp_path / "gpt2.ckpt")
+    shape = ["--vocab", "258", "--seq", "64", "--layers", "1",
+             "--heads", "2", "--dmodel", "32"]
+    train = subprocess.run(
+        [sys.executable, "-m", "adapcc_tpu.workloads.train_gpt2",
+         "--epochs", "1", "--batch", "4", "--corpus-tokens", "2000",
+         "--world", "2", "--checkpoint-file", ckpt, *shape],
+        capture_output=True, text=True, cwd="/root/repo", env=env, timeout=300,
+    )
+    assert train.returncode == 0, train.stdout + train.stderr
+    assert os.path.exists(ckpt)
+
+    gen = subprocess.run(
+        [sys.executable, "-m", "adapcc_tpu.models.gpt2_generate",
+         "--ckpt", ckpt, "--prompt", "hello", "--max-new-tokens", "8",
+         "--temperature", "0", *shape],
+        capture_output=True, text=True, cwd="/root/repo", env=env, timeout=300,
+    )
+    assert gen.returncode == 0, gen.stdout + gen.stderr
+    assert "loaded checkpoint (epoch 0)" in gen.stdout
+
+    # wrong shape flags against the same checkpoint: the friendly
+    # "incompatible" message, not a raw flax from_bytes traceback
+    bad = subprocess.run(
+        [sys.executable, "-m", "adapcc_tpu.models.gpt2_generate",
+         "--ckpt", ckpt, "--prompt", "hello", "--max-new-tokens", "8",
+         "--vocab", "258", "--seq", "64", "--layers", "2",
+         "--heads", "2", "--dmodel", "32"],
+        capture_output=True, text=True, cwd="/root/repo", env=env, timeout=300,
+    )
+    assert bad.returncode != 0
+    assert "incompatible" in bad.stderr, bad.stderr[-500:]
+
+
+def test_cli_rejects_shape_mismatch(tmp_path):
+    import os
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    missing = str(tmp_path / "nope.ckpt")
+    gen = subprocess.run(
+        [sys.executable, "-m", "adapcc_tpu.models.gpt2_generate",
+         "--ckpt", missing, "--prompt", "x"],
+        capture_output=True, text=True, cwd="/root/repo", env=env, timeout=300,
+    )
+    assert gen.returncode != 0
+    assert "not found or incompatible" in gen.stderr
