@@ -79,3 +79,31 @@ def test_json_roundtrip(engine):
     rec = json.loads(results[0].to_json())
     assert rec["collective"] == "reduce"
     assert rec["world"] == 4
+
+
+def test_committed_busbw_artifact_parses_and_is_consistent():
+    """The round-3 virtual-pod sweep artifact (BASELINE.md table) must parse
+    and satisfy the busbw = algbw x correction-factor accounting."""
+    import json
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "results", "busbw_virtual8_r03.jsonl",
+    )
+    rows = [json.loads(line) for line in open(path) if line.strip()]
+    assert len(rows) >= 20
+    seen = set()
+    for r in rows:
+        assert r["world"] == 8
+        factor = BUS_FACTORS[r["collective"]](r["world"])
+        expect = r["algbw_gbps"] * factor
+        assert abs(r["busbw_gbps"] - expect) < 1e-9 * max(1.0, expect), r
+        assert r["time_us"] > 0 and r["size_bytes"] > 0
+        seen.add((r["collective"], r["impl"]))
+    # every engine surface appears: three allreduce impls + the rest
+    assert ("allreduce", "xla") in seen
+    assert ("allreduce", "strategy") in seen
+    assert ("allreduce", "pallas_ring") in seen
+    for coll in ("reduce", "broadcast", "all_gather", "reduce_scatter", "all_to_all"):
+        assert any(c == coll for c, _ in seen), f"missing {coll}"
