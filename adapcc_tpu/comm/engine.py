@@ -331,7 +331,7 @@ class CollectiveEngine:
     ) -> jnp.ndarray:
         self._check_world_dim(stacked, "all_reduce")
         mask = self._active_to_mask(active_gpus)
-        if self.use_xla_fastpath and active_gpus is None and op is not ReduceOp.MAX:
+        if self.use_xla_fastpath and active_gpus is None:
             per_shard = functools.partial(self._psum_shard, op=op)
             key = ("psum", stacked.shape, stacked.dtype.name, op)
         elif self.two_level:
@@ -357,6 +357,8 @@ class CollectiveEngine:
         return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
     def _psum_shard(self, x: jnp.ndarray, mask: jnp.ndarray, op: ReduceOp) -> jnp.ndarray:
+        if op is ReduceOp.MAX:
+            return lax.pmax(x, self.axis_name)
         s = lax.psum(x, self.axis_name)
         if op is ReduceOp.AVG:
             s = s / self.world_size

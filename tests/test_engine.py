@@ -74,6 +74,21 @@ def test_allreduce_max(engine8):
     np.testing.assert_allclose(np.asarray(out), np.full((8, 16), 7))
 
 
+def test_allreduce_max_rides_fastpath(mesh8):
+    """Full-world MAX takes the pmax fastpath (VERDICT r2: it used to be
+    routed to the schedule path asymmetrically) and matches the schedule
+    path's result."""
+    fast = CollectiveEngine(mesh8, Strategy.ring(8), use_xla_fastpath=True)
+    slow = CollectiveEngine(mesh8, Strategy.ring(8), use_xla_fastpath=False)
+    x = stacked_inputs(8)
+    out_fast = np.asarray(fast.all_reduce(x, op=ReduceOp.MAX))
+    np.testing.assert_allclose(out_fast, np.full((8, 16), 8))
+    np.testing.assert_allclose(
+        out_fast, np.asarray(slow.all_reduce(x, op=ReduceOp.MAX))
+    )
+    assert any(k[0] == "psum" for k in fast._cache), "MAX did not use the fastpath"
+
+
 def test_allreduce_uneven_sizes(mesh8):
     # length not divisible by num_trans exercises the share splitter
     eng = CollectiveEngine(mesh8, Strategy.binary(8, num_trans=3), use_xla_fastpath=False)
