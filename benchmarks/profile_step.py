@@ -1,0 +1,191 @@
+"""Attribute the GPT-2 train-step time to components on the live backend.
+
+The round-1 hardware number (3,265 tok/s ≈ 0.4% MFU on a v5e chip) was never
+explained; this harness produces the attribution (VERDICT r2 #3).  It times,
+on the same device and sizes as bench.py:
+
+1. ``dispatch``   — a trivial jitted op in a loop: per-call host→device
+                    dispatch latency (the remote-tunnel tax);
+2. ``matmul``     — a large bf16 matmul chain: achievable MXU TFLOP/s
+                    (the realistic ceiling, vs the advertised peak);
+3. ``forward``    — GPT-2 forward only;
+4. ``grad``       — value_and_grad (forward + backward);
+5. ``train``      — the full DDPTrainer step (grad + allreduce + adamw).
+
+Each phase prints one line immediately (the tunnel can die mid-run); the
+final JSON line carries the whole breakdown plus derived MFU per phase.
+Optionally dumps a Perfetto/XPlane trace: ``PROFILE_TRACE_DIR=/tmp/trace``.
+
+Usage::
+
+    python -m benchmarks.profile_step            # bench.py default sizes
+    BENCH_LAYERS=8 BENCH_DMODEL=512 python -m benchmarks.profile_step
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import time
+
+
+def _progress(msg: str) -> None:
+    print(f"[profile] {msg}", file=sys.stderr, flush=True)
+
+
+def _first_scalar(out):
+    """A scalar host read of one output element — closes the timing window
+    even on remote-tunnel backends where ``block_until_ready`` can return
+    before execution completes (same methodology as bench.py time_steps)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    return float(jax.device_get(jnp.ravel(leaf)[0]))
+
+
+def _timed(fn, iters: int = 10, warmup: int = 2) -> float:
+    """Mean seconds per call over one timed window, compile excluded; the
+    window is closed by a scalar device_get (not block_until_ready)."""
+    for _ in range(warmup):
+        _first_scalar(fn())
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = fn()
+    _first_scalar(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    from adapcc_tpu.launch.launcher import apply_platform_env
+
+    apply_platform_env()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import bench as bench_mod
+    from bench import _env_int  # shared env knob parsing
+    from adapcc_tpu.comm.mesh import build_world_mesh
+    from adapcc_tpu.ddp import DDPTrainer, TrainState
+    from adapcc_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
+    from adapcc_tpu.strategy.ir import Strategy
+
+    out = {"device": str(jax.devices()[0]), "phases": {}}
+    trace_dir = os.environ.get("PROFILE_TRACE_DIR")
+    trace = (
+        jax.profiler.trace(trace_dir) if trace_dir else contextlib.nullcontext()
+    )
+
+    world = _env_int("BENCH_WORLD", 0) or len(jax.devices())
+    mesh = build_world_mesh(world)
+    cfg = GPT2Config(
+        vocab_size=16384,
+        max_seq=_env_int("BENCH_SEQ", 512),
+        n_layer=_env_int("BENCH_LAYERS", 12),
+        n_head=_env_int("BENCH_HEADS", 16),
+        d_model=_env_int("BENCH_DMODEL", 1024),
+        attention=os.environ.get("BENCH_ATTN", "xla"),
+    )
+    batch = _env_int("BENCH_BATCH", 16) * world
+    tokens_per_step = batch * cfg.max_seq
+    # phases 1-4 run unsharded on ONE device (the whole global batch), so
+    # their utilization divides by the single-chip peak; only the sharded
+    # train phase sees the world-scaled peak
+    chip_peak = bench_mod.chip_peak_tflops() * 1e12
+    peak = chip_peak * world
+    flops_tok = bench_mod.train_flops_per_token(cfg)
+
+    with trace:
+        # 1. dispatch latency: the per-call floor every step pays
+        one = jnp.ones((8, 8))
+        tiny = jax.jit(lambda a: a + 1.0)
+        t = _timed(lambda: tiny(one), iters=20)
+        out["phases"]["dispatch"] = {"ms": round(t * 1e3, 3)}
+        _progress(f"dispatch floor {t * 1e3:.2f} ms/call")
+
+        # 2. achievable MXU rate: 8 chained 4096^3 bf16 matmuls
+        n, chain = 4096, 8
+        a = jnp.ones((n, n), jnp.bfloat16)
+
+        @jax.jit
+        def mm(a):
+            x = a
+            for _ in range(chain):
+                x = x @ a
+            return x
+
+        t = _timed(lambda: mm(a), iters=5)
+        mm_tflops = chain * 2 * n**3 / t / 1e12
+        out["phases"]["matmul"] = {
+            "ms": round(t * 1e3, 2),
+            "tflops": round(mm_tflops, 1),
+            "fraction_of_peak": round(mm_tflops * 1e12 / chip_peak, 3),
+        }
+        _progress(
+            f"matmul {mm_tflops:.0f} TFLOP/s "
+            f"({mm_tflops * 1e12 / chip_peak:.0%} of one-chip peak)"
+        )
+
+        # model + data (bench.py sizes)
+        rng = np.random.default_rng(0)
+        toks = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, size=(batch, cfg.max_seq)), jnp.int32
+        )
+        model = GPT2(cfg)
+        params = model.init(jax.random.PRNGKey(0), toks[:1])
+        if os.environ.get("BENCH_PARAM_DTYPE", "bf16") == "bf16":
+            params = jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.bfloat16)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p,
+                params,
+            )
+
+        def loss_fn(p, b):
+            return lm_loss(model.apply(p, b), b)
+
+        # 3. forward only (1/3 of the analytic train FLOPs)
+        fwd = jax.jit(loss_fn)
+        t = _timed(lambda: fwd(params, toks), iters=5)
+        out["phases"]["forward"] = {
+            "ms": round(t * 1e3, 1),
+            "mfu": round(tokens_per_step * (flops_tok / 3) / t / chip_peak, 4),
+        }
+        _progress(f"forward {t * 1e3:.0f} ms (mfu {out['phases']['forward']['mfu']:.3f})")
+
+        # 4. forward + backward
+        vg = jax.jit(lambda p, b: jax.value_and_grad(loss_fn)(p, b))
+        t = _timed(lambda: vg(params, toks), iters=5)
+        out["phases"]["grad"] = {
+            "ms": round(t * 1e3, 1),
+            "mfu": round(tokens_per_step * flops_tok / t / chip_peak, 4),
+        }
+        _progress(f"grad {t * 1e3:.0f} ms (mfu {out['phases']['grad']['mfu']:.3f})")
+
+        # 5. full framework step
+        tx = optax.adamw(3e-4)
+        trainer = DDPTrainer(
+            loss_fn, tx, mesh, Strategy.ring(world),
+            donate_state=False, use_xla_fastpath=True,
+        )
+        state = TrainState.create(params, tx)
+        t = _timed(lambda: trainer.step(state, toks), iters=5)
+        out["phases"]["train"] = {
+            "ms": round(t * 1e3, 1),
+            "mfu": round(tokens_per_step * flops_tok / t / peak, 4),
+            "tokens_per_s": round(tokens_per_step / t, 1),
+        }
+        _progress(f"train {t * 1e3:.0f} ms (mfu {out['phases']['train']['mfu']:.3f})")
+
+    if trace_dir:
+        out["trace_dir"] = trace_dir
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
