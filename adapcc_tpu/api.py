@@ -97,9 +97,13 @@ class AdapCC:
 
     @classmethod
     def boardcast(
-        cls, tensor: jnp.ndarray, size: Optional[int] = None, chunk_bytes: Optional[int] = None
+        cls,
+        tensor: jnp.ndarray,
+        size: Optional[int] = None,
+        chunk_bytes: Optional[int] = None,
+        active_gpus: Optional[Sequence[int]] = None,
     ) -> jnp.ndarray:
-        return cls.communicator.boardcast(tensor, size, chunk_bytes)
+        return cls.communicator.boardcast(tensor, size, chunk_bytes, active_gpus)
 
     @classmethod
     def alltoall(

@@ -161,9 +161,8 @@ def masked_psum_shard(
     topologies where the synthesized strategy beats the flat collective.
     """
     contrib = _mask_contribution(x, active_mask, axis_name, op)
-    if op is ReduceOp.MAX:
-        return lax.pmax(contrib, axis_name)
-    return _avg_normalize(lax.psum(contrib, axis_name), active_mask, op)
+    n_active = jnp.maximum(jnp.sum(active_mask.astype(x.dtype)), 1)
+    return _fused_reduce(contrib, axis_name, op, n_active)
 
 
 def allreduce_shard(
@@ -206,6 +205,54 @@ def reduce_shard(
         return _run_reduce_rounds(acc, tree.reduce_rounds(), axis_name, world, op)
 
     return _avg_normalize(_run_segments(x, strategy, per_segment), active_mask, op)
+
+
+def _fused_reduce(x: jnp.ndarray, axis_name: str, op: ReduceOp, denom) -> jnp.ndarray:
+    """One XLA collective for the op: pmax for MAX, psum for SUM, psum/denom
+    for AVG.  ``denom`` is the caller's averaging base — the full world on
+    fast paths, the active count on masked paths."""
+    if op is ReduceOp.MAX:
+        return lax.pmax(x, axis_name)
+    s = lax.psum(x, axis_name)
+    if op is ReduceOp.AVG:
+        s = s / denom
+    return s
+
+
+def reduce_fastpath_shard(
+    x: jnp.ndarray,
+    strategy: Strategy,
+    axis_name: str = RANKS_AXIS,
+    op: ReduceOp = ReduceOp.SUM,
+) -> jnp.ndarray:
+    """Full-world reduce as one fused XLA collective per tree segment: psum
+    (or pmax), result kept on that segment's root — same contract as the
+    schedule path (root holds the total, others keep their local partial)
+    without the per-round ppermute overhead on a healthy pod."""
+    me = lax.axis_index(axis_name)
+
+    def per_segment(seg, tree):
+        total = _fused_reduce(seg, axis_name, op, strategy.world_size)
+        return jnp.where(me == tree.root, total, seg)
+
+    return _run_segments(x, strategy, per_segment)
+
+
+def broadcast_fastpath_shard(
+    x: jnp.ndarray,
+    strategy: Strategy,
+    axis_name: str = RANKS_AXIS,
+) -> jnp.ndarray:
+    """Full-world broadcast as one masked psum per tree segment: only the
+    root contributes, so the sum IS the root's value on every rank."""
+    me = lax.axis_index(axis_name)
+
+    def per_segment(seg, tree):
+        contrib = jnp.where(me == tree.root, seg, jnp.zeros_like(seg))
+        # psum promotes bool to int32; the schedule path preserves dtype
+        return lax.psum(contrib, axis_name).astype(seg.dtype)
+
+    return _run_segments(x, strategy, per_segment)
 
 
 def broadcast_shard(
@@ -357,12 +404,7 @@ class CollectiveEngine:
         return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
     def _psum_shard(self, x: jnp.ndarray, mask: jnp.ndarray, op: ReduceOp) -> jnp.ndarray:
-        if op is ReduceOp.MAX:
-            return lax.pmax(x, self.axis_name)
-        s = lax.psum(x, self.axis_name)
-        if op is ReduceOp.AVG:
-            s = s / self.world_size
-        return s
+        return _fused_reduce(x, self.axis_name, op, self.world_size)
 
     def reduce(
         self,
@@ -371,6 +413,14 @@ class CollectiveEngine:
         op: ReduceOp = ReduceOp.SUM,
     ) -> jnp.ndarray:
         self._check_world_dim(stacked, "reduce")
+        if self.use_xla_fastpath and active_gpus is None and not self.two_level:
+            per_shard = functools.partial(
+                reduce_fastpath_shard,
+                strategy=self.strategy, axis_name=self.axis_name, op=op,
+            )
+            key = ("reduce_fast", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name, op)
+            self._record("reduce", "xla", stacked)
+            return self._shard_mapped(key, per_shard, 1)(stacked)
         if self.two_level:
             from adapcc_tpu.comm.two_level import reduce_two_level_shard
 
@@ -390,9 +440,26 @@ class CollectiveEngine:
         self._record("reduce", "schedule", stacked)
         return self._shard_mapped(key, per_shard, 2)(stacked, self._active_to_mask(active_gpus))
 
-    def boardcast(self, stacked: jnp.ndarray) -> jnp.ndarray:
-        """Reference spelling kept for API parity (adapcc.py:55-57)."""
+    def boardcast(
+        self, stacked: jnp.ndarray, active_gpus: Optional[Sequence[int]] = None
+    ) -> jnp.ndarray:
+        """Reference spelling kept for API parity (adapcc.py:55-57).
+
+        ``active_gpus`` mirrors the reference C ABI (run.cu:150 takes the
+        active set for every collective); broadcast *values* are unaffected
+        by relay roles — inactive ranks still forward — so the set only
+        pins the schedule path."""
         self._check_world_dim(stacked, "boardcast")
+        self._active_to_mask(active_gpus)  # validate ranks even though the
+        # broadcast result is mask-independent (fail fast on a typo'd set)
+        if self.use_xla_fastpath and active_gpus is None and not self.two_level:
+            per_shard = functools.partial(
+                broadcast_fastpath_shard,
+                strategy=self.strategy, axis_name=self.axis_name,
+            )
+            key = ("broadcast_fast", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name)
+            self._record("broadcast", "xla", stacked)
+            return self._shard_mapped(key, per_shard, 1)(stacked)
         if self.two_level:
             from adapcc_tpu.comm.two_level import broadcast_two_level_shard
 

@@ -278,9 +278,13 @@ class Communicator:
         return self._engine(REDUCE).reduce(tensor, active_gpus=active_gpus, op=op)
 
     def boardcast(
-        self, tensor: jnp.ndarray, size: Optional[int] = None, chunk_bytes: Optional[int] = None
+        self,
+        tensor: jnp.ndarray,
+        size: Optional[int] = None,
+        chunk_bytes: Optional[int] = None,
+        active_gpus: Optional[Sequence[int]] = None,
     ) -> jnp.ndarray:
-        return self._engine(BOARDCAST).boardcast(tensor)
+        return self._engine(BOARDCAST).boardcast(tensor, active_gpus=active_gpus)
 
     def alltoall(
         self, tensor: jnp.ndarray, size: Optional[int] = None, chunk_bytes: Optional[int] = None

@@ -116,8 +116,15 @@ def _make_ops(engine, elems: int) -> Dict[str, tuple]:
             per_rank,
         ),
         ("allreduce", "pallas_ring"): (lambda: engine.ring_allreduce(flat), per_rank),
-        ("reduce", "strategy"): (lambda: engine.reduce(flat), per_rank),
-        ("broadcast", "strategy"): (lambda: engine.boardcast(flat), per_rank),
+        # active_gpus pins the schedule path; bare calls ride the XLA fastpath
+        ("reduce", "xla"): (lambda: engine.reduce(flat), per_rank),
+        ("reduce", "strategy"): (
+            lambda: engine.reduce(flat, active_gpus=list(range(world))), per_rank,
+        ),
+        ("broadcast", "xla"): (lambda: engine.boardcast(flat), per_rank),
+        ("broadcast", "strategy"): (
+            lambda: engine.boardcast(flat, active_gpus=list(range(world))), per_rank,
+        ),
         ("all_gather", "xla"): (lambda: engine.all_gather(flat), total),
         ("reduce_scatter", "xla"): (lambda: engine.reduce_scatter(flat), per_rank),
     }

@@ -158,3 +158,64 @@ def test_reduce_scatter(mesh8):
 def test_world_size_mismatch_rejected(mesh4):
     with pytest.raises(ValueError):
         CollectiveEngine(mesh4, Strategy.ring(8))
+
+
+def test_reduce_fastpath_matches_schedule_on_roots(mesh8):
+    """Full-world reduce rides a fused psum fastpath; root rows must match
+    the schedule path (non-root rows hold unspecified partials on both)."""
+    strat = Strategy.binary(8, num_trans=2)
+    fast = CollectiveEngine(mesh8, strat, use_xla_fastpath=True)
+    slow = CollectiveEngine(mesh8, strat, use_xla_fastpath=False)
+    x = stacked_inputs(8)
+    out_fast = np.asarray(fast.reduce(x))
+    out_slow = np.asarray(slow.reduce(x))
+    assert any(k[0] == "reduce_fast" for k in fast._cache)
+    # each tree's segment is valid at that tree's root
+    from adapcc_tpu.comm.engine import _segment_sizes
+
+    sizes = _segment_sizes(16, strat.tree_shares())
+    off = 0
+    for tree, size in zip(strat.trees, sizes):
+        seg = slice(off, off + size)
+        np.testing.assert_allclose(out_fast[tree.root, seg], np.full(size, 36.0))
+        np.testing.assert_allclose(out_fast[tree.root, seg], out_slow[tree.root, seg])
+        off += size
+
+
+def test_reduce_fastpath_avg_and_max(mesh8):
+    strat = Strategy.ring(8)
+    fast = CollectiveEngine(mesh8, strat, use_xla_fastpath=True)
+    x = stacked_inputs(8)
+    avg = np.asarray(fast.reduce(x, op=ReduceOp.AVG))
+    np.testing.assert_allclose(avg[0], np.full(16, 36.0 / 8))
+    mx = np.asarray(fast.reduce(x, op=ReduceOp.MAX))
+    np.testing.assert_allclose(mx[0], np.full(16, 8.0))
+
+
+def test_broadcast_fastpath_matches_schedule(mesh8):
+    strat = Strategy.binary(8, num_trans=2)
+    fast = CollectiveEngine(mesh8, strat, use_xla_fastpath=True)
+    slow = CollectiveEngine(mesh8, strat, use_xla_fastpath=False)
+    x = stacked_inputs(8)
+    out_fast = np.asarray(fast.boardcast(x))
+    np.testing.assert_allclose(out_fast, np.asarray(slow.boardcast(x)))
+    assert any(k[0] == "broadcast_fast" for k in fast._cache)
+    # active_gpus pins the schedule path on a fastpath engine (run.cu:150
+    # ABI parity) and produces the same values
+    pinned = np.asarray(fast.boardcast(x, active_gpus=list(range(8))))
+    np.testing.assert_allclose(pinned, out_fast)
+    assert any(k[0] == "broadcast" for k in fast._cache)
+
+
+def test_broadcast_fastpath_preserves_bool_dtype(mesh8):
+    eng = CollectiveEngine(mesh8, Strategy.binary(8), use_xla_fastpath=True)
+    x = jnp.stack([jnp.full((8,), bool(r == 0)) for r in range(8)])
+    out = eng.boardcast(x)
+    assert out.dtype == jnp.bool_  # psum promotes bool; the fastpath must not
+    np.testing.assert_allclose(np.asarray(out), True)
+
+
+def test_broadcast_rejects_out_of_range_active_set(mesh8):
+    eng = CollectiveEngine(mesh8, Strategy.binary(8))
+    with pytest.raises(ValueError):
+        eng.boardcast(stacked_inputs(8), active_gpus=[99])
