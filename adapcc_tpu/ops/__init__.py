@@ -1,5 +1,5 @@
 """Hand-tuned Pallas TPU kernels for the hot ops."""
 
-from adapcc_tpu.ops.flash_attention import flash_attention
+from adapcc_tpu.ops.flash_attention import flash_attention, flash_attention_with_lse
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "flash_attention_with_lse"]

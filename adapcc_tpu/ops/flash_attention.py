@@ -197,10 +197,19 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
+    return _flash_bwd_core(scale, causal, block_q, block_k, interpret, res, do, None)
+
+
+def _flash_bwd_core(scale, causal, block_q, block_k, interpret, res, do, dlse):
+    """Shared backward.  An ``lse`` cotangent adds ``dS_ij += p_ij·dlse_i``,
+    which folds into the existing kernels as ``delta → delta − dlse`` (the
+    bracket is ``p·(dp − delta)``) — no kernel change needed."""
     q, k, v, out, lse = res
     BH, T, D = q.shape
     bq, bk = _block_sizes(T, block_q, block_k)
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
     interp = _resolve_interpret(interpret)
 
     dq = pl.pallas_call(
@@ -250,6 +259,29 @@ def _flash_bwd(scale, causal, block_q, block_k, interpret, res, do):
 _flash_bhtd.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhtd_lse(q, k, v, scale, causal, block_q, block_k, interpret):
+    """Like :func:`_flash_bhtd` but also returns the per-row logsumexp —
+    the merge statistic blockwise consumers (ring attention) need.  Both
+    outputs are differentiable: the ``lse`` cotangent lowers to the same
+    backward kernels via ``delta − dlse``."""
+    out, (_, _, _, _, lse) = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, lse
+
+
+def _flash_fwd_lse(q, k, v, scale, causal, block_q, block_k, interpret):
+    out, res = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return (out, res[4]), res
+
+
+def _flash_bwd_lse(scale, causal, block_q, block_k, interpret, res, cts):
+    do, dlse = cts
+    return _flash_bwd_core(scale, causal, block_q, block_k, interpret, res, do, dlse)
+
+
+_flash_bhtd_lse.defvjp(_flash_fwd_lse, _flash_bwd_lse)
+
+
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -277,3 +309,36 @@ def flash_attention(
         scale, causal, block_q, block_k, interpret,
     )
     return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> "tuple[jnp.ndarray, jnp.ndarray]":
+    """Blockwise attention returning ``(out [B,T,H,D], lse [B,H,T])``.
+
+    ``lse[b,h,t] = logsumexp_j(scale·q_t·k_j)`` (with the causal mask
+    applied) — the statistic a blockwise consumer needs to merge partial
+    attention over K/V blocks it sees one at a time (ring attention's
+    log-sum-exp combine).  Fully differentiable in both outputs.
+    """
+    B, T, H, D = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
+    if scale is None:
+        scale = float(1.0 / np.sqrt(D))
+    to_bhtd = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, D)  # noqa: E731
+    out, lse = _flash_bhtd_lse(
+        to_bhtd(q), to_bhtd(k), to_bhtd(v),
+        scale, causal, block_q, block_k, interpret,
+    )
+    return (
+        out.reshape(B, H, T, D).transpose(0, 2, 1, 3),
+        lse.reshape(B, H, T),
+    )

@@ -74,6 +74,16 @@ CHILD = textwrap.dedent(
         assert np.isfinite(float(val)), val
         assert all(np.isfinite(np.asarray(g, dtype=np.float32)).all() for g in grads)
         print(f"MOSAIC_OK flash {jnp.dtype(dtype).name}", flush=True)
+
+    # flash-ring: pallas kernels under scan + switch + shard_map (world=1)
+    from adapcc_tpu.parallel import ring_attention
+
+    ring_mesh = Mesh(np.array([dev]), (RANKS_AXIS,))
+    x = jnp.ones((1, 256, 2, 64), jnp.bfloat16) * 0.1
+    out = ring_attention(ring_mesh, x, x, x, axis_name=RANKS_AXIS, block_impl="flash")
+    jax.block_until_ready(out)
+    assert np.isfinite(np.asarray(out, dtype=np.float32)).all()
+    print("MOSAIC_OK flash_ring", flush=True)
     """
 )
 
@@ -135,3 +145,7 @@ def test_flash_attention_lowers_through_mosaic():
     stdout = _smoke_stdout()
     assert "MOSAIC_OK flash float32" in stdout
     assert "MOSAIC_OK flash bfloat16" in stdout
+
+
+def test_flash_ring_lowers_through_mosaic():
+    assert "MOSAIC_OK flash_ring" in _smoke_stdout()
