@@ -39,6 +39,18 @@ class GPT2Config:
     #: tiles, no attention-matrix HBM traffic.  Training path only (decode
     #: uses the KV cache) and requires dropout == 0.
     attention: str = "xla"
+    #: sequence parallelism: when set (a mesh axis name), the model expects
+    #: to run INSIDE shard_map with tokens sequence-sharded over that axis —
+    #: attention crosses shards via the ring / Ulysses programs
+    #: (parallel/gpt2_sp.py wraps the whole train step), positions are
+    #: globally offset by the shard index, and ``attention == "flash"``
+    #: selects the Pallas block kernel inside the SP program.  Training
+    #: only (decode keeps a single-device KV cache); requires dropout == 0.
+    sp_axis: Optional[str] = None
+    #: which SP scheme carries attention across shards: "ring" rotates K/V
+    #: blocks (O(T_local) memory), "ulysses" trades sequence for heads with
+    #: one all-to-all each way (needs n_head % world == 0)
+    sp_impl: str = "ring"
 
     @staticmethod
     def small() -> "GPT2Config":
@@ -68,6 +80,30 @@ class CausalSelfAttention(nn.Module):
         v = v.reshape(B, T, cfg.n_head, head_dim)
 
         scale = 1.0 / np.sqrt(head_dim)
+        if cfg.sp_axis is not None and not decode:
+            # sequence-parallel attention: this module runs inside shard_map
+            # with [B, T_local, ...] shards; K/V cross shards via the ring or
+            # Ulysses program (attention dropout unsupported there)
+            if cfg.dropout != 0.0:
+                raise ValueError("sequence parallelism requires dropout == 0")
+            block_impl = "flash" if cfg.attention == "flash" else "dense"
+            if cfg.sp_impl == "ring":
+                from adapcc_tpu.parallel.ring_attention import ring_attention_shard
+
+                out = ring_attention_shard(
+                    q, k, v, axis_name=cfg.sp_axis, causal=True, scale=scale,
+                    block_impl=block_impl,
+                )
+            elif cfg.sp_impl == "ulysses":
+                from adapcc_tpu.parallel.ulysses import ulysses_attention_shard
+
+                out = ulysses_attention_shard(
+                    q, k, v, axis_name=cfg.sp_axis, causal=True, scale=scale,
+                    block_impl=block_impl,
+                )
+            else:
+                raise ValueError(f"unknown sp_impl {cfg.sp_impl!r} (ring|ulysses)")
+            return self._project(out.reshape(B, T, cfg.d_model), deterministic)
         if decode:
             # single-token autoregressive step against a fixed-shape KV cache
             # (static [max_seq] slots — no dynamic shapes under jit)
@@ -189,7 +225,14 @@ class GPT2(nn.Module):
         )
         if decode and pos is None:
             raise ValueError("decode=True needs pos (the fed token's absolute position)")
-        positions = jnp.arange(T) if pos is None else jnp.asarray(pos).reshape((1,))
+        if pos is not None:
+            positions = jnp.asarray(pos).reshape((1,))
+        elif cfg.sp_axis is not None:
+            # sequence-sharded: this shard covers global positions
+            # [me*T_local, (me+1)*T_local)
+            positions = jax.lax.axis_index(cfg.sp_axis) * T + jnp.arange(T)
+        else:
+            positions = jnp.arange(T)
         x = wte(tokens) + wpe(positions)[None]
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
 
@@ -212,3 +255,34 @@ def lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+def lm_loss_sp(logits: jnp.ndarray, tokens: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """:func:`lm_loss` under sequence sharding, for use inside ``shard_map``.
+
+    ``logits/tokens`` are this shard's ``[B, T_local, V]`` / ``[B, T_local]``
+    slices of the global sequence.  Each local position's target is the next
+    token — for the shard's last position that token lives on the *next*
+    rank, so it arrives by one tiny ``ppermute`` ([B] int32).  The last
+    rank's final position has no target and is masked out; the result is the
+    psum-weighted global mean, numerically identical to ``lm_loss`` on the
+    unsharded batch (and replicated across ranks).
+    """
+    from jax import lax
+
+    from adapcc_tpu.parallel.ring_attention import _ring_perm
+
+    B, Tl, _ = logits.shape
+    world = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    # rank r receives rank r+1's first token (receive-from-right rotation —
+    # the ring modules' shared convention)
+    next_first = lax.ppermute(tokens[:, 0], axis_name, _ring_perm(world))  # [B]
+    targets = jnp.concatenate([tokens[:, 1:], next_first[:, None]], axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    valid = jnp.ones((B, Tl), logits.dtype)
+    valid = valid.at[:, -1].set(jnp.where(me == world - 1, 0.0, 1.0))
+    total = lax.psum(jnp.sum(-ll * valid), axis_name)
+    count = lax.psum(jnp.sum(valid), axis_name)
+    return total / count

@@ -282,6 +282,23 @@ def _flash_bwd_lse(scale, causal, block_q, block_k, interpret, res, cts):
 _flash_bhtd_lse.defvjp(_flash_fwd_lse, _flash_bwd_lse)
 
 
+def _bthd_call(kernel_entry, q, k, v, causal, scale, block_q, block_k, interpret):
+    """Shared model-layout plumbing for the public wrappers: validate,
+    default the scale, run ``kernel_entry`` on ``[B·H, T, D]`` tensors, and
+    return its raw outputs plus the dims needed to restore the layout."""
+    B, T, H, D = q.shape
+    if k.shape != q.shape or v.shape != q.shape:
+        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
+    if scale is None:
+        scale = float(1.0 / np.sqrt(D))
+    to_bhtd = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, D)  # noqa: E731
+    raw = kernel_entry(
+        to_bhtd(q), to_bhtd(k), to_bhtd(v),
+        scale, causal, block_q, block_k, interpret,
+    )
+    return raw, (B, T, H, D)
+
+
 def flash_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -298,15 +315,8 @@ def flash_attention(
     same call works on the virtual CPU pod.  ``scale`` defaults to
     ``1/sqrt(D)``.  ``T`` must divide by the block sizes (clamped to ``T``).
     """
-    B, T, H, D = q.shape
-    if k.shape != q.shape or v.shape != q.shape:
-        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
-    if scale is None:
-        scale = float(1.0 / np.sqrt(D))
-    to_bhtd = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, D)  # noqa: E731
-    out = _flash_bhtd(
-        to_bhtd(q), to_bhtd(k), to_bhtd(v),
-        scale, causal, block_q, block_k, interpret,
+    out, (B, T, H, D) = _bthd_call(
+        _flash_bhtd, q, k, v, causal, scale, block_q, block_k, interpret
     )
     return out.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
@@ -328,15 +338,8 @@ def flash_attention_with_lse(
     attention over K/V blocks it sees one at a time (ring attention's
     log-sum-exp combine).  Fully differentiable in both outputs.
     """
-    B, T, H, D = q.shape
-    if k.shape != q.shape or v.shape != q.shape:
-        raise ValueError(f"q/k/v shapes differ: {q.shape} {k.shape} {v.shape}")
-    if scale is None:
-        scale = float(1.0 / np.sqrt(D))
-    to_bhtd = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, D)  # noqa: E731
-    out, lse = _flash_bhtd_lse(
-        to_bhtd(q), to_bhtd(k), to_bhtd(v),
-        scale, causal, block_q, block_k, interpret,
+    (out, lse), (B, T, H, D) = _bthd_call(
+        _flash_bhtd_lse, q, k, v, causal, scale, block_q, block_k, interpret
     )
     return (
         out.reshape(B, H, T, D).transpose(0, 2, 1, 3),

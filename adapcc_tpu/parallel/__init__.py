@@ -9,6 +9,7 @@ strategy here is expressed as shardings + collectives over a
 """
 
 from adapcc_tpu.parallel.ulysses import ulysses_attention, ulysses_attention_shard
+from adapcc_tpu.parallel.gpt2_sp import gpt2_sp_loss_and_grad, gpt2_sp_train_step
 from adapcc_tpu.parallel.ring_attention import (
     ring_attention,
     ring_attention_shard,
@@ -23,6 +24,8 @@ from adapcc_tpu.parallel.pipeline import pipeline_apply
 from adapcc_tpu.parallel.expert import expert_parallel_moe
 
 __all__ = [
+    "gpt2_sp_loss_and_grad",
+    "gpt2_sp_train_step",
     "ring_attention",
     "ring_attention_shard",
     "ulysses_attention",

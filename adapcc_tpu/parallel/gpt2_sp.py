@@ -1,0 +1,83 @@
+"""Sequence-parallel GPT-2 training: the whole step in one shard_map.
+
+Long-context training the reference cannot do (SURVEY §5.7 — no sequence
+parallelism anywhere in it).  The global batch ``[B, T]`` is sharded over a
+mesh axis along ``T``; every layer of the model is position-wise except
+attention, which crosses shards via the ring or Ulysses SP programs
+(``GPT2Config.sp_axis`` / ``sp_impl``, models/gpt2.py), optionally on the
+Pallas flash block kernel (``attention="flash"``).  The loss handles the
+shard-boundary target with one ``[B]``-sized ppermute (``lm_loss_sp``) and
+the parameter gradients are psum-reduced, so one jitted program trains on a
+sequence ``world×`` longer than a single device could hold.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from adapcc_tpu.models.gpt2 import GPT2, lm_loss_sp
+
+
+def gpt2_sp_loss_and_grad(
+    model: GPT2, mesh: Mesh, axis_name: str = "ranks"
+) -> Callable[[Any, jnp.ndarray], Tuple[jnp.ndarray, Any]]:
+    """Jitted ``(params, tokens [B, T]) → (loss, grads)`` with the sequence
+    sharded over ``axis_name``; params replicated, grads psum-replicated.
+
+    ``model.cfg.sp_axis`` must equal ``axis_name`` (the attention layers run
+    the cross-shard SP program on that axis) and ``T`` must divide by the
+    axis size.
+    """
+    cfg = model.cfg
+    if cfg.sp_axis != axis_name:
+        raise ValueError(
+            f"model.cfg.sp_axis {cfg.sp_axis!r} must equal the mesh axis "
+            f"{axis_name!r} the step is sharded over"
+        )
+
+    def shard_step(params, tokens):
+        def loss_fn(p):
+            logits = model.apply(p, tokens)
+            return lm_loss_sp(logits, tokens, axis_name)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        # lm_loss_sp psums in the FORWARD pass, and psum transposes to psum
+        # under shard_map — so each shard's backward already carries a
+        # world× factor on its local contribution.  pmean (psum/world)
+        # cancels it exactly; verified against the unsharded gradient in
+        # tests/test_gpt2_sp.py.
+        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, axis_name), grads)
+        return loss, grads
+
+    fn = jax.shard_map(
+        shard_step,
+        mesh=mesh,
+        in_specs=(P(), P(None, axis_name)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+def gpt2_sp_train_step(
+    model: GPT2, tx, mesh: Mesh, axis_name: str = "ranks"
+) -> Callable:
+    """Jitted ``(params, opt_state, tokens) → (params, opt_state, loss)``
+    full SP training step (loss+grad as above, then the optax update)."""
+    import optax
+
+    loss_and_grad = gpt2_sp_loss_and_grad(model, mesh, axis_name)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = loss_and_grad(params, tokens)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return step

@@ -39,13 +39,21 @@ def ulysses_attention_shard(
     axis_name: str,
     causal: bool = True,
     scale: Optional[float] = None,
+    block_impl: str = "dense",
 ) -> jnp.ndarray:
     """Per-shard Ulysses attention, for use inside ``shard_map``.
 
     ``q/k/v``: ``[B, T_local, H, D]`` with ``H`` divisible by the axis size;
     rank r holds global positions ``[r*T_local, (r+1)*T_local)``.
     Returns ``[B, T_local, H, D]`` in ``q.dtype``.
+
+    ``block_impl="flash"`` runs the per-head full-sequence attention on the
+    Pallas flash kernel — after the all-to-all each rank holds the WHOLE
+    sequence for its head group, so the single-device kernel applies
+    directly (no merge statistics needed, unlike the ring).
     """
+    if block_impl not in ("dense", "flash"):
+        raise ValueError(f"unknown block_impl {block_impl!r} (dense|flash)")
     B, Tl, H, D = q.shape
     world = lax.psum(1, axis_name)
     if H % world != 0:
@@ -76,6 +84,12 @@ def ulysses_attention_shard(
     kh = seq_to_heads(k)
     vh = seq_to_heads(v)
 
+    if block_impl == "flash":
+        from adapcc_tpu.ops import flash_attention
+
+        out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
+        return heads_to_seq(out).astype(q.dtype)
+
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", qh.astype(jnp.float32) * scale, kh.astype(jnp.float32)
     )
@@ -97,12 +111,15 @@ def ulysses_attention(
     axis_name: str = "ranks",
     causal: bool = True,
     scale: Optional[float] = None,
+    block_impl: str = "dense",
 ) -> jnp.ndarray:
     """Global-view wrapper: ``q/k/v [B, T, H, D]`` with ``T`` and ``H``
-    divisible by the mesh axis size."""
+    divisible by the mesh axis size.  ``block_impl="flash"`` runs the
+    per-head attention on the Pallas flash kernel."""
     spec = P(None, axis_name, None, None)
     fn = shard_map(
-        partial(ulysses_attention_shard, axis_name=axis_name, causal=causal, scale=scale),
+        partial(ulysses_attention_shard, axis_name=axis_name, causal=causal, scale=scale,
+                block_impl=block_impl),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

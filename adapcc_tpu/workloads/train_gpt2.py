@@ -174,6 +174,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--world", type=int, default=None)
     p.add_argument("--checkpoint-file", type=str, default=None)
     p.add_argument("--sample", action="store_true", help="print a generation sample at the end")
+    p.add_argument("--sp", choices=("none", "ring", "ulysses"), default="none",
+                   help="sequence parallelism: shard the sequence (not the "
+                        "batch) over the world — the long-context regime")
+    p.add_argument("--attn", choices=("xla", "flash"), default="xla",
+                   help="block attention implementation (flash = Pallas kernel)")
     return p
 
 
@@ -210,6 +215,7 @@ def run(args) -> Tuple[float, float]:
     cfg = GPT2Config(
         vocab_size=args.vocab, max_seq=args.seq, n_layer=args.layers,
         n_head=args.heads, d_model=args.dmodel, dtype=jnp.float32,
+        attention=args.attn,
     )
     model = GPT2(cfg)
     params = model.init(jax.random.PRNGKey(0), jnp.asarray(train_set[:1]))
@@ -229,7 +235,21 @@ def run(args) -> Tuple[float, float]:
         optax.clip_by_global_norm(args.clip_norm),
         optax.adamw(schedule, weight_decay=0.01),
     )
-    trainer = DDPTrainer(loss_fn, tx, mesh, Strategy.ring(world))
+    if args.sp != "none":
+        # sequence parallelism: the batch is replicated and the SEQUENCE is
+        # sharded over the world axis — the long-context regime (the DDP
+        # axis is the reference's; SP is the new capability, SURVEY §5.7)
+        import dataclasses
+
+        from adapcc_tpu.parallel import gpt2_sp_train_step
+
+        if args.seq % world:
+            raise ValueError(f"--seq {args.seq} must divide by world {world} under --sp")
+        sp_model = GPT2(dataclasses.replace(cfg, sp_axis="ranks", sp_impl=args.sp))
+        sp_step = gpt2_sp_train_step(sp_model, tx, mesh)
+        trainer = None
+    else:
+        trainer = DDPTrainer(loss_fn, tx, mesh, Strategy.ring(world))
     state = TrainState.create(params, tx)
 
     initial_ppl = evaluate_perplexity(model, state.params, val_set)
@@ -243,7 +263,15 @@ def run(args) -> Tuple[float, float]:
         # the trainer's async dispatch (see DDPTrainer's host-step comment)
         epoch_losses = []
         for b in lm_batches(train_set, args.batch, seed=epoch):
-            state, loss = trainer.step(state, jnp.asarray(b))
+            if trainer is None:
+                params2, opt_state2, loss = sp_step(
+                    state.params, state.opt_state, jnp.asarray(b)
+                )
+                state = TrainState(
+                    params=params2, opt_state=opt_state2, step=state.step + 1
+                )
+            else:
+                state, loss = trainer.step(state, jnp.asarray(b))
             epoch_losses.append(jnp.mean(loss))
         for val in np.asarray(jax.device_get(epoch_losses)):
             losses.update(float(val), args.batch)

@@ -129,3 +129,26 @@ def test_hits_at_1_beats_chance_after_training(capsys):
     params = model.init(jax.random.PRNGKey(0), jnp.asarray(val[:1]))
     untrained = evaluate_hits_at_1(model, params, val)
     assert untrained < trained_hits, (untrained, trained_hits)
+
+
+def test_sp_workload_trains(capsys):
+    """--sp ring --attn flash: the long-context path through the full
+    workload (sequence sharded over the pod, flash blocks in the ring)."""
+    args = build_parser().parse_args(
+        [
+            "--epochs", "1", "--batch", "8", "--vocab", "64", "--seq", "32",
+            "--layers", "1", "--heads", "2", "--dmodel", "64",
+            "--corpus-tokens", "20000", "--world", "4", "--lr", "3e-3",
+            "--warmup-steps", "5", "--sp", "ring", "--attn", "flash",
+        ]
+    )
+    initial, final = run(args)
+    assert final < initial * 0.8, (initial, final)
+
+
+def test_sp_workload_rejects_indivisible_seq():
+    args = build_parser().parse_args(
+        ["--seq", "30", "--world", "4", "--sp", "ring", "--corpus-tokens", "20000"]
+    )
+    with pytest.raises(ValueError, match="divide by world"):
+        run(args)
