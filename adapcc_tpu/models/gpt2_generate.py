@@ -205,6 +205,17 @@ def interact(argv: Optional[list] = None) -> None:
             f"--max-new-tokens {args.max_new_tokens} must be < --seq "
             f"{args.seq}: the KV cache holds prompt + generation together"
         )
+    import os
+
+    mismatch = (
+        f"checkpoint {args.ckpt!r} not found or incompatible with the "
+        f"model shape (--vocab/--seq/--layers/--heads/--dmodel must "
+        f"match training)"
+    )
+    if args.ckpt and not os.path.exists(args.ckpt):
+        # fail before building/compiling the model; same message as the
+        # post-load mismatch path so callers can match on one string
+        raise SystemExit(mismatch)
 
     tok = load_tokenizer()
     vocab = args.vocab or max(getattr(tok, "vocab_size", 258), 258)
@@ -217,11 +228,6 @@ def interact(argv: Optional[list] = None) -> None:
     if args.ckpt:
         from adapcc_tpu.checkpoint import TrainCheckpointState, load_checkpoint
 
-        mismatch = (
-            f"checkpoint {args.ckpt!r} not found or incompatible with the "
-            f"model shape (--vocab/--seq/--layers/--heads/--dmodel must "
-            f"match training)"
-        )
         state = TrainCheckpointState(params={"params": params})
         try:
             ok = load_checkpoint(state, args.ckpt)

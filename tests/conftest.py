@@ -52,3 +52,11 @@ def mesh4():
     from jax.sharding import Mesh
 
     return Mesh(jax.devices()[:4], ("ranks",))
+
+
+@pytest.fixture(scope="session")
+def mesh2():
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(jax.devices()[:2], ("ranks",))

@@ -143,7 +143,7 @@ def test_cli_one_shot_generates_from_trained_checkpoint(tmp_path):
         "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
     }
     ckpt = str(tmp_path / "gpt2.ckpt")
-    shape = ["--vocab", "258", "--seq", "64", "--layers", "1",
+    shape = ["--vocab", "258", "--seq", "32", "--layers", "1",
              "--heads", "2", "--dmodel", "32"]
     train = subprocess.run(
         [sys.executable, "-m", "adapcc_tpu.workloads.train_gpt2",
@@ -168,7 +168,7 @@ def test_cli_one_shot_generates_from_trained_checkpoint(tmp_path):
     bad = subprocess.run(
         [sys.executable, "-m", "adapcc_tpu.models.gpt2_generate",
          "--ckpt", ckpt, "--prompt", "hello", "--max-new-tokens", "8",
-         "--vocab", "258", "--seq", "64", "--layers", "2",
+         "--vocab", "258", "--seq", "32", "--layers", "2",
          "--heads", "2", "--dmodel", "32"],
         capture_output=True, text=True, cwd="/root/repo", env=env, timeout=300,
     )
@@ -185,7 +185,9 @@ def test_cli_rejects_shape_mismatch(tmp_path):
     missing = str(tmp_path / "nope.ckpt")
     gen = subprocess.run(
         [sys.executable, "-m", "adapcc_tpu.models.gpt2_generate",
-         "--ckpt", missing, "--prompt", "x"],
+         "--ckpt", missing, "--prompt", "x",
+         "--seq", "16", "--layers", "1", "--heads", "1", "--dmodel", "16",
+         "--max-new-tokens", "4"],
         capture_output=True, text=True, cwd="/root/repo", env=env, timeout=300,
     )
     assert gen.returncode != 0

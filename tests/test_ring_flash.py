@@ -73,12 +73,15 @@ def test_flash_ring_matches_dense_ring_and_oracle(mesh4, causal):
     np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), atol=2e-5)
 
 
-def test_flash_ring_grads_match_dense_ring(mesh4):
+def test_flash_ring_grads_match_dense_ring(mesh2):
+    # a 2-device mesh: the grad path through scan+switch+pallas is identical
+    # in structure but compiles half the ring (the 4-device variant costs
+    # ~37 s of pure compile on a single-core box)
     q, k, v = _qkv(T=16, seed=3)
 
     def loss(impl):
         def f(q, k, v):
-            return jnp.sum(ring_attention(mesh4, q, k, v, block_impl=impl) ** 2)
+            return jnp.sum(ring_attention(mesh2, q, k, v, block_impl=impl) ** 2)
 
         return f
 
@@ -118,14 +121,14 @@ def test_flash_ulysses_matches_oracle(mesh4, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(oracle), atol=2e-5)
 
 
-def test_flash_ulysses_grads_match_dense(mesh4):
+def test_flash_ulysses_grads_match_dense(mesh2):
     from adapcc_tpu.parallel import ulysses_attention
 
     q, k, v = _qkv(T=16, H=4, seed=5)
 
     def loss(impl):
         def f(q, k, v):
-            return jnp.sum(ulysses_attention(mesh4, q, k, v, block_impl=impl) ** 2)
+            return jnp.sum(ulysses_attention(mesh2, q, k, v, block_impl=impl) ** 2)
 
         return f
 

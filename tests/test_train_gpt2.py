@@ -137,8 +137,8 @@ def test_sp_workload_trains(capsys):
     args = build_parser().parse_args(
         [
             "--epochs", "1", "--batch", "8", "--vocab", "64", "--seq", "32",
-            "--layers", "1", "--heads", "2", "--dmodel", "64",
-            "--corpus-tokens", "20000", "--world", "4", "--lr", "3e-3",
+            "--layers", "1", "--heads", "2", "--dmodel", "32",
+            "--corpus-tokens", "12000", "--world", "4", "--lr", "3e-3",
             "--warmup-steps", "5", "--sp", "ring", "--attn", "flash",
         ]
     )
