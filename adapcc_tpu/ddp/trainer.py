@@ -103,6 +103,7 @@ class DDPTrainer:
         self._deferred: Optional[Any] = None
         self._bank_dirty = False  # some rank holds banked (deferred) grads
         self._compiled: Optional[Callable] = None
+        self._scan_cache: dict = {}  # ("scan", n_steps) → compiled program
         self._host_step = 0
         # optional gradient-noise-scale measurement (units-test/get_gns.py):
         # the per-rank vs allreduced gradient norms fall out of the sync step
@@ -118,6 +119,20 @@ class DDPTrainer:
         self._gns_pending: list = []
 
     # -- step program ----------------------------------------------------------
+
+    def _apply_synced(self, state: TrainState, synced: Any) -> TrainState:
+        """Optimizer tail shared by every step variant: one change to the
+        update rule applies to step() and scan_steps() alike."""
+        updates, opt_state = self.tx.update(synced, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return TrainState(params=params, opt_state=opt_state, step=state.step + 1)
+
+    def _static_full_step(self, state: TrainState, batch: Any):
+        """The static full-world step (no mask, no relay banking): the body
+        scan_steps scans and _build's static path reduces to."""
+        loss, grads = jax.value_and_grad(self.loss_fn)(state.params, batch)
+        synced = self.hook.sync(grads, None)
+        return self._apply_synced(state, synced), loss
 
     def _build(self) -> Callable:
         # without a coordinator (or an explicit dynamic_mask request) the
@@ -138,9 +153,7 @@ class DDPTrainer:
                 outs.append(jax.tree_util.tree_map(lambda d: d[None], new_deferred))
             else:
                 synced = self.hook.sync(grads, mask)
-            updates, opt_state = self.tx.update(synced, state.opt_state, state.params)
-            params = optax.apply_updates(state.params, updates)
-            new_state = TrainState(params=params, opt_state=opt_state, step=state.step + 1)
+            new_state = self._apply_synced(state, synced)
             if self.measure_gns:
                 from adapcc_tpu.measure.gns import ddp_grad_sq_norms
 
@@ -222,6 +235,52 @@ class DDPTrainer:
         self._record_gns(batch, norms, active_mask)
         return new_state, loss
 
+    def scan_steps(
+        self, state: TrainState, batch: Any, n_steps: int
+    ) -> Tuple[TrainState, jnp.ndarray]:
+        """``n_steps`` full-world steps on one batch as ONE compiled dispatch
+        (``lax.scan`` inside the shard_map).
+
+        On a remote/tunneled backend every ``step()`` call pays a
+        host→device dispatch round-trip; a scanned multi-step program pays
+        it once, so this is the honest way to measure device-side
+        throughput (bench.py) and the fast way to run tight loops whose
+        active set cannot change mid-scan.  Static full world only — no
+        per-step negotiation, relay banking, or GNS capture.  Returns
+        ``(final_state, losses [world, n_steps])``.
+        """
+        if self._dynamic_mask or not self.bsp or self.measure_gns:
+            raise ValueError(
+                "scan_steps runs a static full-world program: incompatible "
+                "with dynamic_mask, async relay (bsp=False), and measure_gns"
+            )
+        key = ("scan", int(n_steps))
+        fn = self._scan_cache.get(key)
+        if fn is None:
+            from jax import lax
+
+            def per_shard(state: TrainState, batch: Any):
+                def body(st, _):
+                    return self._static_full_step(st, batch)
+
+                st, losses = lax.scan(body, state, None, length=n_steps)
+                return st, losses[None]  # [1, n] per rank → stacked [world, n]
+
+            fn = jax.jit(
+                jax.shard_map(
+                    per_shard,
+                    mesh=self.mesh,
+                    in_specs=(P(), P(self.axis_name)),
+                    out_specs=(P(), P(self.axis_name)),
+                    check_vma=False,
+                ),
+                donate_argnums=(0,) if self.donate_state else (),
+            )
+            self._scan_cache[key] = fn
+        new_state, losses = fn(state, batch)
+        self._host_step += n_steps
+        return new_state, losses
+
     def _record_gns(self, batch: Any, norms: jnp.ndarray, active_mask) -> None:
         if self._gns is None:
             from adapcc_tpu.measure.gns import GNSEstimator
@@ -266,3 +325,4 @@ class DDPTrainer:
         self.hook.strategy = strategy
         self.hook.reset_plan()
         self._compiled = None
+        self._scan_cache.clear()  # scanned programs trace the old schedule too

@@ -240,13 +240,24 @@ def main() -> None:
 
         tx = optax.adamw(3e-4)
 
+        use_scan = _env_int("BENCH_SCAN", 1)
+        _RESULT["dispatch"] = "scan" if use_scan else "loop"
+
         def time_steps(step_fn, state):
-            """Mean step seconds with a forced host sync closing the window."""
+            """Mean step seconds with a forced host sync closing the window.
+
+            ``step_fn`` runs either one step per call (loop mode: every call
+            pays the host→device dispatch round-trip — the remote-tunnel
+            tax) or all ``steps`` in one scanned dispatch (BENCH_SCAN=1,
+            default: the device-side throughput number)."""
             state, loss = step_fn(state)  # compile + warmup
             _ = float(jax.device_get(jnp.mean(loss)))
             t0 = time.perf_counter()
-            for _i in range(steps):
+            if use_scan:
                 state, loss = step_fn(state)
+            else:
+                for _i in range(steps):
+                    state, loss = step_fn(state)
             # a scalar host read forces the whole dispatched chain to finish;
             # block_until_ready alone is not trustworthy through remote tunnels
             _ = float(jax.device_get(jnp.mean(loss)))
@@ -269,7 +280,10 @@ def main() -> None:
         )
         # both paths donate their state; give each its own param buffers
         fw_state = TrainState.create(jax.tree_util.tree_map(jnp.array, params), tx)
-        fw_time = time_steps(lambda s: trainer.step(s, tokens), fw_state)
+        if use_scan:
+            fw_time = time_steps(lambda s: trainer.scan_steps(s, tokens, steps), fw_state)
+        else:
+            fw_time = time_steps(lambda s: trainer.step(s, tokens), fw_state)
 
         value = tokens_per_step / fw_time
         peak = chip_peak_tflops() * 1e12 * world
@@ -299,9 +313,22 @@ def main() -> None:
                 loss[None],
             )
 
+        if use_scan:
+
+            def base_scan_shard(state, b):
+                def body(st, _):
+                    st2, loss = base_step_shard(st, b)
+                    return st2, loss[0]
+
+                st, losses = jax.lax.scan(body, state, None, length=steps)
+                return st, losses[None]
+
+            base_inner = base_scan_shard
+        else:
+            base_inner = base_step_shard
         base_fn = jax.jit(
             jax.shard_map(
-                base_step_shard,
+                base_inner,
                 mesh=mesh,
                 in_specs=(P(), P("ranks")),
                 out_specs=(P(), P("ranks")),

@@ -252,3 +252,72 @@ def test_trainer_rebuild_recompiles(mesh8):
     assert trainer._compiled is None
     state, loss = trainer.step(state, (x, y))
     assert np.isfinite(float(jnp.mean(loss)))
+
+
+def test_scan_steps_matches_sequential(mesh4):
+    """n scanned steps in one dispatch == n sequential step() calls."""
+    import optax
+
+    from adapcc_tpu.ddp import DDPTrainer, TrainState
+    from adapcc_tpu.models.mlp import MLP
+    from adapcc_tpu.strategy.ir import Strategy
+
+    model = MLP(features=(8, 4))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 6)), jnp.float32)
+    y = jnp.asarray(np.random.default_rng(1).integers(0, 4, size=(8,)))
+    params = model.init(jax.random.PRNGKey(0), x)
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        logits = model.apply(p, xb)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+
+    tx = optax.sgd(1e-2)
+    t_seq = DDPTrainer(loss_fn, tx, mesh4, Strategy.ring(4))
+    t_scan = DDPTrainer(loss_fn, tx, mesh4, Strategy.ring(4))
+
+    s_seq = TrainState.create(params, tx)
+    losses_seq = []
+    for _ in range(3):
+        s_seq, loss = t_seq.step(s_seq, (x, y))
+        losses_seq.append(np.asarray(loss))
+    s_scan, losses_scan = t_scan.scan_steps(TrainState.create(params, tx), (x, y), 3)
+
+    assert losses_scan.shape == (4, 3)
+    np.testing.assert_allclose(
+        np.stack(losses_seq, axis=1), np.asarray(losses_scan), atol=1e-6
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(s_scan.params), jax.tree_util.tree_leaves(s_seq.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_scan_steps_rejects_dynamic_modes(mesh4):
+    import optax
+
+    from adapcc_tpu.ddp import DDPTrainer, TrainState
+    from adapcc_tpu.strategy.ir import Strategy
+
+    tx = optax.sgd(1e-2)
+    t = DDPTrainer(lambda p, b: jnp.sum(p["w"] * b), tx, mesh4, Strategy.ring(4), bsp=False)
+    state = TrainState.create({"w": jnp.ones(())}, tx)
+    with pytest.raises(ValueError, match="scan_steps"):
+        t.scan_steps(state, jnp.ones((4, 1)), 2)
+
+
+def test_rebuild_invalidates_scan_cache(mesh4):
+    import optax
+
+    from adapcc_tpu.ddp import DDPTrainer, TrainState
+    from adapcc_tpu.strategy.ir import Strategy
+
+    tx = optax.sgd(1e-2)
+    t = DDPTrainer(
+        lambda p, b: jnp.sum((p["w"] - jnp.mean(b)) ** 2), tx, mesh4, Strategy.ring(4)
+    )
+    state = TrainState.create({"w": jnp.ones(())}, tx)
+    t.scan_steps(state, jnp.ones((4, 2)), 2)
+    assert t._scan_cache, "scan program should be cached"
+    t.rebuild(Strategy.binary(4))
+    assert not t._scan_cache, "rebuild must drop scanned programs too"
