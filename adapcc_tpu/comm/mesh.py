@@ -37,5 +37,22 @@ def device_ip(device) -> str:
 
 
 def mesh_ip_table(mesh: Mesh) -> List[str]:
-    """Rank→"ip" list for a world mesh (analog of topology/ip_table.txt)."""
+    """Rank→"ip" list for a world mesh (analog of topology/ip_table.txt).
+
+    On a two-level ``(dcn, ici)`` mesh the slice is the host analog — the
+    synthesizer's host grouping (masters + intra-host chains) must follow
+    slice boundaries, not process boundaries, so ranks are labeled by their
+    slice row.  A single-process virtual pod would otherwise collapse to one
+    "host" and the synthesized hierarchy would not match the DCN×ICI
+    execution split (comm/two_level.py).
+    """
+    from adapcc_tpu.comm.two_level import is_two_level
+
+    if is_two_level(mesh):
+        # label purely by slice row: a slice spanning several processes is
+        # still ONE host analog (embedding the process ip here would split
+        # it, hand the synthesizer two masters per slice, and trip
+        # slice_tree's single-inbound-edge check)
+        _, ici = mesh.devices.shape
+        return [f"slice-{r // ici}" for r in range(mesh.devices.size)]
     return [device_ip(d) for d in mesh.devices.flat]

@@ -79,15 +79,21 @@ class Communicator:
         self.axis_name = self.mesh.axis_names[0]
         self.chunk_bytes = args.default_chunk_bytes
 
+        from adapcc_tpu.comm.two_level import is_two_level
+
         os.makedirs(args.topology_dir, exist_ok=True)
         ip_table_path = os.path.join(args.topology_dir, "ip_table.txt")
         self.ip_table = None
-        if os.path.exists(ip_table_path):
+        # a two-level mesh's host analog IS the slice row — a pre-existing
+        # table (launcher-written real IPs, or a prior flat-mesh run in the
+        # same dir) would misalign the synthesizer's host groups with the
+        # DCN×ICI execution split, so the mesh always wins there
+        if os.path.exists(ip_table_path) and not is_two_level(self.mesh):
             table = read_ip_table(ip_table_path)
             if len(table) == self.world_size:
                 self.ip_table = table
         if self.ip_table is None:
-            # missing or stale (wrong world size) artifact: re-derive from mesh
+            # missing/stale (wrong world size) or two-level: derive from mesh
             self.ip_table = mesh_ip_table(self.mesh)
             write_ip_table(self.ip_table, ip_table_path)
 

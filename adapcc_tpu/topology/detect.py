@@ -40,23 +40,31 @@ def _device_slice(device) -> int:
 
 
 def detect_topology(mesh: Mesh, version: str = "tpu-detected") -> LogicalGraph:
-    """Logical graph of the world mesh: one server entry per (process, slice).
+    """Logical graph of the world mesh: one server entry per host analog.
 
-    Rank numbering is mesh order (flattened), matching how the collective
-    engine assigns schedule ranks to mesh positions.
+    The host analog is the mesh's ip-table label (``mesh_ip_table``): the
+    process on a flat mesh, the *slice row* on a two-level ``(dcn, ici)``
+    mesh — so the logical graph's server grouping (which feeds the
+    synthesizer's master/chain hierarchy) always matches the execution
+    split.  Rank numbering is mesh order (flattened), matching how the
+    collective engine assigns schedule ranks to mesh positions.
     """
+    from adapcc_tpu.comm.mesh import mesh_ip_table
+
     devices = list(mesh.devices.flat)
+    table = mesh_ip_table(mesh)
     buckets: Dict[tuple, List[int]] = {}
     for rank, dev in enumerate(devices):
-        key = (getattr(dev, "process_index", 0), _device_slice(dev))
+        key = (table[rank], _device_slice(dev))
         buckets.setdefault(key, []).append(rank)
 
     graph = LogicalGraph(version=version)
-    for sid, ((proc, sl), ranks) in enumerate(sorted(buckets.items())):
+    ordered = sorted(buckets.items(), key=lambda kv: min(kv[1]))
+    for sid, ((ip, sl), ranks) in enumerate(ordered):
         graph.servers.append(
             ServerEntry(
                 server_id=sid,
-                ip=device_ip(devices[ranks[0]]),
+                ip=ip,
                 nic_id=sl,
                 gpus=sorted(ranks),
             )

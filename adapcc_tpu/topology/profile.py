@@ -42,6 +42,15 @@ class NetworkProfiler:
     """Measures per-link latency (s) and bandwidth (GB/s) over a world mesh."""
 
     def __init__(self, mesh: Mesh, axis_name: str = RANKS_AXIS, warmup: int = 1, iters: int = 3):
+        if len(mesh.axis_names) > 1:
+            # multi-axis (e.g. two-level dcn×ici) world: probe over a flat
+            # alias mesh on the same devices in the same order — the probes
+            # measure physical links between flat ranks, and the flat rank r
+            # sits at mesh position (r // ici, r % ici) by construction
+            # (comm/two_level.py build_two_level_mesh), so the matrices line
+            # up with the strategy/ip-table world
+            mesh = Mesh(mesh.devices.reshape(-1), (RANKS_AXIS,))
+            axis_name = RANKS_AXIS
         self.mesh = mesh
         self.axis_name = axis_name
         self.warmup = warmup

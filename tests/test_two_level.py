@@ -208,3 +208,38 @@ def test_two_level_ring_attention_across_slices(mesh2x4):
     att = jnp.where(mask[None, None], att, -1e30)
     ref = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(att, axis=-1), v)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_two_level_adaptive_workflow_e2e(tmp_path):
+    """The whole control plane on a (dcn, ici) mesh: slice-aware detect
+    (servers = slice rows), flat-alias profiling, ParTrees synthesis with
+    per-slice masters, hierarchical execution.  Previously the profiler
+    choked on the 2D mesh and detect collapsed the pod into one host."""
+    from adapcc_tpu.communicator import Communicator
+    from adapcc_tpu.config import CommArgs
+    from adapcc_tpu.primitives import ALLREDUCE, DETECT, PROFILE
+
+    mesh = build_two_level_mesh(2, 4)
+    args = CommArgs(
+        topology_dir=str(tmp_path),
+        strategy_file=str(tmp_path / "strategy.xml"),
+        logical_graph=str(tmp_path / "logical_graph.xml"),
+    )
+    comm = Communicator(args, mesh=mesh)
+    comm.init_threads(DETECT)
+    comm.exit_threads(DETECT)
+    comm.init_threads(PROFILE)
+    comm.exit_threads(PROFILE)
+
+    # the synthesized hierarchy follows slice boundaries
+    xml = (tmp_path / "strategy.xml").read_text()
+    assert "slice-0" in xml and "slice-1" in xml
+    from adapcc_tpu.strategy.xml_io import parse_logical_graph_xml
+
+    graph = parse_logical_graph_xml(str(tmp_path / "logical_graph.xml"))
+    assert graph.local_rank0_list() == [0, 4]
+
+    comm.init_threads(ALLREDUCE)
+    x = jnp.stack([jnp.full((8,), float(r + 1)) for r in range(8)])
+    out = np.asarray(comm.all_reduce(x))
+    np.testing.assert_allclose(out, 36.0)
