@@ -93,9 +93,13 @@ class Communicator:
             if len(table) == self.world_size:
                 self.ip_table = table
         if self.ip_table is None:
-            # missing/stale (wrong world size) or two-level: derive from mesh
+            # missing/stale (wrong world size) or two-level: derive from mesh.
+            # Persist only when no artifact exists — a two-level run must not
+            # clobber a launcher-written real-IP table that a later flat run
+            # in the same dir would then mistake for host identities
             self.ip_table = mesh_ip_table(self.mesh)
-            write_ip_table(self.ip_table, ip_table_path)
+            if not os.path.exists(ip_table_path):
+                write_ip_table(self.ip_table, ip_table_path)
 
         self.synthesizer = Synthesizer(args.strategy_file, self.ip_table, policy=args.policy)
         self._engines: Dict[int, CollectiveEngine] = {}

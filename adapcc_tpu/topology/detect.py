@@ -20,7 +20,6 @@ from typing import Dict, List, Optional
 
 from jax.sharding import Mesh
 
-from adapcc_tpu.comm.mesh import device_ip
 from adapcc_tpu.strategy.xml_io import (
     LogicalGraph,
     ServerEntry,
@@ -82,9 +81,13 @@ def dump_detected_topology(mesh: Mesh, out_dir: str, process_index: Optional[int
     """
     os.makedirs(out_dir, exist_ok=True)
     graph = detect_topology(mesh)
+    devices = list(mesh.devices.flat)
     written = []
     for s in graph.servers:
-        proc = int(s.ip.rsplit("-", 1)[-1]) if "-" in s.ip else s.server_id
+        # the owning process comes from device metadata, not from parsing the
+        # ip label (two-level labels are "slice-N", not "process-N"); a
+        # slice spanning processes is dumped by its first-rank owner
+        proc = getattr(devices[min(s.gpus)], "process_index", 0)
         if process_index is not None and proc != process_index:
             continue
         shard = LogicalGraph(servers=[s], version=graph.version)

@@ -107,3 +107,38 @@ def test_committed_busbw_artifact_parses_and_is_consistent():
     assert ("allreduce", "pallas_ring") in seen
     for coll in ("reduce", "broadcast", "all_gather", "reduce_scatter", "all_to_all"):
         assert any(c == coll for c, _ in seen), f"missing {coll}"
+
+
+def test_longcontext_sweep_tiny_and_artifact():
+    """benchmarks/longcontext.py: a tiny live sweep plus the committed
+    round-3 artifact parse (memory accounting must match the scheme)."""
+    import json
+    import os
+
+    from benchmarks.longcontext import parse_size, run_sweep
+
+    assert parse_size("4K") == 4096 and parse_size("64") == 64
+    res = run_sweep(4, [64], heads=4, head_dim=8, iters=1, warmup=1,
+                    schemes=("single", "ring"))
+    by_scheme = {r.scheme: r for r in res}
+    assert by_scheme["single"].score_bytes_per_device == 4 * 4 * 64 * 64
+    # ring shards the sequence: [Tl, Tl] scores, world^2 smaller
+    assert by_scheme["ring"].score_bytes_per_device == 4 * 4 * 16 * 16
+    assert all(r.fwd_bwd_ms > 0 for r in res)
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "results", "longcontext_virtual4_r03.jsonl",
+    )
+    rows = [json.loads(l) for l in open(path) if l.strip()]
+    assert {r["scheme"] for r in rows} == {"single", "ring", "ulysses"}
+    for r in rows:
+        assert r["fwd_bwd_ms"] > 0 and r["score_bytes_per_device"] > 0
+        if r["scheme"] == "ring":
+            single = [
+                s for s in rows
+                if s["scheme"] == "single" and s["seq"] == r["seq"]
+            ][0]
+            # the memory story: ring is world^2 smaller than single-device
+            assert r["score_bytes_per_device"] * r["world"] ** 2 == \
+                single["score_bytes_per_device"]
