@@ -14,7 +14,7 @@ sequence ``world×`` longer than a single device could hold.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -25,7 +25,7 @@ from adapcc_tpu.models.gpt2 import GPT2, lm_loss_sp
 
 
 def gpt2_sp_loss_and_grad(
-    model: GPT2, mesh: Mesh, axis_name: str = "ranks"
+    model: GPT2, mesh: Mesh, axis_name: str = "ranks", data_axis: Optional[str] = None
 ) -> Callable[[Any, jnp.ndarray], Tuple[jnp.ndarray, Any]]:
     """Jitted ``(params, tokens [B, T]) → (loss, grads)`` with the sequence
     sharded over ``axis_name``; params replicated, grads psum-replicated.
@@ -33,6 +33,12 @@ def gpt2_sp_loss_and_grad(
     ``model.cfg.sp_axis`` must equal ``axis_name`` (the attention layers run
     the cross-shard SP program on that axis) and ``T`` must divide by the
     axis size.
+
+    With ``data_axis`` (a 2D ``(data, sp)`` mesh — the production
+    long-context layout) the batch dim is additionally sharded over the
+    data axis: each data row runs an independent SP ring on its batch
+    shard, losses average over rows, and gradients sync across BOTH axes
+    — DP × SP in one jitted program.
     """
     cfg = model.cfg
     if cfg.sp_axis != axis_name:
@@ -40,6 +46,8 @@ def gpt2_sp_loss_and_grad(
             f"model.cfg.sp_axis {cfg.sp_axis!r} must equal the mesh axis "
             f"{axis_name!r} the step is sharded over"
         )
+    if data_axis is not None and data_axis not in mesh.axis_names:
+        raise ValueError(f"data_axis {data_axis!r} not in mesh axes {mesh.axis_names}")
 
     def shard_step(params, tokens):
         def loss_fn(p):
@@ -53,12 +61,22 @@ def gpt2_sp_loss_and_grad(
         # cancels it exactly; verified against the unsharded gradient in
         # tests/test_gpt2_sp.py.
         grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, axis_name), grads)
+        if data_axis is not None:
+            # plain data parallelism on top: average the per-data-shard loss
+            # and gradients (each shard's grad is already exact for its rows)
+            loss = lax.pmean(loss, data_axis)
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, data_axis), grads
+            )
         return loss, grads
 
+    batch_spec = (
+        P(None, axis_name) if data_axis is None else P(data_axis, axis_name)
+    )
     fn = jax.shard_map(
         shard_step,
         mesh=mesh,
-        in_specs=(P(), P(None, axis_name)),
+        in_specs=(P(), batch_spec),
         out_specs=(P(), P()),
         check_vma=False,
     )
@@ -66,13 +84,14 @@ def gpt2_sp_loss_and_grad(
 
 
 def gpt2_sp_train_step(
-    model: GPT2, tx, mesh: Mesh, axis_name: str = "ranks"
+    model: GPT2, tx, mesh: Mesh, axis_name: str = "ranks",
+    data_axis: Optional[str] = None,
 ) -> Callable:
     """Jitted ``(params, opt_state, tokens) → (params, opt_state, loss)``
-    full SP training step (loss+grad as above, then the optax update)."""
+    full SP (or DP×SP, with ``data_axis``) training step."""
     import optax
 
-    loss_and_grad = gpt2_sp_loss_and_grad(model, mesh, axis_name)
+    loss_and_grad = gpt2_sp_loss_and_grad(model, mesh, axis_name, data_axis)
 
     @jax.jit
     def step(params, opt_state, tokens):

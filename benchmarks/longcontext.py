@@ -24,14 +24,14 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
-import statistics
-import time
 from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
+
+from benchmarks.collectives import _time_op, parse_size
 
 
 @dataclasses.dataclass
@@ -63,17 +63,6 @@ def _score_bytes(scheme: str, B: int, H: int, T: int, world: int, block: int) ->
     raise ValueError(scheme)
 
 
-def _timed(fn, iters: int, warmup: int) -> float:
-    for _ in range(warmup):
-        jax.block_until_ready(fn())
-    samples = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn())
-        samples.append(time.perf_counter() - t0)
-    return statistics.median(samples)
-
-
 def run_sweep(
     world: int,
     seqs: Sequence[int],
@@ -88,6 +77,11 @@ def run_sweep(
     from adapcc_tpu.parallel import ring_attention, ulysses_attention
     from adapcc_tpu.parallel.ring_attention import reference_attention
 
+    known = ("single", "ring", "ring-flash", "ulysses")
+    if schemes:
+        unknown = [s for s in schemes if s not in known]
+        if unknown:
+            raise ValueError(f"unknown schemes {unknown}; choose from {known}")
     if len(jax.devices()) < world:
         raise ValueError(f"need {world} devices, have {len(jax.devices())}")
     mesh = Mesh(np.array(jax.devices()[:world]), ("ranks",))
@@ -117,7 +111,7 @@ def run_sweep(
                 return jnp.sum(prog(q, k, v).astype(jnp.float32) ** 2)
 
             step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-            sec = _timed(lambda: step(q, k, v), iters, warmup)
+            sec = _time_op(lambda: step(q, k, v), iters, warmup)
             results.append(
                 LCResult(
                     scheme=scheme,
@@ -132,12 +126,6 @@ def run_sweep(
                 )
             )
     return results
-
-
-def parse_size(text: str) -> int:
-    text = text.strip().upper()
-    mult = 1024 if text.endswith("K") else 1
-    return int(float(text.rstrip("K"))) * mult
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
