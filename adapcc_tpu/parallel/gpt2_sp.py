@@ -60,14 +60,12 @@ def gpt2_sp_loss_and_grad(
         # world× factor on its local contribution.  pmean (psum/world)
         # cancels it exactly; verified against the unsharded gradient in
         # tests/test_gpt2_sp.py.
-        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, axis_name), grads)
+        # with a data axis the per-data-shard grads also average — one
+        # pmean over both axes (sum/(sp·dp)) instead of two all-reduce rounds
+        axes = (axis_name,) if data_axis is None else (axis_name, data_axis)
+        grads = jax.tree_util.tree_map(lambda g: lax.pmean(g, axes), grads)
         if data_axis is not None:
-            # plain data parallelism on top: average the per-data-shard loss
-            # and gradients (each shard's grad is already exact for its rows)
             loss = lax.pmean(loss, data_axis)
-            grads = jax.tree_util.tree_map(
-                lambda g: lax.pmean(g, data_axis), grads
-            )
         return loss, grads
 
     batch_spec = (

@@ -272,3 +272,32 @@ def test_expert_parallel_capacity_drops_are_bounded(mesh8):
     y, aux = expert_parallel_moe(params, x.reshape(16, cfg.d_model), cfg, mesh)
     assert np.isfinite(np.asarray(y)).all()
     assert np.isfinite(float(aux))
+
+
+def test_train_moe_workload_ep_training_and_inference(capsys):
+    """workloads/train_moe.py: gradients flow through the EP all-to-alls
+    (CE collapses on separable clusters) and the reference's timed inference
+    loop prints its computation-time line."""
+    from adapcc_tpu.workloads.train_moe import build_parser, run
+
+    args = build_parser().parse_args(
+        ["--world", "4", "--steps", "25", "--experts", "4", "--dmodel", "32",
+         "--dhidden", "64", "--batch", "128", "--classes", "4"]
+    )
+    first, last = run(args)
+    assert last < first * 0.2, (first, last)
+
+    args = build_parser().parse_args(
+        ["--world", "4", "--mode", "inference", "--steps", "3",
+         "--experts", "4", "--dmodel", "32", "--dhidden", "64", "--batch", "128"]
+    )
+    run(args)
+    assert "computation time:" in capsys.readouterr().out
+
+
+def test_train_moe_rejects_indivisible_batch():
+    from adapcc_tpu.workloads.train_moe import build_parser, run
+
+    args = build_parser().parse_args(["--world", "4", "--batch", "130"])
+    with pytest.raises(ValueError, match="divide by world"):
+        run(args)
