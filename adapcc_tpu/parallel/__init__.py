@@ -22,6 +22,13 @@ from adapcc_tpu.parallel.tensor import (
 )
 from adapcc_tpu.parallel.pipeline import pipeline_apply
 from adapcc_tpu.parallel.expert import expert_parallel_moe
+from adapcc_tpu.parallel.fsdp import (
+    Zero1Optimizer,
+    fsdp_shardings,
+    fsdp_train_step,
+    shard_fsdp,
+    zero1_train_step,
+)
 
 __all__ = [
     "gpt2_sp_loss_and_grad",
@@ -36,4 +43,9 @@ __all__ = [
     "tree_shardings",
     "pipeline_apply",
     "expert_parallel_moe",
+    "Zero1Optimizer",
+    "fsdp_shardings",
+    "fsdp_train_step",
+    "shard_fsdp",
+    "zero1_train_step",
 ]

@@ -1,0 +1,234 @@
+"""FSDP (ZeRO-3 via GSPMD) and ZeRO-1 sharded-optimizer tests.
+
+Oracle: replicated single-program training on the same data — sharded state
+is a memory layout, not a different algorithm, so losses and params must
+match to float tolerance on the virtual 8-device pod.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from adapcc_tpu.comm.mesh import RANKS_AXIS, build_world_mesh
+from adapcc_tpu.parallel.fsdp import (
+    Zero1Optimizer,
+    fsdp_shardings,
+    fsdp_train_step,
+    shard_fsdp,
+    zero1_train_step,
+)
+
+
+def _mlp_params(rng, din=16, dh=64, dout=16):
+    return {
+        "w1": jnp.asarray(rng.normal(size=(din, dh)) * 0.1, jnp.float32),
+        "b1": jnp.zeros((dh,), jnp.float32),
+        "w2": jnp.asarray(rng.normal(size=(dh, dout)) * 0.1, jnp.float32),
+        "b2": jnp.zeros((dout,), jnp.float32),
+    }
+
+
+def _mlp_loss(p, batch):
+    x, y = batch
+    h = jnp.tanh(x @ p["w1"] + p["b1"])
+    out = h @ p["w2"] + p["b2"]
+    return jnp.mean((out - y) ** 2)
+
+
+def _batch(rng, n=16, din=16, dout=16):
+    x = jnp.asarray(rng.normal(size=(n, din)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(n, dout)), jnp.float32)
+    return x, y
+
+
+# ------------------------------------------------------------------ FSDP/ZeRO-3
+
+
+def test_fsdp_shardings_pick_largest_divisible_dim(mesh8):
+    params = {
+        "big": jnp.zeros((24, 512)),     # 512 % 8 == 0 and larger → shard dim 1
+        "tall": jnp.zeros((4096, 6)),    # only dim 0 divisible → shard dim 0
+        "bias": jnp.zeros((512,)),       # below min_shard_elems → replicated
+        "odd": jnp.zeros((630, 63)),     # nothing divisible by 8 → replicated
+    }
+    sh = fsdp_shardings(params, mesh8, min_shard_elems=2**10)
+    assert sh["big"].spec == P(None, RANKS_AXIS)
+    assert sh["tall"].spec == P(RANKS_AXIS, None)
+    assert sh["bias"].spec == P()
+    assert sh["odd"].spec == P()
+
+
+def test_shard_fsdp_splits_memory(mesh8):
+    params = {"w": jnp.ones((8 * 13, 32), jnp.float32)}
+    sharded = shard_fsdp(params, mesh8, min_shard_elems=1)
+    shard = sharded["w"].addressable_shards[0]
+    assert shard.data.shape == (13, 32)  # 1/8 of rows on each device
+    np.testing.assert_array_equal(np.asarray(sharded["w"]), np.ones((104, 32)))
+
+
+def test_fsdp_train_matches_replicated(mesh8):
+    rng = np.random.default_rng(0)
+    params = _mlp_params(rng)
+    tx = optax.adam(1e-2)
+
+    # oracle: plain replicated training
+    o_params, o_opt = jax.tree_util.tree_map(jnp.array, params), tx.init(params)
+
+    @jax.jit
+    def plain_step(p, o, b):
+        loss, g = jax.value_and_grad(_mlp_loss)(p, b)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    # fsdp: sharded params + opt state, same data
+    f_params = shard_fsdp(params, mesh8, min_shard_elems=64)
+    f_opt = tx.init(f_params)
+    step = fsdp_train_step(_mlp_loss, tx, mesh8, donate=False, min_shard_elems=64)
+
+    losses_plain, losses_fsdp = [], []
+    for i in range(4):
+        b = _batch(np.random.default_rng(100 + i))
+        o_params, o_opt, lp = plain_step(o_params, o_opt, b)
+        f_params, f_opt, lf = step(f_params, f_opt, b)
+        losses_plain.append(float(lp))
+        losses_fsdp.append(float(lf))
+    np.testing.assert_allclose(losses_fsdp, losses_plain, rtol=1e-5, atol=1e-6)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(f_params[k]), np.asarray(o_params[k]), rtol=1e-5, atol=1e-6
+        )
+    # the point of FSDP: each device holds 1/8 of the shardable leaves
+    assert f_params["w1"].addressable_shards[0].data.shape == (16, 8)
+    # adam moments inherit the same sharded layout
+    mu = f_opt[0].mu["w1"]
+    assert mu.addressable_shards[0].data.shape == (16, 8)
+
+
+# ------------------------------------------------------------------ ZeRO-1
+
+
+def test_zero1_matches_plain_adam(mesh8):
+    rng = np.random.default_rng(1)
+    params = _mlp_params(rng)
+    tx = optax.adam(1e-2)
+    opt = Zero1Optimizer(tx, mesh8)
+    master, opt_state = opt.init(params)
+    step = zero1_train_step(_mlp_loss, opt, mesh8)
+
+    o_params, o_opt = jax.tree_util.tree_map(jnp.array, params), tx.init(params)
+
+    @jax.jit
+    def plain_step(p, o, b):
+        # oracle computes the mean of per-shard gradients = gradient of the
+        # mean loss over the global batch only when shards are equal-sized
+        # and the loss is a mean — true for the MSE here
+        loss, g = jax.value_and_grad(_mlp_loss)(p, b)
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o, loss
+
+    p = params
+    for i in range(3):
+        b = _batch(np.random.default_rng(200 + i), n=16)
+        p, master, opt_state, losses = step(p, master, opt_state, b)
+        o_params, o_opt, _ = plain_step(o_params, o_opt, b)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(p[k]), np.asarray(o_params[k]), rtol=2e-5, atol=2e-6
+        )
+
+
+def test_zero1_opt_state_is_sharded(mesh8):
+    params = _mlp_params(np.random.default_rng(2))
+    opt = Zero1Optimizer(optax.adam(1e-3), mesh8)
+    master, opt_state = opt.init(params)
+    n_total = sum(int(np.prod(v.shape)) for v in params.values())
+    shard_len = -(-n_total // 8)  # ceil
+    assert master.shape == (8, shard_len)
+    assert master.addressable_shards[0].data.shape == (1, shard_len)
+    mu = opt_state[0].mu
+    assert mu.shape == (8, shard_len)
+    assert mu.addressable_shards[0].data.shape == (1, shard_len)
+
+
+def test_zero1_apply_with_presynced_grads(mesh8):
+    """apply() with replicated (already-synced) grads reproduces one plain
+    adam step: psum_scatter(g/world) over identical replicas folds back to g."""
+    rng = np.random.default_rng(3)
+    params = _mlp_params(rng)
+    tx = optax.adam(1e-2)
+    opt = Zero1Optimizer(tx, mesh8)
+    master, opt_state = opt.init(params)
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.asarray(rng.normal(size=p.shape), jnp.float32), params
+    )
+    _, _, new_params = opt.apply(master, opt_state, grads)
+
+    u, _ = tx.update(grads, tx.init(params), params)
+    want = optax.apply_updates(params, u)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(new_params[k]), np.asarray(want[k]), rtol=2e-5, atol=2e-6
+        )
+
+
+def test_zero1_handles_nondivisible_param_count(mesh8):
+    """Padding path: total param count not divisible by world."""
+    params = {"w": jnp.ones((3, 5), jnp.float32), "b": jnp.zeros((7,), jnp.float32)}
+    tx = optax.sgd(0.5)
+    opt = Zero1Optimizer(tx, mesh8)
+    master, opt_state = opt.init(params)
+    grads = {"w": jnp.full((3, 5), 2.0), "b": jnp.full((7,), 4.0)}
+    _, _, new_params = opt.apply(master, opt_state, grads)
+    np.testing.assert_allclose(np.asarray(new_params["w"]), np.ones((3, 5)) - 1.0)
+    np.testing.assert_allclose(np.asarray(new_params["b"]), np.zeros((7,)) - 2.0)
+
+
+# ------------------------------------------------------------------ GPT-2 e2e
+
+
+def test_fsdp_gpt2_trains(mesh8):
+    """Flagship-model integration: tiny GPT-2 under full FSDP — params and
+    adam moments sharded over the pod, loss decreases over a few steps."""
+    from adapcc_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
+
+    cfg = GPT2Config(vocab_size=128, max_seq=16, n_layer=1, n_head=2, d_model=32)
+    model = GPT2(cfg)
+    rng = np.random.default_rng(7)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(8, cfg.max_seq)), jnp.int32)
+    params = shard_fsdp(
+        model.init(jax.random.PRNGKey(0), tokens[:1]), mesh8, min_shard_elems=64
+    )
+    tx = optax.adam(1e-2)
+    opt_state = tx.init(params)
+    step = fsdp_train_step(
+        lambda p, b: lm_loss(model.apply(p, b), b), tx, mesh8,
+        donate=False, min_shard_elems=64,
+    )
+    losses = []
+    for _ in range(5):
+        params, opt_state, loss = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    # at least one transformer kernel actually sharded across the pod
+    leaves = [
+        x for x in jax.tree_util.tree_leaves(params)
+        if hasattr(x, "sharding") and x.sharding.spec != P()
+    ]
+    assert leaves, "no GPT-2 leaf was sharded"
+
+
+def test_zero1_reinit_recompiles(mesh8):
+    """init() with a different param tree must invalidate the compiled
+    program (stale meta would reshape into the old layout)."""
+    tx = optax.sgd(1.0)
+    opt = Zero1Optimizer(tx, mesh8)
+    a = {"w": jnp.ones((4, 4), jnp.float32)}
+    master, st = opt.init(a)
+    opt.apply(master, st, {"w": jnp.ones((4, 4))})
+    b = {"w": jnp.ones((16, 16), jnp.float32), "b": jnp.zeros((5,), jnp.float32)}
+    master_b, st_b = opt.init(b)
+    _, _, new_b = opt.apply(master_b, st_b, jax.tree_util.tree_map(jnp.ones_like, b))
+    assert new_b["w"].shape == (16, 16) and new_b["b"].shape == (5,)
