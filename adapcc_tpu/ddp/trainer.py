@@ -72,12 +72,20 @@ class DDPTrainer:
         # force the compiled step to take a runtime active mask even without
         # a communicator (workloads injecting their own skew signal; tests)
         dynamic_mask: Optional[bool] = None,
+        # gradient accumulation: split each rank's batch shard into this many
+        # microbatches, scanned inside the compiled step with fp32 gradient
+        # accumulation — same math as the full batch (for mean losses), peak
+        # activation memory divided by accum_steps
+        accum_steps: int = 1,
     ) -> None:
         self.loss_fn = loss_fn
         self.tx = tx
         self.mesh = mesh
         self.axis_name = axis_name
         self.donate_state = donate_state
+        if accum_steps < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        self.accum_steps = accum_steps
         self.hook = GradSyncHook(
             strategy,
             axis_name=axis_name,
@@ -127,10 +135,51 @@ class DDPTrainer:
         params = optax.apply_updates(state.params, updates)
         return TrainState(params=params, opt_state=opt_state, step=state.step + 1)
 
+    def _value_and_grad(self, params: Any, batch: Any):
+        """Per-rank (loss, grads), microbatch-accumulated when accum_steps>1.
+
+        Accumulation runs as a ``lax.scan`` over ``[accum, B/accum, ...]``
+        microbatches with fp32 gradient carry; the mean over equal-size
+        microbatches equals the full-batch value for mean losses, so every
+        sync/update path downstream is unchanged.
+        """
+        accum = self.accum_steps
+        if accum == 1:
+            return jax.value_and_grad(self.loss_fn)(params, batch)
+
+        def to_micro(x):
+            b = x.shape[0]
+            if b % accum:
+                raise ValueError(
+                    f"per-rank batch {b} not divisible by accum_steps {accum}"
+                )
+            return x.reshape((accum, b // accum) + x.shape[1:])
+
+        micro = jax.tree_util.tree_map(to_micro, batch)
+        g0 = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+
+        def body(carry, mb):
+            acc_l, acc_g = carry
+            loss, g = jax.value_and_grad(self.loss_fn)(params, mb)
+            acc_g = jax.tree_util.tree_map(
+                lambda a, x: a + x.astype(jnp.float32), acc_g, g
+            )
+            return (acc_l + loss.astype(jnp.float32), acc_g), None
+
+        (loss_sum, g_sum), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), g0), micro
+        )
+        grads = jax.tree_util.tree_map(
+            lambda g, p: (g / accum).astype(p.dtype), g_sum, params
+        )
+        return loss_sum / accum, grads
+
     def _static_full_step(self, state: TrainState, batch: Any):
         """The static full-world step (no mask, no relay banking): the body
         scan_steps scans and _build's static path reduces to."""
-        loss, grads = jax.value_and_grad(self.loss_fn)(state.params, batch)
+        loss, grads = self._value_and_grad(state.params, batch)
         synced = self.hook.sync(grads, None)
         return self._apply_synced(state, synced), loss
 
@@ -142,7 +191,7 @@ class DDPTrainer:
         deferred_relay = not self.bsp
 
         def per_shard(state: TrainState, batch: Any, *extra: Any):
-            loss, grads = jax.value_and_grad(self.loss_fn)(state.params, batch)
+            loss, grads = self._value_and_grad(state.params, batch)
             mask = extra[0] if dynamic_mask else None
             outs = []
             if deferred_relay:

@@ -61,10 +61,13 @@ def pack_sequences(stream: np.ndarray, seq_len: int) -> np.ndarray:
 def lm_batches(
     packed: np.ndarray, batch: int, seed: int
 ) -> Iterator[np.ndarray]:
-    """Shuffled full batches of packed sequences."""
-    idx = np.random.default_rng(seed).permutation(len(packed))
-    for i in range(0, len(idx) - batch + 1, batch):
-        yield packed[idx[i : i + batch]]
+    """Shuffled full batches of packed sequences (host-side; the training
+    loop uses :func:`adapcc_tpu.data.device_batches`, which shares the same
+    index semantics and adds async device prefetch)."""
+    from adapcc_tpu.data import batch_indices
+
+    for idx in batch_indices(len(packed), batch, seed):
+        yield packed[idx]
 
 
 # --- evaluation (convai_evaluation.py analog: perplexity + hits@1) ------------
@@ -193,6 +196,7 @@ def run(args) -> Tuple[float, float]:
     import optax
 
     from adapcc_tpu.comm.mesh import build_world_mesh
+    from adapcc_tpu.data import device_batches
     from adapcc_tpu.ddp import DDPTrainer, TrainState
     from adapcc_tpu.models.gpt2 import GPT2, GPT2Config, lm_loss
     from adapcc_tpu.strategy.ir import Strategy
@@ -262,16 +266,23 @@ def run(args) -> Tuple[float, float]:
         # keep per-step losses on device; one host sync per epoch preserves
         # the trainer's async dispatch (see DDPTrainer's host-step comment)
         epoch_losses = []
-        for b in lm_batches(train_set, args.batch, seed=epoch):
+        # async input pipeline: the next batch lands on device — already
+        # sharded over the data axis on the DDP path — while the current
+        # step computes
+        batches = device_batches(
+            train_set, args.batch,
+            mesh=None if trainer is None else mesh, seed=epoch,
+        )
+        for b in batches:
             if trainer is None:
                 params2, opt_state2, loss = sp_step(
-                    state.params, state.opt_state, jnp.asarray(b)
+                    state.params, state.opt_state, b
                 )
                 state = TrainState(
                     params=params2, opt_state=opt_state2, step=state.step + 1
                 )
             else:
-                state, loss = trainer.step(state, jnp.asarray(b))
+                state, loss = trainer.step(state, b)
             epoch_losses.append(jnp.mean(loss))
         for val in np.asarray(jax.device_get(epoch_losses)):
             losses.update(float(val), args.batch)

@@ -321,3 +321,76 @@ def test_rebuild_invalidates_scan_cache(mesh4):
     assert t._scan_cache, "scan program should be cached"
     t.rebuild(Strategy.binary(4))
     assert not t._scan_cache, "rebuild must drop scanned programs too"
+
+
+# ---------------------------------------------------------------- grad accum
+
+
+def test_accum_steps_match_full_batch(mesh8):
+    """accum_steps=2 must reproduce the accum_steps=1 trajectory exactly:
+    for a mean loss, the mean over equal microbatches is the batch mean."""
+    import optax
+    from adapcc_tpu.strategy.ir import Strategy
+
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.normal(size=(6, 4)) * 0.3, jnp.float32)}
+
+    def loss_fn(p, b):
+        x, y = b
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    x = jnp.asarray(rng.normal(size=(16, 6)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(16, 4)), jnp.float32)
+    tx = optax.adam(1e-2)
+
+    def run(accum):
+        tr = DDPTrainer(
+            loss_fn, tx, mesh8, Strategy.ring(8), accum_steps=accum,
+        )
+        st = TrainState.create(jax.tree_util.tree_map(jnp.array, params), tx)
+        losses = []
+        for _ in range(3):
+            st, loss = tr.step(st, (x, y))
+            losses.append(float(jnp.mean(loss)))
+        return st, losses
+
+    st1, l1 = run(1)
+    st2, l2 = run(2)
+    np.testing.assert_allclose(l2, l1, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(st2.params["w"]), np.asarray(st1.params["w"]), rtol=1e-6, atol=1e-7
+    )
+
+
+def test_accum_steps_rejects_nondivisible(mesh8):
+    import optax
+    from adapcc_tpu.strategy.ir import Strategy
+
+    def loss_fn(p, b):
+        return jnp.mean((b @ p["w"]) ** 2)
+
+    tr = DDPTrainer(
+        loss_fn, optax.sgd(0.1), mesh8, Strategy.ring(8), accum_steps=3,
+    )
+    st = TrainState.create({"w": jnp.ones((4, 2))}, optax.sgd(0.1))
+    batch = jnp.ones((16, 4))  # 2 per rank, not divisible by 3
+    with pytest.raises(ValueError, match="not divisible by accum_steps"):
+        tr.step(st, batch)
+
+
+def test_accum_steps_in_scan_steps(mesh8):
+    """Accumulation composes with the scanned multi-step dispatch."""
+    import optax
+    from adapcc_tpu.strategy.ir import Strategy
+
+    def loss_fn(p, b):
+        return jnp.mean((b @ p["w"]) ** 2)
+
+    tx = optax.sgd(0.05)
+    tr = DDPTrainer(loss_fn, tx, mesh8, Strategy.ring(8), accum_steps=2)
+    st = TrainState.create({"w": jnp.ones((4, 2))}, tx)
+    batch = jnp.asarray(np.random.default_rng(1).normal(size=(16, 4)), jnp.float32)
+    st, losses = tr.scan_steps(st, batch, 3)
+    assert losses.shape == (8, 3)
+    l = np.asarray(losses).mean(axis=0)
+    assert l[-1] < l[0]
