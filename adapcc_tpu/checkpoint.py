@@ -211,6 +211,36 @@ class CheckpointManager:
         state.apply_snapshot(restored)
         return True
 
+    # -- sharded (FSDP/ZeRO) pytrees ------------------------------------------
+
+    def save_sharded(self, step: int, tree: Any) -> None:
+        """Save a pytree of (possibly sharded) ``jax.Array``s.
+
+        Each process writes only its addressable shards — FSDP/ZeRO state
+        checkpoints at 1/world of the HBM and never materializes the full
+        parameter on any host, unlike the msgpack path above (which is for
+        small replicated state).
+        """
+        self._mgr.save(step, args=self._ocp.args.StandardSave(tree))
+        self._mgr.wait_until_finished()
+
+    def restore_sharded(self, target: Any, step: Optional[int] = None) -> Any:
+        """Restore into ``target``'s layout: a pytree of arrays (their
+        shardings are reused) or ``jax.ShapeDtypeStruct``s with shardings.
+        Returns the restored tree, sharded as the target prescribes —
+        restore-time resharding (e.g. onto a different world size) is
+        orbax's job, not a host gather."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint step in {self.directory}")
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            if isinstance(x, jax.Array)
+            else x,
+            target,
+        )
+        return self._mgr.restore(step, args=self._ocp.args.StandardRestore(abstract))
+
     def close(self) -> None:
         self._mgr.close()
 

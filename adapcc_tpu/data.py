@@ -45,29 +45,47 @@ def prefetch_to_device(
     if size < 1:
         raise ValueError(f"prefetch size must be >= 1, got {size}")
     q: queue.Queue = queue.Queue(maxsize=size)
+    stop = threading.Event()  # consumer gone: unblock + stop the producer
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def produce() -> None:
         try:
             for batch in it:
+                if stop.is_set():
+                    return
                 if sharding is not None:
                     batch = jax.device_put(batch, sharding)
                 else:
                     batch = jax.device_put(batch)
-                q.put(batch)
+                if not _put(batch):
+                    return
         except BaseException as e:  # noqa: BLE001 — re-raised at the consumer
-            q.put(("__prefetch_error__", e))
+            _put(("__prefetch_error__", e))
             return
-        q.put(_END)
+        _put(_END)
 
     t = threading.Thread(target=produce, daemon=True, name="adapcc-prefetch")
     t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            return
-        if isinstance(item, tuple) and len(item) == 2 and item[0] == "__prefetch_error__":
-            raise RuntimeError("prefetch producer failed") from item[1]
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, tuple) and len(item) == 2 and item[0] == "__prefetch_error__":
+                raise RuntimeError("prefetch producer failed") from item[1]
+            yield item
+    finally:
+        # an abandoned iterator (break / exception in the consumer) must not
+        # leave the producer blocked holding device batches alive
+        stop.set()
 
 
 def batch_indices(

@@ -58,18 +58,6 @@ def pack_sequences(stream: np.ndarray, seq_len: int) -> np.ndarray:
     return stream[: n * seq_len].reshape(n, seq_len)
 
 
-def lm_batches(
-    packed: np.ndarray, batch: int, seed: int
-) -> Iterator[np.ndarray]:
-    """Shuffled full batches of packed sequences (host-side; the training
-    loop uses :func:`adapcc_tpu.data.device_batches`, which shares the same
-    index semantics and adds async device prefetch)."""
-    from adapcc_tpu.data import batch_indices
-
-    for idx in batch_indices(len(packed), batch, seed):
-        yield packed[idx]
-
-
 # --- evaluation (convai_evaluation.py analog: perplexity + hits@1) ------------
 
 

@@ -6,7 +6,6 @@ import pytest
 from adapcc_tpu.workloads.train_gpt2 import (
     build_parser,
     evaluate_perplexity,
-    lm_batches,
     markov_corpus,
     pack_sequences,
     run,
@@ -31,7 +30,9 @@ def test_pack_and_batch():
     packed = pack_sequences(np.arange(103, dtype=np.int32), 10)
     assert packed.shape == (10, 10)
     assert packed[0, 0] == 0 and packed[9, 9] == 99  # tail dropped
-    got = list(lm_batches(packed, batch=4, seed=0))
+    from adapcc_tpu.data import batch_indices
+
+    got = [packed[i] for i in batch_indices(len(packed), 4, seed=0)]
     assert len(got) == 2 and got[0].shape == (4, 10)
 
 
