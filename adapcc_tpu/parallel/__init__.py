@@ -25,6 +25,8 @@ from adapcc_tpu.parallel.expert import expert_parallel_moe
 from adapcc_tpu.parallel.fsdp import (
     Zero1Optimizer,
     fsdp_shardings,
+    fsdp_tp_shardings,
+    fsdp_tp_train_step,
     fsdp_train_step,
     shard_fsdp,
     zero1_train_step,
@@ -45,6 +47,8 @@ __all__ = [
     "expert_parallel_moe",
     "Zero1Optimizer",
     "fsdp_shardings",
+    "fsdp_tp_shardings",
+    "fsdp_tp_train_step",
     "fsdp_train_step",
     "shard_fsdp",
     "zero1_train_step",

@@ -116,6 +116,26 @@ def fsdp_train_step(
     in_shardings, so the update is a stable fixed point under donation).
     """
 
+    return _sharded_train_step(
+        loss_fn, tx, mesh,
+        lambda tree: fsdp_shardings(tree, mesh, axis_name, min_shard_elems),
+        batch_spec=P(axis_name),
+        donate=donate,
+    )
+
+
+def _sharded_train_step(
+    loss_fn: Callable,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    shardings_of: Callable[[Any], Any],
+    batch_spec: P,
+    donate: bool,
+) -> Callable:
+    """Shared engine for every GSPMD sharded-state step variant: state lives
+    in the layout ``shardings_of`` assigns, out_shardings = in_shardings so
+    the update is a stable fixed point under donation."""
+
     def step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         updates, opt_state = tx.update(grads, opt_state, params)
@@ -123,17 +143,12 @@ def fsdp_train_step(
         return params, opt_state, loss
 
     def compile_for(params: Any, opt_state: Any) -> Callable:
-        p_sh = fsdp_shardings(params, mesh, axis_name, min_shard_elems)
-        o_sh = jax.tree_util.tree_map(
-            # optax state mirrors the param tree per-transform; non-array
-            # leaves (e.g. count scalars) replicate
-            lambda leaf: fsdp_shardings(leaf, mesh, axis_name, min_shard_elems)
-            if hasattr(leaf, "shape")
-            else NamedSharding(mesh, P()),
-            opt_state,
-            is_leaf=lambda x: hasattr(x, "shape"),
-        )
-        b_sh = NamedSharding(mesh, P(axis_name))
+        p_sh = shardings_of(params)
+        # optax state mirrors the param tree per-transform, so the same rule
+        # tree-maps over it: moment buffers inherit their parameter's layout,
+        # scalars (count) fall to replicated
+        o_sh = shardings_of(opt_state)
+        b_sh = NamedSharding(mesh, batch_spec)
         return jax.jit(
             step,
             in_shardings=(p_sh, o_sh, b_sh),
@@ -157,6 +172,69 @@ def fsdp_train_step(
 def _tree_key(tree: Any) -> Tuple:
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     return (treedef, tuple((jnp.shape(l), jnp.result_type(l)) for l in leaves))
+
+
+# -- FSDP × TP: 2D sharding over a (data, model) mesh -------------------------
+
+
+def fsdp_tp_shardings(
+    params: Any,
+    mesh: Mesh,
+    tp_rules: Any,
+    data_axis: str = "data",
+    min_shard_elems: int = 2**14,
+) -> Any:
+    """2D layout: Megatron TP rules claim their dims over the model axis,
+    then FSDP shards the largest *free* divisible dim over the data axis —
+    the scaling-book "FSDP + tensor parallelism" composition.  A leaf whose
+    only divisible dim is TP-claimed stays 1D-sharded; small leaves get no
+    additional data-axis sharding (TP-ruled small leaves keep their TP
+    spec, unruled ones stay replicated).
+    """
+    from adapcc_tpu.parallel.tensor import tree_shardings
+
+    tp = tree_shardings(params, mesh, tp_rules)
+    data_size = mesh.shape[data_axis]
+
+    def combine(leaf, tp_sh):
+        shape = jnp.shape(leaf)
+        spec = list(tp_sh.spec) + [None] * (len(shape) - len(tp_sh.spec))
+        if shape and int(np.prod(shape)) >= min_shard_elems:
+            best, best_size = None, 0
+            for i, d in enumerate(shape):
+                if spec[i] is not None:
+                    continue
+                if d % data_size == 0 and d >= best_size:
+                    best, best_size = i, d
+            if best is not None:
+                spec[best] = data_axis
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree_util.tree_map(combine, params, tp)
+
+
+def fsdp_tp_train_step(
+    loss_fn: Callable[[Any, Any], jnp.ndarray],
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    tp_rules: Any,
+    data_axis: str = "data",
+    donate: bool = True,
+    min_shard_elems: int = 2**14,
+) -> Callable:
+    """FSDP over ``data_axis`` × tensor parallel per ``tp_rules``: params and
+    optimizer state live 2D-sharded, batch shards over the data axis, and XLA
+    inserts the per-axis collectives (all-gather on use over data, psum of
+    row-parallel partials over model) — one jitted program on one mesh.
+    """
+    return _sharded_train_step(
+        loss_fn, tx, mesh,
+        lambda tree: fsdp_tp_shardings(
+            tree, mesh, tp_rules, data_axis, min_shard_elems
+        ),
+        batch_spec=P(data_axis),
+        donate=donate,
+    )
 
 
 # -- ZeRO-1: sharded optimizer state over the flat gradient vector ------------

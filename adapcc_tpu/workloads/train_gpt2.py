@@ -21,7 +21,7 @@ Run (virtual pod):
 from __future__ import annotations
 
 import argparse
-from typing import Iterator, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 
