@@ -199,12 +199,17 @@ class GPT2(nn.Module):
         deterministic: bool = True,
         decode: bool = False,
         pos: Optional[jnp.ndarray] = None,
+        return_hidden: bool = False,
     ) -> jnp.ndarray:
         """``tokens [B, T] int32`` → logits ``[B, T, vocab] float32``.
 
         ``decode=True`` runs one-token autoregressive steps against a mutable
         ``'cache'`` collection; ``pos`` (int32 scalar) is the absolute
         position of the fed token (required in decode mode).
+        ``return_hidden=True`` skips the LM head and returns the post-ln_f
+        ``[B, T, d_model]`` hiddens — for the chunked vocab loss
+        (ops/chunked_ce.py), which fuses the head matmul into the loss and
+        never materializes ``[B, T, vocab]``.
         """
         cfg = self.cfg
         B, T = tokens.shape
@@ -243,6 +248,8 @@ class GPT2(nn.Module):
             x = block(cfg, name=f"h{i}")(x, deterministic, decode)
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if return_hidden:
+            return x
         # weight-tied LM head
         logits = x.astype(cfg.dtype) @ wte.embedding.T.astype(cfg.dtype)
         return logits.astype(jnp.float32)
@@ -255,6 +262,22 @@ def lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
+
+
+def lm_loss_chunked(
+    model: "GPT2", params, tokens: jnp.ndarray, block: int = 1024
+) -> jnp.ndarray:
+    """:func:`lm_loss` without the ``[B, T, vocab]`` logits tensor: the model
+    returns post-ln_f hiddens and the weight-tied head matmul fuses into the
+    chunked online-softmax loss (ops/chunked_ce.py).  Same math — head in
+    ``cfg.dtype``, fp32 softmax — at 1/(vocab/block) of the logits HBM.
+    Gradients flow to ``wte`` through both its embedding use and the head.
+    """
+    from adapcc_tpu.ops.chunked_ce import chunked_lm_loss
+
+    hidden = model.apply(params, tokens, return_hidden=True)
+    wte = params["params"]["wte"]["embedding"]
+    return chunked_lm_loss(hidden, wte, tokens, block, model.cfg.dtype)
 
 
 def lm_loss_sp(logits: jnp.ndarray, tokens: jnp.ndarray, axis_name: str) -> jnp.ndarray:

@@ -1,5 +1,12 @@
-"""Hand-tuned Pallas TPU kernels for the hot ops."""
+"""Hand-tuned kernels for the hot ops: Pallas flash attention, chunked
+(online-softmax) vocab cross-entropy."""
 
 from adapcc_tpu.ops.flash_attention import flash_attention, flash_attention_with_lse
+from adapcc_tpu.ops.chunked_ce import chunked_lm_loss, chunked_softmax_xent
 
-__all__ = ["flash_attention", "flash_attention_with_lse"]
+__all__ = [
+    "flash_attention",
+    "flash_attention_with_lse",
+    "chunked_lm_loss",
+    "chunked_softmax_xent",
+]

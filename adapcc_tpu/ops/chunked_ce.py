@@ -1,0 +1,154 @@
+"""Chunked (online-softmax) cross-entropy over the vocabulary.
+
+The LM head is the memory hog of GPT-2 training: ``logits = x @ wte.T`` is a
+``[B, T, V]`` fp32 tensor (512 MB at bench sizes 16×512×16384) that the loss
+reads once, the backward re-reads, and XLA materializes in HBM between the
+two.  This op never forms it: the forward scans the vocabulary in blocks
+maintaining the online-softmax running ``(max, sumexp)`` statistics — the
+same trick flash attention plays over keys (ops/flash_attention.py), applied
+over the vocab axis — and the backward recomputes each block's logits from
+the residuals, so peak live memory is one ``[N, block]`` tile instead of
+``[N, V]``.  Each block is still a big MXU matmul, so FLOP efficiency is
+unchanged; only HBM traffic drops.
+
+Arbitrary vocab sizes are handled by zero-padding ``w`` to a block multiple
+and masking the padded columns to ``-inf`` before the softmax statistics
+(their contribution is exactly zero in both passes), so a prime vocab pays
+one partial block, not a degenerate block=1 scan.
+
+Reference counterpart being improved on: the reference's workloads compute
+full-vocab HF GPT-2 logits and torch CE over them (models/gpt2/
+train_gpt2_ddp.py loss path); there is no memory-efficient variant there.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_logits(x, w_blk, off, V, compute_dtype):
+    """One vocab block's logits ``[N, C]`` in fp32, padded columns (global
+    index >= V) forced to ``-inf`` so they vanish from softmax statistics."""
+    logits = (
+        x.astype(compute_dtype) @ w_blk.T.astype(compute_dtype)
+    ).astype(jnp.float32)
+    C = logits.shape[-1]
+    valid = (off + jnp.arange(C)) < V  # [C]
+    return jnp.where(valid[None, :], logits, -jnp.inf)
+
+
+def _pad_blocks(w, block):
+    V, D = w.shape
+    pad = (-V) % block
+    if pad:
+        w = jnp.concatenate([w, jnp.zeros((pad, D), w.dtype)])
+    return w.reshape((V + pad) // block, block, D), V
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def chunked_softmax_xent(
+    x: jnp.ndarray,
+    w: jnp.ndarray,
+    y: jnp.ndarray,
+    block: int = 1024,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> jnp.ndarray:
+    """Mean cross-entropy of ``softmax(x @ w.T)`` against labels ``y``.
+
+    ``x [N, D]`` activations, ``w [V, D]`` (the tied embedding), ``y [N]``
+    int labels.  Equivalent to ``-mean(log_softmax(x @ w.T)[n, y[n]])`` with
+    the matmul in ``compute_dtype`` and softmax statistics in fp32, never
+    materializing more than one ``[N, block]`` logit tile.  Any ``V`` works;
+    a non-multiple pays one zero-padded block.
+    """
+    loss, _ = _fwd_scan(x, w, y, block, compute_dtype)
+    return loss
+
+
+def _fwd_scan(x, w, y, block, compute_dtype):
+    N = x.shape[0]
+    w_blocks, V = _pad_blocks(w, block)
+    offs = jnp.arange(w_blocks.shape[0]) * block
+
+    def body(carry, inp):
+        m, s, t = carry
+        w_blk, off = inp
+        logits = _block_logits(x, w_blk, off, V, compute_dtype)  # [N, C]
+        C = logits.shape[-1]
+        m_b = jnp.max(logits, axis=-1)  # [N]
+        s_b = jnp.sum(jnp.exp(logits - m_b[:, None]), axis=-1)
+        m_new = jnp.maximum(m, m_b)
+        s = s * jnp.exp(m - m_new) + s_b * jnp.exp(m_b - m_new)
+        # the target logit, when it falls inside this block
+        y_local = y - off
+        in_blk = (y_local >= 0) & (y_local < C)
+        t_b = jnp.take_along_axis(
+            logits, jnp.clip(y_local, 0, C - 1)[:, None], axis=-1
+        )[:, 0]
+        t = t + jnp.where(in_blk, t_b, 0.0)
+        return (m_new, s, t), None
+
+    init = (
+        jnp.full((N,), -jnp.inf, jnp.float32),
+        jnp.zeros((N,), jnp.float32),
+        jnp.zeros((N,), jnp.float32),
+    )
+    (m, s, t), _ = lax.scan(body, init, (w_blocks, offs))
+    lse = jnp.log(s) + m  # [N]
+    loss = jnp.mean(lse - t)
+    return loss, lse
+
+
+def _vjp_fwd(x, w, y, block, compute_dtype):
+    loss, lse = _fwd_scan(x, w, y, block, compute_dtype)
+    return loss, (x, w, y, lse)
+
+
+def _vjp_bwd(block, compute_dtype, res, g):
+    x, w, y, lse = res
+    N, D = x.shape
+    w_blocks, V = _pad_blocks(w, block)
+    offs = jnp.arange(w_blocks.shape[0]) * block
+    scale = g / N  # d(mean)/d(per-row)
+
+    def body(dx, inp):
+        w_blk, off = inp
+        logits = _block_logits(x, w_blk, off, V, compute_dtype)
+        p = jnp.exp(logits - lse[:, None])  # block softmax [N, C]; 0 at pads
+        y_local = y - off
+        onehot = (
+            y_local[:, None] == jnp.arange(logits.shape[-1])[None, :]
+        ).astype(jnp.float32)
+        dl = ((p - onehot) * scale).astype(compute_dtype)
+        dx = dx + (dl @ w_blk.astype(compute_dtype)).astype(jnp.float32)
+        dw_blk = (dl.T @ x.astype(compute_dtype)).astype(jnp.float32)
+        return dx, dw_blk
+
+    dx, dw_blocks = lax.scan(body, jnp.zeros((N, D), jnp.float32), (w_blocks, offs))
+    dw = dw_blocks.reshape(-1, D)[:V]
+    return dx.astype(x.dtype), dw.astype(w.dtype), None
+
+
+chunked_softmax_xent.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+def chunked_lm_loss(
+    hidden: jnp.ndarray,
+    wte: jnp.ndarray,
+    tokens: jnp.ndarray,
+    block: int = 1024,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> jnp.ndarray:
+    """Next-token LM loss from post-LayerNorm hiddens — the drop-in
+    memory-efficient replacement for ``lm_loss(model.apply(...), tokens)``:
+    identical math (positions ``:-1`` against targets ``1:``, weight-tied
+    head in ``compute_dtype``), no ``[B, T, V]`` materialization.
+    """
+    B, T, D = hidden.shape
+    x = hidden[:, :-1].reshape(B * (T - 1), D)
+    y = tokens[:, 1:].reshape(B * (T - 1))
+    return chunked_softmax_xent(x, wte, y, block, compute_dtype)

@@ -170,6 +170,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "batch) over the world — the long-context regime")
     p.add_argument("--attn", choices=("xla", "flash"), default="xla",
                    help="block attention implementation (flash = Pallas kernel)")
+    p.add_argument("--loss", choices=("dense", "chunked"), default="dense",
+                   help="LM loss: dense materializes [B,T,vocab] logits; "
+                        "chunked fuses the head into an online-softmax scan")
     return p
 
 
@@ -212,8 +215,23 @@ def run(args) -> Tuple[float, float]:
     model = GPT2(cfg)
     params = model.init(jax.random.PRNGKey(0), jnp.asarray(train_set[:1]))
 
-    def loss_fn(p, b):
-        return lm_loss(model.apply(p, b), b)
+    if args.loss == "chunked":
+        if args.sp != "none":
+            raise ValueError(
+                "--loss chunked is not wired into the sequence-parallel step "
+                "(gpt2_sp_train_step computes its own sharded loss); drop "
+                "--sp or use --loss dense"
+            )
+        # fuse the LM head into the online-softmax loss: no [B, T, vocab]
+        # logits tensor (ops/chunked_ce.py) — the long-vocab memory saver
+        from adapcc_tpu.models.gpt2 import lm_loss_chunked
+
+        def loss_fn(p, b):
+            return lm_loss_chunked(model, p, b, block=min(1024, args.vocab))
+    else:
+
+        def loss_fn(p, b):
+            return lm_loss(model.apply(p, b), b)
 
     steps_per_epoch = max(1, len(train_set) // args.batch)
     schedule = optax.warmup_cosine_decay_schedule(

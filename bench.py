@@ -235,8 +235,19 @@ def main() -> None:
         _RESULT["attention"] = attention
         _RESULT["param_dtype"] = param_dtype
 
-        def loss_fn(p, b):
-            return lm_loss(model.apply(p, b), b)
+        # BENCH_LOSS=chunked fuses the LM head into an online-softmax scan
+        # (ops/chunked_ce.py): no [B,T,V] logits in HBM, one recompute in bwd
+        loss_impl = os.environ.get("BENCH_LOSS", "dense")
+        _RESULT["loss_impl"] = loss_impl
+        if loss_impl == "chunked":
+            from adapcc_tpu.models.gpt2 import lm_loss_chunked
+
+            def loss_fn(p, b):
+                return lm_loss_chunked(model, p, b, block=2048)
+        else:
+
+            def loss_fn(p, b):
+                return lm_loss(model.apply(p, b), b)
 
         tx = optax.adamw(3e-4)
 
