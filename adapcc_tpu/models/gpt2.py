@@ -34,6 +34,13 @@ class GPT2Config:
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False
+    #: rematerialization granularity when ``remat`` is on: "full" recomputes
+    #: everything in the block (max HBM savings, ~1/3 extra FLOPs); "dots"
+    #: saves matmul outputs and recomputes only the cheap elementwise ops
+    #: (jax.checkpoint_policies.checkpoint_dots) — the usual TPU sweet spot,
+    #: since MXU FLOPs are the scarce resource and elementwise recompute is
+    #: nearly free against HBM-bound steps
+    remat_policy: str = "full"
     #: "xla" materializes [T, T] scores and lets XLA fuse; "flash" runs the
     #: blockwise Pallas kernel (ops/flash_attention.py) — O(T) memory, MXU
     #: tiles, no attention-matrix HBM traffic.  Training path only (decode
@@ -243,7 +250,20 @@ class GPT2(nn.Module):
 
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, static_argnums=(2, 3))
+            policies = {
+                "full": None,  # recompute everything
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+                "dots_no_batch": (
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                ),
+            }
+            if cfg.remat_policy not in policies:
+                raise ValueError(
+                    f"remat_policy {cfg.remat_policy!r} not in {sorted(policies)}"
+                )
+            block = nn.remat(
+                Block, static_argnums=(2, 3), policy=policies[cfg.remat_policy]
+            )
         for i in range(cfg.n_layer):
             x = block(cfg, name=f"h{i}")(x, deterministic, decode)
 

@@ -28,8 +28,9 @@ in the baseline path) still leaves the framework numbers in the JSON with
 Size knobs via env (defaults target a single v5e chip):
     BENCH_LAYERS, BENCH_DMODEL, BENCH_HEADS, BENCH_SEQ, BENCH_BATCH,
     BENCH_STEPS, BENCH_WORLD, BENCH_PEAK_TFLOPS, BENCH_ATTN (flash|xla),
-    BENCH_PARAM_DTYPE (bf16|f32), BENCH_PREFLIGHT_S, BENCH_ATTEMPTS,
-    BENCH_DEADLINE
+    BENCH_PARAM_DTYPE (bf16|f32), BENCH_LOSS (dense|chunked),
+    BENCH_REMAT (off|full|dots|dots_no_batch), BENCH_SCAN (1|0),
+    BENCH_PREFLIGHT_S, BENCH_ATTEMPTS, BENCH_DEADLINE
 """
 
 from __future__ import annotations
@@ -206,6 +207,14 @@ def main() -> None:
         world = _env_int("BENCH_WORLD", 0) or len(jax.devices())
         mesh = build_world_mesh(world)
 
+        remat_env = os.environ.get("BENCH_REMAT", "").strip().lower()
+        if remat_env in ("", "0", "off", "false"):
+            remat_policy = None
+        elif remat_env in ("dots", "dots_no_batch", "full"):
+            remat_policy = remat_env
+        else:  # generic truthy: 1/on/yes → full recompute
+            remat_policy = "full"
+
         attention = _pick_attention()
         cfg = GPT2Config(
             vocab_size=16384,
@@ -214,7 +223,12 @@ def main() -> None:
             n_head=_env_int("BENCH_HEADS", 16),
             d_model=_env_int("BENCH_DMODEL", 1024),
             attention=attention,
+            # BENCH_REMAT: unset/""/"0"/"off" = no remat; "dots" |
+            # "dots_no_batch" pick a policy; any other truthy value = "full"
+            remat=remat_policy is not None,
+            remat_policy=remat_policy or "full",
         )
+        _RESULT["remat"] = remat_policy or "off"
         per_rank_batch = _env_int("BENCH_BATCH", 16)
         batch = per_rank_batch * world
         steps = _env_int("BENCH_STEPS", 10)

@@ -102,3 +102,31 @@ def test_moe_gradients_flow_to_experts():
     g = jax.grad(loss)(params)
     w1g = g["params"]["w1"]
     assert float(jnp.linalg.norm(w1g)) > 0
+
+
+@pytest.mark.parametrize("policy", ["dots", "dots_no_batch"])
+def test_gpt2_remat_policies_match(policy):
+    """Policy-based remat changes the memory/FLOP trade, not the function:
+    forward and gradients equal the non-remat model."""
+    import dataclasses
+
+    cfg = GPT2Config.tiny()
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (1, 16), 0, cfg.vocab_size)
+    params = GPT2(cfg).init(jax.random.PRNGKey(0), tokens)
+    cfg_r = dataclasses.replace(cfg, remat=True, remat_policy=policy)
+    out_a = GPT2(cfg).apply(params, tokens)
+    out_b = GPT2(cfg_r).apply(params, tokens)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), atol=1e-5)
+    ga = jax.grad(lambda p: lm_loss(GPT2(cfg).apply(p, tokens), tokens))(params)
+    gb = jax.grad(lambda p: lm_loss(GPT2(cfg_r).apply(p, tokens), tokens))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(ga), jax.tree_util.tree_leaves(gb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_gpt2_remat_policy_validated():
+    import dataclasses
+
+    cfg = dataclasses.replace(GPT2Config.tiny(), remat=True, remat_policy="bogus")
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="remat_policy"):
+        GPT2(cfg).init(jax.random.PRNGKey(0), tokens)
