@@ -1,0 +1,107 @@
+"""One-shot hardware evidence battery for the flaky-tunnel regime.
+
+The axon tunnel has been mostly wedged this round; when a liveness window
+opens it may close again within minutes.  This driver runs the whole
+measurement battery in priority order, each phase in its own subprocess
+with a hard deadline, appending one JSON line per phase to
+``benchmarks/results/hw_<tag>.jsonl`` as soon as it finishes — so a tunnel
+death mid-battery keeps everything measured so far.
+
+Phases (priority order):
+  1. probe        — tiny jit; records device kind (seconds)
+  2. profile      — benchmarks/profile_step.py attribution (dispatch floor,
+                    MXU rate, forward/grad/train MFU)
+  3. bench        — flagship bench.py, default config (flash + bf16 + scan)
+  4. bench_chunk  — bench.py with BENCH_LOSS=chunked
+  5. bench_remat  — bench.py with BENCH_REMAT=dots
+  6. busbw        — benchmarks/collectives.py on the real chip (world=1)
+
+Usage::
+
+    python -m benchmarks.hw_session [tag]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(name: str, cmd, timeout: int, out_path: str, extra_env=None) -> dict:
+    env = {**os.environ, **(extra_env or {})}
+    t0 = time.time()
+    rec: dict = {"phase": name, "cmd": " ".join(cmd)}
+    try:
+        p = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env
+        )
+        rec["rc"] = p.returncode
+        rec["secs"] = round(time.time() - t0, 1)
+        tail = (p.stdout or "").strip().splitlines()
+        rec["last_line"] = tail[-1] if tail else ""
+        # bench/profile print one JSON line last — keep it parsed when possible
+        try:
+            rec["parsed"] = json.loads(rec["last_line"])
+        except (json.JSONDecodeError, ValueError):
+            rec["stderr_tail"] = (p.stderr or "")[-400:]
+    except subprocess.TimeoutExpired:
+        rec["rc"] = -1
+        rec["secs"] = round(time.time() - t0, 1)
+        rec["error"] = f"timeout after {timeout}s"
+    with open(out_path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(f"[hw] {name}: rc={rec.get('rc')} ({rec['secs']}s)", flush=True)
+    return rec
+
+
+def main() -> int:
+    tag = sys.argv[1] if len(sys.argv) > 1 else "r03"
+    out = os.path.join(REPO, "benchmarks", "results", f"hw_{tag}.jsonl")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    py = sys.executable
+
+    probe_code = (
+        # honor JAX_PLATFORMS if set (the axon sitecustomize overrides the
+        # env var; unset = the real TPU default)
+        "import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+        "p and jax.config.update('jax_platforms', p); "
+        "import jax.numpy as jnp, json; d = jax.devices(); "
+        "jax.jit(lambda a: a + 1)(jnp.ones(8)).block_until_ready(); "
+        "print(json.dumps({'device': str(d[0]), "
+        "'kind': getattr(d[0], 'device_kind', '?')}))"
+    )
+    probe = _run("probe", [py, "-c", probe_code], 120, out)
+    if probe.get("rc") != 0:
+        print("[hw] tunnel dead at probe; aborting battery", flush=True)
+        return 1
+
+    trace_dir = os.path.join(REPO, "benchmarks", "results", f"trace_{tag}")
+    _run(
+        "profile", [py, "-m", "benchmarks.profile_step"], 900, out,
+        {"PROFILE_TRACE_DIR": trace_dir},
+    )
+    _run("bench", [py, "bench.py"], 1600, out, {"BENCH_DEADLINE": "1500"})
+    _run(
+        "bench_chunk", [py, "bench.py"], 1600, out,
+        {"BENCH_DEADLINE": "1500", "BENCH_LOSS": "chunked"},
+    )
+    _run(
+        "bench_remat", [py, "bench.py"], 1600, out,
+        {"BENCH_DEADLINE": "1500", "BENCH_REMAT": "dots"},
+    )
+    _run(
+        "busbw",
+        [py, "-m", "benchmarks.collectives", "--world", "1", "--sizes", "4K,1M,16M,128M"],
+        900, out,
+    )
+    print(f"[hw] battery complete → {out}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
