@@ -2,11 +2,16 @@
 (online-softmax) vocab cross-entropy."""
 
 from adapcc_tpu.ops.flash_attention import flash_attention, flash_attention_with_lse
-from adapcc_tpu.ops.chunked_ce import chunked_lm_loss, chunked_softmax_xent
+from adapcc_tpu.ops.chunked_ce import (
+    chunked_lm_loss,
+    chunked_softmax_xent,
+    chunked_softmax_xent_shard,
+)
 
 __all__ = [
     "flash_attention",
     "flash_attention_with_lse",
     "chunked_lm_loss",
     "chunked_softmax_xent",
+    "chunked_softmax_xent_shard",
 ]

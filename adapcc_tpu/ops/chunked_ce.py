@@ -16,6 +16,10 @@ and masking the padded columns to ``-inf`` before the softmax statistics
 (their contribution is exactly zero in both passes), so a prime vocab pays
 one partial block, not a degenerate block=1 scan.
 
+``chunked_softmax_xent_shard`` is the vocab-parallel (tensor-parallel)
+variant for ``shard_map`` bodies: each rank scans only its vocab shard and
+the stats merge across the axis with one ``pmax`` + two ``psum``.
+
 Reference counterpart being improved on: the reference's workloads compute
 full-vocab HF GPT-2 logits and torch CE over them (models/gpt2/
 train_gpt2_ddp.py loss path); there is no memory-efficient variant there.
@@ -31,7 +35,7 @@ from jax import lax
 
 
 def _block_logits(x, w_blk, off, V, compute_dtype):
-    """One vocab block's logits ``[N, C]`` in fp32, padded columns (global
+    """One vocab block's logits ``[N, C]`` in fp32, padded columns (local
     index >= V) forced to ``-inf`` so they vanish from softmax statistics."""
     logits = (
         x.astype(compute_dtype) @ w_blk.T.astype(compute_dtype)
@@ -47,6 +51,75 @@ def _pad_blocks(w, block):
     if pad:
         w = jnp.concatenate([w, jnp.zeros((pad, D), w.dtype)])
     return w.reshape((V + pad) // block, block, D), V
+
+
+def _stats_scan(x, w, y_local, block, compute_dtype):
+    """The shared online-softmax core: scan ``w``'s blocks accumulating
+    running ``(max, sumexp@max, target-logit)`` over rows of ``x``.
+
+    ``y_local`` is the target id in this weight matrix's local index space;
+    ids outside ``[0, V)`` (another shard's target, or the zero-pad tail)
+    contribute nothing to the target accumulator.
+    """
+    N = x.shape[0]
+    w_blocks, V = _pad_blocks(w, block)
+    offs = jnp.arange(w_blocks.shape[0]) * block
+    # a pad-tail id passes the per-block range test but its logit is -inf;
+    # the ownership guard keeps it (and other shards' targets) out of t
+    y_mine = (y_local >= 0) & (y_local < V)
+
+    def body(carry, inp):
+        m, s, t = carry
+        w_blk, off = inp
+        logits = _block_logits(x, w_blk, off, V, compute_dtype)
+        C = logits.shape[-1]
+        m_b = jnp.max(logits, axis=-1)
+        s_b = jnp.sum(jnp.exp(logits - m_b[:, None]), axis=-1)
+        m_new = jnp.maximum(m, m_b)
+        s = s * jnp.exp(m - m_new) + s_b * jnp.exp(m_b - m_new)
+        yb = y_local - off
+        in_blk = (yb >= 0) & (yb < C) & y_mine
+        t_b = jnp.take_along_axis(logits, jnp.clip(yb, 0, C - 1)[:, None], axis=-1)[:, 0]
+        t = t + jnp.where(in_blk, t_b, 0.0)
+        return (m_new, s, t), None
+
+    init = (
+        jnp.full((N,), -jnp.inf, jnp.float32),
+        jnp.zeros((N,), jnp.float32),
+        jnp.zeros((N,), jnp.float32),
+    )
+    carry, _ = lax.scan(body, init, (w_blocks, offs))
+    return carry  # (m, s, t), each [N]
+
+
+def _bwd_scan(x, w, y_local, lse, scale, block, compute_dtype):
+    """The shared backward core: recompute each block's logits against the
+    (global) ``lse``, form ``dlogits = (softmax - onehot)·scale``, and
+    accumulate ``dx`` (local, un-psum'd) and per-block ``dw``."""
+    N, D = x.shape
+    w_blocks, V = _pad_blocks(w, block)
+    offs = jnp.arange(w_blocks.shape[0]) * block
+    y_mine = (y_local >= 0) & (y_local < V)
+
+    def body(dx, inp):
+        w_blk, off = inp
+        logits = _block_logits(x, w_blk, off, V, compute_dtype)
+        p = jnp.exp(logits - lse[:, None])  # softmax columns; 0 at pads
+        yb = y_local - off
+        onehot = (
+            (yb[:, None] == jnp.arange(logits.shape[-1])[None, :])
+            & y_mine[:, None]
+        ).astype(jnp.float32)
+        dl = ((p - onehot) * scale).astype(compute_dtype)
+        dx = dx + (dl @ w_blk.astype(compute_dtype)).astype(jnp.float32)
+        dw_blk = (dl.T @ x.astype(compute_dtype)).astype(jnp.float32)
+        return dx, dw_blk
+
+    dx, dw_blocks = lax.scan(body, jnp.zeros((N, D), jnp.float32), (w_blocks, offs))
+    return dx, dw_blocks.reshape(-1, D)[:V]
+
+
+# -- single-device (or GSPMD-replicated) variant -------------------------------
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -65,75 +138,87 @@ def chunked_softmax_xent(
     materializing more than one ``[N, block]`` logit tile.  Any ``V`` works;
     a non-multiple pays one zero-padded block.
     """
-    loss, _ = _fwd_scan(x, w, y, block, compute_dtype)
+    loss, _ = _fwd(x, w, y, block, compute_dtype)
     return loss
 
 
-def _fwd_scan(x, w, y, block, compute_dtype):
-    N = x.shape[0]
-    w_blocks, V = _pad_blocks(w, block)
-    offs = jnp.arange(w_blocks.shape[0]) * block
-
-    def body(carry, inp):
-        m, s, t = carry
-        w_blk, off = inp
-        logits = _block_logits(x, w_blk, off, V, compute_dtype)  # [N, C]
-        C = logits.shape[-1]
-        m_b = jnp.max(logits, axis=-1)  # [N]
-        s_b = jnp.sum(jnp.exp(logits - m_b[:, None]), axis=-1)
-        m_new = jnp.maximum(m, m_b)
-        s = s * jnp.exp(m - m_new) + s_b * jnp.exp(m_b - m_new)
-        # the target logit, when it falls inside this block
-        y_local = y - off
-        in_blk = (y_local >= 0) & (y_local < C)
-        t_b = jnp.take_along_axis(
-            logits, jnp.clip(y_local, 0, C - 1)[:, None], axis=-1
-        )[:, 0]
-        t = t + jnp.where(in_blk, t_b, 0.0)
-        return (m_new, s, t), None
-
-    init = (
-        jnp.full((N,), -jnp.inf, jnp.float32),
-        jnp.zeros((N,), jnp.float32),
-        jnp.zeros((N,), jnp.float32),
-    )
-    (m, s, t), _ = lax.scan(body, init, (w_blocks, offs))
-    lse = jnp.log(s) + m  # [N]
-    loss = jnp.mean(lse - t)
-    return loss, lse
+def _fwd(x, w, y, block, compute_dtype):
+    m, s, t = _stats_scan(x, w, y, block, compute_dtype)
+    lse = jnp.log(s) + m
+    return jnp.mean(lse - t), lse
 
 
 def _vjp_fwd(x, w, y, block, compute_dtype):
-    loss, lse = _fwd_scan(x, w, y, block, compute_dtype)
+    loss, lse = _fwd(x, w, y, block, compute_dtype)
     return loss, (x, w, y, lse)
 
 
 def _vjp_bwd(block, compute_dtype, res, g):
     x, w, y, lse = res
-    N, D = x.shape
-    w_blocks, V = _pad_blocks(w, block)
-    offs = jnp.arange(w_blocks.shape[0]) * block
-    scale = g / N  # d(mean)/d(per-row)
-
-    def body(dx, inp):
-        w_blk, off = inp
-        logits = _block_logits(x, w_blk, off, V, compute_dtype)
-        p = jnp.exp(logits - lse[:, None])  # block softmax [N, C]; 0 at pads
-        y_local = y - off
-        onehot = (
-            y_local[:, None] == jnp.arange(logits.shape[-1])[None, :]
-        ).astype(jnp.float32)
-        dl = ((p - onehot) * scale).astype(compute_dtype)
-        dx = dx + (dl @ w_blk.astype(compute_dtype)).astype(jnp.float32)
-        dw_blk = (dl.T @ x.astype(compute_dtype)).astype(jnp.float32)
-        return dx, dw_blk
-
-    dx, dw_blocks = lax.scan(body, jnp.zeros((N, D), jnp.float32), (w_blocks, offs))
-    dw = dw_blocks.reshape(-1, D)[:V]
+    dx, dw = _bwd_scan(x, w, y, lse, g / x.shape[0], block, compute_dtype)
     return dx.astype(x.dtype), dw.astype(w.dtype), None
 
 
 chunked_softmax_xent.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# -- vocab-parallel (tensor-parallel) variant ----------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def chunked_softmax_xent_shard(
+    x: jnp.ndarray,
+    w_shard: jnp.ndarray,
+    y: jnp.ndarray,
+    axis_name: str,
+    block: int = 1024,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> jnp.ndarray:
+    """Vocab-parallel chunked cross-entropy, for use inside ``shard_map``.
+
+    The TP composition of :func:`chunked_softmax_xent`: ``w_shard
+    [V/world, D]`` is this rank's contiguous vocab rows (the Megatron
+    ``wte: P(model, None)`` layout), ``x [N, D]`` and ``y [N]`` (global
+    ids) replicated across the axis.  Each rank scans only its shard; the
+    online-softmax ``(max, sumexp)`` stats and the target logit then merge
+    with one ``pmax`` + two ``psum`` of ``[N]`` vectors — vocabulary,
+    logits, and ``dw`` never leave their shard; only ``dx`` needs a psum in
+    the backward.  Returns the replicated global-softmax loss.
+    """
+    loss, _ = _shard_fwd(x, w_shard, y, axis_name, block, compute_dtype)
+    return loss
+
+
+def _shard_fwd(x, w_shard, y, axis_name, block, compute_dtype):
+    me = lax.axis_index(axis_name)
+    y_local = y - me * w_shard.shape[0]  # this shard's view of the target ids
+    m_r, s_r, t_r = _stats_scan(x, w_shard, y_local, block, compute_dtype)
+    m = lax.pmax(m_r, axis_name)
+    # a rank can't be all-empty (V_local >= 1), so m_r > -inf and the
+    # rescale below is well-defined
+    s = lax.psum(s_r * jnp.exp(m_r - m), axis_name)
+    t = lax.psum(t_r, axis_name)
+    lse = jnp.log(s) + m
+    return jnp.mean(lse - t), lse
+
+
+def _shard_vjp_fwd(x, w_shard, y, axis_name, block, compute_dtype):
+    loss, lse = _shard_fwd(x, w_shard, y, axis_name, block, compute_dtype)
+    return loss, (x, w_shard, y, lse)
+
+
+def _shard_vjp_bwd(axis_name, block, compute_dtype, res, g):
+    x, w_shard, y, lse = res
+    me = lax.axis_index(axis_name)
+    y_local = y - me * w_shard.shape[0]
+    dx, dw = _bwd_scan(x, w_shard, y_local, lse, g / x.shape[0], block, compute_dtype)
+    # x was replicated across the axis, so its cotangent sums the per-shard
+    # contributions; dw stays local to the shard
+    dx = lax.psum(dx, axis_name)
+    return dx.astype(x.dtype), dw.astype(w_shard.dtype), None
+
+
+chunked_softmax_xent_shard.defvjp(_shard_vjp_fwd, _shard_vjp_bwd)
 
 
 def chunked_lm_loss(
