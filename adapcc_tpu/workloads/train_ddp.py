@@ -46,6 +46,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--world", type=int, default=None, help="mesh size (default: all devices)")
     p.add_argument("--coordinator", action="store_true", help="enable the relay/fault coordinator")
     p.add_argument(
+        "--dp-mode", choices=["ddp", "fsdp", "zero1"], default="ddp",
+        help="data-parallel state layout: ddp replicates (adaptive bucket "
+        "hook); fsdp shards params+optimizer via GSPMD; zero1 shards the "
+        "optimizer on a flat fp32 master (both beyond the reference)",
+    )
+    p.add_argument(
+        "--min-shard-elems", type=int, default=2**14,
+        help="fsdp: leaves smaller than this stay replicated",
+    )
+    p.add_argument(
         "--no-bsp", dest="is_bsp", action="store_false", default=True,
         help="async relay mode: straggler gradients are buffered and folded "
         "into their next active step instead of dropped (reference is_bsp)",
@@ -121,6 +131,16 @@ def make_workload(name: str, batch: int, rng):
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    if args.dp_mode != "ddp":
+        # sharded-state modes sync via GSPMD/psum, not the adaptive hook —
+        # the relay/straggler machinery rides the hook, so reject the combo
+        # up front (before any server/engine side effects) instead of
+        # silently ignoring the flags
+        if args.coordinator or not args.is_bsp or args.profile_freq:
+            raise ValueError(
+                "--coordinator/--no-bsp/--profile_freq require --dp-mode ddp "
+                "(relay and re-adaptation ride the DDP gradient hook)"
+            )
     # join the multi-host world if the launcher set the coordinator env
     from adapcc_tpu.launch import maybe_initialize_distributed
 
@@ -129,40 +149,86 @@ def main(argv=None) -> None:
     world = int(mesh.devices.size)
 
     comm_args = CommArgs.from_namespace(args)
-    AdapCC.init(comm_args, mesh=mesh)
-    AdapCC.setup(ALLREDUCE)
-    if args.coordinator:
-        AdapCC.communicator.enable_coordinator(is_master=True, num_processes=1, port=0)
+    if args.dp_mode == "ddp":
+        # the adaptive bootstrap + collective engine back the gradient hook;
+        # the GSPMD modes never touch them, so they skip the whole lifecycle
+        AdapCC.init(comm_args, mesh=mesh)
+        AdapCC.setup(ALLREDUCE)
+        if args.coordinator:
+            AdapCC.communicator.enable_coordinator(
+                is_master=True, num_processes=1, port=0
+            )
 
     loss_fn, params, batch_fn = make_workload(args.model, args.batch, jax.random.PRNGKey(0))
     tx = optax.adam(args.lr)
-    trainer = DDPTrainer(
-        loss_fn,
-        tx,
-        mesh,
-        AdapCC.communicator.strategy,
-        communicator=AdapCC.communicator,
-        use_xla_fastpath=comm_args.use_xla_fastpath,
-        bsp=comm_args.is_bsp,
-    )
-    state = TrainState.create(params, tx)
+
+    if args.dp_mode == "fsdp":
+        from adapcc_tpu.parallel import fsdp_shardings, fsdp_train_step, shard_fsdp
+        from jax.sharding import PartitionSpec
+
+        params = shard_fsdp(params, mesh, min_shard_elems=args.min_shard_elems)
+        sh = fsdp_shardings(params, mesh, min_shard_elems=args.min_shard_elems)
+        n_sharded = sum(
+            s.spec != PartitionSpec() for s in jax.tree_util.tree_leaves(sh)
+        )
+        print(f"fsdp: {n_sharded}/{len(jax.tree_util.tree_leaves(sh))} leaves sharded")
+        opt_state = tx.init(params)
+        fsdp_step = fsdp_train_step(
+            loss_fn, tx, mesh, min_shard_elems=args.min_shard_elems
+        )
+
+        def run_step(step):
+            nonlocal params, opt_state
+            params, opt_state, loss = fsdp_step(params, opt_state, batch_fn())
+            return loss
+
+    elif args.dp_mode == "zero1":
+        from adapcc_tpu.parallel import Zero1Optimizer, zero1_train_step
+
+        z_opt = Zero1Optimizer(tx, mesh)
+        master, z_state = z_opt.init(params)
+        z_step = zero1_train_step(loss_fn, z_opt, mesh)
+
+        def run_step(step):
+            nonlocal params, master, z_state
+            params, master, z_state, losses = z_step(params, master, z_state, batch_fn())
+            return losses
+
+    else:
+        trainer = DDPTrainer(
+            loss_fn,
+            tx,
+            mesh,
+            AdapCC.communicator.strategy,
+            communicator=AdapCC.communicator,
+            use_xla_fastpath=comm_args.use_xla_fastpath,
+            bsp=comm_args.is_bsp,
+        )
+        state = TrainState.create(params, tx)
+
+        def run_step(step):
+            nonlocal state
+            # periodic re-adaptation (reference train_ddp.py:45-46)
+            if args.profile_freq and step > 0 and step % args.profile_freq == 0:
+                AdapCC.reconstruct_topology(comm_args, ALLREDUCE)
+                trainer.rebuild(AdapCC.communicator.strategy)
+            state, loss = trainer.step(state, batch_fn(), step_idx=step)
+            return loss
 
     t_last = time.perf_counter()
     for step in range(args.steps):
-        # periodic re-adaptation (reference train_ddp.py:45-46)
-        if args.profile_freq and step > 0 and step % args.profile_freq == 0:
-            AdapCC.reconstruct_topology(comm_args, ALLREDUCE)
-            trainer.rebuild(AdapCC.communicator.strategy)
-        state, loss = trainer.step(state, batch_fn(), step_idx=step)
+        loss = run_step(step)
         if step % 5 == 0 or step == args.steps - 1:
             now = time.perf_counter()
             print(
                 f"step {step:4d}  loss {float(jnp.mean(loss)):.4f}  "
-                f"({(now - t_last):.3f}s since last log)  world={world}"
+                f"({(now - t_last):.3f}s since last log)  world={world} "
+                f"mode={args.dp_mode}"
             )
             t_last = now
 
-    AdapCC.clear(ALLREDUCE)
+    if args.dp_mode == "ddp":
+        AdapCC.clear(ALLREDUCE)
 
 
 if __name__ == "__main__":
