@@ -28,6 +28,13 @@ class MoEConfig:
     top_k: int = 2
     capacity_factor: float = 1.25
     dtype: jnp.dtype = jnp.bfloat16
+    #: ST-MoE router z-loss: penalizes large router logits (mean logsumexp²
+    #: over tokens), keeping the fp32 softmax well-scaled.  The term rides
+    #: inside the returned aux scalar, so its EFFECTIVE weight on the
+    #: objective is this coefficient × the consumer's aux-loss weight —
+    #: with train_moe's default ``--aux-weight 0.01``, the 0.1 here lands on
+    #: ST-MoE's recommended effective 1e-3.  0 disables.
+    router_z_coef: float = 0.1
 
     @staticmethod
     def tiny() -> "MoEConfig":
@@ -60,6 +67,9 @@ class MoEMLP(nn.Module):
             jax.nn.one_hot(jnp.argmax(gate_probs, axis=-1), cfg.num_experts), axis=0
         )
         aux_loss = cfg.num_experts * jnp.sum(me * ce)
+        if cfg.router_z_coef:
+            z = jax.nn.logsumexp(gate_logits, axis=-1)  # [tokens]
+            aux_loss = aux_loss + cfg.router_z_coef * jnp.mean(z**2)
 
         # top-k dispatch with per-expert positional capacity
         combine = jnp.zeros((n_tokens, cfg.num_experts, capacity), dtype=jnp.float32)

@@ -50,6 +50,12 @@ def _moe_shard(
     me = jnp.mean(probs, axis=0)
     ce = jnp.mean(jax.nn.one_hot(jnp.argmax(probs, -1), E), axis=0)
     aux_loss = E * jnp.sum(lax.pmean(me, axis_name) * lax.pmean(ce, axis_name))
+    if cfg.router_z_coef:
+        # ST-MoE router z-loss, globally token-averaged (matches MoEMLP)
+        z = jax.nn.logsumexp(logits, axis=-1)
+        aux_loss = aux_loss + cfg.router_z_coef * lax.pmean(
+            jnp.mean(z**2), axis_name
+        )
 
     # top-k dispatch with per-rank positional capacity
     combine = jnp.zeros((n_loc, E, capacity), jnp.float32)

@@ -163,11 +163,11 @@ def main(argv=None) -> None:
     tx = optax.adam(args.lr)
 
     if args.dp_mode == "fsdp":
-        from adapcc_tpu.parallel import fsdp_shardings, fsdp_train_step, shard_fsdp
+        from adapcc_tpu.parallel import fsdp_shardings, fsdp_train_step
         from jax.sharding import PartitionSpec
 
-        params = shard_fsdp(params, mesh, min_shard_elems=args.min_shard_elems)
         sh = fsdp_shardings(params, mesh, min_shard_elems=args.min_shard_elems)
+        params = jax.device_put(params, sh)
         n_sharded = sum(
             s.spec != PartitionSpec() for s in jax.tree_util.tree_leaves(sh)
         )

@@ -130,3 +130,31 @@ def test_gpt2_remat_policy_validated():
     tokens = jnp.zeros((1, 8), jnp.int32)
     with pytest.raises(ValueError, match="remat_policy"):
         GPT2(cfg).init(jax.random.PRNGKey(0), tokens)
+
+
+def test_moe_router_z_loss():
+    """z-loss adds coef·mean(logsumexp²) to the aux term and is disabled at
+    coef 0; the EP shard path reports the same global value."""
+    import dataclasses
+
+    from adapcc_tpu.models.moe import MoEConfig, MoEMLP
+
+    cfg0 = dataclasses.replace(MoEConfig.tiny(), router_z_coef=0.0)
+    cfg1 = dataclasses.replace(MoEConfig.tiny(), router_z_coef=0.1)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 8, 32)), jnp.float32)
+    params = MoEMLP(cfg0).init(jax.random.PRNGKey(0), x)
+    y0, aux0 = MoEMLP(cfg0).apply(params, x)
+    y1, aux1 = MoEMLP(cfg1).apply(params, x)
+    np.testing.assert_allclose(np.asarray(y0), np.asarray(y1))  # output unchanged
+    assert float(aux1) > float(aux0)  # logsumexp² penalty is positive
+
+    # EP shard path matches the single-device aux (same global mean)
+    from jax.sharding import Mesh
+
+    from adapcc_tpu.parallel import expert_parallel_moe
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("experts",))
+    _, aux_ep = expert_parallel_moe(
+        params, x.reshape(-1, cfg1.d_model), cfg1, mesh
+    )
+    np.testing.assert_allclose(float(aux_ep), float(aux1), rtol=1e-5)
