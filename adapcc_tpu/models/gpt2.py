@@ -300,6 +300,60 @@ def lm_loss_chunked(
     return chunked_lm_loss(hidden, wte, tokens, block, model.cfg.dtype)
 
 
+def _sp_targets_and_mask(tokens: jnp.ndarray, axis_name: str):
+    """Shared SP boundary handling: each local position's target is the next
+    token — the shard's last position's target lives on the *next* rank and
+    arrives by one tiny ``[B]`` ppermute (rank r receives rank r+1's first
+    token, the ring modules' shared convention); the last rank's final
+    position has no target and is masked out."""
+    from jax import lax
+
+    from adapcc_tpu.parallel.ring_attention import _ring_perm
+
+    B, Tl = tokens.shape
+    world = lax.psum(1, axis_name)
+    me = lax.axis_index(axis_name)
+    next_first = lax.ppermute(tokens[:, 0], axis_name, _ring_perm(world))  # [B]
+    targets = jnp.concatenate([tokens[:, 1:], next_first[:, None]], axis=1)
+    valid = jnp.ones((B, Tl), jnp.float32)
+    valid = valid.at[:, -1].set(jnp.where(me == world - 1, 0.0, 1.0))
+    return targets, valid
+
+
+def _sp_masked_mean(nll: jnp.ndarray, valid: jnp.ndarray, axis_name: str):
+    """psum-weighted global mean over valid positions — replicated, and
+    numerically identical to the unsharded mean."""
+    from jax import lax
+
+    total = lax.psum(jnp.sum(nll * valid.astype(nll.dtype)), axis_name)
+    count = lax.psum(jnp.sum(valid), axis_name)
+    return total / count
+
+
+def lm_loss_sp_chunked(
+    hidden: jnp.ndarray,
+    wte: jnp.ndarray,
+    tokens: jnp.ndarray,
+    axis_name: str,
+    block: int = 1024,
+    compute_dtype=None,
+) -> jnp.ndarray:
+    """:func:`lm_loss_sp` without the ``[B, T_local, vocab]`` logits tensor:
+    the long-context × long-vocab composition.  Same boundary handling and
+    psum-weighted global mean (the shared helpers); the per-position NLL
+    comes from the chunked online-softmax scan (ops/chunked_ce.py).
+    """
+    from adapcc_tpu.ops.chunked_ce import chunked_softmax_nll
+
+    B, Tl, D = hidden.shape
+    targets, valid = _sp_targets_and_mask(tokens, axis_name)
+    nll = chunked_softmax_nll(
+        hidden.reshape(B * Tl, D), wte, targets.reshape(B * Tl),
+        block, compute_dtype or hidden.dtype,
+    ).reshape(B, Tl)
+    return _sp_masked_mean(nll, valid, axis_name)
+
+
 def lm_loss_sp(logits: jnp.ndarray, tokens: jnp.ndarray, axis_name: str) -> jnp.ndarray:
     """:func:`lm_loss` under sequence sharding, for use inside ``shard_map``.
 
@@ -311,21 +365,7 @@ def lm_loss_sp(logits: jnp.ndarray, tokens: jnp.ndarray, axis_name: str) -> jnp.
     psum-weighted global mean, numerically identical to ``lm_loss`` on the
     unsharded batch (and replicated across ranks).
     """
-    from jax import lax
-
-    from adapcc_tpu.parallel.ring_attention import _ring_perm
-
-    B, Tl, _ = logits.shape
-    world = lax.psum(1, axis_name)
-    me = lax.axis_index(axis_name)
-    # rank r receives rank r+1's first token (receive-from-right rotation —
-    # the ring modules' shared convention)
-    next_first = lax.ppermute(tokens[:, 0], axis_name, _ring_perm(world))  # [B]
-    targets = jnp.concatenate([tokens[:, 1:], next_first[:, None]], axis=1)
+    targets, valid = _sp_targets_and_mask(tokens, axis_name)
     logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    valid = jnp.ones((B, Tl), logits.dtype)
-    valid = valid.at[:, -1].set(jnp.where(me == world - 1, 0.0, 1.0))
-    total = lax.psum(jnp.sum(-ll * valid), axis_name)
-    count = lax.psum(jnp.sum(valid), axis_name)
-    return total / count
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return _sp_masked_mean(nll, valid, axis_name)

@@ -119,10 +119,47 @@ def _bwd_scan(x, w, y_local, lse, scale, block, compute_dtype):
     return dx, dw_blocks.reshape(-1, D)[:V]
 
 
-# -- single-device (or GSPMD-replicated) variant -------------------------------
+# -- single-device (or GSPMD-replicated) variants ------------------------------
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def chunked_softmax_nll(
+    x: jnp.ndarray,
+    w: jnp.ndarray,
+    y: jnp.ndarray,
+    block: int = 1024,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> jnp.ndarray:
+    """Per-row negative log-likelihood ``[N]`` of ``softmax(x @ w.T)`` at
+    labels ``y`` — the composable core: masking, weighting, and sharded
+    reductions (e.g. the SP boundary mask) happen outside in plain JAX,
+    with per-row cotangents flowing back through the block scan.
+    """
+    nll, _ = _fwd_nll(x, w, y, block, compute_dtype)
+    return nll
+
+
+def _fwd_nll(x, w, y, block, compute_dtype):
+    m, s, t = _stats_scan(x, w, y, block, compute_dtype)
+    lse = jnp.log(s) + m
+    return lse - t, lse
+
+
+def _nll_vjp_fwd(x, w, y, block, compute_dtype):
+    nll, lse = _fwd_nll(x, w, y, block, compute_dtype)
+    return nll, (x, w, y, lse)
+
+
+def _nll_vjp_bwd(block, compute_dtype, res, g):
+    x, w, y, lse = res
+    # g [N]: per-row cotangent — d nll_n / d logits_nc = softmax_nc - onehot_nc
+    dx, dw = _bwd_scan(x, w, y, lse, g[:, None], block, compute_dtype)
+    return dx.astype(x.dtype), dw.astype(w.dtype), None
+
+
+chunked_softmax_nll.defvjp(_nll_vjp_fwd, _nll_vjp_bwd)
+
+
 def chunked_softmax_xent(
     x: jnp.ndarray,
     w: jnp.ndarray,
@@ -138,28 +175,7 @@ def chunked_softmax_xent(
     materializing more than one ``[N, block]`` logit tile.  Any ``V`` works;
     a non-multiple pays one zero-padded block.
     """
-    loss, _ = _fwd(x, w, y, block, compute_dtype)
-    return loss
-
-
-def _fwd(x, w, y, block, compute_dtype):
-    m, s, t = _stats_scan(x, w, y, block, compute_dtype)
-    lse = jnp.log(s) + m
-    return jnp.mean(lse - t), lse
-
-
-def _vjp_fwd(x, w, y, block, compute_dtype):
-    loss, lse = _fwd(x, w, y, block, compute_dtype)
-    return loss, (x, w, y, lse)
-
-
-def _vjp_bwd(block, compute_dtype, res, g):
-    x, w, y, lse = res
-    dx, dw = _bwd_scan(x, w, y, lse, g / x.shape[0], block, compute_dtype)
-    return dx.astype(x.dtype), dw.astype(w.dtype), None
-
-
-chunked_softmax_xent.defvjp(_vjp_fwd, _vjp_bwd)
+    return jnp.mean(chunked_softmax_nll(x, w, y, block, compute_dtype))
 
 
 # -- vocab-parallel (tensor-parallel) variant ----------------------------------

@@ -25,7 +25,9 @@ from adapcc_tpu.models.gpt2 import GPT2, lm_loss_sp
 
 
 def gpt2_sp_loss_and_grad(
-    model: GPT2, mesh: Mesh, axis_name: str = "ranks", data_axis: Optional[str] = None
+    model: GPT2, mesh: Mesh, axis_name: str = "ranks",
+    data_axis: Optional[str] = None, loss: str = "dense",
+    loss_block: int = 1024,
 ) -> Callable[[Any, jnp.ndarray], Tuple[jnp.ndarray, Any]]:
     """Jitted ``(params, tokens [B, T]) → (loss, grads)`` with the sequence
     sharded over ``axis_name``; params replicated, grads psum-replicated.
@@ -48,11 +50,27 @@ def gpt2_sp_loss_and_grad(
         )
     if data_axis is not None and data_axis not in mesh.axis_names:
         raise ValueError(f"data_axis {data_axis!r} not in mesh axes {mesh.axis_names}")
+    if loss not in ("dense", "chunked"):
+        raise ValueError(f"loss must be 'dense' or 'chunked', got {loss!r}")
+    use_chunked = loss == "chunked"
 
     def shard_step(params, tokens):
-        def loss_fn(p):
-            logits = model.apply(p, tokens)
-            return lm_loss_sp(logits, tokens, axis_name)
+        if use_chunked:
+            # long-context × long-vocab: no [B, T_local, V] logits either
+            from adapcc_tpu.models.gpt2 import lm_loss_sp_chunked
+
+            def loss_fn(p):
+                hidden = model.apply(p, tokens, return_hidden=True)
+                return lm_loss_sp_chunked(
+                    hidden, p["params"]["wte"]["embedding"], tokens, axis_name,
+                    block=min(loss_block, cfg.vocab_size),
+                    compute_dtype=cfg.dtype,
+                )
+        else:
+
+            def loss_fn(p):
+                logits = model.apply(p, tokens)
+                return lm_loss_sp(logits, tokens, axis_name)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         # lm_loss_sp psums in the FORWARD pass, and psum transposes to psum
@@ -83,13 +101,16 @@ def gpt2_sp_loss_and_grad(
 
 def gpt2_sp_train_step(
     model: GPT2, tx, mesh: Mesh, axis_name: str = "ranks",
-    data_axis: Optional[str] = None,
+    data_axis: Optional[str] = None, loss: str = "dense",
+    loss_block: int = 1024,
 ) -> Callable:
     """Jitted ``(params, opt_state, tokens) → (params, opt_state, loss)``
     full SP (or DP×SP, with ``data_axis``) training step."""
     import optax
 
-    loss_and_grad = gpt2_sp_loss_and_grad(model, mesh, axis_name, data_axis)
+    loss_and_grad = gpt2_sp_loss_and_grad(
+        model, mesh, axis_name, data_axis, loss, loss_block
+    )
 
     @jax.jit
     def step(params, opt_state, tokens):

@@ -216,14 +216,9 @@ def run(args) -> Tuple[float, float]:
     params = model.init(jax.random.PRNGKey(0), jnp.asarray(train_set[:1]))
 
     if args.loss == "chunked":
-        if args.sp != "none":
-            raise ValueError(
-                "--loss chunked is not wired into the sequence-parallel step "
-                "(gpt2_sp_train_step computes its own sharded loss); drop "
-                "--sp or use --loss dense"
-            )
         # fuse the LM head into the online-softmax loss: no [B, T, vocab]
         # logits tensor (ops/chunked_ce.py) — the long-vocab memory saver
+        # (the SP branch passes loss= through to its own sharded variant)
         from adapcc_tpu.models.gpt2 import lm_loss_chunked
 
         def loss_fn(p, b):
@@ -256,7 +251,7 @@ def run(args) -> Tuple[float, float]:
         if args.seq % world:
             raise ValueError(f"--seq {args.seq} must divide by world {world} under --sp")
         sp_model = GPT2(dataclasses.replace(cfg, sp_axis="ranks", sp_impl=args.sp))
-        sp_step = gpt2_sp_train_step(sp_model, tx, mesh)
+        sp_step = gpt2_sp_train_step(sp_model, tx, mesh, loss=args.loss)
         trainer = None
     else:
         trainer = DDPTrainer(loss_fn, tx, mesh, Strategy.ring(world))

@@ -120,16 +120,31 @@ def test_chunked_xent_nonmultiple_vocab_pads():
     assert gw.shape == (V, D)
 
 
-def test_sp_plus_chunked_loss_rejected():
-    from adapcc_tpu.workloads.train_gpt2 import build_parser, run
+def test_sp_chunked_loss_matches_dense_sp(mesh8):
+    """The long-context x long-vocab composition: the SP chunked loss equals
+    the dense SP loss, and its full training gradient matches."""
+    import dataclasses
 
-    args = build_parser().parse_args(
-        ["--sp", "ring", "--loss", "chunked", "--epochs", "1",
-         "--corpus-tokens", "2000", "--batch", "4", "--seq", "16",
-         "--layers", "1", "--heads", "2", "--dmodel", "32"]
+    from adapcc_tpu.parallel import gpt2_sp_loss_and_grad
+
+    cfg = GPT2Config(
+        vocab_size=48, max_seq=32, n_layer=1, n_head=2, d_model=16,
+        dtype=jnp.float32, sp_axis="ranks",
     )
-    with pytest.raises(ValueError, match="chunked"):
-        run(args)
+    model = GPT2(cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(8).integers(0, cfg.vocab_size, size=(2, 32)), jnp.int32
+    )
+    params = GPT2(dataclasses.replace(cfg, sp_axis=None)).init(
+        jax.random.PRNGKey(0), tokens
+    )
+    dense = gpt2_sp_loss_and_grad(model, mesh8, loss="dense")
+    chunk = gpt2_sp_loss_and_grad(model, mesh8, loss="chunked")
+    ld, gd = dense(params, tokens)
+    lc, gc = chunk(params, tokens)
+    np.testing.assert_allclose(float(lc), float(ld), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(gd), jax.tree_util.tree_leaves(gc)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
 # ------------------------------------------------------------- vocab-parallel
