@@ -77,6 +77,13 @@ class DDPTrainer:
         # accumulation — same math as the full batch (for mean losses), peak
         # activation memory divided by accum_steps
         accum_steps: int = 1,
+        # ZeRO-1 optimizer sharding (parallel/fsdp.py) composed with the
+        # adaptive sync: the hook's strategy/relay allreduce produces the
+        # synced gradient, then each rank updates only its flat [N/world]
+        # optimizer shard and all-gathers the new params — relay tolerance
+        # and 1/world optimizer memory in ONE compiled program.  States come
+        # from :meth:`init_state` (not TrainState.create).
+        zero1: bool = False,
     ) -> None:
         self.loss_fn = loss_fn
         self.tx = tx
@@ -86,6 +93,7 @@ class DDPTrainer:
         if accum_steps < 1:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
         self.accum_steps = accum_steps
+        self.zero1 = zero1
         self.hook = GradSyncHook(
             strategy,
             axis_name=axis_name,
@@ -128,12 +136,88 @@ class DDPTrainer:
 
     # -- step program ----------------------------------------------------------
 
+    def init_state(self, params: Any) -> TrainState:
+        """Build the trainer's state: replicated optax state normally, the
+        ZeRO-1 flat master + sharded optimizer state when ``zero1=True``."""
+        if not self.zero1:
+            return TrainState.create(params, self.tx)
+        from adapcc_tpu.parallel.fsdp import Zero1Optimizer
+
+        opt = Zero1Optimizer(self.tx, self.mesh, self.axis_name)
+        master, opt_state = opt.init(params)
+        return TrainState(
+            params=params, opt_state=(master, opt_state), step=jnp.zeros((), jnp.int32)
+        )
+
+    def _check_state(self, state: TrainState) -> None:
+        """Catch the common zero1 misuse (TrainState.create's replicated
+        optax state) before it dies as a cryptic shard_map spec error."""
+        if not self.zero1:
+            return
+        world = self.mesh.shape[self.axis_name]
+        opt = state.opt_state
+        ok = (
+            isinstance(opt, tuple)
+            and len(opt) == 2
+            and getattr(opt[0], "ndim", 0) == 2
+            and opt[0].shape[0] == world
+        )
+        if not ok:
+            raise ValueError(
+                "zero1=True needs the sharded (master [world, N/world], opt "
+                "shard) state from trainer.init_state(params) — got a "
+                "replicated optax state (TrainState.create?)"
+            )
+
+    def _state_spec(self):
+        """shard_map pytree-prefix spec for TrainState: everything
+        replicated, except the ZeRO-1 ``(master, opt shard)`` pair whose
+        leading ``[world]`` dim shards over the axis."""
+        opt_spec = P(self.axis_name) if self.zero1 else P()
+        return TrainState(params=P(), opt_state=opt_spec, step=P())
+
     def _apply_synced(self, state: TrainState, synced: Any) -> TrainState:
         """Optimizer tail shared by every step variant: one change to the
-        update rule applies to step() and scan_steps() alike."""
-        updates, opt_state = self.tx.update(synced, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return TrainState(params=params, opt_state=opt_state, step=state.step + 1)
+        update rule applies to step() and scan_steps() alike.
+
+        Runs inside the shard_map body.  ZeRO-1: the synced gradient is
+        replicated (the hook allreduced it), so this rank's flat slice is a
+        free local read; the optax update touches only the [N/world] shard
+        and one all-gather rebuilds the replicated params.
+        """
+        if not self.zero1:
+            updates, opt_state = self.tx.update(synced, state.opt_state, state.params)
+            params = optax.apply_updates(state.params, updates)
+            return TrainState(params=params, opt_state=opt_state, step=state.step + 1)
+
+        from adapcc_tpu.parallel.fsdp import (
+            _flatten,
+            _flatten_meta,
+            local_grad_shard,
+            zero1_apply_shard,
+        )
+
+        world = self.mesh.shape[self.axis_name]
+        meta = _flatten_meta(state.params, world)
+        master, opt_state = state.opt_state  # [1, L] / [1, ...] per shard
+        master = master[0]
+        opt_state = jax.tree_util.tree_map(lambda x: x[0], opt_state)
+        # the hook already allreduced: every rank holds the same synced
+        # grads, so its slice is a free local read
+        g_shard = local_grad_shard(
+            _flatten(synced, meta), meta, world, self.axis_name
+        )
+        master, opt_state, params = zero1_apply_shard(
+            self.tx, master, opt_state, g_shard, meta, self.axis_name
+        )
+        return TrainState(
+            params=params,
+            opt_state=(
+                master[None],
+                jax.tree_util.tree_map(lambda x: x[None], opt_state),
+            ),
+            step=state.step + 1,
+        )
 
     def _value_and_grad(self, params: Any, batch: Any):
         """Per-rank (loss, grads), microbatch-accumulated when accum_steps>1.
@@ -212,12 +296,12 @@ class DDPTrainer:
             return (new_state, loss[None], *outs)
 
         in_specs = (
-            (P(), P(self.axis_name))
+            (self._state_spec(), P(self.axis_name))
             + ((P(),) if dynamic_mask else ())
             + ((P(self.axis_name),) if deferred_relay else ())
         )
         out_specs = (
-            (P(), P(self.axis_name))
+            (self._state_spec(), P(self.axis_name))
             + ((P(),) if self.measure_gns else ())
             + ((P(self.axis_name),) if deferred_relay else ())
         )
@@ -250,6 +334,7 @@ class DDPTrainer:
         ``active_mask`` overrides the coordinator's negotiation (workloads
         injecting their own skew signal; requires a dynamic-mask trainer).
         """
+        self._check_state(state)
         if self._compiled is None:
             self._compiled = self._build()
         # host-side counter: reading state.step would force a device sync on
@@ -303,6 +388,7 @@ class DDPTrainer:
                 "scan_steps runs a static full-world program: incompatible "
                 "with dynamic_mask, async relay (bsp=False), and measure_gns"
             )
+        self._check_state(state)
         key = ("scan", int(n_steps))
         fn = self._scan_cache.get(key)
         if fn is None:
@@ -319,8 +405,8 @@ class DDPTrainer:
                 jax.shard_map(
                     per_shard,
                     mesh=self.mesh,
-                    in_specs=(P(), P(self.axis_name)),
-                    out_specs=(P(), P(self.axis_name)),
+                    in_specs=(self._state_spec(), P(self.axis_name)),
+                    out_specs=(self._state_spec(), P(self.axis_name)),
                     check_vma=False,
                 ),
                 donate_argnums=(0,) if self.donate_state else (),

@@ -274,6 +274,36 @@ def _unflatten(flat: jnp.ndarray, meta: _FlatMeta) -> Any:
     return jax.tree_util.tree_unflatten(meta.treedef, parts)
 
 
+def zero1_apply_shard(
+    tx: optax.GradientTransformation,
+    master: jnp.ndarray,
+    opt_state: Any,
+    g_shard: jnp.ndarray,
+    meta: _FlatMeta,
+    axis_name: str,
+):
+    """The in-shard ZeRO-1 update cycle, shared by every composition site
+    (Zero1Optimizer.apply, zero1_train_step, DDPTrainer(zero1=True)):
+    optax update on this rank's flat ``[N/world]`` slice, then one
+    ``all_gather`` rebuilds the replicated params.  Runs inside shard_map;
+    ``master``/``opt_state`` enter WITHOUT their leading shard dim."""
+    updates, opt_state = tx.update(g_shard, opt_state, master)
+    master = optax.apply_updates(master, updates)
+    flat_p = lax.all_gather(master, axis_name).reshape(-1)
+    return master, opt_state, _unflatten(flat_p, meta)
+
+
+def local_grad_shard(
+    flat_g: jnp.ndarray, meta: _FlatMeta, world: int, axis_name: str
+) -> jnp.ndarray:
+    """This rank's slice of an already-replicated flat gradient — a free
+    local read, no collective."""
+    shard_len = meta.padded // world
+    return lax.dynamic_index_in_dim(
+        flat_g.reshape(world, shard_len), lax.axis_index(axis_name), keepdims=False
+    )
+
+
 class Zero1Optimizer:
     """Optimizer-state-sharded DDP (ZeRO stage 1) over one mesh axis.
 
@@ -334,16 +364,10 @@ class Zero1Optimizer:
             # grads enter replicated (in_spec P()): every rank already holds
             # the full synced gradient, so its shard is a free local slice —
             # no collective needed on this path
-            flat_g = _flatten(grads_tree, meta)
-            g_shard = lax.dynamic_index_in_dim(
-                flat_g.reshape(world, shard_len),
-                lax.axis_index(axis),
-                keepdims=False,
+            g_shard = local_grad_shard(_flatten(grads_tree, meta), meta, world, axis)
+            master, opt_state, new_params = zero1_apply_shard(
+                tx, master, opt_state, g_shard, meta, axis
             )
-            updates, opt_state = tx.update(g_shard, opt_state, master)
-            master = optax.apply_updates(master, updates)
-            flat_p = lax.all_gather(master, axis).reshape(-1)
-            new_params = _unflatten(flat_p, meta)
             return (
                 master[None],
                 jax.tree_util.tree_map(lambda x: x[None], opt_state),
@@ -401,15 +425,16 @@ def zero1_train_step(
             master = master[0]
             opt_state = jax.tree_util.tree_map(lambda x: x[0], opt_state)
             loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            # unsynced per-rank grads: the reduce-scatter both averages and
+            # slices (the bandwidth-optimal half of a ring allreduce)
             flat_g = _flatten(grads, meta) / world
             g_shard = lax.psum_scatter(
                 flat_g.reshape(world, shard_len), axis_name,
                 scatter_dimension=0, tiled=False,
             )
-            updates, opt_state = tx.update(g_shard, opt_state, master)
-            master = optax.apply_updates(master, updates)
-            flat_p = lax.all_gather(master, axis_name).reshape(-1)
-            new_params = _unflatten(flat_p, meta)
+            master, opt_state, new_params = zero1_apply_shard(
+                tx, master, opt_state, g_shard, meta, axis_name
+            )
             return (
                 new_params,
                 master[None],
