@@ -187,7 +187,10 @@ def format_table(results: Sequence[BenchResult]) -> str:
 def main(argv: Optional[Sequence[str]] = None) -> None:
     from adapcc_tpu.comm.engine import CollectiveEngine
     from adapcc_tpu.comm.mesh import build_world_mesh
+    from adapcc_tpu.launch.launcher import apply_platform_env
     from adapcc_tpu.strategy.ir import Strategy
+
+    apply_platform_env()  # honor JAX_PLATFORMS despite the site customization
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--world", type=int, default=0, help="mesh size (default: all devices)")
@@ -197,19 +200,61 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--strategy", choices=["ring", "binary"], default="binary")
+    ap.add_argument(
+        "--two-level", default="",
+        help='"DxI" (e.g. 2x4): hierarchical (dcn, ici) mesh — the strategy '
+        "is ParTrees-synthesized over the slice layout and executes as "
+        "ICI-collective + DCN master-tree rounds (comm/two_level.py)",
+    )
     ap.add_argument("--json", action="store_true", help="emit JSON lines instead of a table")
     args = ap.parse_args(argv)
 
-    world = args.world or len(jax.devices())
-    mesh = build_world_mesh(world)
-    strategy = Strategy.ring(world) if args.strategy == "ring" else Strategy.binary(world)
+    impls = [i for i in args.impls.split(",") if i] or None
+    if args.two_level:
+        import re
+
+        from adapcc_tpu.comm.mesh import mesh_ip_table
+        from adapcc_tpu.comm.two_level import build_two_level_mesh
+        from adapcc_tpu.primitives import ALLREDUCE
+        from adapcc_tpu.strategy.synthesizer import Synthesizer
+
+        m = re.fullmatch(r"(\d+)x(\d+)", args.two_level.lower())
+        if not m:
+            ap.error(f'--two-level expects "DxI" (e.g. 2x4), got {args.two_level!r}')
+        if args.world or args.strategy != "binary":
+            ap.error(
+                "--two-level is exclusive with --world/--strategy: the mesh "
+                "size is DxI and the hierarchy is ParTrees-synthesized"
+            )
+        if impls and "pallas_ring" in impls:
+            ap.error(
+                "pallas_ring is a flat-mesh kernel; drop it from --impls "
+                "under --two-level"
+            )
+        dcn, ici = int(m.group(1)), int(m.group(2))
+        world = dcn * ici
+        mesh = build_two_level_mesh(dcn, ici)
+        # uniform profile → ParTrees emits the masters-plus-chains hierarchy
+        # that the two-level executor splits into ICI + DCN phases
+        ones = [[1.0] * world for _ in range(world)]
+        strategy = Synthesizer(None, mesh_ip_table(mesh)).synthesize(
+            ALLREDUCE, 1, 4 << 20, ones, ones
+        )
+        if impls is None:
+            impls = ["xla", "strategy"]  # the Pallas ring is a flat-mesh kernel
+    else:
+        world = args.world or len(jax.devices())
+        mesh = build_world_mesh(world)
+        strategy = (
+            Strategy.ring(world) if args.strategy == "ring" else Strategy.binary(world)
+        )
     engine = CollectiveEngine(mesh, strategy)
 
     results = run_sweep(
         engine,
         [parse_size(s) for s in args.sizes.split(",") if s],
         collectives=[c for c in args.collectives.split(",") if c] or None,
-        impls=[i for i in args.impls.split(",") if i] or None,
+        impls=impls,
         iters=args.iters,
         warmup=args.warmup,
     )

@@ -142,3 +142,38 @@ def test_longcontext_sweep_tiny_and_artifact():
             # the memory story: ring is world^2 smaller than single-device
             assert r["score_bytes_per_device"] * r["world"] ** 2 == \
                 single["score_bytes_per_device"]
+
+
+def test_committed_twolevel_sweep_artifact_parses():
+    """The committed two-level (2x4 dcn x ici) sweep artifact parses with the
+    same busbw accounting; both engine surfaces appear for allreduce."""
+    import json
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "results", "busbw_twolevel2x4_r03.jsonl",
+    )
+    rows = [json.loads(line) for line in open(path) if line.strip()]
+    assert len(rows) >= 14
+    seen = set()
+    for r in rows:
+        assert r["world"] == 8
+        factor = BUS_FACTORS[r["collective"]](r["world"])
+        assert abs(r["busbw_gbps"] - r["algbw_gbps"] * factor) < 1e-9 * max(
+            1.0, r["busbw_gbps"]
+        )
+        seen.add((r["collective"], r["impl"]))
+    assert ("allreduce", "xla") in seen and ("allreduce", "strategy") in seen
+    assert ("allreduce", "pallas_ring") not in seen  # flat-mesh kernel
+
+
+def test_collectives_cli_two_level(capsys):
+    """--two-level DxI synthesizes the hierarchy and sweeps on the (dcn,
+    ici) mesh end to end."""
+    from benchmarks.collectives import main as coll_main
+
+    coll_main(["--two-level", "2x4", "--sizes", "4K", "--iters", "1",
+               "--warmup", "1", "--collectives", "allreduce"])
+    out = capsys.readouterr().out
+    assert "allreduce" in out and "strategy" in out
