@@ -166,6 +166,10 @@ def test_committed_twolevel_sweep_artifact_parses():
         seen.add((r["collective"], r["impl"]))
     assert ("allreduce", "xla") in seen and ("allreduce", "strategy") in seen
     assert ("allreduce", "pallas_ring") not in seen  # flat-mesh kernel
+    # reduce/broadcast have no XLA fastpath on two-level meshes: an "xla"
+    # row there would be a mislabeled copy of the schedule measurement
+    assert ("reduce", "xla") not in seen and ("broadcast", "xla") not in seen
+    assert ("reduce", "strategy") in seen and ("broadcast", "strategy") in seen
 
 
 def test_collectives_cli_two_level(capsys):
