@@ -22,7 +22,7 @@ into the two halves the reference interleaves:
 
 from __future__ import annotations
 
-from typing import Any, List, Optional, Sequence
+from typing import Any, List, Optional
 
 import jax.numpy as jnp
 import numpy as np

@@ -7,7 +7,7 @@ VGG16's 102.8M-float bucket).  NHWC layout, bf16-friendly.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple, Union
+from typing import Tuple, Union
 
 import flax.linen as nn
 import jax.numpy as jnp

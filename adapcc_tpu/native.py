@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import ctypes
 import os
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 from adapcc_tpu.comm.relay import RelayRole
 from adapcc_tpu.strategy.ir import CommRound

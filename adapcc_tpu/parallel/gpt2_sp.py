@@ -13,7 +13,6 @@ sequence ``world×`` longer than a single device could hold.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, Optional, Tuple
 
 import jax

@@ -26,7 +26,7 @@ from adapcc_tpu import ALLREDUCE, AdapCC
 from adapcc_tpu.comm.mesh import build_world_mesh
 from adapcc_tpu.config import CommArgs
 from adapcc_tpu.ddp import DDPTrainer, TrainState
-from adapcc_tpu.primitives import DETECT, SKIP_BOOTSTRAP
+from adapcc_tpu.primitives import DETECT
 
 
 def build_parser() -> argparse.ArgumentParser:

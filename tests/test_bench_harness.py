@@ -8,7 +8,6 @@ import subprocess
 import sys
 
 import numpy as np
-import pytest
 
 sys.path.insert(0, "/root/repo")
 import bench  # noqa: E402

@@ -1,6 +1,5 @@
 """Benchmark harness tests on the virtual CPU pod (tiny sizes)."""
 
-import numpy as np
 import pytest
 
 from benchmarks.collectives import (

@@ -9,10 +9,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-import pytest
 from jax.sharding import PartitionSpec as P
 
-from adapcc_tpu.comm.mesh import RANKS_AXIS, build_world_mesh
+from adapcc_tpu.comm.mesh import RANKS_AXIS
 from adapcc_tpu.parallel.fsdp import (
     Zero1Optimizer,
     fsdp_shardings,

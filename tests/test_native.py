@@ -5,7 +5,6 @@ strategy shape, including the pruned/relay variants — the native engine is a
 drop-in accelerator, not a second source of truth.
 """
 
-import itertools
 
 import pytest
 
