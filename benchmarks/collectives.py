@@ -17,10 +17,12 @@ Three allreduce implementations are swept side by side:
 * ``strategy`` — the synthesized masked-ppermute tree schedule,
 * ``pallas_ring`` — the hand-written Pallas ring kernel.
 
-Bytes accounting per collective (``n`` = payload floats per rank, ``w`` =
-world): allreduce/broadcast/reduce move ``4n`` bytes per rank; all_gather's
-and all_to_all's payload is the full ``4·n·w`` exchanged volume;
-reduce_scatter's is its ``4n`` input per rank.
+Bytes accounting per collective (``b`` = per-rank payload bytes =
+elements × dtype itemsize, ``w`` = world): allreduce/broadcast/reduce move
+``b`` bytes per rank; all_gather's and all_to_all's payload is the full
+``b·w`` exchanged volume; reduce_scatter's is its ``b`` input per rank.
+``--dtype`` sets the payload element type (default float32, the
+nccl-tests convention).
 
 Usage (real TPU or the virtual CPU pod)::
 
@@ -61,6 +63,7 @@ class BenchResult:
     time_us: float  # median per-op wall time
     algbw_gbps: float
     busbw_gbps: float
+    dtype: str = "float32"
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -95,7 +98,7 @@ def _time_op(fn: Callable[[], jnp.ndarray], iters: int, warmup: int) -> float:
     return statistics.median(samples)
 
 
-def _make_ops(engine, elems: int) -> Dict[str, tuple]:
+def _make_ops(engine, elems: int, dtype=jnp.float32) -> Dict[str, tuple]:
     """(callable, bytes_moved) per (collective, impl) for one message size.
 
     On a two-level mesh the engine routes reduce/broadcast through the
@@ -105,14 +108,16 @@ def _make_ops(engine, elems: int) -> Dict[str, tuple]:
     the genuinely distinct surfaces are swept.
     """
     world = engine.world_size
-    itemsize = 4  # float32 sweep, matching nccl-tests' default dtype
+    itemsize = jnp.dtype(dtype).itemsize
     rng = np.random.default_rng(elems)
     # pre-place the payload with the engine's sharding: the timed region must
     # cover the collective alone, not a per-call reshard of the input
     sharding = NamedSharding(engine.mesh, P(engine.axis_name))
-    flat = jax.device_put(
-        np.asarray(rng.normal(size=(world, elems)), np.float32), sharding
-    )
+    if jnp.issubdtype(dtype, jnp.integer):
+        host = rng.integers(-8, 8, size=(world, elems))
+    else:
+        host = rng.normal(size=(world, elems))
+    flat = jax.device_put(jnp.asarray(host, dtype), sharding)
     per_rank = elems * itemsize
     total = per_rank * world
 
@@ -155,13 +160,15 @@ def run_sweep(
     impls: Optional[Sequence[str]] = None,
     iters: int = 20,
     warmup: int = 2,
+    dtype=jnp.float32,
 ) -> List[BenchResult]:
     """Sweep ``sizes_bytes`` (per-rank payload bytes) over the engine's ops."""
     world = engine.world_size
     results: List[BenchResult] = []
+    itemsize = jnp.dtype(dtype).itemsize
     for nbytes in sizes_bytes:
-        elems = max(1, nbytes // 4)
-        for (coll, impl), (fn, moved) in _make_ops(engine, elems).items():
+        elems = max(1, nbytes // itemsize)
+        for (coll, impl), (fn, moved) in _make_ops(engine, elems, dtype).items():
             if collectives and coll not in collectives:
                 continue
             if impls and impl not in impls:
@@ -177,6 +184,7 @@ def run_sweep(
                     time_us=sec * 1e6,
                     algbw_gbps=algbw,
                     busbw_gbps=algbw * BUS_FACTORS[coll](world),
+                    dtype=jnp.dtype(dtype).name,
                 )
             )
     return results
@@ -212,6 +220,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--strategy", choices=["ring", "binary"], default="binary")
+    ap.add_argument("--dtype", choices=["f32", "bf16", "int8"], default="f32",
+                    help="payload dtype (pallas_ring has per-dtype tiling)")
     ap.add_argument(
         "--two-level", default="",
         help='"DxI" (e.g. 2x4): hierarchical (dcn, ici) mesh — the strategy '
@@ -272,12 +282,13 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         impls=impls,
         iters=args.iters,
         warmup=args.warmup,
+        dtype={"f32": jnp.float32, "bf16": jnp.bfloat16, "int8": jnp.int8}[args.dtype],
     )
     if args.json:
         for r in results:
             print(r.to_json())
     else:
-        print(f"# world={world} platform={jax.devices()[0].platform}")
+        print(f"# world={world} platform={jax.devices()[0].platform} dtype={args.dtype}")
         print(format_table(results))
 
 

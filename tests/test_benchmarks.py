@@ -180,3 +180,26 @@ def test_collectives_cli_two_level(capsys):
                "--warmup", "1", "--collectives", "allreduce"])
     out = capsys.readouterr().out
     assert "allreduce" in out and "strategy" in out
+
+
+def test_collectives_dtype_sweep(capsys):
+    """--dtype bf16/int8 payloads flow through the sweep, including the
+    integer-payload branch and the Pallas ring's per-dtype tiling."""
+    from benchmarks.collectives import main as coll_main
+
+    coll_main(["--world", "4", "--sizes", "4K", "--iters", "1", "--warmup", "1",
+               "--dtype", "bf16", "--collectives", "allreduce",
+               "--impls", "xla,strategy"])
+    out = capsys.readouterr().out
+    assert "allreduce" in out and "dtype=bf16" in out
+
+    coll_main(["--world", "4", "--sizes", "2K", "--iters", "1", "--warmup", "1",
+               "--dtype", "int8", "--collectives", "allreduce",
+               "--impls", "pallas_ring", "--json"])
+    import json as _json
+
+    rows = [
+        _json.loads(l) for l in capsys.readouterr().out.splitlines() if l.strip()
+    ]
+    assert rows and all(r["dtype"] == "int8" for r in rows)
+    assert any(r["impl"] == "pallas_ring" for r in rows)

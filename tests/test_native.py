@@ -15,7 +15,7 @@ from adapcc_tpu.comm.relay import (
     prune_reduce_rounds,
 )
 from adapcc_tpu.strategy.ir import Strategy
-from adapcc_tpu.strategy.xml_io import emit_strategy_xml, parse_strategy_xml
+from adapcc_tpu.strategy.xml_io import emit_strategy_xml
 
 pytestmark = pytest.mark.skipif(
     not native.available(), reason="libadapcc_rt.so not built (run `make native`)"

@@ -65,7 +65,7 @@ def test_ring_attention_bf16_and_grads(mesh8):
 
 def test_column_row_parallel_pair(mesh8):
     """Column→row sharded matmul chain equals the dense chain."""
-    
+
     from jax import shard_map
 
     rng = np.random.default_rng(2)
