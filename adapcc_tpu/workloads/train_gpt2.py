@@ -173,11 +173,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--loss", choices=("dense", "chunked"), default="dense",
                    help="LM loss: dense materializes [B,T,vocab] logits; "
                         "chunked fuses the head into an online-softmax scan")
+    p.add_argument("--accum", type=int, default=1,
+                   help="gradient accumulation: microbatches per step "
+                        "(DDP path; per-rank batch must divide by it)")
+    p.add_argument("--zero1", action="store_true",
+                   help="shard the optimizer state ZeRO-1 style inside the "
+                        "adaptive DDP step (fp32 flat master)")
     return p
 
 
 def run(args) -> Tuple[float, float]:
     """Train; returns (initial_val_ppl, final_val_ppl)."""
+    if args.sp != "none" and (args.accum != 1 or args.zero1):
+        raise ValueError(
+            "--accum/--zero1 ride the DDP trainer; they are not wired "
+            "into the sequence-parallel step — drop --sp to use them"
+        )
     from adapcc_tpu.launch import maybe_initialize_distributed
 
     maybe_initialize_distributed()
@@ -254,8 +265,14 @@ def run(args) -> Tuple[float, float]:
         sp_step = gpt2_sp_train_step(sp_model, tx, mesh, loss=args.loss)
         trainer = None
     else:
-        trainer = DDPTrainer(loss_fn, tx, mesh, Strategy.ring(world))
-    state = TrainState.create(params, tx)
+        trainer = DDPTrainer(
+            loss_fn, tx, mesh, Strategy.ring(world),
+            accum_steps=args.accum, zero1=args.zero1,
+        )
+    state = (
+        trainer.init_state(params) if trainer is not None
+        else TrainState.create(params, tx)
+    )
 
     initial_ppl = evaluate_perplexity(model, state.params, val_set)
     uniform = float(args.vocab)

@@ -153,3 +153,24 @@ def test_sp_workload_rejects_indivisible_seq():
     )
     with pytest.raises(ValueError, match="divide by world"):
         run(args)
+
+
+def test_workload_accum_zero1_flags():
+    """--accum + --zero1 train through the adaptive DDP step; combining
+    either with --sp is rejected before any training."""
+    args = build_parser().parse_args(
+        ["--epochs", "3", "--batch", "16", "--corpus-tokens", "2500",
+         "--world", "8", "--seq", "16", "--layers", "1", "--heads", "2",
+         "--dmodel", "32", "--accum", "2", "--zero1",
+         "--warmup-steps", "2", "--lr", "1e-2"]
+    )
+    initial, final = run(args)
+    assert final < initial * 0.9  # a real drop, not uniform-bound noise
+
+    bad = build_parser().parse_args(
+        ["--sp", "ring", "--zero1", "--epochs", "1", "--corpus-tokens", "2000",
+         "--batch", "4", "--seq", "16", "--layers", "1", "--heads", "2",
+         "--dmodel", "32"]
+    )
+    with pytest.raises(ValueError, match="drop --sp"):
+        run(bad)
