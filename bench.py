@@ -29,7 +29,7 @@ Size knobs via env (defaults target a single v5e chip):
     BENCH_LAYERS, BENCH_DMODEL, BENCH_HEADS, BENCH_SEQ, BENCH_BATCH,
     BENCH_STEPS, BENCH_WORLD, BENCH_PEAK_TFLOPS, BENCH_ATTN (flash|xla),
     BENCH_PARAM_DTYPE (bf16|f32), BENCH_LOSS (dense|chunked),
-    BENCH_REMAT (off|full|dots|dots_no_batch), BENCH_SCAN (1|0),
+    BENCH_REMAT (off|full|dots|dots_no_batch), BENCH_SCAN (1|0), BENCH_ACCUM,
     BENCH_PREFLIGHT_S, BENCH_ATTEMPTS, BENCH_DEADLINE
 """
 
@@ -230,6 +230,8 @@ def main() -> None:
         )
         _RESULT["remat"] = remat_policy or "off"
         per_rank_batch = _env_int("BENCH_BATCH", 16)
+        accum = _env_int("BENCH_ACCUM", 1)
+        _RESULT["accum"] = accum
         batch = per_rank_batch * world
         steps = _env_int("BENCH_STEPS", 10)
 
@@ -302,6 +304,9 @@ def main() -> None:
         trainer = DDPTrainer(
             loss_fn, tx, mesh, Strategy.ring(world),
             donate_state=True, use_xla_fastpath=True,
+            # BENCH_ACCUM>1 scans microbatches inside the step: activation
+            # memory / accum at unchanged math — the HBM headroom knob
+            accum_steps=accum,
         )
         # both paths donate their state; give each its own param buffers
         fw_state = TrainState.create(jax.tree_util.tree_map(jnp.array, params), tx)

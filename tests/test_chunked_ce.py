@@ -227,3 +227,28 @@ def test_vocab_parallel_padded_shard_regression(mesh8):
     ox, ow = jax.grad(lambda x, w: _dense_xent(x, w, y), argnums=(0, 1))(x, w)
     np.testing.assert_allclose(np.asarray(dx[0]), np.asarray(ox), atol=2e-6)
     np.testing.assert_allclose(np.asarray(dw), np.asarray(ow), atol=2e-6)
+
+
+def test_sp_chunked_loss_ulysses_path(mesh8):
+    """The chunked SP loss is orthogonal to the attention scheme: parity
+    with the dense SP loss holds on the Ulysses program too."""
+    import dataclasses
+
+    from adapcc_tpu.parallel import gpt2_sp_loss_and_grad
+
+    cfg = GPT2Config(
+        vocab_size=48, max_seq=32, n_layer=1, n_head=8, d_model=16,
+        dtype=jnp.float32, sp_axis="ranks", sp_impl="ulysses",
+    )
+    model = GPT2(cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(9).integers(0, cfg.vocab_size, size=(2, 32)), jnp.int32
+    )
+    params = GPT2(dataclasses.replace(cfg, sp_axis=None)).init(
+        jax.random.PRNGKey(0), tokens
+    )
+    ld, gd = gpt2_sp_loss_and_grad(model, mesh8, loss="dense")(params, tokens)
+    lc, gc = gpt2_sp_loss_and_grad(model, mesh8, loss="chunked")(params, tokens)
+    np.testing.assert_allclose(float(lc), float(ld), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(gd), jax.tree_util.tree_leaves(gc)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
