@@ -31,9 +31,34 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Single source of truth for "is the backend live" — shared with
+# scripts/hw_watch.py so the watcher and the battery can never disagree
+# about what a live window means.  Honors JAX_PLATFORMS when set (the
+# axon sitecustomize overrides the env var; unset = the real TPU default).
+PROBE_CODE = (
+    "import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
+    "p and jax.config.update('jax_platforms', p); "
+    "import jax.numpy as jnp, json; d = jax.devices(); "
+    "jax.jit(lambda a: a + 1)(jnp.ones(8)).block_until_ready(); "
+    "print(json.dumps({'device': str(d[0]), "
+    "'kind': getattr(d[0], 'device_kind', '?'), "
+    "'platform': d[0].platform}))"
+)
+
+
+def hw_env() -> dict:
+    """Child env for hardware runs: strip the virtual-CPU-pod pins."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    return env
+
 
 def _run(name: str, cmd, timeout: int, out_path: str, extra_env=None) -> dict:
-    env = {**os.environ, **(extra_env or {})}
+    # base on hw_env(), not raw os.environ: a leaked JAX_PLATFORMS=cpu /
+    # XLA_FLAGS pin from the test regime must not silently turn the
+    # hardware battery into a CPU battery when invoked directly
+    env = {**hw_env(), **(extra_env or {})}
     t0 = time.time()
     rec: dict = {"phase": name, "cmd": " ".join(cmd)}
     try:
@@ -65,19 +90,17 @@ def main() -> int:
     os.makedirs(os.path.dirname(out), exist_ok=True)
     py = sys.executable
 
-    probe_code = (
-        # honor JAX_PLATFORMS if set (the axon sitecustomize overrides the
-        # env var; unset = the real TPU default)
-        "import os, jax; p = os.environ.get('JAX_PLATFORMS'); "
-        "p and jax.config.update('jax_platforms', p); "
-        "import jax.numpy as jnp, json; d = jax.devices(); "
-        "jax.jit(lambda a: a + 1)(jnp.ones(8)).block_until_ready(); "
-        "print(json.dumps({'device': str(d[0]), "
-        "'kind': getattr(d[0], 'device_kind', '?')}))"
-    )
-    probe = _run("probe", [py, "-c", probe_code], 120, out)
+    probe = _run("probe", [py, "-c", PROBE_CODE], 120, out)
     if probe.get("rc") != 0:
         print("[hw] tunnel dead at probe; aborting battery", flush=True)
+        return 1
+    # a CPU-fallback probe must not masquerade as a hardware window
+    # (HW_EXPECT_PLATFORM=any opts out, e.g. for harness smoke tests)
+    expect = os.environ.get("HW_EXPECT_PLATFORM", "tpu")
+    got = (probe.get("parsed") or {}).get("platform", "?")
+    if expect != "any" and got != expect:
+        print(f"[hw] probe platform {got!r} != expected {expect!r}; "
+              "aborting battery", flush=True)
         return 1
 
     trace_dir = os.path.join(REPO, "benchmarks", "results", f"trace_{tag}")

@@ -1,0 +1,136 @@
+"""Tunnel watcher: fire the hardware battery at the first live window.
+
+Round 3 lost its single ~2-minute TPU window because the probe loop lived
+in /tmp and nothing auto-fired the measurement battery (VERDICT r3,
+Missing #1).  This watcher closes that gap:
+
+- every ``--interval`` seconds (default 180) it runs a bounded probe
+  subprocess (tiny jit on the default backend; 90 s deadline),
+- every attempt is appended to ``benchmarks/results/hw_watch_<tag>.jsonl``
+  so the watching itself leaves an artifact,
+- on the first successful probe it execs ``python -m benchmarks.hw_session
+  <tag>`` (blocking; the battery appends per-phase JSONL as it goes), then
+  keeps watching for further windows and re-fires with suffixed tags
+  (``<tag>b``, ``<tag>c``) up to ``--max-batteries``.
+
+``JAX_PLATFORMS`` / ``XLA_FLAGS`` are stripped from child environments:
+the test-suite conftest pins a virtual CPU pod via those, and a leaked
+value would turn a hardware probe into a CPU probe.
+
+Usage::
+
+    python scripts/hw_watch.py r04 --max-hours 11
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import string
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from benchmarks.hw_session import PROBE_CODE, hw_env  # noqa: E402
+
+
+def probe(deadline: int) -> dict:
+    t0 = time.time()
+    rec: dict = {"ts": round(t0, 1)}
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", PROBE_CODE],
+            capture_output=True, text=True, timeout=deadline,
+            cwd=REPO, env=hw_env(),
+        )
+        rec["secs"] = round(time.time() - t0, 1)
+        if p.returncode == 0:
+            try:
+                rec.update(json.loads((p.stdout or "").strip().splitlines()[-1]))
+                # a CPU-fallback probe is NOT a live hardware window
+                expect = os.environ.get("HW_EXPECT_PLATFORM", "tpu")
+                rec["ok"] = expect == "any" or rec.get("platform") == expect
+                if not rec["ok"]:
+                    rec["error"] = f"platform {rec.get('platform')!r} != {expect!r}"
+            except (json.JSONDecodeError, IndexError):
+                rec["ok"] = False
+                rec["error"] = "unparseable probe output"
+        else:
+            rec["ok"] = False
+            rec["error"] = (p.stderr or "")[-300:]
+    except subprocess.TimeoutExpired:
+        rec["ok"] = False
+        rec["secs"] = round(time.time() - t0, 1)
+        rec["error"] = f"probe timeout after {deadline}s"
+    except Exception as e:  # fork/exec failures must not kill an 11 h watch
+        rec["ok"] = False
+        rec["secs"] = round(time.time() - t0, 1)
+        rec["error"] = f"{type(e).__name__}: {e}"
+    return rec
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("tag", nargs="?", default="r04")
+    ap.add_argument("--interval", type=int, default=180)
+    ap.add_argument("--probe-deadline", type=int, default=90)
+    ap.add_argument("--max-hours", type=float, default=11.0)
+    ap.add_argument("--max-batteries", type=int, default=3)
+    args = ap.parse_args()
+
+    out = os.path.join(REPO, "benchmarks", "results", f"hw_watch_{args.tag}.jsonl")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    end = time.time() + args.max_hours * 3600
+    succeeded = 0   # batteries whose own probe ran (rc==0) — these spend budget
+    attempts = 0    # all batteries fired, incl. ones a flapping window killed
+
+    print(f"[watch] probing every {args.interval}s until "
+          f"{args.max_batteries} good batteries or {args.max_hours}h", flush=True)
+    while time.time() < end:
+        rec = probe(args.probe_deadline)
+        with open(out, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        if rec.get("ok"):
+            # suffix repeat batteries: r04, r04b, ..., r04z, r04x26, r04x27, ...
+            if attempts == 0:
+                tag = args.tag
+            elif attempts < 26:
+                tag = args.tag + string.ascii_lowercase[attempts]
+            else:
+                tag = f"{args.tag}x{attempts}"
+            print(f"[watch] LIVE ({rec.get('kind')}) → battery {tag}", flush=True)
+            try:
+                bat_rc = subprocess.run(
+                    [sys.executable, "-m", "benchmarks.hw_session", tag],
+                    cwd=REPO, env=hw_env(),
+                ).returncode
+            except Exception as e:
+                bat_rc = -99
+                print(f"[watch] battery spawn failed: {e}", flush=True)
+            attempts += 1
+            if bat_rc == 0:
+                succeeded += 1
+            with open(out, "a") as f:
+                f.write(json.dumps({"ts": round(time.time(), 1),
+                                    "battery": tag, "rc": bat_rc}) + "\n")
+            # only batteries that got past their own probe spend the budget —
+            # a flapping tunnel must not exhaust attempts with zero data
+            if succeeded >= args.max_batteries:
+                print("[watch] battery budget spent; exiting", flush=True)
+                return 0
+            # a window just closed or battery finished — back off a little
+            time.sleep(max(args.interval, 300) if bat_rc == 0 else args.interval)
+        else:
+            print(f"[watch] dead ({rec.get('error', '?')[:60]})", flush=True)
+            time.sleep(args.interval)
+    print(f"[watch] {args.max_hours}h elapsed; "
+          f"{succeeded}/{attempts} batteries succeeded", flush=True)
+    return 0 if succeeded else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
