@@ -28,6 +28,16 @@ from adapcc_tpu.comm.mesh import RANKS_AXIS
 _END = object()
 
 
+class _PrefetchError:
+    """Private in-band wrapper for a producer failure — unambiguous even
+    when the iterator legitimately yields tuples."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 def prefetch_to_device(
     it: Iterator[Any],
     size: int = 2,
@@ -68,7 +78,7 @@ def prefetch_to_device(
                 if not _put(batch):
                     return
         except BaseException as e:  # noqa: BLE001 — re-raised at the consumer
-            _put(("__prefetch_error__", e))
+            _put(_PrefetchError(e))
             return
         _put(_END)
 
@@ -79,8 +89,8 @@ def prefetch_to_device(
             item = q.get()
             if item is _END:
                 return
-            if isinstance(item, tuple) and len(item) == 2 and item[0] == "__prefetch_error__":
-                raise RuntimeError("prefetch producer failed") from item[1]
+            if isinstance(item, _PrefetchError):
+                raise RuntimeError("prefetch producer failed") from item.exc
             yield item
     finally:
         # an abandoned iterator (break / exception in the consumer) must not

@@ -115,6 +115,7 @@ def emulate_heterogeneous_steps(
     """
     barrier = threading.Barrier(world_size)
     errors: List[BaseException] = []
+    broken: List[int] = []  # ranks that saw the barrier break
 
     def worker(rank: int) -> None:
         try:
@@ -124,7 +125,9 @@ def emulate_heterogeneous_steps(
                 probe.hook_arrive(step, rank)
                 barrier.wait(timeout=step_timeout_s)
         except threading.BrokenBarrierError:
-            pass  # a peer failed and aborted; its error is already captured
+            # either a peer aborted (its error is in `errors`) or this
+            # rank's wait timed out — the caller distinguishes below
+            broken.append(rank)
         except BaseException as exc:  # noqa: BLE001 — re-raised in the caller
             errors.append(exc)
             barrier.abort()  # release peers so the caller's join() returns
@@ -136,4 +139,9 @@ def emulate_heterogeneous_steps(
         t.join()
     if errors:
         raise errors[0]
+    if broken:  # barrier broke with no peer error captured: a wait timed out
+        raise TimeoutError(
+            f"emulation barrier broke on ranks {sorted(broken)} with no peer "
+            f"error — a barrier.wait exceeded step_timeout_s={step_timeout_s}"
+        )
     return [probe.wait_time(s) for s in range(num_steps)]

@@ -32,9 +32,11 @@ class MoEConfig:
     #: over tokens), keeping the fp32 softmax well-scaled.  The term rides
     #: inside the returned aux scalar, so its EFFECTIVE weight on the
     #: objective is this coefficient × the consumer's aux-loss weight —
-    #: with train_moe's default ``--aux-weight 0.01``, the 0.1 here lands on
-    #: ST-MoE's recommended effective 1e-3.  0 disables.
-    router_z_coef: float = 0.1
+    #: with train_moe's default ``--aux-weight 0.01``, a 0.1 here lands on
+    #: ST-MoE's recommended effective 1e-3.  Defaults to 0 (disabled) so the
+    #: aux objective is opt-in; workloads that want it set it explicitly
+    #: (train_moe passes 0.1).
+    router_z_coef: float = 0.0
 
     @staticmethod
     def tiny() -> "MoEConfig":

@@ -452,6 +452,16 @@ def zero1_train_step(
         return jax.jit(fn, donate_argnums=(1, 2))
 
     def stepper(params, master, opt_state, batch):
+        for leaf in jax.tree_util.tree_leaves(batch):
+            shape = getattr(leaf, "shape", None)
+            dim = shape[0] if shape else None
+            if dim is not None and dim % opt.world:
+                raise ValueError(
+                    f"zero1_train_step: batch leading dim {dim} does not "
+                    f"divide world={opt.world}; pad or resize the global "
+                    "batch (an indivisible batch would otherwise fail with "
+                    "an opaque shard_map/GSPMD error)"
+                )
         key = _tree_key(params)
         if key not in meta_holder:
             meta_holder[key] = build(params)

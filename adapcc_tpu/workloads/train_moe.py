@@ -76,6 +76,7 @@ def run(args) -> Tuple[float, float]:
     cfg = MoEConfig(
         num_experts=args.experts, d_model=args.dmodel, d_hidden=args.dhidden,
         top_k=args.top_k, capacity_factor=2.0, dtype=jnp.float32,
+        router_z_coef=0.1,
     )
     model = MoEMLP(cfg)
     x_np, y_np = _cluster_data(args.batch, cfg.d_model, args.classes)

@@ -179,8 +179,29 @@ def _pick_attention() -> str:
         return "xla"
 
 
+def _parse_remat_env() -> "str | None":
+    """Validate BENCH_REMAT before any slow phase — a typo must fail fast,
+    not after (or masked by) a multi-minute preflight."""
+    remat_env = os.environ.get("BENCH_REMAT", "").strip().lower()
+    if remat_env in ("", "0", "off", "false", "no", "none"):
+        return None
+    if remat_env in ("1", "on", "yes", "true", "full"):
+        return "full"
+    if remat_env in ("dots", "dots_no_batch"):
+        return remat_env
+    raise ValueError(
+        f"BENCH_REMAT={remat_env!r}: expected off/full/dots/dots_no_batch"
+    )
+
+
 def main() -> None:
     _arm_watchdog()
+    _phase_begin("config")
+    try:
+        remat_policy = _parse_remat_env()
+    except ValueError as e:
+        _RESULT["error"] = str(e)
+        _emit(2)
     _phase_begin("preflight")
     try:
         _RESULT["backend"] = preflight()
@@ -206,14 +227,6 @@ def main() -> None:
 
         world = _env_int("BENCH_WORLD", 0) or len(jax.devices())
         mesh = build_world_mesh(world)
-
-        remat_env = os.environ.get("BENCH_REMAT", "").strip().lower()
-        if remat_env in ("", "0", "off", "false"):
-            remat_policy = None
-        elif remat_env in ("dots", "dots_no_batch", "full"):
-            remat_policy = remat_env
-        else:  # generic truthy: 1/on/yes → full recompute
-            remat_policy = "full"
 
         attention = _pick_attention()
         cfg = GPT2Config(
