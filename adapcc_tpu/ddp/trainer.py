@@ -452,6 +452,14 @@ class DDPTrainer:
         self._flush_gns()
         return self._gns
 
+    def reset(self) -> None:
+        """Zero the host step counter and drop any banked (deferred)
+        gradients, keeping compiled programs.  For harnesses that warm up
+        the compile cache on throwaway state before a measured run."""
+        self._host_step = 0
+        self._deferred = None
+        self._bank_dirty = False
+
     # -- re-adaptation ---------------------------------------------------------
 
     def rebuild(self, strategy: Strategy) -> None:

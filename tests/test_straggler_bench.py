@@ -1,0 +1,82 @@
+"""Straggler-regime benchmark regression: the adaptive sync plane must beat
+full-wait BSP under injected heterogeneity (the experiment that justifies
+the coordinator/relay machinery; reference problem evidence:
+units-test/wait_time_heter_bc128.csv + get_wait_time.py heter_alpha).
+
+Committed artifact: benchmarks/results/straggler_virtual8_r04.jsonl.
+Margins are generous — the suite box is single-core and thread scheduling
+is noisy; the committed artifact carries the headline numbers.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from benchmarks.straggler import main as straggler_main
+
+
+@pytest.fixture(scope="module")
+def persistent_records():
+    return straggler_main(
+        [
+            "--world", "8", "--steps", "12", "--base-ms", "10",
+            "--alpha", "6", "--pattern", "persistent",
+        ]
+    )
+
+
+@pytest.fixture(scope="module")
+def bursty_records():
+    return straggler_main(
+        [
+            "--world", "8", "--steps", "12", "--base-ms", "10",
+            "--alpha", "6", "--pattern", "bursty",
+        ]
+    )
+
+
+def test_persistent_rentbuy_beats_full_wait(persistent_records):
+    """Rent-or-buy freeze + relay skip must outrun full-wait BSP when one
+    rank is persistently alpha x slower: the leader stops waiting once
+    renting costs more than buying (logic.hook_arrive), so per-step wait
+    drops from alpha*base to ~base + rent window."""
+    a, b, _ = persistent_records
+    assert a["mode"] == "full_wait" and b["mode"] == "rentbuy_bsp"
+    # measured ~2.1x on the artifact run; require a conservative floor
+    assert b["steps_per_s"] >= 1.3 * a["steps_per_s"], (a, b)
+    assert b["wait_mean_ms"] <= 0.7 * a["wait_mean_ms"], (a, b)
+    # the straggler is excluded, not waited for
+    assert b["active_mean"] < 8.0
+    assert a["active_mean"] == 8.0
+
+
+def test_persistent_async_also_beats_full_wait(persistent_records):
+    a, _, c = persistent_records
+    assert c["mode"] == "rentbuy_async"
+    # wall time on the tiny test model is dominated by the bank's O(params)
+    # device overhead (negligible vs a real backward); the wait component is
+    # the transferable claim — the artifact run shows 1.9x wall at 40 steps
+    assert c["wait_mean_ms"] <= 0.7 * a["wait_mean_ms"], (a, c)
+    # a never-rejoining straggler's bank never lands: async == bsp in
+    # landed data (the honest accounting, not the optimistic one)
+    assert c["landed_fraction"] == pytest.approx(7 / 8, abs=0.05)
+
+
+def test_bursty_async_bank_recovers_dropped_gradients(bursty_records):
+    """With an intermittent (1-in-4) straggler the rank catches back up and
+    rejoins; the async bank then folds its deferred gradients into the
+    masked average (hook.sync_deferred), so landed data beats BSP drop and
+    the trajectory actually moves (different final loss)."""
+    a, b, c = bursty_records
+    assert c["landed_fraction"] >= b["landed_fraction"] + 0.05, (b, c)
+    # rejoin visible: some steps ran full-world, some masked
+    assert max(c["active_counts"]) == 8 and min(c["active_counts"]) < 8
+    # banked gradients landing must change the trajectory vs dropping them
+    assert c["final_eval_loss"] != b["final_eval_loss"], (b, c)
+
+
+def test_bursty_adaptive_caps_tail_wait(bursty_records):
+    """Even when mean throughput is a wash (only 1 in 4 steps is slow), the
+    adaptive path caps the tail: no step waits the full alpha*base."""
+    a, b, _ = bursty_records
+    assert b["wait_p95_ms"] <= 0.7 * a["wait_p95_ms"], (a, b)
