@@ -116,6 +116,176 @@ def _mask_contribution(
     return jnp.where(my_active, seg, _identity_for(op, seg.dtype))
 
 
+# --------------------------------------------------------------------------- #
+# merged multi-tree execution: one ppermute per round ACROSS trees
+# --------------------------------------------------------------------------- #
+#
+# The reference gets tree-level concurrency from one pthread pair per tree
+# (allreduce.cu:735-742): all trees' round-k transfers ride different links
+# at the same wall-clock time.  The naive XLA lowering loses that — each
+# tree's round chain runs sequentially inside the traced program.  Rotated
+# trees (ring / binary / ParTrees) have isomorphic round structures, so the
+# merged executor stacks the per-tree segments into one [T, seg] buffer and
+# combines every tree's round-k edges into as few ppermutes as the
+# partial-permutation contract allows (greedy coloring: within one ppermute
+# each rank sends at most once and receives at most once).  Each rank
+# *selects* which tree's row it sends from a static per-round table, so the
+# per-link bytes are identical to the sequential path — only the dispatch
+# count drops, by ~num_trans.  A ring strategy with T=world merged this way
+# IS the bandwidth-optimal segmented ring allreduce (reduce-scatter shape up,
+# all-gather shape down).
+
+
+class _MergedPlan:
+    """Static per-round send/receive tables for merged multi-tree execution.
+
+    Each group is ``(perm, src_row, dst_row, is_dst)``: the ppermute edge
+    list plus, per rank, which stacked row it sends / receives into.
+    """
+
+    def __init__(self, reduce_groups, broadcast_groups):
+        self.reduce_groups = reduce_groups
+        self.broadcast_groups = broadcast_groups
+
+
+def _color_rounds(per_tree_rounds: Sequence[Sequence[CommRound]], world: int):
+    """Align trees' rounds by index and split each union into valid partial
+    permutations; returns the group table list."""
+    groups = []
+    depth = max((len(r) for r in per_tree_rounds), default=0)
+    for k in range(depth):
+        edges: List[Tuple[int, int, int]] = []  # (src, dst, tree)
+        for ti, rounds in enumerate(per_tree_rounds):
+            if k < len(rounds):
+                edges.extend((s, d, ti) for s, d in rounds[k].edges)
+        colors: List[List[Tuple[int, int, int]]] = []
+        for e in edges:
+            for c in colors:
+                if all(e[0] != s and e[1] != d for s, d, _ in c):
+                    c.append(e)
+                    break
+            else:
+                colors.append([e])
+        for c in colors:
+            perm = tuple((s, d) for s, d, _ in c)
+            src_row = np.zeros((world,), np.int32)
+            dst_row = np.zeros((world,), np.int32)
+            is_dst = np.zeros((world,), bool)
+            for s, d, t in c:
+                src_row[s] = t
+                dst_row[d] = t
+                is_dst[d] = True
+            groups.append((perm, src_row, dst_row, is_dst))
+    return groups
+
+
+_MERGED_PLANS: Dict[Tuple, Optional[_MergedPlan]] = {}
+
+
+def _merged_plan(strategy: Strategy) -> Optional[_MergedPlan]:
+    """Build (and cache) the merged plan, or None when merging buys nothing:
+    a single tree (groups == rounds) or heavily skewed MILP shares (stacking
+    pads every segment to the largest, wasting bandwidth).
+
+    ``ADAPCC_MERGE_ROUNDS=0`` disables merging — the A/B knob for measuring
+    the merged executor against sequential per-tree chains on hardware.
+    """
+    import os
+
+    if os.environ.get("ADAPCC_MERGE_ROUNDS", "1") in ("0", "off", "false"):
+        return None
+    shares = strategy.tree_shares()
+    key = (strategy.fingerprint(), tuple(round(s, 6) for s in shares))
+    if key in _MERGED_PLANS:
+        return _MERGED_PLANS[key]
+    plan: Optional[_MergedPlan] = None
+    if len(strategy.trees) > 1 and max(shares) <= 2.0 * min(shares):
+        reduce_rounds = [t.reduce_rounds() for t in strategy.trees]
+        bcast_rounds = [t.broadcast_rounds() for t in strategy.trees]
+        rg = _color_rounds(reduce_rounds, strategy.world_size)
+        bg = _color_rounds(bcast_rounds, strategy.world_size)
+        n_sequential = sum(len(r) for r in reduce_rounds) + sum(
+            len(r) for r in bcast_rounds
+        )
+        if len(rg) + len(bg) < n_sequential:
+            plan = _MergedPlan(rg, bg)
+    _MERGED_PLANS[key] = plan
+    return plan
+
+
+def _stack_segments(
+    flat: jnp.ndarray, sizes: Sequence[int], pad_value
+) -> jnp.ndarray:
+    """[n] → [T, max(sizes)] with each tree's segment padded to the max."""
+    pad = max(sizes)
+    rows = []
+    off = 0
+    for size in sizes:
+        seg = flat[off : off + size]
+        if size < pad:
+            seg = jnp.concatenate([seg, jnp.full((pad - size,), pad_value, flat.dtype)])
+        rows.append(seg)
+        off += size
+    return jnp.stack(rows)
+
+
+def _unstack_segments(stacked: jnp.ndarray, sizes: Sequence[int]) -> jnp.ndarray:
+    return jnp.concatenate([stacked[t, :size] for t, size in enumerate(sizes)])
+
+
+def _run_merged_groups(
+    stacked: jnp.ndarray,
+    groups,
+    axis_name: str,
+    combine: str,
+) -> jnp.ndarray:
+    """Run one phase's merged rounds: each group is one ppermute where rank r
+    sends its ``src_row[r]``-th stacked row and folds the received segment
+    into its ``dst_row[r]``-th row (``combine``: add | max | adopt)."""
+    me = lax.axis_index(axis_name)
+    for perm, src_row, dst_row, is_dst in groups:
+        send = lax.dynamic_index_in_dim(
+            stacked, jnp.asarray(src_row)[me], 0, keepdims=False
+        )
+        recvd = lax.ppermute(send, axis_name, perm)
+        row = jnp.asarray(dst_row)[me]
+        sel = jnp.asarray(is_dst)[me]
+        cur = lax.dynamic_index_in_dim(stacked, row, 0, keepdims=False)
+        if combine == "add":
+            new = jnp.where(sel, cur + recvd, cur)
+        elif combine == "max":
+            new = jnp.where(sel, jnp.maximum(cur, recvd), cur)
+        else:  # adopt (broadcast)
+            new = jnp.where(sel, recvd, cur)
+        stacked = lax.dynamic_update_index_in_dim(stacked, new, row, 0)
+    return stacked
+
+
+def _run_merged(
+    x: jnp.ndarray,
+    strategy: Strategy,
+    plan: _MergedPlan,
+    axis_name: str,
+    op: ReduceOp,
+    phases: str,  # "reduce" | "broadcast" | "both"
+    active_mask: Optional[jnp.ndarray],
+) -> jnp.ndarray:
+    flat = x.reshape(-1)
+    if flat.size == 0:
+        return x
+    if active_mask is not None:
+        flat = _mask_contribution(flat, active_mask, axis_name, op)
+    sizes = _segment_sizes(flat.size, strategy.tree_shares())
+    pad_value = _identity_for(op, flat.dtype)
+    stacked = _stack_segments(flat, sizes, pad_value)
+    if phases in ("reduce", "both"):
+        combine = "max" if op is ReduceOp.MAX else "add"
+        stacked = _run_merged_groups(stacked, plan.reduce_groups, axis_name, combine)
+    if phases in ("broadcast", "both"):
+        stacked = _run_merged_groups(stacked, plan.broadcast_groups, axis_name, "adopt")
+    return _unstack_segments(stacked, sizes).reshape(x.shape)
+
+
 def _run_segments(
     x: jnp.ndarray,
     strategy: Strategy,
@@ -179,6 +349,10 @@ def allreduce_shard(
     (relays receive too, matching the reference broadcast phase).
     """
     world = strategy.world_size
+    plan = _merged_plan(strategy)
+    if plan is not None:
+        result = _run_merged(x, strategy, plan, axis_name, op, "both", active_mask)
+        return _avg_normalize(result, active_mask, op)
 
     def per_segment(seg, tree):
         acc = _mask_contribution(seg, active_mask, axis_name, op)
@@ -199,6 +373,10 @@ def reduce_shard(
     (reference reduceContext keeps the result at the root, reduce.cu:258-269);
     other ranks hold partial sums for their segment."""
     world = strategy.world_size
+    plan = _merged_plan(strategy)
+    if plan is not None:
+        result = _run_merged(x, strategy, plan, axis_name, op, "reduce", active_mask)
+        return _avg_normalize(result, active_mask, op)
 
     def per_segment(seg, tree):
         acc = _mask_contribution(seg, active_mask, axis_name, op)
@@ -264,6 +442,11 @@ def broadcast_shard(
     else's (reference boardcastContext reads the user tensor at the root,
     boardcast.cu:279-282)."""
     world = strategy.world_size
+    plan = _merged_plan(strategy)
+    if plan is not None:
+        return _run_merged(
+            x, strategy, plan, axis_name, ReduceOp.SUM, "broadcast", None
+        )
 
     def per_segment(seg, tree):
         return _run_broadcast_rounds(seg, tree.broadcast_rounds(), axis_name, world)
@@ -352,6 +535,13 @@ class CollectiveEngine:
                 f"{self.world_size}, got shape {stacked.shape}"
             )
 
+    def _schedule_variant(self) -> Tuple[str, bool]:
+        """Cache-key component for schedule-path programs: the strategy
+        fingerprint plus whether the trace will take the merged-round path —
+        flipping ADAPCC_MERGE_ROUNDS mid-process must miss the cache, not
+        replay a program traced under the other setting."""
+        return (self.strategy.fingerprint(), _merged_plan(self.strategy) is not None)
+
     def _shard_mapped(self, key: Tuple, per_shard: Callable, n_args: int) -> Callable:
         fn = self._cache.get(key)
         if fn is None:
@@ -399,7 +589,7 @@ class CollectiveEngine:
                 axis_name=self.axis_name,
                 op=op,
             )
-            key = ("allreduce", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name, op)
+            key = ("allreduce", self._schedule_variant(), stacked.shape, stacked.dtype.name, op)
         self._record("allreduce", "xla" if key[0] == "psum" else "schedule", stacked)
         return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
@@ -436,7 +626,7 @@ class CollectiveEngine:
             per_shard = functools.partial(
                 reduce_shard, strategy=self.strategy, axis_name=self.axis_name, op=op
             )
-            key = ("reduce", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name, op)
+            key = ("reduce", self._schedule_variant(), stacked.shape, stacked.dtype.name, op)
         self._record("reduce", "schedule", stacked)
         return self._shard_mapped(key, per_shard, 2)(stacked, self._active_to_mask(active_gpus))
 
@@ -474,7 +664,7 @@ class CollectiveEngine:
             per_shard = functools.partial(
                 broadcast_shard, strategy=self.strategy, axis_name=self.axis_name
             )
-            key = ("broadcast", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name)
+            key = ("broadcast", self._schedule_variant(), stacked.shape, stacked.dtype.name)
         # trace vocabulary is normalized ("broadcast"); only the API keeps
         # the reference's "boardcast" spelling
         self._record("broadcast", "schedule", stacked)

@@ -348,7 +348,7 @@ class Strategy:
             order = [(t + i) % world_size for i in range(world_size)]
             children = {order[i]: [order[i + 1]] for i in range(world_size - 1)}
             trees.append(Tree(order[0], children, ips))
-        return Strategy(trees, world_size)
+        return Strategy(trees, world_size, synthesis="ring")
 
     @staticmethod
     def binary(world_size: int, num_trans: int = 1, ips: Optional[Dict[int, str]] = None) -> "Strategy":
@@ -364,4 +364,4 @@ class Strategy:
                 if kids:
                     children[order[i]] = kids
             trees.append(Tree(order[0], children, ips))
-        return Strategy(trees, world_size)
+        return Strategy(trees, world_size, synthesis="binary")

@@ -64,6 +64,9 @@ class BenchResult:
     algbw_gbps: float
     busbw_gbps: float
     dtype: str = "float32"
+    #: strategy shape behind "strategy"-impl rows, e.g. "ring x8 (merged)";
+    #: "" for strategy-independent impls (xla, pallas_ring)
+    strategy: str = ""
 
     def to_json(self) -> str:
         return json.dumps(dataclasses.asdict(self))
@@ -153,6 +156,18 @@ def _make_ops(engine, elems: int, dtype=jnp.float32) -> Dict[str, tuple]:
     return ops
 
 
+def _strategy_label(engine) -> str:
+    """Self-describing artifact rows: strategy shape + whether the engine's
+    schedule path runs merged multi-tree rounds."""
+    from adapcc_tpu.comm.engine import _merged_plan
+
+    strat = engine.strategy
+    label = f"{strat.synthesis or 'unnamed'} x{strat.num_trans}"
+    if not getattr(engine, "two_level", False) and _merged_plan(strat) is not None:
+        label += " (merged)"
+    return label
+
+
 def run_sweep(
     engine,
     sizes_bytes: Sequence[int],
@@ -185,6 +200,7 @@ def run_sweep(
                     algbw_gbps=algbw,
                     busbw_gbps=algbw * BUS_FACTORS[coll](world),
                     dtype=jnp.dtype(dtype).name,
+                    strategy=_strategy_label(engine) if impl == "strategy" else "",
                 )
             )
     return results
@@ -220,6 +236,9 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--strategy", choices=["ring", "binary"], default="binary")
+    ap.add_argument("--trans", type=int, default=1,
+                    help="num_trans parallel trees (the reference's parallel-"
+                    "transmission axis; >1 engages merged-round execution)")
     ap.add_argument("--dtype", choices=["f32", "bf16", "int8"], default="f32",
                     help="payload dtype (pallas_ring has per-dtype tiling)")
     ap.add_argument(
@@ -246,10 +265,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                 f'--two-level expects "DxI" with D, I >= 2 (e.g. 2x4), '
                 f"got {args.two_level!r}"
             )
-        if args.world or args.strategy != "binary":
+        if args.world or args.strategy != "binary" or args.trans != 1:
             ap.error(
-                "--two-level is exclusive with --world/--strategy: the mesh "
-                "size is DxI and the hierarchy is ParTrees-synthesized"
+                "--two-level is exclusive with --world/--strategy/--trans: "
+                "the mesh size is DxI and the hierarchy is ParTrees-synthesized"
             )
         if impls and "pallas_ring" in impls:
             ap.error(
@@ -271,7 +290,9 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         world = args.world or len(jax.devices())
         mesh = build_world_mesh(world)
         strategy = (
-            Strategy.ring(world) if args.strategy == "ring" else Strategy.binary(world)
+            Strategy.ring(world, args.trans)
+            if args.strategy == "ring"
+            else Strategy.binary(world, args.trans)
         )
     engine = CollectiveEngine(mesh, strategy)
 

@@ -108,6 +108,45 @@ def test_committed_busbw_artifact_parses_and_is_consistent():
         assert any(c == coll for c, _ in seen), f"missing {coll}"
 
 
+def test_committed_busbw_r04_artifact_merged_rounds_win():
+    """Round-4 sweep artifact: accounting holds, rows are self-describing
+    (strategy labels), the merged multi-tree executor beats the sequential
+    per-tree chains on the same ring x8 strategy at every common size, and
+    the Pallas ring rows cover the dtype tiling matrix."""
+    import json
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "results", "busbw_virtual8_r04.jsonl",
+    )
+    rows = [json.loads(line) for line in open(path) if line.strip()]
+    assert len(rows) >= 30
+    merged, unmerged = {}, {}
+    pallas_dtypes = set()
+    for r in rows:
+        assert r["world"] == 8
+        factor = BUS_FACTORS[r["collective"]](r["world"])
+        assert abs(r["busbw_gbps"] - r["algbw_gbps"] * factor) < 1e-9 * max(
+            1.0, r["busbw_gbps"]
+        ), r
+        if r["impl"] == "strategy":
+            assert r["strategy"], "strategy rows must be self-describing"
+            if r["strategy"] == "ring x8 (merged)":
+                merged[r["size_bytes"]] = r["busbw_gbps"]
+            elif r["strategy"] == "ring x8":
+                unmerged[r["size_bytes"]] = r["busbw_gbps"]
+        if r["impl"] == "pallas_ring":
+            pallas_dtypes.add(r["dtype"])
+    common = set(merged) & set(unmerged)
+    assert common, "artifact must carry the merged-vs-sequential A/B"
+    for size in common:
+        assert merged[size] > 1.5 * unmerged[size], (
+            size, merged[size], unmerged[size],
+        )
+    assert {"float32", "bfloat16", "int8"} <= pallas_dtypes
+
+
 def test_longcontext_sweep_tiny_and_artifact():
     """benchmarks/longcontext.py: a tiny live sweep plus the committed
     round-3 artifact parse (memory accounting must match the scheme)."""
