@@ -705,6 +705,18 @@ class CollectiveEngine:
                 f"all_to_all needs a [world, world, ...] stacked array, got {stacked.shape}"
             )
 
+        if self.two_level:
+            from adapcc_tpu.comm.two_level import all_to_all_two_level_shard
+
+            def per_shard(x):  # x: [1, world, *payload]
+                return all_to_all_two_level_shard(
+                    x[0], self.num_slices, self.ici_size
+                )[None]
+
+            key = ("alltoall2l", stacked.shape, stacked.dtype.name)
+            self._record("all_to_all", "two_level", stacked)
+            return self._shard_mapped(key, per_shard, 1)(stacked)
+
         def per_shard(x):  # x: [1, world, *payload]
             return lax.all_to_all(x[0], self.axis_name, split_axis=0, concat_axis=0)[None]
 

@@ -32,12 +32,20 @@ def _moe_shard(
     x: jnp.ndarray,
     *,
     cfg: MoEConfig,
-    axis_name: str,
+    axis_name,
     capacity: int,
+    a2a=None,
 ):
     """Per-shard EP MoE.  ``x [n_loc, D]`` token shard; ``w1/w2`` carry this
     rank's expert slice ``[E_loc, ...]``; router params are replicated.
+    ``axis_name`` may be a tuple of mesh axes (two-level worlds); ``a2a``
+    overrides the token shuffle (e.g. the hierarchical DCN×ICI exchange).
     Returns ``(y [n_loc, D], aux_loss)``."""
+    if a2a is None:
+        a2a = partial(
+            lax.all_to_all, axis_name=axis_name, split_axis=0, concat_axis=0,
+            tiled=False,
+        )
     world = lax.psum(1, axis_name)
     n_loc, D = x.shape
     E = cfg.num_experts
@@ -83,7 +91,7 @@ def _moe_shard(
     expert_in = expert_in.reshape(world, e_loc, capacity, D)
     # exchange: afterwards axis 0 indexes the *source* rank and the local
     # expert slice is mine
-    recv = lax.all_to_all(expert_in, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    recv = a2a(expert_in)
 
     # --- my experts run on everyone's tokens ----------------------------
     flat = recv.transpose(1, 0, 2, 3).reshape(e_loc, world * capacity, D)
@@ -92,7 +100,7 @@ def _moe_shard(
     out = out.reshape(e_loc, world, capacity, D).transpose(1, 0, 2, 3)
 
     # --- return all-to-all + weighted combine ---------------------------
-    back = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0, tiled=False)
+    back = a2a(out)
     expert_out = back.reshape(E, capacity, D)
     y = jnp.einsum("nec,ecd->nd", combine.astype(cfg.dtype), expert_out)
     return y.astype(x.dtype), aux_loss
@@ -112,8 +120,37 @@ def expert_parallel_moe(
     Dense + stacked ``w1/w2``); experts shard over ``mesh[axis_name]``, tokens
     shard over the same axis (DP-style), router is replicated.  ``x [N, D]``
     with ``N`` divisible by the axis size.  Returns ``(y [N, D], aux_loss)``.
+
+    On a two-level ``("dcn", "ici")`` mesh the expert/token world is the
+    flattened ``dcn × ici`` grid and the dispatch/return shuffles run as the
+    hierarchical two-hop exchange (`all_to_all_two_level_shard`): intra-slice
+    regrouping on ICI, then strictly lane-aligned DCN traffic — instead of a
+    DCN-oblivious flat collective.
     """
-    world = mesh.shape[axis_name]
+    from adapcc_tpu.comm.two_level import (
+        all_to_all_two_level_shard,
+        is_two_level,
+    )
+
+    a2a = None
+    if is_two_level(mesh):
+        if axis_name != "experts":
+            raise ValueError(
+                "on a (dcn, ici) mesh expert_parallel_moe shards experts over "
+                f"the full flattened grid; a specific axis_name ({axis_name!r}) "
+                "would be silently ignored — build a flat sub-mesh for "
+                "single-axis EP instead"
+            )
+        num_slices, ici_size = (int(s) for s in mesh.devices.shape)
+        axis_name = tuple(mesh.axis_names)
+        world = num_slices * ici_size
+        a2a = partial(
+            all_to_all_two_level_shard,
+            num_slices=num_slices,
+            ici_size=ici_size,
+        )
+    else:
+        world = mesh.shape[axis_name]
     p = params["params"]
     if cfg.num_experts % world:
         raise ValueError(f"{cfg.num_experts} experts not divisible by world {world}")
@@ -122,7 +159,7 @@ def expert_parallel_moe(
         capacity = max(1, int(-(-cfg.capacity_factor * cfg.top_k * n_loc // cfg.num_experts)))
 
     fn = shard_map(
-        partial(_moe_shard, cfg=cfg, axis_name=axis_name, capacity=capacity),
+        partial(_moe_shard, cfg=cfg, axis_name=axis_name, capacity=capacity, a2a=a2a),
         mesh=mesh,
         in_specs=(P(), P(), P(axis_name), P(axis_name), P(axis_name)),
         out_specs=(P(axis_name), P()),
