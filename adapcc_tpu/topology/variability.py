@@ -33,18 +33,43 @@ _DRIFT_WINDOW = 12
 
 
 def detect_drift(
-    history: Sequence[float], threshold: float = 0.3, window: int = _DRIFT_WINDOW
+    history: Sequence[float],
+    threshold: float = 0.3,
+    window: int = _DRIFT_WINDOW,
+    consecutive: int = 1,
+    direction: str = "both",
 ) -> bool:
-    """Has the newest reading drifted > ``threshold`` (relative) from the
-    median of the trailing ``window``?  The trigger condition for
-    re-adaptation: a sustained bandwidth dip like the reference's observed
-    14.7 → 1.7 GB-scale drops (cloud/trace/bandwidth-hw.txt)."""
-    if len(history) < 2:
+    """Have the newest ``consecutive`` readings EACH drifted > ``threshold``
+    (relative) from the median of the trailing ``window`` before them?  The
+    trigger condition for re-adaptation: a sustained bandwidth dip like the
+    reference's observed 14.7 → 1.7 GB-scale drops
+    (cloud/trace/bandwidth-hw.txt).
+
+    ``consecutive > 1`` makes the trigger *sustained* — a single noisy probe
+    (scheduler jitter on a loaded host) cannot fire a re-synthesis.
+    ``direction`` limits which deviations count: ``"down"`` (a degraded
+    link — the case re-adaptation exists for), ``"up"``, or ``"both"``.
+    """
+    if direction not in ("down", "up", "both"):
+        raise ValueError(f"direction must be down/up/both, got {direction!r}")
+    if consecutive < 1:
+        raise ValueError(f"consecutive must be >= 1, got {consecutive}")
+    if len(history) < consecutive + 1:
         return False
-    base = statistics.median(history[-window - 1 : -1])
+    base = statistics.median(history[-window - consecutive : -consecutive])
     if base <= 0:
         return False
-    return abs(history[-1] - base) / base > threshold
+    for v in history[-consecutive:]:
+        rel = (v - base) / base
+        if direction == "down":
+            hit = rel < -threshold
+        elif direction == "up":
+            hit = rel > threshold
+        else:
+            hit = abs(rel) > threshold
+        if not hit:
+            return False
+    return True
 
 
 class VariabilityMonitor:
@@ -67,10 +92,14 @@ class VariabilityMonitor:
         drift_threshold: float = 0.3,
         on_drift: Optional[Callable[[float], None]] = None,
         max_samples: int = 100_000,
+        drift_consecutive: int = 1,
+        drift_direction: str = "both",
     ) -> None:
         self.interval_s = interval_s
         self.out_dir = out_dir
         self.drift_threshold = drift_threshold
+        self.drift_consecutive = drift_consecutive
+        self.drift_direction = drift_direction
         self.on_drift = on_drift
         # in-memory traces are bounded (oldest trimmed) — day-scale runs keep
         # their full history in the trace *files*, not in RAM
@@ -103,8 +132,15 @@ class VariabilityMonitor:
             self._append(os.path.join(self.out_dir, "latency.txt"), ts, t_lat)
         if self.on_drift is not None and detect_drift(
             # drift only reads the trailing window; don't copy full history
-            [v for _, v in self.bandwidth_trace[-_DRIFT_WINDOW - 1 :]],
+            [
+                v
+                for _, v in self.bandwidth_trace[
+                    -_DRIFT_WINDOW - self.drift_consecutive :
+                ]
+            ],
             self.drift_threshold,
+            consecutive=self.drift_consecutive,
+            direction=self.drift_direction,
         ):
             self.on_drift(gbps)
         return gbps, t_lat

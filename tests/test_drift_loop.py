@@ -1,0 +1,53 @@
+"""Drift → re-adaptation loop regression (benchmarks/drift_loop.py).
+
+Pins the closed loop the reference only motivates (cloud/trace/
+bandwidth-hw.txt): variability monitor detects an inter-host bandwidth
+collapse → the real ``AdapCC.reconstruct_topology`` re-profiles and ParTrees
+re-routes the master trees → the strategy fingerprint changes — while a
+control re-adaptation on a healthy fabric leaves it unchanged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from benchmarks.drift_loop import main as drift_main
+
+_ART = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "benchmarks", "results", "drift_virtual4x2_r04.jsonl",
+)
+
+
+def test_drift_triggers_strategy_change():
+    summary = drift_main(["--samples", "16", "--degrade-at", "8"])
+    assert summary["drift_detected_at"] is not None
+    assert summary["drift_detected_at"] >= 8, (
+        "drift must not fire before the degradation", summary,
+    )
+    # control: healthy re-adaptation kept the strategy (asserted inside
+    # main(), surfaced here for the record)
+    assert summary["fingerprint_control"] == summary["fingerprint_initial"]
+    assert summary["strategy_changed"], summary
+    # the trace actually shows the collapse
+    assert summary["bw_after_median"] < 0.5 * summary["bw_before_median"]
+
+
+def test_committed_drift_artifact():
+    rows = [json.loads(l) for l in open(_ART) if l.strip()]
+    assert rows, "committed drift artifact missing"
+    s = rows[-1]
+    assert s["strategy_changed"] is True
+    assert s["fingerprint_control"] == s["fingerprint_initial"]
+    assert s["fingerprint_after_drift"] != s["fingerprint_initial"]
+    # sustained detection: fires once `consecutive` degraded samples landed
+    assert s["degrade_at"] <= s["drift_detected_at"] <= s["degrade_at"] + 2
+    # the cloud-trace-shaped files sit alongside
+    trace_dir = _ART[: -len(".jsonl")]
+    for name in ("bandwidth.txt", "latency.txt"):
+        path = os.path.join(trace_dir, name)
+        lines = open(path).read().strip().splitlines()
+        assert len(lines) == s["samples"], (path, len(lines))
+        ts, val = lines[0].split()
+        float(ts), float(val)
