@@ -95,6 +95,17 @@ class VariabilityMonitor:
         drift_consecutive: int = 1,
         drift_direction: str = "both",
     ) -> None:
+        # fail bad drift config at construction — detect_drift's own checks
+        # would otherwise first fire on the monitor's daemon thread, killing
+        # monitoring with nothing but a stderr traceback
+        if drift_direction not in ("down", "up", "both"):
+            raise ValueError(
+                f"drift_direction must be down/up/both, got {drift_direction!r}"
+            )
+        if drift_consecutive < 1:
+            raise ValueError(
+                f"drift_consecutive must be >= 1, got {drift_consecutive}"
+            )
         self.interval_s = interval_s
         self.out_dir = out_dir
         self.drift_threshold = drift_threshold

@@ -14,7 +14,10 @@ Phases (priority order):
   3. bench        — flagship bench.py, default config (flash + bf16 + scan)
   4. bench_chunk  — bench.py with BENCH_LOSS=chunked
   5. bench_remat  — bench.py with BENCH_REMAT=dots
-  6. busbw        — benchmarks/collectives.py on the real chip (world=1)
+  6. bench_loop   — bench.py with BENCH_SCAN=0: per-step dispatch instead of
+                    the scanned window; (bench_loop.step_ms - bench.step_ms)
+                    IS the tunnel's per-dispatch tax (PERF_NOTES hyp. 2/5)
+  7. busbw        — benchmarks/collectives.py on the real chip (world=1)
 
 Usage::
 
@@ -116,6 +119,10 @@ def main() -> int:
     _run(
         "bench_remat", [py, "bench.py"], 1600, out,
         {"BENCH_DEADLINE": "1500", "BENCH_REMAT": "dots"},
+    )
+    _run(
+        "bench_loop", [py, "bench.py"], 1600, out,
+        {"BENCH_DEADLINE": "1500", "BENCH_SCAN": "0"},
     )
     _run(
         "busbw",

@@ -22,7 +22,46 @@ import jax  # noqa: E402
 # initializes.
 jax.config.update("jax_platforms", "cpu")
 
+import time  # noqa: E402
+
 import pytest  # noqa: E402
+
+_SUITE_T0 = time.time()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy test (>~15 s single-core).  Fast lane for "
+        "development: python -m pytest tests/ -q -m 'not slow' (~5 min); "
+        "the driver/judge invocation (tests/ -x -q) runs everything.",
+    )
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Suite wall-time budget guard (VERDICT r3 #8): the driver runs
+    ``pytest tests/ -x -q`` on a single-core box with a practical ~16 min
+    ceiling.  Non-fatal — a loaded box must not turn green tests red — but
+    loudly visible, so additions that blow the budget get trimmed or marked
+    ``slow`` in the same change that adds them."""
+    wall = time.time() - _SUITE_T0
+    budget = float(os.environ.get("ADAPCC_SUITE_BUDGET_S", "960"))
+    # count tests that RAN (deselected fast-lane tests must not trip the
+    # full-suite gate; stats keys are public API, unlike _numcollected)
+    n_run = sum(
+        len(terminalreporter.stats.get(k, []))
+        for k in ("passed", "failed", "error", "skipped")
+    )
+    terminalreporter.write_sep(
+        "-", f"suite wall {wall:.0f}s (budget {budget:.0f}s, {n_run} ran)"
+    )
+    if n_run > 400 and wall > budget:  # full-suite runs only
+        terminalreporter.write_line(
+            f"WARNING: full suite exceeded its {budget:.0f}s budget by "
+            f"{wall - budget:.0f}s — trim the heaviest tests (pytest "
+            "--durations=15) or move coverage to the slow marker",
+            red=True,
+        )
 
 # Build the native runtime once per checkout so the ctypes parity tests run
 # instead of skipping (the .so is a build artifact, not committed).

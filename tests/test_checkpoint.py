@@ -139,6 +139,7 @@ def test_run_elastic_gives_up_after_max_restarts():
     assert rc == 17
 
 
+@pytest.mark.slow
 def test_elastic_workload_survives_injected_crash(tmp_path):
     """E2E: supervised worker crashes after checkpointing epoch 0, restarts,
     and resumes from epoch 1 (main_elastic.py torchrun-elastic flow)."""

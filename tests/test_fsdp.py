@@ -6,6 +6,8 @@ match to float tolerance on the virtual 8-device pod.
 """
 
 import jax
+
+import pytest
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -188,6 +190,7 @@ def test_zero1_handles_nondivisible_param_count(mesh8):
 # ------------------------------------------------------------------ GPT-2 e2e
 
 
+@pytest.mark.slow
 def test_fsdp_gpt2_trains(mesh8):
     """Flagship-model integration: tiny GPT-2 under full FSDP — params and
     adam moments sharded over the pod, loss decreases over a few steps."""

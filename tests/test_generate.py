@@ -26,6 +26,7 @@ def tiny_model():
     return model, params
 
 
+@pytest.mark.slow
 def test_greedy_cache_matches_full_forward(tiny_model):
     """The scan+cache decode must reproduce naive full-forward greedy decoding
     exactly — the correctness oracle for the cache plumbing."""
@@ -130,6 +131,7 @@ def test_byte_tokenizer_roundtrip():
     assert tok.decode(ids + [tok.eos_id]) == "héllo"
 
 
+@pytest.mark.slow
 def test_cli_one_shot_generates_from_trained_checkpoint(tmp_path):
     """E2E (VERDICT r2 #10): train_gpt2 writes a checkpoint; the interact CLI
     loads it with the matching shape flags and generates one-shot.

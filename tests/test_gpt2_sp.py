@@ -56,6 +56,7 @@ def test_sp_flash_blocks_match_dense(mesh4):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
 
+@pytest.mark.slow
 def test_sp_train_step_learns(mesh4):
     model = GPT2(GPT2Config(**BASE, sp_axis="ranks"))
     tokens = _tokens(B=8, seed=2)
@@ -84,6 +85,7 @@ def test_sp_rejects_dropout(mesh4):
         gpt2_sp_loss_and_grad(model, mesh4)(params, tokens)
 
 
+@pytest.mark.slow
 def test_dp_x_sp_matches_single_device(mesh4):
     """2D (data, sp) mesh: batch sharded over data, sequence over sp — loss
     and grads must still equal the single-device computation."""
@@ -110,6 +112,7 @@ def test_dp_x_sp_matches_single_device(mesh4):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
 
+@pytest.mark.slow
 def test_dp_x_sp_train_step_learns(mesh4):
     from jax.sharding import Mesh
 

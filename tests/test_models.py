@@ -23,6 +23,7 @@ def test_gpt2_forward_and_loss():
     assert np.isfinite(float(loss))
 
 
+@pytest.mark.slow
 def test_gpt2_gradients_nonzero():
     cfg = GPT2Config.tiny()
     model = GPT2(cfg)
@@ -47,6 +48,7 @@ def test_gpt2_remat_variant_matches():
     np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_vgg_forward():
     model = VGG(cfg=VGG11_CFG, num_classes=10, classifier_width=64)
     x = jnp.ones((2, 32, 32, 3))
@@ -132,6 +134,7 @@ def test_gpt2_remat_policy_validated():
         GPT2(cfg).init(jax.random.PRNGKey(0), tokens)
 
 
+@pytest.mark.slow
 def test_moe_router_z_loss():
     """z-loss adds coef·mean(logsumexp²) to the aux term and is disabled at
     coef 0; the EP shard path reports the same global value."""
@@ -148,13 +151,17 @@ def test_moe_router_z_loss():
     np.testing.assert_allclose(np.asarray(y0), np.asarray(y1))  # output unchanged
     assert float(aux1) > float(aux0)  # logsumexp² penalty is positive
 
-    # EP shard path matches the single-device aux (same global mean)
+    # EP shard path matches the single-device aux (same global mean);
+    # top_k=1 keeps the EP program's unrolled dispatch small — the parity
+    # claim (z-loss pmean across shards) is top_k-independent
     from jax.sharding import Mesh
 
     from adapcc_tpu.parallel import expert_parallel_moe
 
+    cfg_ep = dataclasses.replace(cfg1, top_k=1)
+    _, aux_ref = MoEMLP(cfg_ep).apply(params, x)
     mesh = Mesh(np.array(jax.devices()[:4]), ("experts",))
     _, aux_ep = expert_parallel_moe(
-        params, x.reshape(-1, cfg1.d_model), cfg1, mesh
+        params, x.reshape(-1, cfg_ep.d_model), cfg_ep, mesh
     )
-    np.testing.assert_allclose(float(aux_ep), float(aux1), rtol=1e-5)
+    np.testing.assert_allclose(float(aux_ep), float(aux_ref), rtol=1e-5)

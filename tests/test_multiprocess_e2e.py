@@ -13,6 +13,8 @@ the KV fan-out (commu.py:345-351).
 """
 
 import os
+
+import pytest
 import socket
 import subprocess
 import sys
@@ -81,6 +83,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_detect_profile_synthesize_allreduce(tmp_path):
     port = _free_port()
     script = tmp_path / "child.py"

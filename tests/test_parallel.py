@@ -41,6 +41,7 @@ def test_ring_attention_matches_full_attention(mesh8, causal):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_bf16_and_grads(mesh8):
     """bfloat16 forward stays close to the fp32 oracle and is differentiable."""
     rng = np.random.default_rng(1)
@@ -146,6 +147,7 @@ def test_pipeline_matches_sequential(mesh8):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_backward_matches_sequential(mesh8):
     """PP training: gradients THROUGH the pipeline (ppermute+scan+psum) must
     equal the sequential stack's — the point of pipeline parallelism is
@@ -229,6 +231,7 @@ def test_pipeline_single_microbatch(mesh8):
 # ---------------------------------------------------------------------- EP
 
 
+@pytest.mark.slow
 def test_expert_parallel_matches_dense_moe(mesh8):
     """With ample capacity (no drops) EP output == single-device MoEMLP."""
     cfg = MoEConfig(
@@ -257,6 +260,7 @@ def test_expert_parallel_matches_dense_moe(mesh8):
     assert np.isfinite(float(got_aux))
 
 
+@pytest.mark.slow
 def test_expert_parallel_capacity_drops_are_bounded(mesh8):
     """Tight capacity drops tokens but never produces NaN/garbage."""
     cfg = MoEConfig(

@@ -73,6 +73,7 @@ def test_flash_ring_matches_dense_ring_and_oracle(mesh4, causal):
     np.testing.assert_allclose(np.asarray(flash), np.asarray(dense), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_flash_ring_grads_match_dense_ring(mesh2):
     # a 2-device mesh: the grad path through scan+switch+pallas is identical
     # in structure but compiles half the ring (the 4-device variant costs
@@ -121,6 +122,7 @@ def test_flash_ulysses_matches_oracle(mesh4, causal):
     np.testing.assert_allclose(np.asarray(out), np.asarray(oracle), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_flash_ulysses_grads_match_dense(mesh2):
     from adapcc_tpu.parallel import ulysses_attention
 

@@ -76,6 +76,7 @@ def test_run_rejects_tiny_corpus():
         run(args)
 
 
+@pytest.mark.slow
 def test_train_gpt2_learns_structure(capsys):
     """Two epochs on the Markov corpus must cut validation perplexity far
     below the untrained model — end-to-end LM learning through the DDP stack."""
@@ -94,6 +95,7 @@ def test_train_gpt2_learns_structure(capsys):
     assert "sample continuation:" in out
 
 
+@pytest.mark.slow
 def test_hits_at_1_beats_chance_after_training(capsys):
     """The ConvAI candidate-ranking metric (convai_evaluation.py hits@1): a
     trained model must rank the gold continuation above distractors far more
@@ -132,6 +134,7 @@ def test_hits_at_1_beats_chance_after_training(capsys):
     assert untrained < trained_hits, (untrained, trained_hits)
 
 
+@pytest.mark.slow
 def test_sp_workload_trains(capsys):
     """--sp ring --attn flash: the long-context path through the full
     workload (sequence sharded over the pod, flash blocks in the ring)."""

@@ -192,6 +192,7 @@ def test_two_level_all_to_all_is_hierarchical_and_matches_flat(mesh2x4):
     assert any(ev.impl == "two_level" for ev in trace.events())
 
 
+@pytest.mark.slow
 def test_two_level_expert_parallel_moe(mesh2x4):
     """EP MoE rides the hierarchical all-to-all on a (dcn, ici) world and
     matches the dense (single-device) MoEMLP forward."""
@@ -200,8 +201,11 @@ def test_two_level_expert_parallel_moe(mesh2x4):
     from adapcc_tpu.models.moe import MoEConfig, MoEMLP
     from adapcc_tpu.parallel import expert_parallel_moe
 
+    # top_k=1 keeps the unrolled dispatch small — the claim under test is
+    # the hierarchical exchange, which is top_k-independent (flat-mesh EP
+    # with top_k=2 is covered by test_parallel)
     cfg = dataclasses.replace(
-        MoEConfig.tiny(), num_experts=8, capacity_factor=8.0, top_k=2,
+        MoEConfig.tiny(), num_experts=8, capacity_factor=8.0, top_k=1,
         dtype=jnp.float32,
     )
     model = MoEMLP(cfg)

@@ -31,6 +31,7 @@ def test_ulysses_matches_ring(mesh8):
     np.testing.assert_allclose(np.asarray(u), np.asarray(r), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ulysses_grads_flow(mesh4):
     q, k, v = _qkv(1, 8, 4, 4, seed=1)
 
