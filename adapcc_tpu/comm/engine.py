@@ -182,17 +182,20 @@ def _color_rounds(per_tree_rounds: Sequence[Sequence[CommRound]], world: int):
 _MERGED_PLANS: Dict[Tuple, Optional[_MergedPlan]] = {}
 
 
+def _merged_env_disabled() -> bool:
+    """``ADAPCC_MERGE_ROUNDS=0`` disables round merging everywhere — the A/B
+    knob for measuring the merged executor against sequential per-tree
+    chains on hardware (flat and two-level paths share it)."""
+    import os
+
+    return os.environ.get("ADAPCC_MERGE_ROUNDS", "1") in ("0", "off", "false")
+
+
 def _merged_plan(strategy: Strategy) -> Optional[_MergedPlan]:
     """Build (and cache) the merged plan, or None when merging buys nothing:
     a single tree (groups == rounds) or heavily skewed MILP shares (stacking
-    pads every segment to the largest, wasting bandwidth).
-
-    ``ADAPCC_MERGE_ROUNDS=0`` disables merging — the A/B knob for measuring
-    the merged executor against sequential per-tree chains on hardware.
-    """
-    import os
-
-    if os.environ.get("ADAPCC_MERGE_ROUNDS", "1") in ("0", "off", "false"):
+    pads every segment to the largest, wasting bandwidth)."""
+    if _merged_env_disabled():
         return None
     shares = strategy.tree_shares()
     key = (strategy.fingerprint(), tuple(round(s, 6) for s in shares))
@@ -540,7 +543,18 @@ class CollectiveEngine:
         fingerprint plus whether the trace will take the merged-round path —
         flipping ADAPCC_MERGE_ROUNDS mid-process must miss the cache, not
         replay a program traced under the other setting."""
-        return (self.strategy.fingerprint(), _merged_plan(self.strategy) is not None)
+        if self.two_level:
+            from adapcc_tpu.comm.two_level import _two_level_merged_plan
+
+            merged = (
+                _two_level_merged_plan(
+                    self.strategy, self.num_slices, self.ici_size
+                )
+                is not None
+            )
+        else:
+            merged = _merged_plan(self.strategy) is not None
+        return (self.strategy.fingerprint(), merged)
 
     def _shard_mapped(self, key: Tuple, per_shard: Callable, n_args: int) -> Callable:
         fn = self._cache.get(key)
@@ -581,7 +595,7 @@ class CollectiveEngine:
                 ici_size=self.ici_size,
                 op=op,
             )
-            key = ("allreduce2l", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name, op)
+            key = ("allreduce2l", self._schedule_variant(), stacked.shape, stacked.dtype.name, op)
         else:
             per_shard = functools.partial(
                 allreduce_shard,
@@ -621,7 +635,7 @@ class CollectiveEngine:
                 ici_size=self.ici_size,
                 op=op,
             )
-            key = ("reduce2l", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name, op)
+            key = ("reduce2l", self._schedule_variant(), stacked.shape, stacked.dtype.name, op)
         else:
             per_shard = functools.partial(
                 reduce_shard, strategy=self.strategy, axis_name=self.axis_name, op=op
@@ -659,7 +673,7 @@ class CollectiveEngine:
                 num_slices=self.num_slices,
                 ici_size=self.ici_size,
             )
-            key = ("broadcast2l", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name)
+            key = ("broadcast2l", self._schedule_variant(), stacked.shape, stacked.dtype.name)
         else:
             per_shard = functools.partial(
                 broadcast_shard, strategy=self.strategy, axis_name=self.axis_name

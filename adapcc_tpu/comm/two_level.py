@@ -35,10 +35,17 @@ from jax.sharding import Mesh
 
 from adapcc_tpu.comm.engine import (
     _avg_normalize,
+    _color_rounds,
     _identity_for,
+    _merged_env_disabled,
+    _MergedPlan,
     _run_broadcast_rounds,
+    _run_merged_groups,
     _run_reduce_rounds,
     _run_segments,
+    _segment_sizes,
+    _stack_segments,
+    _unstack_segments,
 )
 from adapcc_tpu.primitives import ReduceOp
 from adapcc_tpu.strategy.ir import Strategy, Tree
@@ -110,6 +117,102 @@ def mesh_rank_slice(num_slices: int, ici_size: int) -> List[int]:
     return [r // ici_size for r in range(num_slices * ici_size)]
 
 
+_TL_MERGED_PLANS: Dict = {}
+
+
+def _two_level_merged_plan(
+    strategy: Strategy, num_slices: int, ici_size: int
+) -> Optional["_MergedPlan"]:
+    """Merged DCN-round plan over the strategy's slice trees (the two-level
+    analog of ``engine._merged_plan``), or None when merging buys nothing.
+
+    On top of the DCN-round merge, the merged executor fuses ALL trees'
+    slice-local reductions into ONE ici-axis collective over the stacked
+    segments — the sequential path pays one per tree.
+    """
+    if _merged_env_disabled():
+        return None
+    shares = strategy.tree_shares()
+    key = (
+        strategy.fingerprint(), num_slices, ici_size,
+        tuple(round(s, 6) for s in shares),
+    )
+    if key in _TL_MERGED_PLANS:
+        return _TL_MERGED_PLANS[key]
+    plan = None
+    if len(strategy.trees) > 1 and max(shares) <= 2.0 * min(shares):
+        rank_slice = mesh_rank_slice(num_slices, ici_size)
+        slice_trees = [
+            slice_tree(t, rank_slice, num_slices) for t in strategy.trees
+        ]
+        reduce_rounds = [st.reduce_rounds() for st in slice_trees]
+        bcast_rounds = [st.broadcast_rounds() for st in slice_trees]
+        rg = _color_rounds(reduce_rounds, num_slices)
+        bg = _color_rounds(bcast_rounds, num_slices)
+        n_seq = sum(len(r) for r in reduce_rounds) + sum(
+            len(r) for r in bcast_rounds
+        )
+        if len(rg) + len(bg) < n_seq:
+            plan = _MergedPlan(rg, bg)
+    _TL_MERGED_PLANS[key] = plan
+    return plan
+
+
+def _run_two_level_merged(
+    x: jnp.ndarray,
+    strategy: Strategy,
+    plan: "_MergedPlan",
+    num_slices: int,
+    ici_size: int,
+    dcn_axis: str,
+    ici_axis: str,
+    op: ReduceOp,
+    phases: str,  # "reduce" | "broadcast" | "both"
+    contrib_of=None,
+    root_select: bool = False,
+) -> jnp.ndarray:
+    """One merged two-level execution shared by allreduce / reduce /
+    broadcast: mask+stack the per-tree segments, ONE ici-axis collective
+    for level 1 (all trees at once), merged DCN groups for level 2.
+
+    ``root_select`` is the broadcast flavor of level 1: instead of reducing
+    contributions, row ``t`` takes tree ``t``'s root-rank value (everyone
+    else feeds zero into the slice psum).
+    """
+    flat = x.reshape(-1)
+    if contrib_of is not None:
+        flat = contrib_of(flat)
+    sizes = _segment_sizes(flat.size, strategy.tree_shares())
+    stacked = _stack_segments(flat, sizes, _identity_for(op, flat.dtype))
+
+    if root_select:
+        rank_slice = mesh_rank_slice(num_slices, ici_size)
+        root_slices = jnp.asarray(
+            np.array([rank_slice[t.root] for t in strategy.trees])
+        )
+        root_lanes = jnp.asarray(
+            np.array([t.root % ici_size for t in strategy.trees])
+        )
+        sel = (
+            (lax.axis_index(dcn_axis) == root_slices)
+            & (lax.axis_index(ici_axis) == root_lanes)
+        )[:, None]
+        acc = lax.psum(
+            jnp.where(sel, stacked, jnp.zeros_like(stacked)), ici_axis
+        ).astype(stacked.dtype)
+    elif op is ReduceOp.MAX:
+        acc = lax.pmax(stacked, ici_axis)
+    else:
+        acc = lax.psum(stacked, ici_axis)
+
+    if phases in ("reduce", "both"):
+        combine = "max" if op is ReduceOp.MAX else "add"
+        acc = _run_merged_groups(acc, plan.reduce_groups, dcn_axis, combine)
+    if phases in ("broadcast", "both"):
+        acc = _run_merged_groups(acc, plan.broadcast_groups, dcn_axis, "adopt")
+    return _unstack_segments(acc, sizes).reshape(x.shape)
+
+
 def allreduce_two_level_shard(
     x: jnp.ndarray,
     active_mask: jnp.ndarray,
@@ -131,8 +234,19 @@ def allreduce_two_level_shard(
     flat_rank = lax.axis_index(dcn_axis) * ici_size + lax.axis_index(ici_axis)
     my_active = active_mask[flat_rank]
 
+    def contrib_of(v):
+        return jnp.where(my_active, v, _identity_for(op, v.dtype))
+
+    plan = _two_level_merged_plan(strategy, num_slices, ici_size)
+    if plan is not None:
+        result = _run_two_level_merged(
+            x, strategy, plan, num_slices, ici_size, dcn_axis, ici_axis,
+            op, "both", contrib_of=contrib_of,
+        )
+        return _avg_normalize(result, active_mask, op)
+
     def per_segment(seg: jnp.ndarray, tree: Tree) -> jnp.ndarray:
-        contrib = jnp.where(my_active, seg, _identity_for(op, seg.dtype))
+        contrib = contrib_of(seg)
         # level 1: slice-local reduction rides the ICI axis
         if op is ReduceOp.MAX:
             acc = lax.pmax(contrib, ici_axis)
@@ -209,8 +323,19 @@ def reduce_two_level_shard(
     flat_rank = lax.axis_index(dcn_axis) * ici_size + lax.axis_index(ici_axis)
     my_active = active_mask[flat_rank]
 
+    def contrib_of(v):
+        return jnp.where(my_active, v, _identity_for(op, v.dtype))
+
+    plan = _two_level_merged_plan(strategy, num_slices, ici_size)
+    if plan is not None:
+        result = _run_two_level_merged(
+            x, strategy, plan, num_slices, ici_size, dcn_axis, ici_axis,
+            op, "reduce", contrib_of=contrib_of,
+        )
+        return _avg_normalize(result, active_mask, op)
+
     def per_segment(seg: jnp.ndarray, tree: Tree) -> jnp.ndarray:
-        contrib = jnp.where(my_active, seg, _identity_for(op, seg.dtype))
+        contrib = contrib_of(seg)
         acc = lax.pmax(contrib, ici_axis) if op is ReduceOp.MAX else lax.psum(contrib, ici_axis)
         st = slice_tree(tree, rank_slice, num_slices)
         return _run_reduce_rounds(acc, st.reduce_rounds(), dcn_axis, num_slices, op)
@@ -233,6 +358,13 @@ def broadcast_two_level_shard(
     rank_slice = mesh_rank_slice(num_slices, ici_size)
     my_dcn = lax.axis_index(dcn_axis)
     my_lane = lax.axis_index(ici_axis)
+
+    plan = _two_level_merged_plan(strategy, num_slices, ici_size)
+    if plan is not None:
+        return _run_two_level_merged(
+            x, strategy, plan, num_slices, ici_size, dcn_axis, ici_axis,
+            ReduceOp.SUM, "broadcast", root_select=True,
+        )
 
     def per_segment(seg: jnp.ndarray, tree: Tree) -> jnp.ndarray:
         root_slice = rank_slice[tree.root]

@@ -330,13 +330,19 @@ class Strategy:
 
     def fingerprint(self) -> str:
         """Stable hash for the compiled-program cache (the analog of the
-        reference's per-strategy transmission contexts, SURVEY.md §7)."""
+        reference's per-strategy transmission contexts, SURVEY.md §7).
+        Memoized — trees are structurally immutable after construction, and
+        hot dispatch paths consult this per collective call."""
+        cached = self.__dict__.get("_fingerprint")
+        if cached is not None:
+            return cached
         h = hashlib.sha256()
         h.update(str(self.world_size).encode())
         for t in self.trees:
             h.update(repr(sorted((p, tuple(c)) for p, c in t.children.items())).encode())
             h.update(str(t.root).encode())
-        return h.hexdigest()[:16]
+        self.__dict__["_fingerprint"] = fp = h.hexdigest()[:16]
+        return fp
 
     @staticmethod
     def ring(world_size: int, num_trans: int = 1, ips: Optional[Dict[int, str]] = None) -> "Strategy":

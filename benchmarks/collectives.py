@@ -158,14 +158,22 @@ def _make_ops(engine, elems: int, dtype=jnp.float32) -> Dict[str, tuple]:
 
 def _strategy_label(engine) -> str:
     """Self-describing artifact rows: strategy shape + whether the engine's
-    schedule path runs merged multi-tree rounds."""
-    from adapcc_tpu.comm.engine import _merged_plan
-
+    schedule path runs merged multi-tree rounds (both the flat and the
+    two-level plan respect the ADAPCC_MERGE_ROUNDS kill-switch, so A/B rows
+    are distinguishable)."""
     strat = engine.strategy
     label = f"{strat.synthesis or 'unnamed'} x{strat.num_trans}"
-    if not getattr(engine, "two_level", False) and _merged_plan(strat) is not None:
-        label += " (merged)"
-    return label
+    if getattr(engine, "two_level", False):
+        from adapcc_tpu.comm.two_level import _two_level_merged_plan
+
+        merged = _two_level_merged_plan(
+            strat, engine.num_slices, engine.ici_size
+        ) is not None
+    else:
+        from adapcc_tpu.comm.engine import _merged_plan
+
+        merged = _merged_plan(strat) is not None
+    return label + (" (merged)" if merged else "")
 
 
 def run_sweep(
@@ -265,10 +273,11 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                 f'--two-level expects "DxI" with D, I >= 2 (e.g. 2x4), '
                 f"got {args.two_level!r}"
             )
-        if args.world or args.strategy != "binary" or args.trans != 1:
+        if args.world or args.strategy != "binary":
             ap.error(
-                "--two-level is exclusive with --world/--strategy/--trans: "
-                "the mesh size is DxI and the hierarchy is ParTrees-synthesized"
+                "--two-level is exclusive with --world/--strategy: the mesh "
+                "size is DxI and the hierarchy is ParTrees-synthesized "
+                "(--trans feeds the synthesizer's parallel_degree)"
             )
         if impls and "pallas_ring" in impls:
             ap.error(
@@ -282,7 +291,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         # that the two-level executor splits into ICI + DCN phases
         ones = [[1.0] * world for _ in range(world)]
         strategy = Synthesizer(None, mesh_ip_table(mesh)).synthesize(
-            ALLREDUCE, 1, 4 << 20, ones, ones
+            ALLREDUCE, args.trans, 4 << 20, ones, ones
         )
         if impls is None:
             impls = ["xla", "strategy"]  # the Pallas ring is a flat-mesh kernel

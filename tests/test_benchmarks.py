@@ -210,6 +210,30 @@ def test_committed_twolevel_sweep_artifact_parses():
     assert ("reduce", "strategy") in seen and ("broadcast", "strategy") in seen
 
 
+def test_committed_twolevel_r04_artifact_carries_merged_ab():
+    """Round-4 two-level artifact: accounting holds and the multi-tree
+    merged-vs-sequential A/B pair is present and distinguishable by label
+    (the CPU-pod inversion it records is analyzed in BASELINE.md)."""
+    import json
+    import os
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "results", "busbw_twolevel2x4_r04.jsonl",
+    )
+    rows = [json.loads(line) for line in open(path) if line.strip()]
+    labels = set()
+    for r in rows:
+        assert r["world"] == 8
+        factor = BUS_FACTORS[r["collective"]](r["world"])
+        assert abs(r["busbw_gbps"] - r["algbw_gbps"] * factor) < 1e-9 * max(
+            1.0, r["busbw_gbps"]
+        )
+        if r["impl"] == "strategy":
+            labels.add(r["strategy"])
+    assert "partrees x2 (merged)" in labels and "partrees x2" in labels, labels
+
+
 def test_collectives_cli_two_level(capsys):
     """--two-level DxI synthesizes the hierarchy and sweeps on the (dcn,
     ici) mesh end to end."""

@@ -1,0 +1,125 @@
+"""Merged multi-tree execution on two-level (DCN × ICI) worlds.
+
+The flat engine merges rotated trees' round-k edges into single ppermutes
+(test_engine_merged); the two-level executor gets the same treatment on the
+DCN axis — plus a stronger fusion on the ICI axis: ALL trees' slice-local
+reductions collapse into ONE ici-axis collective over the stacked segments
+instead of one per tree.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from adapcc_tpu.comm import two_level as TL
+from adapcc_tpu.comm.engine import CollectiveEngine
+from adapcc_tpu.primitives import ReduceOp
+from adapcc_tpu.strategy.ir import Strategy, Tree
+
+
+@pytest.fixture(scope="module")
+def mesh2x4():
+    return TL.build_two_level_mesh(2, 4)
+
+
+def rotated_hier_strategy(num_trans=2):
+    """Masters 0 and 4 with chains, rotated per tree (the ParTrees shape)."""
+    ips = {r: ("a" if r < 4 else "b") for r in range(8)}
+    trees = []
+    for t in range(num_trans):
+        if t % 2 == 0:
+            children = {0: [1, 4], 1: [2], 2: [3], 4: [5], 5: [6], 6: [7]}
+            root = 0
+        else:
+            children = {4: [5, 0], 5: [6], 6: [7], 0: [1], 1: [2], 2: [3]}
+            root = 4
+        trees.append(Tree(root, children, ips))
+    return Strategy(trees, 8)
+
+
+def test_two_level_merged_plan_exists_and_shrinks_rounds():
+    strat = rotated_hier_strategy(2)
+    plan = TL._two_level_merged_plan(strat, num_slices=2, ici_size=4)
+    assert plan is not None
+    seq_dcn_rounds = 0
+    for tree in strat.trees:
+        st = TL.slice_tree(tree, TL.mesh_rank_slice(2, 4), 2)
+        seq_dcn_rounds += len(st.reduce_rounds()) + len(st.broadcast_rounds())
+    merged = len(plan.reduce_groups) + len(plan.broadcast_groups)
+    assert merged < seq_dcn_rounds, (merged, seq_dcn_rounds)
+
+
+def test_two_level_merged_plan_gates():
+    # single tree: nothing to merge
+    assert (
+        TL._two_level_merged_plan(
+            rotated_hier_strategy(1), num_slices=2, ici_size=4
+        )
+        is None
+    )
+    # skewed shares: stacking would waste bandwidth on padding
+    skewed = rotated_hier_strategy(2)
+    skewed.shares = [0.9, 0.1]
+    assert TL._two_level_merged_plan(skewed, num_slices=2, ici_size=4) is None
+
+
+@pytest.mark.parametrize("op", [ReduceOp.SUM, ReduceOp.AVG, ReduceOp.MAX])
+def test_two_level_merged_allreduce_oracle(mesh2x4, op):
+    strat = rotated_hier_strategy(2)
+    assert TL._two_level_merged_plan(strat, 2, 4) is not None
+    eng = CollectiveEngine(mesh2x4, strat, use_xla_fastpath=False)
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(8, 37)).astype(np.float32)
+    for active in (list(range(8)), [0, 1, 3, 4, 5, 6]):
+        mask = np.zeros(8, bool)
+        mask[active] = True
+        got = np.asarray(
+            eng.all_reduce(jnp.asarray(x), active_gpus=active, op=op)
+        )
+        xm = np.where(mask[:, None], x, -np.inf if op is ReduceOp.MAX else 0.0)
+        if op is ReduceOp.MAX:
+            want = xm.max(0)
+        elif op is ReduceOp.AVG:
+            want = xm.sum(0) / mask.sum()
+        else:
+            want = xm.sum(0)
+        np.testing.assert_allclose(
+            got, np.broadcast_to(want, x.shape), atol=1e-5
+        )
+
+
+def test_two_level_merged_reduce_and_broadcast_oracles(mesh2x4):
+    strat = rotated_hier_strategy(2)
+    eng = CollectiveEngine(mesh2x4, strat, use_xla_fastpath=False)
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(8, 37)).astype(np.float32)
+    from adapcc_tpu.comm.engine import _segment_sizes
+
+    sizes = _segment_sizes(37, strat.tree_shares())
+
+    # reduce: every ICI lane of each tree's root slice holds the total
+    got_r = np.asarray(eng.reduce(jnp.asarray(x)))
+    off = 0
+    for tree, size in zip(strat.trees, sizes):
+        root_slice = TL.mesh_rank_slice(2, 4)[tree.root]
+        lanes = range(root_slice * 4, root_slice * 4 + 4)
+        for lane in lanes:
+            np.testing.assert_allclose(
+                got_r[lane, off : off + size],
+                x[:, off : off + size].sum(0),
+                atol=1e-5,
+            )
+        off += size
+
+    # broadcast: each segment adopts its tree's root-rank value everywhere
+    got_b = np.asarray(eng.boardcast(jnp.asarray(x)))
+    off = 0
+    for tree, size in zip(strat.trees, sizes):
+        np.testing.assert_allclose(
+            got_b[:, off : off + size],
+            np.broadcast_to(x[tree.root, off : off + size], (8, size)),
+            atol=1e-6,
+        )
+        off += size
