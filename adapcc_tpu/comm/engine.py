@@ -195,24 +195,52 @@ def _merged_plan(strategy: Strategy) -> Optional[_MergedPlan]:
     """Build (and cache) the merged plan, or None when merging buys nothing:
     a single tree (groups == rounds) or heavily skewed MILP shares (stacking
     pads every segment to the largest, wasting bandwidth)."""
+    return _build_merged_plan(
+        strategy,
+        strategy.world_size,
+        lambda: (
+            [t.reduce_rounds() for t in strategy.trees],
+            [t.broadcast_rounds() for t in strategy.trees],
+        ),
+        _MERGED_PLANS,
+    )
+
+
+def _build_merged_plan(
+    strategy: Strategy,
+    world: int,
+    rounds_of: Callable[[], Tuple[list, list]],
+    cache: Dict,
+    key_extra: Tuple = (),
+) -> Optional[_MergedPlan]:
+    """Shared gate + coloring + cache for merged plans (flat and two-level
+    differ only in the rounds source and the permutation world).
+
+    Returns None when merging buys nothing: env kill-switch, a single tree
+    (groups == rounds), heavily skewed MILP shares (stacking pads every
+    segment to the largest, wasting bandwidth), or a coloring that fails to
+    reduce the round count.
+    """
     if _merged_env_disabled():
         return None
     shares = strategy.tree_shares()
-    key = (strategy.fingerprint(), tuple(round(s, 6) for s in shares))
-    if key in _MERGED_PLANS:
-        return _MERGED_PLANS[key]
+    key = (
+        strategy.fingerprint(), *key_extra,
+        tuple(round(s, 6) for s in shares),
+    )
+    if key in cache:
+        return cache[key]
     plan: Optional[_MergedPlan] = None
     if len(strategy.trees) > 1 and max(shares) <= 2.0 * min(shares):
-        reduce_rounds = [t.reduce_rounds() for t in strategy.trees]
-        bcast_rounds = [t.broadcast_rounds() for t in strategy.trees]
-        rg = _color_rounds(reduce_rounds, strategy.world_size)
-        bg = _color_rounds(bcast_rounds, strategy.world_size)
+        reduce_rounds, bcast_rounds = rounds_of()
+        rg = _color_rounds(reduce_rounds, world)
+        bg = _color_rounds(bcast_rounds, world)
         n_sequential = sum(len(r) for r in reduce_rounds) + sum(
             len(r) for r in bcast_rounds
         )
         if len(rg) + len(bg) < n_sequential:
             plan = _MergedPlan(rg, bg)
-    _MERGED_PLANS[key] = plan
+    cache[key] = plan
     return plan
 
 

@@ -35,9 +35,8 @@ from jax.sharding import Mesh
 
 from adapcc_tpu.comm.engine import (
     _avg_normalize,
-    _color_rounds,
+    _build_merged_plan,
     _identity_for,
-    _merged_env_disabled,
     _MergedPlan,
     _run_broadcast_rounds,
     _run_merged_groups,
@@ -130,32 +129,20 @@ def _two_level_merged_plan(
     slice-local reductions into ONE ici-axis collective over the stacked
     segments — the sequential path pays one per tree.
     """
-    if _merged_env_disabled():
-        return None
-    shares = strategy.tree_shares()
-    key = (
-        strategy.fingerprint(), num_slices, ici_size,
-        tuple(round(s, 6) for s in shares),
-    )
-    if key in _TL_MERGED_PLANS:
-        return _TL_MERGED_PLANS[key]
-    plan = None
-    if len(strategy.trees) > 1 and max(shares) <= 2.0 * min(shares):
+    def rounds_of():
         rank_slice = mesh_rank_slice(num_slices, ici_size)
         slice_trees = [
             slice_tree(t, rank_slice, num_slices) for t in strategy.trees
         ]
-        reduce_rounds = [st.reduce_rounds() for st in slice_trees]
-        bcast_rounds = [st.broadcast_rounds() for st in slice_trees]
-        rg = _color_rounds(reduce_rounds, num_slices)
-        bg = _color_rounds(bcast_rounds, num_slices)
-        n_seq = sum(len(r) for r in reduce_rounds) + sum(
-            len(r) for r in bcast_rounds
+        return (
+            [st.reduce_rounds() for st in slice_trees],
+            [st.broadcast_rounds() for st in slice_trees],
         )
-        if len(rg) + len(bg) < n_seq:
-            plan = _MergedPlan(rg, bg)
-    _TL_MERGED_PLANS[key] = plan
-    return plan
+
+    return _build_merged_plan(
+        strategy, num_slices, rounds_of, _TL_MERGED_PLANS,
+        key_extra=(num_slices, ici_size),
+    )
 
 
 def _run_two_level_merged(
