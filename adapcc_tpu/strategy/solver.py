@@ -138,6 +138,14 @@ def modeled_makespan(
     gurobi/solver.py:190-208).  Puts the heuristic and the solver on one
     scale — the property that justifies the solver's existence is
     ``makespan(milp) ≤ makespan(partrees)`` on the same profile.
+
+    The MAX across trees assumes parallel transmissions run concurrently —
+    true in the reference via per-tree pthread pairs (allreduce.cu:735-742)
+    and true here via the merged-round executor (engine._run_merged), which
+    combines all trees' round-k edges into shared ppermutes.  Under the
+    sequential fallback (single tree, skewed shares, or
+    ADAPCC_MERGE_ROUNDS=0) tree times ADD instead, and this objective is a
+    lower bound rather than an estimate.
     """
     bw = np.asarray(bandwidth_graph, dtype=float)
     lat = np.asarray(latency_graph, dtype=float)

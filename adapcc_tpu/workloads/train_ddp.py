@@ -60,6 +60,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="async relay mode: straggler gradients are buffered and folded "
         "into their next active step instead of dropped (reference is_bsp)",
     )
+    p.add_argument(
+        "--sync-mode", choices=["auto", "psum", "schedule"], default="auto",
+        help="gradient-sync data plane: psum = masked XLA collective per "
+        "leaf; schedule = bucketed strategy-tree allreduce (multi-tree "
+        "strategies run merged rounds); auto picks by topology",
+    )
     return p
 
 
@@ -203,6 +209,7 @@ def main(argv=None) -> None:
             communicator=AdapCC.communicator,
             use_xla_fastpath=comm_args.use_xla_fastpath,
             bsp=comm_args.is_bsp,
+            sync_mode=args.sync_mode,
         )
         state = TrainState.create(params, tx)
 
