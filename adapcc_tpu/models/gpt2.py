@@ -46,6 +46,9 @@ class GPT2Config:
     #: tiles, no attention-matrix HBM traffic.  Training path only (decode
     #: uses the KV cache) and requires dropout == 0.
     attention: str = "xla"
+    #: flash kernel tile edge (block_q == block_k); the VMEM-vs-parallelism
+    #: trade to sweep on hardware (bench.py BENCH_FLASH_BLOCK)
+    flash_block: int = 128
     #: sequence parallelism: when set (a mesh axis name), the model expects
     #: to run INSIDE shard_map with tokens sequence-sharded over that axis —
     #: attention crosses shards via the ring / Ulysses programs
@@ -100,6 +103,7 @@ class CausalSelfAttention(nn.Module):
                 out = ring_attention_shard(
                     q, k, v, axis_name=cfg.sp_axis, causal=True, scale=scale,
                     block_impl=block_impl,
+                    block_q=cfg.flash_block, block_k=cfg.flash_block,
                 )
             elif cfg.sp_impl == "ulysses":
                 from adapcc_tpu.parallel.ulysses import ulysses_attention_shard
@@ -107,6 +111,7 @@ class CausalSelfAttention(nn.Module):
                 out = ulysses_attention_shard(
                     q, k, v, axis_name=cfg.sp_axis, causal=True, scale=scale,
                     block_impl=block_impl,
+                    block_q=cfg.flash_block, block_k=cfg.flash_block,
                 )
             else:
                 raise ValueError(f"unknown sp_impl {cfg.sp_impl!r} (ring|ulysses)")
@@ -149,6 +154,7 @@ class CausalSelfAttention(nn.Module):
             out = flash_attention(
                 q.astype(cfg.dtype), k.astype(cfg.dtype), v.astype(cfg.dtype),
                 causal=True, scale=scale,
+                block_q=cfg.flash_block, block_k=cfg.flash_block,
             )
             return self._project(out.reshape(B, T, cfg.d_model), deterministic)
         else:

@@ -40,6 +40,8 @@ def ulysses_attention_shard(
     causal: bool = True,
     scale: Optional[float] = None,
     block_impl: str = "dense",
+    block_q: int = 128,
+    block_k: int = 128,
 ) -> jnp.ndarray:
     """Per-shard Ulysses attention, for use inside ``shard_map``.
 
@@ -87,7 +89,10 @@ def ulysses_attention_shard(
     if block_impl == "flash":
         from adapcc_tpu.ops import flash_attention
 
-        out = flash_attention(qh, kh, vh, causal=causal, scale=scale)
+        out = flash_attention(
+            qh, kh, vh, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k,
+        )
         return heads_to_seq(out).astype(q.dtype)
 
     s = jnp.einsum(

@@ -30,6 +30,7 @@ Size knobs via env (defaults target a single v5e chip):
     BENCH_STEPS, BENCH_WORLD, BENCH_PEAK_TFLOPS, BENCH_ATTN (flash|xla),
     BENCH_PARAM_DTYPE (bf16|f32), BENCH_LOSS (dense|chunked),
     BENCH_REMAT (off|full|dots|dots_no_batch), BENCH_SCAN (1|0), BENCH_ACCUM,
+    BENCH_FLASH_BLOCK (flash tile edge, default 128),
     BENCH_PREFLIGHT_S, BENCH_ATTEMPTS, BENCH_DEADLINE
 """
 
@@ -168,9 +169,16 @@ def _pick_attention() -> str:
     try:
         from adapcc_tpu.ops import flash_attention
 
-        x = jnp.ones((1, 256, 2, 64), jnp.bfloat16)
+        # probe at the REAL seq and tile sizes: a VMEM overflow at
+        # BENCH_FLASH_BLOCK=512 or a seq/block divisibility error must fall
+        # back here, not burn the whole bench phase later
+        block = _env_int("BENCH_FLASH_BLOCK", 128)
+        seq = _env_int("BENCH_SEQ", 512)
+        x = jnp.ones((1, seq, 2, 64), jnp.bfloat16)
         jax.block_until_ready(jax.jit(
-            lambda q, k, v: flash_attention(q, k, v, causal=True)
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True, block_q=block, block_k=block
+            )
         )(x, x, x))
         return "flash"
     except Exception as e:  # noqa: BLE001 — any lowering failure falls back
@@ -236,12 +244,16 @@ def main() -> None:
             n_head=_env_int("BENCH_HEADS", 16),
             d_model=_env_int("BENCH_DMODEL", 1024),
             attention=attention,
+            # flash tile edge: the VMEM-vs-parallelism sweep knob for the
+            # hardware battery (128 default; 256/512 worth probing on v5e)
+            flash_block=_env_int("BENCH_FLASH_BLOCK", 128),
             # BENCH_REMAT: unset/""/"0"/"off" = no remat; "dots" |
             # "dots_no_batch" pick a policy; any other truthy value = "full"
             remat=remat_policy is not None,
             remat_policy=remat_policy or "full",
         )
         _RESULT["remat"] = remat_policy or "off"
+        _RESULT["flash_block"] = cfg.flash_block
         per_rank_batch = _env_int("BENCH_BATCH", 16)
         accum = _env_int("BENCH_ACCUM", 1)
         _RESULT["accum"] = accum

@@ -17,7 +17,9 @@ Phases (priority order):
   6. bench_loop   — bench.py with BENCH_SCAN=0: per-step dispatch instead of
                     the scanned window; (bench_loop.step_ms - bench.step_ms)
                     IS the tunnel's per-dispatch tax (PERF_NOTES hyp. 2/5)
-  7. busbw        — benchmarks/collectives.py on the real chip (world=1)
+  7. bench_fblk256 — bench.py with BENCH_FLASH_BLOCK=256: flash tile sweep
+                    (VMEM residency vs grid parallelism on the real MXU)
+  8. busbw        — benchmarks/collectives.py on the real chip (world=1)
 
 Usage::
 
@@ -123,6 +125,10 @@ def main() -> int:
     _run(
         "bench_loop", [py, "bench.py"], 1600, out,
         {"BENCH_DEADLINE": "1500", "BENCH_SCAN": "0"},
+    )
+    _run(
+        "bench_fblk256", [py, "bench.py"], 1600, out,
+        {"BENCH_DEADLINE": "1500", "BENCH_FLASH_BLOCK": "256"},
     )
     _run(
         "busbw",
