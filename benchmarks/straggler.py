@@ -209,6 +209,13 @@ def main(argv: Optional[Sequence[str]] = None) -> List[Dict]:
     ap.add_argument("--out", type=str, default=None,
                     help="append one JSON line per mode to this file")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sync-mode", choices=("auto", "psum", "schedule"),
+                    default="auto",
+                    help="gradient-sync data plane; schedule = bucketed "
+                    "strategy-tree allreduce (merged rounds on multi-tree)")
+    ap.add_argument("--trans", type=int, default=1,
+                    help="ring-strategy parallel trees (>1 engages the "
+                    "merged-round executor on the schedule path)")
     args = ap.parse_args(argv)
 
     import jax
@@ -257,9 +264,11 @@ def main(argv: Optional[Sequence[str]] = None) -> List[Dict]:
             loss_fn,
             optax.sgd(0.05),
             mesh,
-            Strategy.ring(world),
+            Strategy.ring(world, args.trans),
             dynamic_mask=(mode != "full_wait"),
             bsp=(mode != "rentbuy_async"),
+            sync_mode=args.sync_mode,
+            use_xla_fastpath=(args.sync_mode != "schedule"),
         )
         state = trainer.init_state(jax.tree_util.tree_map(jnp.array, params0))
         # compile outside the measured window (full-world warmup plus, for
@@ -289,6 +298,7 @@ def main(argv: Optional[Sequence[str]] = None) -> List[Dict]:
         rec.update(
             world=world, base_ms=args.base_ms, alpha=args.alpha,
             pattern=args.pattern, slow_rank=args.slow_rank,
+            sync_mode=args.sync_mode, trans=args.trans,
             backend=jax.devices()[0].platform,
         )
         records.append(rec)
