@@ -341,6 +341,39 @@ class Communicator:
         self._controller_thread = threading.Thread(target=self._controller_loop, daemon=True)
         self._controller_thread.start()
 
+    def calibrate_coordinator(self, total_grad_bytes: float) -> bool:
+        """Feed measured quantities into the rent-or-buy cost model: the
+        caller's gradient volume plus this world's *profiled* mean link
+        bandwidth (the matrices gathered during the bootstrap).  Replaces
+        the reference coordinator's hardcoded constants
+        (rpc_server.py:41-46).  The logic's world is the PROCESS count —
+        the rent-or-buy warps the inter-process collective, so the cost
+        model is scaled to that world.  Master-process only (the decision
+        logic lives with the server); returns False when there is no
+        in-process server or no usable profile — callers treat that as
+        "stay on the defaults", not an error.
+        """
+        if self._coordinator_server is None:
+            return False
+        lat, bw = gather_topo_profile(self.args.topology_dir, self.world_size)
+        # the rent-or-buy prices the INTER-process collective: averaging in
+        # fast intra-process ICI links would inflate the estimate ~(ici/dcn)x
+        # and make the leader commit to partial sets almost immediately
+        ips = np.asarray(self.ip_table)
+        inter = ips[:, None] != ips[None, :]
+        links = bw[(bw > 0) & inter]
+        if links.size == 0:
+            # single-process world: no inter-process links exist; fall back
+            # to the overall off-diagonal mean (the model is near-degenerate
+            # at n=1 processes anyway — sole-leader path)
+            links = bw[(bw > 0) & ~np.eye(self.world_size, dtype=bool)]
+        if links.size == 0:
+            return False
+        self._coordinator_server.logic.calibrate(
+            total_grad_bytes, float(links.mean())
+        )
+        return True
+
     @property
     def _controller_alive(self) -> bool:
         return self._controller_thread is not None and self._controller_thread.is_alive()

@@ -65,6 +65,26 @@ class CoordinatorLogic:
         self._frozen: Dict[int, List[int]] = {}
         self._heartbeats: Dict[int, List[int]] = defaultdict(list)
 
+    def calibrate(self, total_grad_bytes: float, link_bandwidth_gbps: float) -> None:
+        """Replace the reference's hardcoded cost constants
+        (rpc_server.py:41-46) with measured quantities: the gradient volume a
+        step actually allreduces and the profiled per-link bandwidth.
+
+        Sets the units so ``_initial_rent_cost()`` equals the ring-allreduce
+        estimate ``2(n-1)/n · bytes / bw`` in SECONDS — the same clock the
+        leader's wall-time rent accrues on, so the rent-or-buy comparison
+        becomes dimensionally honest instead of heuristically scaled.
+        Thread-safe; takes effect for the next freeze decision.
+        """
+        if total_grad_bytes <= 0 or link_bandwidth_gbps <= 0:
+            raise ValueError(
+                f"calibrate needs positive bytes/bandwidth, got "
+                f"{total_grad_bytes}/{link_bandwidth_gbps}"
+            )
+        with self._cond:
+            self.accumulated_size = total_grad_bytes / 1e9  # GB
+            self.accumulated_bandwidth = self.world_size * link_bandwidth_gbps
+
     # -- hook phase ------------------------------------------------------------
 
     def _initial_rent_cost(self) -> float:
@@ -101,7 +121,18 @@ class CoordinatorLogic:
             # wall time actually waited (a condition variable wakes early on
             # any notify — heartbeats, other steps' arrivals — so counting a
             # full slot per wakeup would inflate rent arbitrarily).
-            initial_rent = self._initial_rent_cost()
+            # snapshot the cost constants once: calibrate() may retune them
+            # mid-wait (trainer's first step races the same step's freeze),
+            # and one decision must not mix two scales — the new constants
+            # take effect at the NEXT step's freeze
+            size, bandwidth = self.accumulated_size, self.accumulated_bandwidth
+            n = self.world_size
+            initial_rent = 2 * (n - 1) * size / bandwidth
+
+            def buy_cost(m: int) -> float:
+                ratio = ((m - 1) / m) / ((n - 1) / n)
+                return initial_rent * ratio + n * size / bandwidth
+
             t0 = time.monotonic()
             while True:
                 accumulated_rent = time.monotonic() - t0
@@ -110,7 +141,7 @@ class CoordinatorLogic:
                     break
                 if num_ready > 1:
                     if (
-                        accumulated_rent + initial_rent >= self._buy_cost(num_ready)
+                        accumulated_rent + initial_rent >= buy_cost(num_ready)
                         or accumulated_rent > self.relay_threshold
                     ):
                         break

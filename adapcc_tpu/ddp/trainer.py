@@ -118,6 +118,7 @@ class DDPTrainer:
             )
         self._deferred: Optional[Any] = None
         self._bank_dirty = False  # some rank holds banked (deferred) grads
+        self._coord_calibrated = False
         self._compiled: Optional[Callable] = None
         self._scan_cache: dict = {}  # ("scan", n_steps) → compiled program
         self._host_step = 0
@@ -337,6 +338,23 @@ class DDPTrainer:
         self._check_state(state)
         if self._compiled is None:
             self._compiled = self._build()
+        if not self._coord_calibrated:
+            # rent-or-buy calibration: this trainer's actual gradient volume
+            # + the bootstrap's profiled link bandwidth replace the
+            # coordinator's hardcoded cost constants.  Latches on SUCCESS —
+            # a False (worker process, coordinator not yet enabled, no
+            # profile) retries next step; the no-server case is a cheap
+            # attribute check inside calibrate_coordinator
+            comm = self.hook.communicator
+            if comm is None or not hasattr(comm, "calibrate_coordinator"):
+                self._coord_calibrated = True
+            else:
+                grad_bytes = sum(
+                    leaf.nbytes for leaf in jax.tree_util.tree_leaves(state.params)
+                )
+                self._coord_calibrated = comm.calibrate_coordinator(
+                    float(grad_bytes)
+                )
         # host-side counter: reading state.step would force a device sync on
         # every dispatch, serializing the loop
         idx = self._host_step if step_idx is None else step_idx

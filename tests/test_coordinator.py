@@ -175,6 +175,72 @@ def test_grpc_fault_detection(server):
 # communicator integration
 # --------------------------------------------------------------------------- #
 
+def test_calibrate_sets_dimensionally_honest_costs():
+    """calibrate() replaces the reference's unit-less constants: the initial
+    rent becomes the ring-allreduce seconds estimate 2(n-1)/n * bytes/bw,
+    and the commit threshold scales with gradient volume — a bigger model
+    waits longer before paying the partial-collective make-up cost."""
+    logic = CoordinatorLogic(8)
+    logic.calibrate(total_grad_bytes=400e6, link_bandwidth_gbps=100.0)
+    expect = 2 * 7 / 8 * 400e6 / (100.0 * 1e9)
+    assert logic._initial_rent_cost() == pytest.approx(expect)
+
+    small, big = CoordinatorLogic(8), CoordinatorLogic(8)
+    small.calibrate(1e6, 100.0)
+    big.calibrate(1e9, 100.0)
+    # rent the leader tolerates before freezing a 7-of-8 partial set
+    slack = lambda lg: lg._buy_cost(7) - lg._initial_rent_cost()  # noqa: E731
+    assert slack(big) > slack(small) > 0
+
+    with pytest.raises(ValueError, match="positive"):
+        logic.calibrate(0, 100.0)
+
+
+def test_communicator_calibrates_from_profiled_bandwidth(tmp_path, mesh4):
+    """calibrate_coordinator reads the bootstrap's gathered profile CSVs and
+    feeds the measured mean link bandwidth into the server's logic."""
+    from adapcc_tpu.communicator import Communicator
+    from adapcc_tpu.config import CommArgs
+
+    topo = tmp_path / "topo"
+    topo.mkdir()
+    with open(topo / "topo_profile_0", "w") as f:
+        for s in range(4):
+            for d in range(4):
+                if s != d:
+                    f.write(f"{s},{d},lat,0.00001\n")
+                    f.write(f"{s},{d},bw,25.0\n")
+    args = CommArgs(
+        topology_dir=str(topo),
+        strategy_file=str(topo / "strategy.xml"),
+        logical_graph=str(topo / "lg.xml"),
+    )
+    # launcher-written 2-host ip table: calibration must average ONLY the
+    # inter-process links (fast intra-host ICI would inflate the estimate)
+    with open(topo / "ip_table.txt", "w") as f:
+        f.write("\n".join(["10.0.0.1", "10.0.0.1", "10.0.0.2", "10.0.0.2"]))
+    with open(topo / "topo_profile_0", "w") as f:  # overwrite: 100 intra / 10 inter
+        for s in range(4):
+            for d in range(4):
+                if s != d:
+                    bw = 100.0 if (s < 2) == (d < 2) else 10.0
+                    f.write(f"{s},{d},lat,0.00001\n")
+                    f.write(f"{s},{d},bw,{bw}\n")
+    comm = Communicator(args, mesh=mesh4)
+    # without a server (worker process): no-op, defaults stay
+    assert comm.calibrate_coordinator(1e6) is False
+    comm.enable_coordinator(is_master=True, process_rank=0, num_processes=2, port=0)
+    try:
+        assert comm.calibrate_coordinator(100e6) is True
+        logic = comm._coordinator_server.logic
+        assert logic.accumulated_size == pytest.approx(0.1)  # GB
+        # the coordinator's world is PROCESSES (n=2): the cost model prices
+        # the inter-process collective, so only the 10 GB/s links count
+        assert logic.accumulated_bandwidth == pytest.approx(2 * 10.0)
+    finally:
+        comm.clear()
+
+
 def test_communicator_coordinator_plane(tmp_path, mesh4):
     from adapcc_tpu.communicator import Communicator
     from adapcc_tpu.config import CommArgs
