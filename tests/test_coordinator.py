@@ -241,6 +241,50 @@ def test_communicator_calibrates_from_profiled_bandwidth(tmp_path, mesh4):
         comm.clear()
 
 
+def test_trainer_pushes_calibration_on_first_step(tmp_path, mesh4):
+    """DDPTrainer's first step feeds its real gradient volume into the
+    in-process coordinator's rent-or-buy model (closing the loop from
+    profile + model to policy)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from adapcc_tpu.communicator import Communicator
+    from adapcc_tpu.config import CommArgs
+    from adapcc_tpu.ddp import DDPTrainer, TrainState
+    from adapcc_tpu.strategy.ir import Strategy
+
+    topo = tmp_path / "topo"
+    topo.mkdir()
+    with open(topo / "topo_profile_0", "w") as f:
+        for s in range(4):
+            for d in range(4):
+                if s != d:
+                    f.write(f"{s},{d},lat,0.00001\n{s},{d},bw,25.0\n")
+    args = CommArgs(
+        topology_dir=str(topo),
+        strategy_file=str(topo / "strategy.xml"),
+        logical_graph=str(topo / "lg.xml"),
+    )
+    comm = Communicator(args, mesh=mesh4)
+    comm.enable_coordinator(is_master=True, process_rank=0, num_processes=1, port=0)
+    try:
+        params = {"w": jnp.ones((8, 4), jnp.float32)}  # 128 bytes
+        tx = optax.sgd(0.1)
+        trainer = DDPTrainer(
+            lambda p, b: jnp.mean((b @ p["w"]) ** 2), tx, mesh4,
+            Strategy.ring(4), communicator=comm,
+        )
+        state = TrainState.create(params, tx)
+        batch = jnp.ones((8, 8), jnp.float32)
+        trainer.step(state, batch)
+        logic = comm._coordinator_server.logic
+        assert trainer._coord_calibrated
+        assert logic.accumulated_size == pytest.approx(128 / 1e9)
+    finally:
+        comm.clear()
+
+
 def test_communicator_coordinator_plane(tmp_path, mesh4):
     from adapcc_tpu.communicator import Communicator
     from adapcc_tpu.config import CommArgs
