@@ -100,6 +100,30 @@ def test_merged_allreduce_oracle_with_relay_mask(mesh8, op):
         np.testing.assert_allclose(got, np.broadcast_to(want, x.shape), atol=1e-5)
 
 
+def test_merged_integer_dtypes(mesh8):
+    """Identity padding and combines hold for integer payloads (int32 SUM,
+    int32 MAX uses iinfo.min as the pad/mask identity)."""
+    strat = Strategy.ring(8, 4)
+    x = np.arange(8 * 11, dtype=np.int32).reshape(8, 11)
+    got = _run(
+        mesh8,
+        functools.partial(E.allreduce_shard, strategy=strat, op=ReduceOp.SUM),
+        jnp.asarray(x),
+        jnp.ones((8,), jnp.bool_),
+    )
+    np.testing.assert_array_equal(got, np.broadcast_to(x.sum(0), x.shape))
+    mask = np.array([1, 1, 0, 1, 1, 1, 1, 1], bool)
+    got_max = _run(
+        mesh8,
+        functools.partial(E.allreduce_shard, strategy=strat, op=ReduceOp.MAX),
+        jnp.asarray(x),
+        jnp.asarray(mask),
+    )
+    np.testing.assert_array_equal(
+        got_max, np.broadcast_to(x[mask].max(0), x.shape)
+    )
+
+
 def test_merged_reduce_and_broadcast_oracles(mesh8):
     """reduce: each tree's root holds its segment's total; broadcast: each
     segment adopts its root's values — same contract as the sequential path."""
