@@ -29,7 +29,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from adapcc_tpu.comm.engine import CollectiveEngine
-from adapcc_tpu.comm.mesh import RANKS_AXIS, build_world_mesh, mesh_ip_table
+from adapcc_tpu.comm.mesh import build_world_mesh, mesh_ip_table
 from adapcc_tpu.config import CommArgs
 from adapcc_tpu.primitives import (
     ALLGATHER,
@@ -46,7 +46,6 @@ from adapcc_tpu.strategy.ir import Strategy
 from adapcc_tpu.strategy.synthesizer import Synthesizer
 from adapcc_tpu.strategy.xml_io import parse_strategy_xml, read_ip_table, write_ip_table
 from adapcc_tpu.topology.detect import (
-    detect_topology,
     dump_detected_topology,
     gather_detect_graph,
 )
