@@ -49,13 +49,24 @@ class GradSyncHook:
         use_xla_fastpath: bool = True,
         communicator: Optional[Any] = None,
         mode: str = "auto",
+        compress: str = "off",
     ) -> None:
         """``mode``: ``"psum"`` = per-leaf masked psum (one XLA collective per
         leaf — no bucketing copies, optimal on a flat ICI mesh and still
         honoring subset semantics); ``"schedule"`` = bucketed strategy-tree
         allreduce (the adaptive path for hierarchical topologies);
         ``"auto"`` = psum when fastpath is allowed and the strategy spans a
-        single host group, schedule otherwise."""
+        single host group, schedule otherwise.
+
+        ``compress``: ``"bf16"`` casts gradients to bfloat16 for the wire
+        (halving ICI/DCN bytes) and back afterwards — the torch-DDP
+        ``bf16_compress_hook`` analog (the XLA-native cousin of quantized
+        allreduce, PAPERS.md EQuARX).  Accumulation then happens in bf16,
+        adding ~bf16-eps relative error to the synced mean; ``"off"`` keeps
+        the gradient dtype end to end.
+        """
+        if compress not in ("off", "bf16"):
+            raise ValueError(f"compress must be off|bf16, got {compress!r}")
         self.strategy = strategy
         self.axis_name = axis_name
         self.op = op
@@ -63,6 +74,7 @@ class GradSyncHook:
         self.use_xla_fastpath = use_xla_fastpath
         self.communicator = communicator
         self.mode = mode
+        self.compress = compress
         self._plan: Optional[BucketPlan] = None
         self.recorded_buckets: List[tuple] = []  # (size, chunk_bytes) per bucket
 
@@ -106,6 +118,20 @@ class GradSyncHook:
         attached): masking and the active-count divide fold away at trace
         time, leaving exactly the plain-DDP program.
         """
+        import jax as _jax
+
+        if self.compress == "bf16":
+            orig_dtypes = _jax.tree_util.tree_map(lambda g: g.dtype, grads)
+            wire = _jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.bfloat16), grads
+            )
+            synced = self._sync_impl(wire, active_mask)
+            return _jax.tree_util.tree_map(
+                lambda s, dt: s.astype(dt), synced, orig_dtypes
+            )
+        return self._sync_impl(grads, active_mask)
+
+    def _sync_impl(self, grads: Any, active_mask: Optional[jnp.ndarray]) -> Any:
         import jax as _jax
         from jax import lax as _lax
 

@@ -84,6 +84,9 @@ class DDPTrainer:
         # and 1/world optimizer memory in ONE compiled program.  States come
         # from :meth:`init_state` (not TrainState.create).
         zero1: bool = False,
+        # "bf16" halves gradient-sync wire bytes (torch bf16_compress_hook
+        # analog); adds ~bf16-eps relative error to the synced mean
+        grad_compress: str = "off",
     ) -> None:
         self.loss_fn = loss_fn
         self.tx = tx
@@ -101,6 +104,7 @@ class DDPTrainer:
             use_xla_fastpath=use_xla_fastpath,
             communicator=communicator,
             mode=sync_mode,
+            compress=grad_compress,
         )
         self.bsp = bsp
         self._dynamic_mask = (

@@ -61,6 +61,11 @@ def build_parser() -> argparse.ArgumentParser:
         "into their next active step instead of dropped (reference is_bsp)",
     )
     p.add_argument(
+        "--grad-compress", choices=["off", "bf16"], default="off",
+        help="bf16 gradient-sync wire compression (torch bf16_compress_hook "
+        "analog): halves ICI/DCN bytes, ~bf16-eps error on the synced mean",
+    )
+    p.add_argument(
         "--sync-mode", choices=["auto", "psum", "schedule"], default="auto",
         help="gradient-sync data plane: psum = masked XLA collective per "
         "leaf; schedule = bucketed strategy-tree allreduce (multi-tree "
@@ -210,6 +215,7 @@ def main(argv=None) -> None:
             use_xla_fastpath=comm_args.use_xla_fastpath,
             bsp=comm_args.is_bsp,
             sync_mode=args.sync_mode,
+            grad_compress=args.grad_compress,
         )
         state = TrainState.create(params, tx)
 

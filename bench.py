@@ -31,6 +31,7 @@ Size knobs via env (defaults target a single v5e chip):
     BENCH_PARAM_DTYPE (bf16|f32), BENCH_LOSS (dense|chunked),
     BENCH_REMAT (off|full|dots|dots_no_batch), BENCH_SCAN (1|0), BENCH_ACCUM,
     BENCH_FLASH_BLOCK (flash tile edge, default 128),
+    BENCH_GRAD_COMPRESS (off|bf16 gradient-sync wire dtype),
     BENCH_PREFLIGHT_S, BENCH_ATTEMPTS, BENCH_DEADLINE
 """
 
@@ -207,6 +208,11 @@ def main() -> None:
     _phase_begin("config")
     try:
         remat_policy = _parse_remat_env()
+        grad_compress = os.environ.get("BENCH_GRAD_COMPRESS", "off")
+        if grad_compress not in ("off", "bf16"):
+            raise ValueError(
+                f"BENCH_GRAD_COMPRESS={grad_compress!r}: expected off/bf16"
+            )
     except ValueError as e:
         _RESULT["error"] = str(e)
         _emit(2)
@@ -332,7 +338,10 @@ def main() -> None:
             # BENCH_ACCUM>1 scans microbatches inside the step: activation
             # memory / accum at unchanged math — the HBM headroom knob
             accum_steps=accum,
+            # BENCH_GRAD_COMPRESS=bf16 halves gradient-sync wire bytes
+            grad_compress=grad_compress,
         )
+        _RESULT["grad_compress"] = grad_compress
         # both paths donate their state; give each its own param buffers
         fw_state = TrainState.create(jax.tree_util.tree_map(jnp.array, params), tx)
         if use_scan:

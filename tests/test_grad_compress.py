@@ -1,0 +1,116 @@
+"""bf16 gradient-sync wire compression (GradSyncHook compress="bf16").
+
+The torch-DDP ``bf16_compress_hook`` analog, XLA-native (PAPERS.md EQuARX is
+the quantized cousin): gradients cross the wire as bfloat16 — half the
+ICI/DCN bytes — and come back in their original dtype.  Pinned here: the
+collective really runs on bf16 (visible in the lowered HLO), the synced
+mean stays within bf16 tolerance of the uncompressed path on BOTH data
+planes, the async relay bank keeps full precision, and a full train step
+still learns.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from adapcc_tpu.comm.mesh import build_world_mesh
+from adapcc_tpu.ddp import DDPTrainer, TrainState
+from adapcc_tpu.ddp.hook import GradSyncHook
+from adapcc_tpu.strategy.ir import Strategy
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return build_world_mesh(8)
+
+
+def _shard(mesh, fn, *args, n_extra=0):
+    g = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh,
+            in_specs=(P("ranks"),) + (P(),) * n_extra,
+            out_specs=P("ranks"),
+            check_vma=False,
+        )
+    )
+    return g, args
+
+
+@pytest.mark.parametrize("mode", ["psum", "schedule"])
+def test_compressed_sync_matches_uncompressed_within_bf16(mesh8, mode):
+    strat = Strategy.ring(8, 4)
+    rng = np.random.default_rng(0)
+    grads = jnp.asarray(rng.normal(size=(8, 57)).astype(np.float32))
+    mask = jnp.asarray(np.array([1, 1, 1, 0, 1, 1, 1, 1], bool))
+
+    def run(compress):
+        hook = GradSyncHook(strat, mode=mode, compress=compress)
+        fn, _ = _shard(
+            mesh8, lambda g, m: hook.sync(g, m), grads, mask, n_extra=1
+        )
+        return np.asarray(fn(grads, mask))
+
+    plain = run("off")
+    comp = run("bf16")
+    assert comp.dtype == np.float32  # dtype restored after the wire
+    np.testing.assert_allclose(comp, plain, rtol=2e-2, atol=2e-2)
+
+
+def test_wire_is_actually_bf16(mesh8):
+    """The lowered program's collective operates on bf16 operands."""
+    strat = Strategy.ring(8)
+    grads = jnp.ones((8, 64), jnp.float32)
+
+    def lowered_text(compress):
+        hook = GradSyncHook(strat, mode="psum", compress=compress)
+        fn = jax.jit(
+            jax.shard_map(
+                lambda g: hook.sync(g, None), mesh=mesh8,
+                in_specs=P("ranks"), out_specs=P("ranks"), check_vma=False,
+            )
+        )
+        return fn.lower(grads).as_text()
+
+    assert "bf16" in lowered_text("bf16")
+    assert "bf16" not in lowered_text("off")
+
+
+def test_compress_rejects_unknown():
+    with pytest.raises(ValueError, match="off|bf16"):
+        GradSyncHook(Strategy.ring(8), compress="fp8")
+
+
+def test_compressed_trainer_learns_and_bank_stays_full_precision(mesh8):
+    """End to end: a compressed trainer's loss decreases, and in async relay
+    mode the deferred bank is carried in the ORIGINAL dtype (accumulating a
+    bank in bf16 would compound rounding across banked steps)."""
+    def loss_fn(p, b):
+        return jnp.mean((b @ p["w"]) ** 2)
+
+    params = {"w": jnp.ones((6, 3), jnp.float32)}
+    tx = optax.sgd(0.05)
+    trainer = DDPTrainer(
+        loss_fn, tx, mesh8, Strategy.ring(8),
+        grad_compress="bf16", bsp=False, dynamic_mask=True,
+    )
+    state = TrainState.create(params, tx)
+    batch = jnp.asarray(
+        np.random.default_rng(1).normal(size=(16, 6)), jnp.float32
+    )
+    mask = jnp.asarray(np.array([0, 1, 1, 1, 1, 1, 1, 1], bool))
+    l0 = None
+    for _ in range(5):
+        state, losses = trainer.step(state, batch, active_mask=mask)
+        l0 = float(jnp.mean(losses)) if l0 is None else l0
+    assert float(jnp.mean(losses)) < l0
+    bank_dtypes = {
+        leaf.dtype for leaf in jax.tree_util.tree_leaves(trainer._deferred)
+    }
+    assert bank_dtypes == {jnp.dtype(jnp.float32)}
