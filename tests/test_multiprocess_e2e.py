@@ -10,6 +10,12 @@ and chunk size, the worker blocking-fetches them) against the real
 coordinator KV store — the analog of the reference's fake-multi-node
 localhost launches (units-test/launch_get_wait_time.sh) with scp replaced by
 the KV fan-out (commu.py:345-351).
+
+A second phase reuses the two processes as a two-level world: each
+process's local devices form one slice's ICI lanes, so the (dcn, ici)
+mesh's inter-slice rounds — merged-executor allreduce and the two-hop
+hierarchical all-to-all — genuinely cross the process boundary, the DCN
+analog available without real multi-host DCN.
 """
 
 import os
@@ -72,6 +78,47 @@ CHILD = textwrap.dedent(
         np.testing.assert_allclose(np.asarray(shard.data), 6.0)
     print(f"PROC{proc_id} allreduce ok", flush=True)
     comm.clear()
+
+    # -- two-level collectives where the PROCESS BOUNDARY is the DCN axis --
+    # (each process's 2 local devices are one slice's ICI lanes; inter-slice
+    # rounds genuinely cross processes).  Two rotated master+chain trees
+    # engage the merged executor: one fused ici collective + merged DCN
+    # groups, executed across real process boundaries.
+    from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.comm.two_level import build_two_level_mesh
+    from adapcc_tpu.strategy.ir import Strategy, Tree
+
+    mesh2l = build_two_level_mesh(2, 2)
+    ips = {r: f"slice-{r // 2}" for r in range(4)}
+    trees = [
+        Tree(0, {0: [1, 2], 2: [3]}, ips),
+        Tree(2, {2: [3, 0], 0: [1]}, ips),
+    ]
+    eng = CollectiveEngine(mesh2l, Strategy(trees, 4), use_xla_fastpath=False)
+
+    arr2 = jax.make_array_from_callback(
+        (4, 8), NamedSharding(mesh2l, P(("dcn", "ici"))), lambda idx: full[idx]
+    )
+    out2 = eng.all_reduce(arr2)
+    for shard in out2.addressable_shards:
+        np.testing.assert_allclose(np.asarray(shard.data), 6.0)
+    print(f"PROC{proc_id} two-level allreduce ok", flush=True)
+
+    blocks = np.stack(
+        [[np.full((1,), 10.0 * s + d, np.float32) for d in range(4)]
+         for s in range(4)]
+    )
+    a2a_in = jax.make_array_from_callback(
+        (4, 4, 1), NamedSharding(mesh2l, P(("dcn", "ici"))),
+        lambda idx: blocks[idx],
+    )
+    a2a_out = eng.all_to_all(a2a_in)
+    for shard in a2a_out.addressable_shards:
+        data = np.asarray(shard.data)
+        r = int(data[0, 0, 0])  # source-0 block value is 10*0 + my_rank
+        np.testing.assert_allclose(data[0, :, 0], 10.0 * np.arange(4) + r)
+    print(f"PROC{proc_id} two-level a2a ok", flush=True)
+
     jax.distributed.shutdown()
     """
 )
@@ -114,6 +161,8 @@ def test_two_process_detect_profile_synthesize_allreduce(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
         assert f"PROC{pid} allreduce ok" in out
+        assert f"PROC{pid} two-level allreduce ok" in out
+        assert f"PROC{pid} two-level a2a ok" in out
 
     # the worker's strategy bytes came through the KV store — byte-identical
     shas = sorted(l.split()[3] for o in outs for l in o.splitlines() if "strategy sha" in l)
