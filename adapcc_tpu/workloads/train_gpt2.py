@@ -179,6 +179,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--zero1", action="store_true",
                    help="shard the optimizer state ZeRO-1 style inside the "
                         "adaptive DDP step (fp32 flat master)")
+    p.add_argument("--grad-compress", choices=["off", "bf16"], default="off",
+                   help="bf16 gradient-sync wire compression (DDP path)")
     return p
 
 
@@ -268,6 +270,7 @@ def run(args) -> Tuple[float, float]:
         trainer = DDPTrainer(
             loss_fn, tx, mesh, Strategy.ring(world),
             accum_steps=args.accum, zero1=args.zero1,
+            grad_compress=args.grad_compress,
         )
     state = (
         trainer.init_state(params) if trainer is not None
