@@ -34,17 +34,35 @@ class TrainState:
     params: Any
     opt_state: Any
     step: jnp.ndarray
+    # non-gradient model collections (e.g. BatchNorm ``batch_stats``),
+    # updated by the loss when the trainer runs in ``stateful_loss`` mode;
+    # the default empty tuple adds no pytree leaves, so stateless trainers
+    # and old checkpoints are unaffected
+    model_state: Any = ()
 
     @classmethod
-    def create(cls, params: Any, tx: optax.GradientTransformation) -> "TrainState":
-        return cls(params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32))
+    def create(
+        cls,
+        params: Any,
+        tx: optax.GradientTransformation,
+        model_state: Any = (),
+    ) -> "TrainState":
+        return cls(
+            params=params,
+            opt_state=tx.init(params),
+            step=jnp.zeros((), jnp.int32),
+            model_state=model_state,
+        )
 
 
 class DDPTrainer:
     """Builds and caches the compiled data-parallel train step.
 
     ``loss_fn(params, batch) -> scalar`` is evaluated per rank on that rank's
-    batch shard; everything else is the trainer's business.
+    batch shard; everything else is the trainer's business.  With
+    ``stateful_loss=True`` the contract becomes ``loss_fn(params,
+    model_state, batch) -> (scalar, new_model_state)`` — non-gradient model
+    collections (BatchNorm running stats) ride in ``TrainState.model_state``.
     """
 
     def __init__(
@@ -87,8 +105,28 @@ class DDPTrainer:
         # "bf16" halves gradient-sync wire bytes (torch bf16_compress_hook
         # analog); adds ~bf16-eps relative error to the synced mean
         grad_compress: str = "off",
+        # stateful losses carry non-gradient model collections (BatchNorm
+        # running stats): ``loss_fn(params, model_state, batch) -> (loss,
+        # new_model_state)``, with the state riding in
+        # ``TrainState.model_state``.  The state is compiled replicated, so
+        # on a multi-rank mesh the loss must produce cross-rank identical
+        # state — BatchNorm with ``axis_name`` set (SyncBN) does; unsynced
+        # per-rank statistics would silently diverge from the spec.
+        # Relay/masked steps: the active mask gates GRADIENT sync only; the
+        # SyncBN pmean still averages every rank's batch, by design —
+        # a straggler's forward ran on real data, so its activation
+        # statistics are sound even when its late gradients are dropped,
+        # and full-axis stats stay bit-identical across ranks (a masked
+        # pmean would fork per-rank state and violate the replication spec).
+        stateful_loss: bool = False,
     ) -> None:
         self.loss_fn = loss_fn
+        self.stateful_loss = stateful_loss
+        # one internal signature for both modes: (params, ms, batch) -> (loss, ms)
+        if stateful_loss:
+            self._loss3 = loss_fn
+        else:
+            self._loss3 = lambda p, ms, b: (loss_fn(p, b), ms)
         self.tx = tx
         self.mesh = mesh
         self.axis_name = axis_name
@@ -141,17 +179,20 @@ class DDPTrainer:
 
     # -- step program ----------------------------------------------------------
 
-    def init_state(self, params: Any) -> TrainState:
+    def init_state(self, params: Any, model_state: Any = ()) -> TrainState:
         """Build the trainer's state: replicated optax state normally, the
         ZeRO-1 flat master + sharded optimizer state when ``zero1=True``."""
         if not self.zero1:
-            return TrainState.create(params, self.tx)
+            return TrainState.create(params, self.tx, model_state=model_state)
         from adapcc_tpu.parallel.fsdp import Zero1Optimizer
 
         opt = Zero1Optimizer(self.tx, self.mesh, self.axis_name)
         master, opt_state = opt.init(params)
         return TrainState(
-            params=params, opt_state=(master, opt_state), step=jnp.zeros((), jnp.int32)
+            params=params,
+            opt_state=(master, opt_state),
+            step=jnp.zeros((), jnp.int32),
+            model_state=model_state,
         )
 
     def _check_state(self, state: TrainState) -> None:
@@ -179,9 +220,13 @@ class DDPTrainer:
         replicated, except the ZeRO-1 ``(master, opt shard)`` pair whose
         leading ``[world]`` dim shards over the axis."""
         opt_spec = P(self.axis_name) if self.zero1 else P()
-        return TrainState(params=P(), opt_state=opt_spec, step=P())
+        return TrainState(
+            params=P(), opt_state=opt_spec, step=P(), model_state=P()
+        )
 
-    def _apply_synced(self, state: TrainState, synced: Any) -> TrainState:
+    def _apply_synced(
+        self, state: TrainState, synced: Any, model_state: Any = None
+    ) -> TrainState:
         """Optimizer tail shared by every step variant: one change to the
         update rule applies to step() and scan_steps() alike.
 
@@ -190,10 +235,17 @@ class DDPTrainer:
         free local read; the optax update touches only the [N/world] shard
         and one all-gather rebuilds the replicated params.
         """
+        if model_state is None:
+            model_state = state.model_state
         if not self.zero1:
             updates, opt_state = self.tx.update(synced, state.opt_state, state.params)
             params = optax.apply_updates(state.params, updates)
-            return TrainState(params=params, opt_state=opt_state, step=state.step + 1)
+            return TrainState(
+                params=params,
+                opt_state=opt_state,
+                step=state.step + 1,
+                model_state=model_state,
+            )
 
         from adapcc_tpu.parallel.fsdp import (
             _flatten,
@@ -222,19 +274,25 @@ class DDPTrainer:
                 jax.tree_util.tree_map(lambda x: x[None], opt_state),
             ),
             step=state.step + 1,
+            model_state=model_state,
         )
 
-    def _value_and_grad(self, params: Any, batch: Any):
-        """Per-rank (loss, grads), microbatch-accumulated when accum_steps>1.
+    def _value_and_grad(self, params: Any, model_state: Any, batch: Any):
+        """Per-rank (loss, grads, new_model_state), microbatch-accumulated
+        when accum_steps>1.
 
         Accumulation runs as a ``lax.scan`` over ``[accum, B/accum, ...]``
         microbatches with fp32 gradient carry; the mean over equal-size
         microbatches equals the full-batch value for mean losses, so every
-        sync/update path downstream is unchanged.
+        sync/update path downstream is unchanged.  Model state threads
+        through the microbatches sequentially (torch grad-accum semantics:
+        BatchNorm statistics see every microbatch).
         """
         accum = self.accum_steps
+        vg = jax.value_and_grad(self._loss3, has_aux=True)
         if accum == 1:
-            return jax.value_and_grad(self.loss_fn)(params, batch)
+            (loss, new_ms), grads = vg(params, model_state, batch)
+            return loss, grads, new_ms
 
         def to_micro(x):
             b = x.shape[0]
@@ -250,27 +308,29 @@ class DDPTrainer:
         )
 
         def body(carry, mb):
-            acc_l, acc_g = carry
-            loss, g = jax.value_and_grad(self.loss_fn)(params, mb)
+            acc_l, acc_g, ms = carry
+            (loss, ms), g = vg(params, ms, mb)
             acc_g = jax.tree_util.tree_map(
                 lambda a, x: a + x.astype(jnp.float32), acc_g, g
             )
-            return (acc_l + loss.astype(jnp.float32), acc_g), None
+            return (acc_l + loss.astype(jnp.float32), acc_g, ms), None
 
-        (loss_sum, g_sum), _ = jax.lax.scan(
-            body, (jnp.zeros((), jnp.float32), g0), micro
+        (loss_sum, g_sum, new_ms), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), g0, model_state), micro
         )
         grads = jax.tree_util.tree_map(
             lambda g, p: (g / accum).astype(p.dtype), g_sum, params
         )
-        return loss_sum / accum, grads
+        return loss_sum / accum, grads, new_ms
 
     def _static_full_step(self, state: TrainState, batch: Any):
         """The static full-world step (no mask, no relay banking): the body
         scan_steps scans and _build's static path reduces to."""
-        loss, grads = self._value_and_grad(state.params, batch)
+        loss, grads, new_ms = self._value_and_grad(
+            state.params, state.model_state, batch
+        )
         synced = self.hook.sync(grads, None)
-        return self._apply_synced(state, synced), loss
+        return self._apply_synced(state, synced, new_ms), loss
 
     def _build(self) -> Callable:
         # without a coordinator (or an explicit dynamic_mask request) the
@@ -280,7 +340,9 @@ class DDPTrainer:
         deferred_relay = not self.bsp
 
         def per_shard(state: TrainState, batch: Any, *extra: Any):
-            loss, grads = self._value_and_grad(state.params, batch)
+            loss, grads, new_ms = self._value_and_grad(
+                state.params, state.model_state, batch
+            )
             mask = extra[0] if dynamic_mask else None
             outs = []
             if deferred_relay:
@@ -291,7 +353,7 @@ class DDPTrainer:
                 outs.append(jax.tree_util.tree_map(lambda d: d[None], new_deferred))
             else:
                 synced = self.hook.sync(grads, mask)
-            new_state = self._apply_synced(state, synced)
+            new_state = self._apply_synced(state, synced, new_ms)
             if self.measure_gns:
                 from adapcc_tpu.measure.gns import ddp_grad_sq_norms
 

@@ -5,5 +5,6 @@ bf16 matmuls on the MXU, static shapes, remat-friendly blocks."""
 
 from adapcc_tpu.models.mlp import MLP
 from adapcc_tpu.models.gpt2 import GPT2, GPT2Config
+from adapcc_tpu.models.resnet import ResNet, ResNet18, ResNet34, ResNet50
 
-__all__ = ["MLP", "GPT2", "GPT2Config"]
+__all__ = ["MLP", "GPT2", "GPT2Config", "ResNet", "ResNet18", "ResNet34", "ResNet50"]

@@ -101,8 +101,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--image-size", type=int, default=32)
     p.add_argument("--noise", type=float, default=1.0,
                    help="blob corruption; lower = easier problem")
-    p.add_argument("--model", choices=["vgg", "mlp"], default="vgg",
-                   help="vgg = the reference benchmark model; mlp = fast smoke")
+    p.add_argument("--model", choices=["vgg", "resnet18", "mlp"], default="vgg",
+                   help="resnet18 = the reference study's default --arch "
+                        "(stateless GroupNorm variant here; the SyncBN path "
+                        "runs in main_elastic); vgg = fast conv benchmark; "
+                        "mlp = fast smoke")
     p.add_argument("--world", type=int, default=None)
     p.add_argument("--measure-gns", action="store_true")
     p.add_argument("--accuracy-trace", type=str, default=None,
@@ -140,6 +143,14 @@ def run(args) -> Tuple[float, float]:
 
     if args.model == "vgg":
         net = VGG11(num_classes=args.num_classes, classifier_width=64, dtype=jnp.float32)
+        apply_fn = net.apply
+        params = net.init(jax.random.PRNGKey(0), jnp.asarray(train_x[:1]))
+    elif args.model == "resnet18":
+        from adapcc_tpu.models.resnet import ResNet18
+
+        net = ResNet18(
+            num_classes=args.num_classes, small_inputs=True, dtype=jnp.float32
+        )
         apply_fn = net.apply
         params = net.init(jax.random.PRNGKey(0), jnp.asarray(train_x[:1]))
     else:

@@ -37,9 +37,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--checkpoint-file", type=str, default="/tmp/adapcc_elastic/checkpoint.ckpt")
     p.add_argument("--world", type=int, default=None)
-    p.add_argument("--model", choices=("vgg11", "mlp"), default="vgg11",
-                   help="vgg11 matches the reference workload; mlp compiles "
-                        "in seconds for restart-path tests")
+    p.add_argument("--model", choices=("resnet18", "resnet50", "vgg11", "mlp"),
+                   default="vgg11",
+                   help="resnet18 is the reference's default --arch "
+                        "(main_elastic.py:75); vgg11 compiles much faster on "
+                        "the virtual pod; mlp compiles in seconds for "
+                        "restart-path tests")
+    p.add_argument("--norm", choices=("group", "batch"), default="batch",
+                   help="resnet norm layer: batch = SyncBN running stats "
+                        "carried in the checkpoint (reference torchvision "
+                        "behavior, cross-replica synced); group = stateless")
     p.add_argument("--crash-at-epoch", type=int, default=None,
                    help="fault injection: die after checkpointing this epoch")
     p.add_argument("--supervise", action="store_true",
@@ -63,7 +70,21 @@ def worker(args) -> int:
     mesh = build_world_mesh(args.world)
     world = int(mesh.devices.size)
 
-    if args.model == "vgg11":
+    stateful = False
+    if args.model in ("resnet18", "resnet50"):
+        from adapcc_tpu.models.resnet import ResNet18, ResNet50
+
+        from adapcc_tpu.comm.mesh import RANKS_AXIS
+
+        ctor = ResNet18 if args.model == "resnet18" else ResNet50
+        # small_inputs: the 32x32 synthetic data below is CIFAR-shaped
+        model = ctor(
+            num_classes=10, small_inputs=True, dtype=jnp.float32,
+            norm=args.norm,
+            axis_name=RANKS_AXIS if args.norm == "batch" else None,
+        )
+        stateful = args.norm == "batch"
+    elif args.model == "vgg11":
         from adapcc_tpu.models.vgg import VGG11
 
         model = VGG11(num_classes=10, classifier_width=128, dtype=jnp.float32)
@@ -78,25 +99,50 @@ def worker(args) -> int:
     rng = np.random.default_rng(0)
     images = jnp.asarray(rng.normal(size=(args.batch, 32, 32, 3)), jnp.float32)
     labels = jnp.asarray(rng.integers(0, 10, size=(args.batch,)))
-    params = model.init(jax.random.PRNGKey(0), images[:1])
 
-    def loss_fn(p, batch):
-        x, y = batch
-        logits = model.apply(p, x)
-        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+    if stateful:
+        # SyncBN: running statistics ride in TrainState.model_state and the
+        # checkpoint's extra dict (the reference's State carries the whole
+        # torchvision module incl. BN buffers)
+        variables = model.init(jax.random.PRNGKey(0), images[:1], train=True)
+        params, model_state = variables["params"], variables["batch_stats"]
+
+        def loss_fn(p, ms, batch):
+            x, y = batch
+            logits, upd = model.apply(
+                {"params": p, "batch_stats": ms}, x, train=True,
+                mutable=["batch_stats"],
+            )
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            return ce.mean(), upd["batch_stats"]
+    else:
+        params = model.init(jax.random.PRNGKey(0), images[:1])
+        model_state = ()
+
+        def loss_fn(p, batch):
+            x, y = batch
+            logits = model.apply(p, x)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
     tx = optax.sgd(args.lr, momentum=0.9)
-    trainer = DDPTrainer(loss_fn, tx, mesh, Strategy.ring(world))
-    train_state = TrainState.create(params, tx)
+    trainer = DDPTrainer(
+        loss_fn, tx, mesh, Strategy.ring(world), stateful_loss=stateful
+    )
+    train_state = trainer.init_state(params, model_state=model_state)
 
     # rendezvous restore: newest checkpoint wins across the (new) world
-    ckpt = TrainCheckpointState(params=train_state.params, opt_state=train_state.opt_state)
+    ckpt = TrainCheckpointState(
+        params=train_state.params,
+        opt_state=train_state.opt_state,
+        extra={"model_state": model_state} if stateful else {},
+    )
     ckpt = restore_newest_across_processes(ckpt, args.checkpoint_file)
     start_epoch = ckpt.epoch + 1
     if start_epoch > 0:
         print(f"=> resuming from epoch {start_epoch}")
         train_state = TrainState(
-            params=ckpt.params, opt_state=ckpt.opt_state, step=ckpt.step
+            params=ckpt.params, opt_state=ckpt.opt_state, step=ckpt.step,
+            model_state=ckpt.extra.get("model_state", ()) if stateful else (),
         )
 
     for epoch in range(start_epoch, args.epochs):
@@ -108,6 +154,8 @@ def worker(args) -> int:
         ckpt.opt_state = train_state.opt_state
         ckpt.epoch = epoch
         ckpt.step = int(train_state.step)
+        if stateful:
+            ckpt.extra["model_state"] = train_state.model_state
         save_checkpoint(ckpt, args.checkpoint_file)
 
         # fault injection fires only in the first generation, so the
@@ -130,6 +178,7 @@ def main(argv=None) -> int:
             "--lr", str(args.lr),
             "--checkpoint-file", args.checkpoint_file,
             "--model", args.model,
+            "--norm", args.norm,
         ]
         if args.world:
             worker_argv += ["--world", str(args.world)]

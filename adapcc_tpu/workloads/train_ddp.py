@@ -39,7 +39,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--parallel_degree", type=int, default=2)
     p.add_argument("--profile_freq", type=int, default=0)
     # workload knobs
-    p.add_argument("--model", choices=["mlp", "vgg", "vit", "gpt2"], default="mlp")
+    p.add_argument(
+        "--model",
+        choices=["mlp", "vgg", "resnet18", "resnet50", "vit", "gpt2"],
+        default="mlp",
+    )
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--batch", type=int, default=64)
     p.add_argument("--lr", type=float, default=1e-3)
@@ -91,10 +95,18 @@ def make_workload(name: str, batch: int, rng):
 
         return loss_fn, params, lambda: (x, y)
 
-    if name == "vgg":
-        from adapcc_tpu.models.vgg import VGG16
+    if name in ("vgg", "resnet18", "resnet50"):
+        if name == "vgg":
+            from adapcc_tpu.models.vgg import VGG16
 
-        model = VGG16(num_classes=10, classifier_width=512)
+            model = VGG16(num_classes=10, classifier_width=512)
+        else:
+            # stateless GroupNorm variant: drops into the same loss_fn
+            # contract as every other workload (SyncBN runs in main_elastic)
+            from adapcc_tpu.models.resnet import ResNet18, ResNet50
+
+            ctor = ResNet18 if name == "resnet18" else ResNet50
+            model = ctor(num_classes=10, small_inputs=True, dtype=jnp.float32)
         x = jnp.asarray(np.random.default_rng(0).normal(size=(batch, 32, 32, 3)), jnp.float32)
         y = jnp.asarray(np.random.default_rng(1).integers(0, 10, size=(batch,)))
         params = model.init(rng, x[:1])

@@ -567,3 +567,113 @@ def test_accum_zero1_schedule_mode_compose(mesh8):
         np.testing.assert_allclose(
             np.asarray(sf.params[k]), np.asarray(sp.params[k]), rtol=2e-5, atol=2e-6
         )
+
+
+# --------------------------------------------------------------------------- #
+# stateful loss (SyncBN batch_stats through the compiled step)
+# --------------------------------------------------------------------------- #
+
+def _bn_net_and_loss():
+    import flax.linen as nn
+
+    class BNNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            x = nn.Dense(16)(x)
+            x = nn.BatchNorm(
+                use_running_average=not train,
+                axis_name=RANKS_AXIS if train else None,
+                momentum=0.9,
+            )(x)
+            return nn.Dense(4)(nn.relu(x))
+
+    net = BNNet()
+
+    def loss_fn(p, ms, batch):
+        x, y = batch
+        logits, upd = net.apply(
+            {"params": p, "batch_stats": ms}, x, train=True,
+            mutable=["batch_stats"],
+        )
+        ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        return ce.mean(), upd["batch_stats"]
+
+    return net, loss_fn
+
+
+def test_stateful_loss_syncbn_stats_update(mesh4):
+    """SyncBN under the adaptive DDP step (reference torchvision-BN DDP,
+    main_elastic.py:243-244): batch_stats ride TrainState.model_state,
+    update every step, and — because the model psums statistics over the
+    mesh axis — stay identical to the full-batch single-device stats."""
+    net, loss_fn = _bn_net_and_loss()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 12)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, size=(8,)))
+    v0 = net.init(jax.random.PRNGKey(0), x[:1], train=True)
+    tx = optax.sgd(1e-2)
+    tr = DDPTrainer(loss_fn, tx, mesh4, Strategy.ring(4), stateful_loss=True)
+    state = tr.init_state(v0["params"], model_state=v0["batch_stats"])
+    s0 = jax.tree_util.tree_map(np.asarray, state.model_state)
+    state, _ = tr.step(state, (x, y))
+
+    moved = jax.tree_util.tree_map(
+        lambda a, b: float(np.abs(np.asarray(a) - b).max()), state.model_state, s0
+    )
+    assert any(m > 0 for m in jax.tree_util.tree_leaves(moved))
+
+    # oracle: SyncBN's cross-rank mean/var over [8/4 per rank] must equal
+    # the single-device full-batch statistics (same first step, world=1)
+    mean = np.asarray(x @ np.asarray(v0["params"]["Dense_0"]["kernel"])
+                      + np.asarray(v0["params"]["Dense_0"]["bias"])).mean(0)
+    got = np.asarray(state.model_state["BatchNorm_0"]["mean"])
+    np.testing.assert_allclose(got, 0.1 * mean, rtol=1e-4, atol=1e-5)
+
+
+def test_stateful_loss_scan_steps_carries_stats(mesh4):
+    net, loss_fn = _bn_net_and_loss()
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(8, 12)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, size=(8,)))
+    v0 = net.init(jax.random.PRNGKey(0), x[:1], train=True)
+    tx = optax.sgd(1e-2)
+    tr = DDPTrainer(loss_fn, tx, mesh4, Strategy.ring(4), stateful_loss=True)
+    st_scan = tr.init_state(v0["params"], model_state=v0["batch_stats"])
+    st_scan, _ = tr.scan_steps(st_scan, (x, y), 3)
+
+    tr2 = DDPTrainer(loss_fn, tx, mesh4, Strategy.ring(4), stateful_loss=True)
+    st_loop = tr2.init_state(v0["params"], model_state=v0["batch_stats"])
+    for _ in range(3):
+        st_loop, _ = tr2.step(st_loop, (x, y))
+    tree_close(st_scan.model_state, st_loop.model_state)
+    tree_close(st_scan.params, st_loop.params)
+
+
+def test_stateful_loss_accum_carries_stats(mesh4):
+    """accum_steps>1 threads model_state through the microbatch scan carry:
+    two sequential microbatches must produce the same running stats as two
+    manual applications of the EMA update."""
+    net, loss_fn = _bn_net_and_loss()
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(8, 12)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, size=(8,)))
+    v0 = net.init(jax.random.PRNGKey(0), x[:1], train=True)
+    tx = optax.sgd(1e-2)
+    tr = DDPTrainer(
+        loss_fn, tx, mesh4, Strategy.ring(4), stateful_loss=True, accum_steps=2
+    )
+    state = tr.init_state(v0["params"], model_state=v0["batch_stats"])
+    state, _ = tr.step(state, (x, y))
+
+    # oracle: SyncBN sees the full cross-rank microbatch at each of the two
+    # scan iterations; both microbatches share identical global statistics
+    # only if the data does — here they differ, so a carry bug (stats from
+    # one microbatch only, or the pre-scan stats) produces a different EMA
+    h = np.asarray(x @ np.asarray(v0["params"]["Dense_0"]["kernel"])
+                   + np.asarray(v0["params"]["Dense_0"]["bias"]))
+    # microbatch m on rank r is x[r*2+m]; microbatch m's global batch is
+    # ranks' rows [0*2+m, 1*2+m, 2*2+m, 3*2+m]
+    m0, m1 = h[0::2].mean(0), h[1::2].mean(0)
+    want = 0.9 * (0.9 * 0.0 + 0.1 * m0) + 0.1 * m1
+    got = np.asarray(state.model_state["BatchNorm_0"]["mean"])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

@@ -165,3 +165,81 @@ def test_moe_router_z_loss():
         params, x.reshape(-1, cfg_ep.d_model), cfg_ep, mesh
     )
     np.testing.assert_allclose(float(aux_ep), float(aux_ref), rtol=1e-5)
+
+
+# -- ResNet (reference main_elastic.py --arch resnet18/50) ---------------------
+
+
+def test_resnet_forward_group_and_batch_norm():
+    # two-stage tiny net: same block/norm/shortcut code paths as ResNet18
+    # at a fraction of the CPU compile cost (the full-width archs are
+    # covered shape-only below)
+    from adapcc_tpu.models.resnet import BasicBlock, ResNet
+
+    x = jnp.ones((2, 16, 16, 3), jnp.float32)
+    gn = ResNet(stage_sizes=(1, 1), block_cls=BasicBlock, num_classes=10,
+                width=8, small_inputs=True, dtype=jnp.float32)
+    v = gn.init(jax.random.PRNGKey(0), x)
+    # GroupNorm variant is stateless: params only
+    assert set(v.keys()) == {"params"}
+    out = gn.apply(v, x)
+    assert out.shape == (2, 10) and out.dtype == jnp.float32
+    assert np.all(np.isfinite(np.asarray(out)))
+
+    bn = ResNet(stage_sizes=(1, 1), block_cls=BasicBlock, num_classes=10,
+                width=8, small_inputs=True, dtype=jnp.float32, norm="batch")
+    vb = bn.init(jax.random.PRNGKey(0), x, train=True)
+    assert "batch_stats" in vb
+    out_t, upd = bn.apply(vb, x, train=True, mutable=["batch_stats"])
+    assert out_t.shape == (2, 10)
+    # train-mode batch statistics actually update the running stats
+    before = jax.tree_util.tree_leaves(vb["batch_stats"])
+    after = jax.tree_util.tree_leaves(upd["batch_stats"])
+    assert any(
+        float(np.abs(np.asarray(a) - np.asarray(b)).max()) > 0
+        for a, b in zip(after, before)
+    )
+    out_e = bn.apply(
+        {"params": vb["params"], "batch_stats": upd["batch_stats"]}, x, train=False
+    )
+    assert out_e.shape == (2, 10)
+
+
+def test_resnet50_bottleneck_forward():
+    from adapcc_tpu.models.resnet import Bottleneck, ResNet
+
+    x = jnp.ones((1, 16, 16, 3), jnp.float32)
+    m = ResNet(stage_sizes=(1, 1), block_cls=Bottleneck, num_classes=7,
+               width=8, small_inputs=True, dtype=jnp.float32)
+    v = m.init(jax.random.PRNGKey(0), x)
+    assert m.apply(v, x).shape == (1, 7)
+
+
+def test_resnet_param_counts_match_torchvision():
+    """Exact structural parity with the reference's torchvision archs
+    (main_elastic.py:75 resnet18 default): the BN variants at full width
+    reproduce torchvision's published parameter counts to the digit.
+    eval_shape only — nothing is materialized."""
+    from adapcc_tpu.models.resnet import ResNet18, ResNet50
+
+    for ctor, want in ((ResNet18, 11_689_512), (ResNet50, 25_557_032)):
+        mdl = ctor(num_classes=1000, norm="batch")
+        shapes = jax.eval_shape(
+            lambda k, m=mdl: m.init(k, jnp.ones((1, 224, 224, 3))),
+            jax.random.PRNGKey(0),
+        )
+        n = sum(
+            int(np.prod(p.shape))
+            for p in jax.tree_util.tree_leaves(shapes["params"])
+        )
+        assert n == want
+
+
+def test_resnet_imagenet_stem_downsamples():
+    from adapcc_tpu.models.resnet import BasicBlock, ResNet
+
+    m = ResNet(stage_sizes=(1,), block_cls=BasicBlock, num_classes=5,
+               width=8, dtype=jnp.float32)
+    x = jnp.ones((1, 64, 64, 3), jnp.float32)
+    v = m.init(jax.random.PRNGKey(0), x)
+    assert m.apply(v, x).shape == (1, 5)
