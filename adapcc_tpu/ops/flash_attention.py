@@ -42,6 +42,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# Mosaic requires the last two dims of every block shape to be divisible by
+# the (8, 128) tile or equal to the whole array's dims.  A naive ``[BH, T]``
+# logsumexp output with block ``(1, bq)`` violates the sublane rule (the 1),
+# so lse/delta cross every pallas_call boundary lane-padded to
+# ``[BH, T, _LSE_LANES]`` (block ``(1, bq, 8)``: bq % 8 == 0, 8 == minor dim)
+# and are sliced back to ``[BH, T]`` outside the kernels.
+_LSE_LANES = 8
+
 
 def _causal_mask(s, qi, kj, block_q, block_k):
     q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -76,7 +84,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, 
         m = m_new
 
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    lse_ref[0] = jnp.broadcast_to(
+        (m + jnp.log(l))[:, None], (bq, _LSE_LANES)
+    )
 
 
 def _dq_kernel(
@@ -86,8 +96,8 @@ def _dq_kernel(
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse_col = lse_ref[0][:, 0:1]      # [bq, 1] from the lane-padded layout
+    delta_col = delta_ref[0][:, 0:1]
     bq, d = q.shape
     n_k = k_ref.shape[1] // block_k
 
@@ -100,11 +110,11 @@ def _dq_kernel(
         ) * scale
         if causal:
             s = _causal_mask(s, qi, j, block_q, block_k)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse_col)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta_col) * scale
         dq = dq + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -126,21 +136,21 @@ def _dkv_kernel(
     for i in range(n_q):
         q = q_ref[0, i * block_q : (i + 1) * block_q, :].astype(jnp.float32)
         do = do_ref[0, i * block_q : (i + 1) * block_q, :].astype(jnp.float32)
-        lse = lse_ref[0, i * block_q : (i + 1) * block_q]
-        delta = delta_ref[0, i * block_q : (i + 1) * block_q]
+        lse_col = lse_ref[0, i * block_q : (i + 1) * block_q, 0:1]
+        delta_col = delta_ref[0, i * block_q : (i + 1) * block_q, 0:1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale
         if causal:
             s = _causal_mask(s, i, kj, block_q, block_k)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse_col)
         dv = dv + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta[:, None]) * scale
+        ds = p * (dp - delta_col) * scale
         dk = dk + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -173,7 +183,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     BH, T, D = q.shape
     bq, bk = _block_sizes(T, block_q, block_k)
     grid = (BH, T // bq)
-    out, lse = pl.pallas_call(
+    out, lse3 = pl.pallas_call(
         functools.partial(
             _fwd_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
         ),
@@ -185,14 +195,15 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, T, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, T), jnp.float32),
+            jax.ShapeDtypeStruct((BH, T, _LSE_LANES), jnp.float32),
         ],
         interpret=_resolve_interpret(interpret),
     )(q, k, v)
+    lse = lse3[:, :, 0]
     return out, (q, k, v, out, lse)
 
 
@@ -211,6 +222,9 @@ def _flash_bwd_core(scale, causal, block_q, block_k, interpret, res, do, dlse):
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)
     interp = _resolve_interpret(interpret)
+    # lane-pad the per-row statistics for the kernels' tiled block specs
+    lse3 = jnp.broadcast_to(lse[..., None], (BH, T, _LSE_LANES))
+    delta3 = jnp.broadcast_to(delta[..., None], (BH, T, _LSE_LANES))
 
     dq = pl.pallas_call(
         functools.partial(
@@ -222,13 +236,13 @@ def _flash_bwd_core(scale, causal, block_q, block_k, interpret, res, do, dlse):
             pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, T, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, _LSE_LANES), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
         interpret=interp,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse3, delta3)
 
     dk, dv = pl.pallas_call(
         functools.partial(
@@ -240,8 +254,8 @@ def _flash_bwd_core(scale, causal, block_q, block_k, interpret, res, do, dlse):
             pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, T, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, T), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, T), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, T, _LSE_LANES), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, T, _LSE_LANES), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
@@ -252,7 +266,7 @@ def _flash_bwd_core(scale, causal, block_q, block_k, interpret, res, do, dlse):
             jax.ShapeDtypeStruct((BH, T, D), v.dtype),
         ],
         interpret=interp,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse3, delta3)
     return dq, dk, dv
 
 

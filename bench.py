@@ -301,15 +301,39 @@ def main() -> None:
         use_scan = _env_int("BENCH_SCAN", 1)
         _RESULT["dispatch"] = "scan" if use_scan else "loop"
 
-        def time_steps(step_fn, state):
-            """Mean step seconds with a forced host sync closing the window.
+        def time_steps(step_fn, state, label):
+            """Mean steady-state step seconds, forced host sync closing the
+            window.
 
             ``step_fn`` runs either one step per call (loop mode: every call
             pays the host→device dispatch round-trip — the remote-tunnel
             tax) or all ``steps`` in one scanned dispatch (BENCH_SCAN=1,
-            default: the device-side throughput number)."""
-            state, loss = step_fn(state)  # compile + warmup
-            _ = float(jax.device_get(jnp.mean(loss)))
+            default: the device-side throughput number).
+
+            Warmup is multi-window on TPU: the tunneled runtime migrates the
+            executable + buffer residency over a fresh program's first TWO
+            executions (~30 s each measured on the r04 hardware session,
+            PERF_NOTES), settling ~300x faster from the third — a single
+            warmup call times the migration transient, not the device
+            (exactly the round-1..4a 0.01-MFU artifact).  Up to
+            ``BENCH_WARMUP_WINDOWS`` windows run (default 3 on tpu, 1
+            elsewhere), exiting early once a window collapses to <1/4 of the
+            previous (steady state proven); every warmup window time lands
+            in the JSON for transparency."""
+            default_w = 3 if jax.devices()[0].platform == "tpu" else 1
+            max_w = _env_int("BENCH_WARMUP_WINDOWS", default_w)
+            trail = []
+            prev = None
+            for _ in range(max_w):
+                t0 = time.perf_counter()
+                state, loss = step_fn(state)
+                _ = float(jax.device_get(jnp.mean(loss)))
+                w = time.perf_counter() - t0
+                trail.append(round(w * 1e3, 1))
+                if prev is not None and w < prev / 4:
+                    break  # migration transient collapsed: steady state
+                prev = w
+            _RESULT[f"warmup_windows_ms_{label}"] = trail
             t0 = time.perf_counter()
             if use_scan:
                 state, loss = step_fn(state)
@@ -345,9 +369,13 @@ def main() -> None:
         # both paths donate their state; give each its own param buffers
         fw_state = TrainState.create(jax.tree_util.tree_map(jnp.array, params), tx)
         if use_scan:
-            fw_time = time_steps(lambda s: trainer.scan_steps(s, tokens, steps), fw_state)
+            fw_time = time_steps(
+                lambda s: trainer.scan_steps(s, tokens, steps), fw_state, "framework"
+            )
         else:
-            fw_time = time_steps(lambda s: trainer.step(s, tokens), fw_state)
+            fw_time = time_steps(
+                lambda s: trainer.step(s, tokens), fw_state, "framework"
+            )
 
         value = tokens_per_step / fw_time
         peak = chip_peak_tflops() * 1e12 * world
@@ -401,7 +429,7 @@ def main() -> None:
             donate_argnums=(0,),
         )
         base_state = TrainState.create(jax.tree_util.tree_map(jnp.array, params), tx)
-        base_time = time_steps(lambda s: base_fn(s, tokens), base_state)
+        base_time = time_steps(lambda s: base_fn(s, tokens), base_state, "baseline")
         baseline = tokens_per_step / base_time
         _RESULT["baseline_step_ms"] = round(base_time * 1e3, 2)
         _RESULT["vs_baseline"] = round(_RESULT["value"] / baseline, 4)
