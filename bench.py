@@ -321,7 +321,9 @@ def main() -> None:
             previous (steady state proven); every warmup window time lands
             in the JSON for transparency."""
             default_w = 3 if jax.devices()[0].platform == "tpu" else 1
-            max_w = _env_int("BENCH_WARMUP_WINDOWS", default_w)
+            # at least one warmup always: zero would time compile + the
+            # migration transient — the exact artifact this loop eliminates
+            max_w = max(1, _env_int("BENCH_WARMUP_WINDOWS", default_w))
             trail = []
             prev = None
             for _ in range(max_w):

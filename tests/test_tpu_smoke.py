@@ -129,11 +129,25 @@ def _run_smoke_child():
     return _CACHE["result"]
 
 
+# child stderr signatures of a dying/contended tunnel (not a lowering bug):
+# these skip rather than fail, so a mid-suite tunnel flap or a concurrent
+# hardware battery holding the chip cannot turn the suite red
+# deliberately narrow: RESOURCE_EXHAUSTED/ABORTED are excluded because
+# device OOM surfaces as RESOURCE_EXHAUSTED — that is a kernel regression
+# this smoke exists to catch, not a flap
+_TRANSPORT_ERRORS = (
+    "UNAVAILABLE", "DEADLINE_EXCEEDED",
+    "failed to connect", "Connection refused", "Socket closed",
+)
+
+
 def _smoke_stdout():
     res = _run_smoke_child()
     if isinstance(res, str):
         pytest.skip(res)
     stdout, stderr, rc = res
+    if rc != 0 and any(sig in stderr for sig in _TRANSPORT_ERRORS):
+        pytest.skip(f"TPU runtime dropped mid-smoke: {stderr[-200:]}")
     assert rc == 0, stderr[-3000:]
     return stdout
 
