@@ -174,6 +174,8 @@ def run(args) -> Tuple[float, float]:
     trainer = DDPTrainer(
         loss_fn, tx, mesh, Strategy.ring(world),
         measure_gns=args.measure_gns and world > 1,
+        # loop-owned state: see train_gpt2 donation note
+        donate_state=True,
     )
     state = TrainState.create(params, tx)
     eval_forward = jax.jit(apply_fn)  # one cache for all validation epochs

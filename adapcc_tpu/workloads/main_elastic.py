@@ -126,7 +126,9 @@ def worker(args) -> int:
 
     tx = optax.sgd(args.lr, momentum=0.9)
     trainer = DDPTrainer(
-        loss_fn, tx, mesh, Strategy.ring(world), stateful_loss=stateful
+        loss_fn, tx, mesh, Strategy.ring(world), stateful_loss=stateful,
+        # loop-owned state: see train_gpt2 donation note
+        donate_state=True,
     )
     train_state = trainer.init_state(params, model_state=model_state)
 

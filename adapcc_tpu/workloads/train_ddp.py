@@ -228,6 +228,8 @@ def main(argv=None) -> None:
             bsp=comm_args.is_bsp,
             sync_mode=args.sync_mode,
             grad_compress=args.grad_compress,
+            # loop-owned state: see train_gpt2 donation note
+            donate_state=True,
         )
         state = TrainState.create(params, tx)
 

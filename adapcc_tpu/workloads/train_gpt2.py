@@ -271,6 +271,13 @@ def run(args) -> Tuple[float, float]:
             loss_fn, tx, mesh, Strategy.ring(world),
             accum_steps=args.accum, zero1=args.zero1,
             grad_compress=args.grad_compress,
+            # NO donate_state here, unlike the other workloads: this loop
+            # feeds from the async device_batches prefetcher, and a donating
+            # step racing the prefetch thread's device_put deadlocks the
+            # XLA CPU collective rendezvous (verified on the 8-device pod:
+            # only some ranks join, 40 s timeout, SIGABRT).  Donated
+            # steady-state throughput is measured by bench.py, which uses a
+            # static batch and can donate safely.
         )
     state = (
         trainer.init_state(params) if trainer is not None
