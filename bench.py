@@ -30,7 +30,7 @@ Size knobs via env (defaults target a single v5e chip):
     BENCH_STEPS, BENCH_WORLD, BENCH_PEAK_TFLOPS, BENCH_ATTN (flash|xla),
     BENCH_PARAM_DTYPE (bf16|f32), BENCH_LOSS (dense|chunked),
     BENCH_REMAT (off|full|dots|dots_no_batch), BENCH_SCAN (1|0), BENCH_ACCUM,
-    BENCH_FLASH_BLOCK (flash tile edge, default 128),
+    BENCH_FLASH_BLOCK (flash tile edge, default 256 — measured best on v5e),
     BENCH_GRAD_COMPRESS (off|bf16 gradient-sync wire dtype),
     BENCH_PREFLIGHT_S, BENCH_ATTEMPTS, BENCH_DEADLINE
 """
@@ -158,6 +158,22 @@ def train_flops_per_token(cfg) -> float:
     return 3.0 * fwd
 
 
+#: measured best on v5e at T=512: 88,760 tok/s vs 79,751 at 128
+#: (battery hw_r04s3.jsonl bench phases)
+_DEFAULT_FLASH_BLOCK = 256
+
+
+def flash_block_for(seq: int) -> int:
+    """Largest tile <= BENCH_FLASH_BLOCK that divides ``seq`` — flash
+    requires T %% block == 0, so an indivisible seq (384, 640, ...) clamps
+    to a compatible tile instead of silently downgrading to xla attention."""
+    want = _env_int("BENCH_FLASH_BLOCK", _DEFAULT_FLASH_BLOCK)
+    b = max(8, min(want, seq))
+    while b > 8 and seq % b:
+        b -= 8
+    return b
+
+
 def _pick_attention() -> str:
     """Probe-compile the flash path on the live backend; fall back to the XLA
     attention (recording why) rather than failing the whole bench."""
@@ -173,8 +189,8 @@ def _pick_attention() -> str:
         # probe at the REAL seq and tile sizes: a VMEM overflow at
         # BENCH_FLASH_BLOCK=512 or a seq/block divisibility error must fall
         # back here, not burn the whole bench phase later
-        block = _env_int("BENCH_FLASH_BLOCK", 128)
         seq = _env_int("BENCH_SEQ", 512)
+        block = flash_block_for(seq)  # same resolution the bench cfg uses
         x = jnp.ones((1, seq, 2, 64), jnp.bfloat16)
         jax.block_until_ready(jax.jit(
             lambda q, k, v: flash_attention(
@@ -250,9 +266,10 @@ def main() -> None:
             n_head=_env_int("BENCH_HEADS", 16),
             d_model=_env_int("BENCH_DMODEL", 1024),
             attention=attention,
-            # flash tile edge: the VMEM-vs-parallelism sweep knob for the
-            # hardware battery (128 default; 256/512 worth probing on v5e)
-            flash_block=_env_int("BENCH_FLASH_BLOCK", 128),
+            # flash tile: largest seq-compatible tile <= BENCH_FLASH_BLOCK
+            # (default 256, measured best on v5e; probe fallback guards the
+            # rest — VMEM overflow etc.)
+            flash_block=flash_block_for(_env_int("BENCH_SEQ", 512)),
             # BENCH_REMAT: unset/""/"0"/"off" = no remat; "dots" |
             # "dots_no_batch" pick a policy; any other truthy value = "full"
             remat=remat_policy is not None,

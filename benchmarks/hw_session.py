@@ -17,7 +17,7 @@ Phases (priority order):
   6. bench_loop   — bench.py with BENCH_SCAN=0: per-step dispatch instead of
                     the scanned window; (bench_loop.step_ms - bench.step_ms)
                     IS the tunnel's per-dispatch tax (PERF_NOTES hyp. 2/5)
-  7. bench_fblk256 — bench.py with BENCH_FLASH_BLOCK=256: flash tile sweep
+  7. bench_fblk128 — bench.py with BENCH_FLASH_BLOCK=128: flash tile A/B vs the 256 default
                     (VMEM residency vs grid parallelism on the real MXU)
   8. busbw        — benchmarks/collectives.py on the real chip (world=1)
 
@@ -127,8 +127,8 @@ def main() -> int:
         {"BENCH_DEADLINE": "1500", "BENCH_SCAN": "0"},
     )
     _run(
-        "bench_fblk256", [py, "bench.py"], 1600, out,
-        {"BENCH_DEADLINE": "1500", "BENCH_FLASH_BLOCK": "256"},
+        "bench_fblk128", [py, "bench.py"], 1600, out,
+        {"BENCH_DEADLINE": "1500", "BENCH_FLASH_BLOCK": "128"},
     )
     _run(
         "busbw",

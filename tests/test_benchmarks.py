@@ -281,14 +281,30 @@ def test_committed_hw_r04_artifacts_verified_tpu():
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "benchmarks", "results",
     )
-    for name in ("hw_r04s2.jsonl", "hw_r04s2b.jsonl"):
+    s3 = None
+    for name in ("hw_r04s2.jsonl", "hw_r04s2b.jsonl", "hw_r04s3.jsonl"):
         rows = [json.loads(l) for l in open(os.path.join(root, name)) if l.strip()]
+        if name == "hw_r04s3.jsonl":
+            s3 = rows
         probe = next(r for r in rows if r["phase"] == "probe")
         assert probe["parsed"]["platform"] == "tpu"
         prof = next(r for r in rows if r["phase"] == "profile")
         phases = prof["parsed"]["phases"]
         assert set(phases) == {"dispatch", "matmul", "forward", "grad", "train"}
         assert phases["train"]["mfu"] > 0.3  # profile_step warmed past the transient
+
+    # r04s3 fired after the flash fix + steady-state warmup landed: every
+    # bench phase must carry flash (no fallback) and a steady MFU
+    for r in s3:
+        if r["phase"].startswith("bench"):
+            p = r["parsed"]
+            assert "flash_error" not in p, r["phase"]
+            assert p["attention"] == "flash"
+            assert p["mfu"] > 0.35, r["phase"]
+            assert len(p["warmup_windows_ms_framework"]) >= 2
+    fblk = next(r["parsed"] for r in s3 if r["phase"] == "bench_fblk256")
+    base = next(r["parsed"] for r in s3 if r["phase"] == "bench")
+    assert fblk["value"] > base["value"]  # block 256 measured best on v5e
 
     levers = [
         json.loads(l)
