@@ -164,14 +164,17 @@ _DEFAULT_FLASH_BLOCK = 256
 
 
 def flash_block_for(seq: int) -> int:
-    """Largest tile <= BENCH_FLASH_BLOCK that divides ``seq`` — flash
-    requires T %% block == 0, so an indivisible seq (384, 640, ...) clamps
-    to a compatible tile instead of silently downgrading to xla attention."""
+    """Largest 8-aligned tile <= BENCH_FLASH_BLOCK that divides ``seq`` —
+    flash requires T %% block == 0, so an indivisible seq (384, 640, ...)
+    clamps to a compatible tile instead of silently downgrading to xla
+    attention.  When no aligned divisor exists (seq itself not a multiple
+    of 8, or a pathological knob value), fall back to the full sequence as
+    one block — always kernel-legal; the probe-compile guards VMEM."""
     want = _env_int("BENCH_FLASH_BLOCK", _DEFAULT_FLASH_BLOCK)
-    b = max(8, min(want, seq))
-    while b > 8 and seq % b:
+    b = min(max(8, want - want % 8), seq)
+    while b >= 8 and seq % b:
         b -= 8
-    return b
+    return b if b >= 8 and seq % b == 0 else seq
 
 
 def _pick_attention() -> str:
