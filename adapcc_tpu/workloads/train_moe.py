@@ -18,6 +18,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import functools
 import sys
 import time
 from typing import Optional, Sequence, Tuple
@@ -118,7 +119,10 @@ def run(args) -> Tuple[float, float]:
     params = {"moe": moe_params, "head": head_params}
     opt_state = tx.init(params)
 
-    @jax.jit
+    # donate the loop-owned state: in-place updates, and on tunneled
+    # runtimes non-donated threading re-uploads it every step (PERF_NOTES
+    # round-4 bisection); x/y are static and never donated
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(params, opt_state, x, y):
         (loss, (ce, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, x, y)
         updates, opt_state = tx.update(grads, opt_state, params)

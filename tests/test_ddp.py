@@ -677,3 +677,44 @@ def test_stateful_loss_accum_carries_stats(mesh4):
     want = 0.9 * (0.9 * 0.0 + 0.1 * m0) + 0.1 * m1
     got = np.asarray(state.model_state["BatchNorm_0"]["mean"])
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_stateful_loss_masked_step_semantics(mesh4):
+    """Relay/masked steps with a stateful loss: the active mask gates
+    GRADIENT sync only — the SyncBN statistics still pmean over the full
+    axis (a straggler's forward ran on real data), so the committed stats
+    equal the full-batch stats while the parameter update excludes the
+    masked rank's gradient contribution."""
+    net, loss_fn = _bn_net_and_loss()
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(8, 12)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, size=(8,)))
+    v0 = net.init(jax.random.PRNGKey(0), x[:1], train=True)
+    tx = optax.sgd(1e-2)
+
+    tr_mask = DDPTrainer(
+        loss_fn, tx, mesh4, Strategy.ring(4), stateful_loss=True,
+        dynamic_mask=True,
+    )
+    st = tr_mask.init_state(v0["params"], model_state=v0["batch_stats"])
+    mask = jnp.array([True, True, True, False])
+    st_m, _ = tr_mask.step(st, (x, y), active_mask=mask)
+
+    # full-world reference on an identical trainer
+    tr_full = DDPTrainer(
+        loss_fn, tx, mesh4, Strategy.ring(4), stateful_loss=True,
+        dynamic_mask=True,
+    )
+    st_f, _ = tr_full.step(
+        tr_full.init_state(v0["params"], model_state=v0["batch_stats"]),
+        (x, y), active_mask=jnp.ones(4, bool),
+    )
+
+    # stats identical (full-axis pmean either way) ...
+    tree_close(st_m.model_state, st_f.model_state)
+    # ... but the params differ: rank 3's gradients were excluded
+    diffs = jax.tree_util.tree_map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+        st_m.params, st_f.params,
+    )
+    assert any(d > 0 for d in jax.tree_util.tree_leaves(diffs))
