@@ -79,3 +79,20 @@ def test_watchdog_deadline_emits_partial_json():
     assert out.returncode == 3
     parsed = json.loads(out.stdout.strip().splitlines()[-1])
     assert "watchdog" in parsed["error"] and "framework" in parsed["error"]
+
+
+def test_flash_block_for_resolution(monkeypatch):
+    """Tile resolution: largest 8-aligned divisor of seq <= the knob, with
+    the full-sequence fallback when no aligned divisor exists — no
+    knob/seq combination may silently downgrade flash to xla."""
+    import bench
+
+    monkeypatch.delenv("BENCH_FLASH_BLOCK", raising=False)
+    assert bench.flash_block_for(512) == 256   # default, divides
+    assert bench.flash_block_for(384) == 192   # 256 doesn't divide: clamp
+    assert bench.flash_block_for(300) == 300   # no aligned divisor: full seq
+    assert bench.flash_block_for(8) == 8
+    monkeypatch.setenv("BENCH_FLASH_BLOCK", "100")
+    assert bench.flash_block_for(512) == 64    # 8-aligned (96) then divisor
+    monkeypatch.setenv("BENCH_FLASH_BLOCK", "128")
+    assert bench.flash_block_for(512) == 128
