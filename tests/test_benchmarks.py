@@ -282,7 +282,11 @@ def test_committed_hw_r04_artifacts_verified_tpu():
         "benchmarks", "results",
     )
     s3 = None
-    for name in ("hw_r04s2.jsonl", "hw_r04s2b.jsonl", "hw_r04s3.jsonl"):
+    # s4's probe/profile/bench ran live before the tunnel wedged mid-battery
+    # (its later phases carry error rows by design — the bounded-failure
+    # record of the window closing), so only the healthy prefix is pinned
+    for name in ("hw_r04s2.jsonl", "hw_r04s2b.jsonl", "hw_r04s3.jsonl",
+                 "hw_r04s4.jsonl"):
         rows = [json.loads(l) for l in open(os.path.join(root, name)) if l.strip()]
         if name == "hw_r04s3.jsonl":
             s3 = rows
