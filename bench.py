@@ -121,6 +121,47 @@ def preflight() -> str:
     raise RuntimeError(f"backend unreachable after {attempts} attempts: {last}")
 
 
+def latest_committed_bench() -> "dict | None":
+    """Newest committed hardware-battery bench row (TPU backend, non-null
+    value) under benchmarks/results/hw_r*.jsonl — the round's standing
+    evidence when the live tunnel is down at bench time.  Battery files
+    only (hw_r<round>s<session>), natural-sorted so session 10 outranks
+    session 2."""
+    import glob
+    import re
+
+    def natural(path):
+        name = os.path.basename(path)
+        return [int(t) if t.isdigit() else t for t in re.split(r"(\d+)", name)]
+
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "benchmarks", "results")
+    best = None
+    for path in sorted(glob.glob(os.path.join(root, "hw_r*.jsonl")), key=natural):
+        try:
+            for line in open(path):
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                p = r.get("parsed") or {}
+                if (
+                    r.get("phase") == "bench"
+                    and p.get("value")
+                    and "tpu" in str(p.get("backend", "")).lower()
+                ):
+                    best = {
+                        "artifact": os.path.basename(path),
+                        "value": p["value"],
+                        "mfu": p.get("mfu"),
+                        "step_ms": p.get("step_ms"),
+                        "backend": p.get("backend"),
+                    }
+        except OSError:
+            continue
+    return best
+
+
 #: advertised bf16 peak TFLOP/s per chip, by device_kind substring
 _PEAK_TFLOPS = (
     ("v5 lite", 197.0),  # v5e
@@ -240,6 +281,12 @@ def main() -> None:
         _RESULT["backend"] = preflight()
     except Exception as e:  # noqa: BLE001
         _RESULT["error"] = f"preflight: {e}"
+        # a dead tunnel zeroes THIS run, not the round's evidence: point the
+        # artifact at the newest committed live-battery bench row so a
+        # reader of the JSON alone finds the measured number
+        last = latest_committed_bench()
+        if last:
+            _RESULT["last_live_bench"] = last
         _emit(2)
 
     _phase_begin("setup")
