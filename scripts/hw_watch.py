@@ -82,8 +82,25 @@ def main() -> int:
     ap.add_argument("--max-batteries", type=int, default=3)
     args = ap.parse_args()
 
-    out = os.path.join(REPO, "benchmarks", "results", f"hw_watch_{args.tag}.jsonl")
-    os.makedirs(os.path.dirname(out), exist_ok=True)
+    # never append to a previous session's (possibly committed) artifacts:
+    # if this tag's battery or watch file already exists, auto-suffix a
+    # fresh session tag (r04 → r04b → r04c ...)
+    results = os.path.join(REPO, "benchmarks", "results")
+    os.makedirs(results, exist_ok=True)
+
+    def _taken(tag: str) -> bool:
+        return os.path.exists(os.path.join(results, f"hw_{tag}.jsonl")) or \
+            os.path.exists(os.path.join(results, f"hw_watch_{tag}.jsonl"))
+
+    if _taken(args.tag):
+        for ch in string.ascii_lowercase[1:]:
+            if not _taken(args.tag + ch):
+                print(f"[watch] tag {args.tag!r} has existing artifacts; "
+                      f"using {args.tag + ch!r}", flush=True)
+                args.tag = args.tag + ch
+                break
+
+    out = os.path.join(results, f"hw_watch_{args.tag}.jsonl")
     end = time.time() + args.max_hours * 3600
     succeeded = 0   # batteries whose own probe ran (rc==0) — these spend budget
     attempts = 0    # all batteries fired, incl. ones a flapping window killed
