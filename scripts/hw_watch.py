@@ -93,12 +93,23 @@ def main() -> int:
             os.path.exists(os.path.join(results, f"hw_watch_{tag}.jsonl"))
 
     if _taken(args.tag):
-        for ch in string.ascii_lowercase[1:]:
-            if not _taken(args.tag + ch):
-                print(f"[watch] tag {args.tag!r} has existing artifacts; "
-                      f"using {args.tag + ch!r}", flush=True)
-                args.tag = args.tag + ch
+        base = args.tag
+        candidates = [base + ch for ch in string.ascii_lowercase[1:]]
+        # unbounded numeric fallback (same scheme as the battery namer):
+        # the guard must never silently fall through to the taken tag
+        n = 26
+        fresh = None
+        for cand in candidates:
+            if not _taken(cand):
+                fresh = cand
                 break
+        while fresh is None:
+            if not _taken(f"{base}x{n}"):
+                fresh = f"{base}x{n}"
+            n += 1
+        print(f"[watch] tag {base!r} has existing artifacts; "
+              f"using {fresh!r}", flush=True)
+        args.tag = fresh
 
     out = os.path.join(results, f"hw_watch_{args.tag}.jsonl")
     end = time.time() + args.max_hours * 3600
