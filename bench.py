@@ -23,7 +23,10 @@ main process ever initializes it; a *watchdog* emits whatever was measured
 plus an ``error`` field if a phase hangs past ``BENCH_DEADLINE``; each phase
 records its partial results as soon as they exist, so a late failure (e.g.
 in the baseline path) still leaves the framework numbers in the JSON with
-``error`` naming the dead phase and a nonzero exit code.
+``error`` naming the dead phase and a nonzero exit code.  On a dead
+backend the artifact additionally carries ``last_live_bench`` — the
+newest committed battery bench row — so the JSON alone still points at
+the round's measured number.
 
 Size knobs via env (defaults target a single v5e chip):
     BENCH_LAYERS, BENCH_DMODEL, BENCH_HEADS, BENCH_SEQ, BENCH_BATCH,
