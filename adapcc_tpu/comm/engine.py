@@ -185,10 +185,19 @@ _MERGED_PLANS: Dict[Tuple, Optional[_MergedPlan]] = {}
 def _merged_env_disabled() -> bool:
     """``ADAPCC_MERGE_ROUNDS=0`` disables round merging everywhere — the A/B
     knob for measuring the merged executor against sequential per-tree
-    chains on hardware (flat and two-level paths share it)."""
+    chains on hardware (flat and two-level paths share it).  Unknown values
+    raise: a typo silently enabling the default would invalidate the A/B
+    (same policy as bench.py's BENCH_REMAT validation)."""
     import os
 
-    return os.environ.get("ADAPCC_MERGE_ROUNDS", "1") in ("0", "off", "false")
+    val = os.environ.get("ADAPCC_MERGE_ROUNDS", "1").strip().lower()
+    if val in ("0", "off", "false", "no"):
+        return True
+    if val in ("", "1", "on", "true", "yes"):
+        return False
+    raise ValueError(
+        f"ADAPCC_MERGE_ROUNDS={val!r}: expected 1/on/true or 0/off/false"
+    )
 
 
 def _merged_plan(strategy: Strategy) -> Optional[_MergedPlan]:
@@ -518,6 +527,9 @@ class CollectiveEngine:
                 f"mesh has {mesh.devices.size} devices but strategy world is "
                 f"{strategy.world_size}"
             )
+        # fail fast on a typo'd A/B knob: dying here costs nothing, dying at
+        # the first traced collective costs the whole backend/model setup
+        _merged_env_disabled()
         self.mesh = mesh
         self.strategy = strategy
         # two-level world: a ("dcn", "ici") mesh executes strategies

@@ -197,3 +197,32 @@ def test_merged_reduce_and_broadcast_oracles(mesh8):
             np.broadcast_to(x[tree.root, off : off + size], (8, size)),
         )
         off += size
+
+
+def test_merge_rounds_env_knob_validated(monkeypatch):
+    """A typo'd ADAPCC_MERGE_ROUNDS must raise, not silently run the
+    default executor and invalidate the A/B (BENCH_REMAT policy)."""
+    import pytest
+
+    from adapcc_tpu.comm.engine import _merged_env_disabled
+
+    monkeypatch.setenv("ADAPCC_MERGE_ROUNDS", "0")
+    assert _merged_env_disabled() is True
+    monkeypatch.setenv("ADAPCC_MERGE_ROUNDS", "1")
+    assert _merged_env_disabled() is False
+    monkeypatch.setenv("ADAPCC_MERGE_ROUNDS", "of")
+    with pytest.raises(ValueError, match="ADAPCC_MERGE_ROUNDS"):
+        _merged_env_disabled()
+
+
+def test_merge_rounds_typo_fails_at_engine_construction(monkeypatch, mesh4):
+    """The knob typo dies at CollectiveEngine construction — before any
+    backend/model setup is spent — not at the first traced collective."""
+    import pytest
+
+    from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.strategy.ir import Strategy
+
+    monkeypatch.setenv("ADAPCC_MERGE_ROUNDS", "of")
+    with pytest.raises(ValueError, match="ADAPCC_MERGE_ROUNDS"):
+        CollectiveEngine(mesh4, Strategy.ring(4))
