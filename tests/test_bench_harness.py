@@ -110,3 +110,33 @@ def test_latest_committed_bench_finds_live_row():
     # redden this test, just change the pointed-at number
     assert row["value"] and row["value"] > 0
     assert row["artifact"].startswith("hw_r")
+
+
+def test_latest_committed_bench_natural_order(tmp_path, monkeypatch):
+    """Session 10 must outrank session 2 (numeric-aware sort, not
+    lexicographic) and watch logs must not be scanned."""
+    import json
+    import os
+
+    import bench
+
+    results = tmp_path / "benchmarks" / "results"
+    results.mkdir(parents=True)
+
+    def row(value):
+        return json.dumps({
+            "phase": "bench",
+            "parsed": {"value": value, "mfu": 0.1, "step_ms": 1.0,
+                       "backend": "PREFLIGHT_OK tpu TPU v5 lite"},
+        })
+
+    (results / "hw_r04s2.jsonl").write_text(row(111.0) + "\n")
+    (results / "hw_r04s10.jsonl").write_text(row(999.0) + "\n")
+    # a bench-shaped row in a watch log must be ignored
+    (results / "hw_watch_r04s99.jsonl").write_text(row(123456.0) + "\n")
+
+    # point the scanner's root (dirname(abspath(bench.py))) at tmp_path
+    monkeypatch.setattr(bench.os.path, "abspath", lambda p: str(tmp_path / "bench.py"))
+    out = bench.latest_committed_bench()
+    assert out["artifact"] == "hw_r04s10.jsonl"
+    assert out["value"] == 999.0
