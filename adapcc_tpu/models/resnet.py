@@ -63,8 +63,13 @@ class _AutoGroupNorm(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        channels = x.shape[-1]
+        # largest group count <= 32 that divides the channel count: flax
+        # GroupNorm requires divisibility, and non-power-of-two widths
+        # (e.g. C=48) would otherwise die inside flax with a generic error
+        groups = next(g for g in range(min(32, channels), 0, -1) if channels % g == 0)
         return nn.GroupNorm(
-            num_groups=min(32, x.shape[-1]),
+            num_groups=groups,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
         )(x)

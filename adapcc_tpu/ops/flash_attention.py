@@ -168,6 +168,16 @@ def _block_sizes(T: int, block_q: int, block_k: int):
     bq, bk = min(block_q, T), min(block_k, T)
     if T % bq or T % bk:
         raise ValueError(f"seq len {T} must divide into blocks ({bq}, {bk})")
+    # Mosaic sublane rule: the lane-padded (1, bq, _LSE_LANES) block specs
+    # require 8-aligned block sizes (or the degenerate bq == T case).  An
+    # unaligned block compiles past tracing and dies deep in Mosaic with a
+    # cryptic tiling error on hardware — reject it here with the real reason.
+    for name, b in (("block_q", bq), ("block_k", bk)):
+        if b % 8 and b != T:
+            raise ValueError(
+                f"{name}={b} must be a multiple of 8 (Mosaic sublane "
+                f"alignment) or equal to the sequence length {T}"
+            )
     return bq, bk
 
 

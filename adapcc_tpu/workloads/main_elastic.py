@@ -138,7 +138,19 @@ def worker(args) -> int:
         opt_state=train_state.opt_state,
         extra={"model_state": model_state} if stateful else {},
     )
-    ckpt = restore_newest_across_processes(ckpt, args.checkpoint_file)
+    try:
+        ckpt = restore_newest_across_processes(ckpt, args.checkpoint_file)
+    except (KeyError, ValueError, TypeError) as e:
+        # flax from_bytes raises a raw dict-key/shape mismatch when the file
+        # was written under a different --norm mode (e.g. a pre-SyncBN ckpt
+        # without extra["model_state"]); surface the actual cause instead
+        print(
+            f"=> checkpoint {args.checkpoint_file!r} is incompatible with "
+            f"--norm {args.norm!r} (was it written under a different norm "
+            f"mode?): {e}",
+            file=sys.stderr,
+        )
+        return 2
     start_epoch = ckpt.epoch + 1
     if start_epoch > 0:
         print(f"=> resuming from epoch {start_epoch}")

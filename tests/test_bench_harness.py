@@ -140,3 +140,18 @@ def test_latest_committed_bench_natural_order(tmp_path, monkeypatch):
     out = bench.latest_committed_bench()
     assert out["artifact"] == "hw_r04s10.jsonl"
     assert out["value"] == 999.0
+
+
+def test_attach_last_live_bench_never_raises(monkeypatch):
+    """The fallback pointer runs immediately before the error-JSON emission;
+    an unexpected failure inside it must degrade to an error *field*, never
+    a traceback that would eat the artifact (ADVICE r4)."""
+    import bench
+
+    def boom():
+        raise RuntimeError("surprise artifact shape")
+
+    monkeypatch.setattr(bench, "latest_committed_bench", boom)
+    monkeypatch.setitem(bench._RESULT, "last_live_bench", None)
+    bench._attach_last_live_bench()  # must not raise
+    assert "surprise artifact shape" in bench._RESULT["last_live_bench_error"]

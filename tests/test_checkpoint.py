@@ -338,3 +338,33 @@ def test_fsdp_training_resumes_after_crash(mesh8, tmp_path):
         np.asarray(p["w"]), np.asarray(op["w"]), rtol=1e-6, atol=1e-7
     )
     mgr2.close()
+
+def test_elastic_incompatible_checkpoint_friendly_error(tmp_path):
+    """A checkpoint whose tree doesn't match the worker's template (e.g.
+    written under a different --norm mode) must exit with a friendly
+    incompatibility message, not a raw flax from_bytes traceback (ADVICE r4)."""
+    import subprocess
+    import sys
+
+    path = str(tmp_path / "stale.ckpt")
+    # epoch >= 0 so the worker actually resumes from it
+    save_checkpoint(
+        TrainCheckpointState(params={"alien": np.zeros(3, np.float32)}, epoch=1),
+        path,
+    )
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    }
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "adapcc_tpu.workloads.main_elastic",
+            "--epochs", "1", "--steps-per-epoch", "1", "--world", "1",
+            "--batch", "4", "--model", "mlp", "--checkpoint-file", path,
+        ],
+        capture_output=True, text=True, cwd="/root/repo", env=env, timeout=240,
+    )
+    assert out.returncode == 2, out.stdout + out.stderr
+    assert "incompatible" in out.stderr
+    assert "Traceback" not in out.stderr

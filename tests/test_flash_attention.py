@@ -131,3 +131,18 @@ def test_gpt2_flash_config_trains():
         jax.tree_util.tree_map(lambda g: bool(np.isfinite(np.asarray(g)).all()), grads)
     )
     assert finite, "non-finite grads through the flash branch"
+
+
+def test_unaligned_block_raises_clearly():
+    # bq=12 divides T=24 but violates Mosaic's 8-sublane alignment for the
+    # lane-padded lse/delta block specs; must fail at trace time with the
+    # real reason, not deep inside Mosaic on hardware (ADVICE r4)
+    q, k, v = _qkv(T=24)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        flash_attention(q, k, v, block_q=12, block_k=24)
+    with pytest.raises(ValueError, match="multiple of 8"):
+        flash_attention(q, k, v, block_q=24, block_k=12)
+    # degenerate full-sequence block is exempt even when unaligned
+    q4, k4, v4 = _qkv(T=4)
+    out = flash_attention(q4, k4, v4, block_q=4, block_k=4)
+    assert out.shape == q4.shape

@@ -243,3 +243,15 @@ def test_resnet_imagenet_stem_downsamples():
     x = jnp.ones((1, 64, 64, 3), jnp.float32)
     v = m.init(jax.random.PRNGKey(0), x)
     assert m.apply(v, x).shape == (1, 5)
+
+
+def test_resnet_non_power_of_two_width():
+    # C=48 has no 32-group split; the auto norm must pick the largest
+    # divisor <= 32 (24) instead of dying inside flax (ADVICE r4)
+    from adapcc_tpu.models.resnet import BasicBlock, ResNet
+
+    x = jnp.ones((1, 16, 16, 3), jnp.float32)
+    m = ResNet(stage_sizes=(1, 1), block_cls=BasicBlock, num_classes=5,
+               width=48, small_inputs=True, dtype=jnp.float32)
+    v = m.init(jax.random.PRNGKey(0), x)
+    assert m.apply(v, x).shape == (1, 5)
