@@ -803,6 +803,71 @@ class CollectiveEngine:
         self._record("allreduce", "pallas_ring", stacked)
         return self._shard_mapped(key, per_shard, 1)(stacked)
 
+    def ring_reduce_scatter(
+        self, stacked: jnp.ndarray, interpret: Optional[bool] = None
+    ) -> jnp.ndarray:
+        """Pallas ICI ring reduce-scatter (the RS half of the hand-tuned ring,
+        :func:`adapcc_tpu.comm.pallas_ring.ring_reduce_scatter_shard`).
+
+        Input ``[world, n]`` → output ``[world, chunk]`` with row ``r`` = the
+        fully reduced chunk ``r`` of the flattened, tile-padded input
+        (``chunk = tile_round(ceil(n / world))``).  The kernel leaves chunk
+        ``(r+1) % world`` on rank ``r``; one static roll restores chunk order
+        in the stacked single-controller view so this matches
+        :meth:`reduce_scatter`'s row semantics on tile-aligned payloads.
+        """
+        from adapcc_tpu.comm.pallas_ring import ring_reduce_scatter_shard
+
+        if self.two_level:
+            raise ValueError(
+                "ring_reduce_scatter needs a flat ranks mesh (a single ICI "
+                "ring); two-level worlds use the strategy primitives"
+            )
+        self._check_world_dim(stacked, "ring_reduce_scatter")
+        if interpret is None:
+            interpret = jax.devices()[0].platform != "tpu"
+        world = self.world_size
+
+        def per_shard(x):  # x: [1, *payload]
+            return ring_reduce_scatter_shard(
+                x[0], world, self.axis_name, interpret=interpret
+            )[None]
+
+        key = ("ring_rs", stacked.shape, stacked.dtype.name, bool(interpret))
+        self._record("reduce_scatter", "pallas_ring", stacked)
+        out = self._shard_mapped(key, per_shard, 1)(stacked)
+        return jnp.roll(out, 1, axis=0)
+
+    def ring_all_gather(
+        self, stacked: jnp.ndarray, interpret: Optional[bool] = None
+    ) -> jnp.ndarray:
+        """Pallas ICI ring all-gather (the AG half of the hand-tuned ring).
+
+        Input ``[world, chunk]`` (row ``r`` = rank ``r``'s tile-aligned
+        payload) → output ``[world, world, chunk]`` — row ``r`` is the full
+        gathered stack as seen by rank ``r``, matching :meth:`all_gather`.
+        """
+        from adapcc_tpu.comm.pallas_ring import ring_all_gather_shard
+
+        if self.two_level:
+            raise ValueError(
+                "ring_all_gather needs a flat ranks mesh (a single ICI "
+                "ring); two-level worlds use the strategy primitives"
+            )
+        self._check_world_dim(stacked, "ring_all_gather")
+        if interpret is None:
+            interpret = jax.devices()[0].platform != "tpu"
+        world = self.world_size
+
+        def per_shard(x):  # x: [1, chunk]
+            return ring_all_gather_shard(
+                x[0], world, self.axis_name, interpret=interpret
+            )[None]
+
+        key = ("ring_ag", stacked.shape, stacked.dtype.name, bool(interpret))
+        self._record("all_gather", "pallas_ring", stacked)
+        return self._shard_mapped(key, per_shard, 1)(stacked)
+
     def reduce_scatter(self, stacked: jnp.ndarray, op: ReduceOp = ReduceOp.SUM) -> jnp.ndarray:
         """Native XLA reduce-scatter (reference stub: REDUCESCATTER enum).
 

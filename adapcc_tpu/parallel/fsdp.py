@@ -249,14 +249,18 @@ class _FlatMeta(NamedTuple):
     padded: int
 
 
-def _flatten_meta(params: Any, world: int) -> _FlatMeta:
+def _flatten_meta(params: Any, world: int, align: int = 1) -> _FlatMeta:
+    """``align`` rounds the per-rank shard length up to a multiple (the
+    Pallas ring kernels move whole VMEM tiles, so the ring path needs
+    tile-aligned shards; the XLA path keeps align=1)."""
     leaves, treedef = jax.tree_util.tree_flatten(params)
     shapes = tuple(tuple(l.shape) for l in leaves)
     sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
     dtypes = tuple(l.dtype for l in leaves)
     total = int(sum(sizes))
-    padded = ((total + world - 1) // world) * world
-    return _FlatMeta(treedef, shapes, sizes, dtypes, total, padded)
+    shard = -(-total // world)
+    shard = -(-shard // align) * align
+    return _FlatMeta(treedef, shapes, sizes, dtypes, total, world * shard)
 
 
 def _flatten(tree: Any, meta: _FlatMeta, dtype=jnp.float32) -> jnp.ndarray:
@@ -281,26 +285,49 @@ def zero1_apply_shard(
     g_shard: jnp.ndarray,
     meta: _FlatMeta,
     axis_name: str,
+    ring: bool = False,
+    ring_interpret: bool = False,
 ):
     """The in-shard ZeRO-1 update cycle, shared by every composition site
     (Zero1Optimizer.apply, zero1_train_step, DDPTrainer(zero1=True)):
     optax update on this rank's flat ``[N/world]`` slice, then one
     ``all_gather`` rebuilds the replicated params.  Runs inside shard_map;
-    ``master``/``opt_state`` enter WITHOUT their leading shard dim."""
+    ``master``/``opt_state`` enter WITHOUT their leading shard dim.
+
+    ``ring=True`` rides the Pallas ICI ring all-gather instead of XLA's
+    (the hand-tuned data plane): rank ``r`` then owns chunk ``(r+1) % world``
+    (the ring's natural ownership), and the gathered rank-ordered rows are
+    rolled back into chunk order before unflattening.
+    """
     updates, opt_state = tx.update(g_shard, opt_state, master)
     master = optax.apply_updates(master, updates)
-    flat_p = lax.all_gather(master, axis_name).reshape(-1)
+    if ring:
+        from adapcc_tpu.comm.pallas_ring import ring_all_gather_shard
+
+        world = meta.padded // master.size
+        gathered = ring_all_gather_shard(
+            master, world, axis_name, interpret=ring_interpret
+        )
+        # gathered[i] = rank i's payload = chunk (i+1) % world
+        flat_p = jnp.roll(gathered, 1, axis=0).reshape(-1)
+    else:
+        flat_p = lax.all_gather(master, axis_name).reshape(-1)
     return master, opt_state, _unflatten(flat_p, meta)
 
 
 def local_grad_shard(
-    flat_g: jnp.ndarray, meta: _FlatMeta, world: int, axis_name: str
+    flat_g: jnp.ndarray, meta: _FlatMeta, world: int, axis_name: str,
+    offset: int = 0,
 ) -> jnp.ndarray:
     """This rank's slice of an already-replicated flat gradient — a free
-    local read, no collective."""
+    local read, no collective.  ``offset=1`` selects the ring path's chunk
+    ownership (rank ``r`` owns chunk ``(r+1) % world``)."""
     shard_len = meta.padded // world
+    idx = lax.axis_index(axis_name)
+    if offset:
+        idx = (idx + offset) % world
     return lax.dynamic_index_in_dim(
-        flat_g.reshape(world, shard_len), lax.axis_index(axis_name), keepdims=False
+        flat_g.reshape(world, shard_len), idx, keepdims=False
     )
 
 
@@ -319,6 +346,15 @@ class Zero1Optimizer:
 
     The fp32 flat master copy also gives mixed-precision training a proper
     master-weight update for bf16 params for free.
+
+    ``ring=True`` swaps both collectives onto the Pallas ICI ring kernels
+    (:mod:`adapcc_tpu.comm.pallas_ring`) — the hand-tuned data plane, the
+    TPU analog of the reference's CUDA chunk pipeline (trans.cu:58-100).
+    The ring's natural chunk ownership (rank ``r`` finishes reduce-scatter
+    holding chunk ``(r+1) % world``) is adopted as the shard layout, so no
+    extra rotation hop is paid at step time; shards are VMEM-tile aligned.
+    Checkpoints of ring and non-ring masters are NOT interchangeable (the
+    row→chunk mapping differs).
     """
 
     def __init__(
@@ -326,25 +362,43 @@ class Zero1Optimizer:
         tx: optax.GradientTransformation,
         mesh: Mesh,
         axis_name: str = RANKS_AXIS,
+        ring: bool = False,
+        ring_interpret: Optional[bool] = None,
     ) -> None:
         self.tx = tx
         self.mesh = mesh
         self.axis_name = axis_name
         self.world = mesh.shape[axis_name]
+        self.ring = ring
+        if ring_interpret is None:
+            ring_interpret = jax.devices()[0].platform != "tpu"
+        self.ring_interpret = ring_interpret
         self._meta: Optional[_FlatMeta] = None
         self._compiled: Optional[Callable] = None
+
+    def _align(self) -> int:
+        if not self.ring:
+            return 1
+        from adapcc_tpu.comm.pallas_ring import _tile_elems
+
+        return _tile_elems(jnp.float32)
 
     def init(self, params: Any) -> Tuple[jnp.ndarray, Any]:
         """Returns ``(flat_master [world, N/world] fp32, opt_state shard)``.
 
         Both carry a leading ``[world]`` dim sharded over the mesh axis, so
-        each device holds exactly its slice.
+        each device holds exactly its slice.  In ring mode row ``r`` holds
+        chunk ``(r+1) % world`` (the ring's ownership); the XLA path keeps
+        the identity layout.
         """
-        meta = self._meta = _flatten_meta(params, self.world)
+        meta = self._meta = _flatten_meta(params, self.world, self._align())
         self._compiled = None  # re-init with a new tree invalidates the program
         flat = _flatten(params, meta)
         shard_len = meta.padded // self.world
         master = flat.reshape(self.world, shard_len)
+        if self.ring:
+            # row r ← chunk (r+1) % world
+            master = jnp.roll(master, -1, axis=0)
         opt_state = jax.vmap(self.tx.init)(master)
         sharding = NamedSharding(self.mesh, P(self.axis_name))
         return (
@@ -357,16 +411,22 @@ class Zero1Optimizer:
         world, axis, tx = self.world, self.axis_name, self.tx
         shard_len = meta.padded // world
 
+        ring, ring_interpret = self.ring, self.ring_interpret
+
         def per_shard(master, opt_state, grads_tree):
             # strip the [1] shard dim shard_map leaves on the leading axis
             master = master[0]
             opt_state = jax.tree_util.tree_map(lambda x: x[0], opt_state)
             # grads enter replicated (in_spec P()): every rank already holds
             # the full synced gradient, so its shard is a free local slice —
-            # no collective needed on this path
-            g_shard = local_grad_shard(_flatten(grads_tree, meta), meta, world, axis)
+            # no collective needed on this path (ring ownership = offset 1)
+            g_shard = local_grad_shard(
+                _flatten(grads_tree, meta), meta, world, axis,
+                offset=1 if ring else 0,
+            )
             master, opt_state, new_params = zero1_apply_shard(
-                tx, master, opt_state, g_shard, meta, axis
+                tx, master, opt_state, g_shard, meta, axis,
+                ring=ring, ring_interpret=ring_interpret,
             )
             return (
                 master[None],
@@ -416,10 +476,11 @@ def zero1_train_step(
     axis_name = opt.axis_name
 
     def build(params):
-        meta = _flatten_meta(params, opt.world)
+        meta = _flatten_meta(params, opt.world, opt._align())
         world = opt.world
         shard_len = meta.padded // world
         tx = opt.tx
+        ring, ring_interpret = opt.ring, opt.ring_interpret
 
         def per_shard(params, master, opt_state, batch):
             master = master[0]
@@ -428,12 +489,22 @@ def zero1_train_step(
             # unsynced per-rank grads: the reduce-scatter both averages and
             # slices (the bandwidth-optimal half of a ring allreduce)
             flat_g = _flatten(grads, meta) / world
-            g_shard = lax.psum_scatter(
-                flat_g.reshape(world, shard_len), axis_name,
-                scatter_dimension=0, tiled=False,
-            )
+            if ring:
+                from adapcc_tpu.comm.pallas_ring import ring_reduce_scatter_shard
+
+                # the Pallas ring leaves rank r with reduced chunk
+                # (r+1) % world — exactly this mode's master/opt layout
+                g_shard = ring_reduce_scatter_shard(
+                    flat_g, world, axis_name, interpret=ring_interpret
+                )
+            else:
+                g_shard = lax.psum_scatter(
+                    flat_g.reshape(world, shard_len), axis_name,
+                    scatter_dimension=0, tiled=False,
+                )
             master, opt_state, new_params = zero1_apply_shard(
-                tx, master, opt_state, g_shard, meta, axis_name
+                tx, master, opt_state, g_shard, meta, axis_name,
+                ring=ring, ring_interpret=ring_interpret,
             )
             return (
                 new_params,

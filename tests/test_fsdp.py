@@ -295,3 +295,52 @@ def test_fsdp_tp_2d_shardings_and_training(mesh8):
     assert k.addressable_shards[0].data.shape == (32 // 4, 96 // 2)
     # adam moments share the 2D layout
     assert opt[0].mu["params"]["h0"]["attn"]["qkv"]["kernel"].sharding.spec == qkv
+
+
+def test_zero1_ring_matches_xla_path(mesh8):
+    """ZeRO-1 on the Pallas ring data plane (ring=True) trains to the same
+    params as the XLA psum_scatter/all_gather path (VERDICT r4 item 4)."""
+    rng = np.random.default_rng(11)
+    params = _mlp_params(rng)
+    tx = optax.adam(1e-2)
+
+    runs = {}
+    for ring in (False, True):
+        opt = Zero1Optimizer(tx, mesh8, ring=ring)
+        master, opt_state = opt.init(params)
+        step = zero1_train_step(_mlp_loss, opt, mesh8)
+        p = jax.tree_util.tree_map(jnp.array, params)
+        for i in range(2):
+            b = _batch(np.random.default_rng(300 + i), n=16)
+            p, master, opt_state, losses = step(p, master, opt_state, b)
+        runs[ring] = (p, np.asarray(losses))
+
+    np.testing.assert_allclose(runs[True][1], runs[False][1], rtol=1e-5, atol=1e-6)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(runs[True][0][k]), np.asarray(runs[False][0][k]),
+            rtol=2e-5, atol=2e-6,
+        )
+
+
+def test_zero1_ring_apply_presynced(mesh8):
+    """The apply() composition site (replicated grads, no RS) also rides the
+    ring all-gather and reproduces the XLA-path update."""
+    rng = np.random.default_rng(12)
+    params = _mlp_params(rng)
+    tx = optax.sgd(1e-1)
+    grads = jax.tree_util.tree_map(
+        lambda v: jnp.asarray(rng.normal(size=v.shape), jnp.float32), params
+    )
+
+    outs = {}
+    for ring in (False, True):
+        opt = Zero1Optimizer(tx, mesh8, ring=ring)
+        master, opt_state = opt.init(params)
+        _, _, new_params = opt.apply(master, opt_state, grads)
+        outs[ring] = new_params
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(outs[True][k]), np.asarray(outs[False][k]),
+            rtol=1e-6, atol=1e-7,
+        )

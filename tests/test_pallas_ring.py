@@ -141,3 +141,39 @@ def test_engine_ring_allreduce_entry(mesh8):
     xs = jnp.stack([jnp.full((2 * _TILE,), float(r + 1)) for r in range(8)])
     out = np.asarray(eng.ring_allreduce(xs))
     np.testing.assert_allclose(out, np.full((8, 2 * _TILE), 36.0))
+
+
+def test_engine_ring_reduce_scatter_matches_xla(mesh8):
+    """Engine entry point parity: the Pallas ring RS (rolled into chunk
+    order) must match the XLA reduce_scatter row semantics on tile-aligned
+    payloads (VERDICT r4 item 4)."""
+    rng = np.random.default_rng(7)
+    xs = jnp.asarray(rng.normal(size=(8, 8 * _TILE)), jnp.float32)
+    eng = CollectiveEngine(mesh8, Strategy.ring(8))
+    ring = np.asarray(eng.ring_reduce_scatter(xs))
+    xla = np.asarray(eng.reduce_scatter(xs))
+    assert ring.shape == xla.shape == (8, _TILE)
+    np.testing.assert_allclose(ring, xla, rtol=1e-5, atol=1e-5)
+
+
+def test_engine_ring_all_gather_matches_xla(mesh8):
+    rng = np.random.default_rng(8)
+    xs = jnp.asarray(rng.normal(size=(8, _TILE)), jnp.float32)
+    eng = CollectiveEngine(mesh8, Strategy.ring(8))
+    ring = np.asarray(eng.ring_all_gather(xs))
+    xla = np.asarray(eng.all_gather(xs))
+    assert ring.shape == xla.shape == (8, 8, _TILE)
+    np.testing.assert_allclose(ring, xla, rtol=1e-5, atol=1e-5)
+
+
+def test_engine_ring_rs_ag_roundtrip_is_allreduce(mesh8):
+    """RS followed by AG through the engine reproduces the allreduce sum —
+    the ZeRO-1 step's collective pair, stacked-view edition."""
+    rng = np.random.default_rng(9)
+    xs = jnp.asarray(rng.normal(size=(8, 8 * _TILE)), jnp.float32)
+    eng = CollectiveEngine(mesh8, Strategy.ring(8))
+    scattered = eng.ring_reduce_scatter(xs)
+    gathered = np.asarray(eng.ring_all_gather(scattered))
+    expect = np.asarray(xs).sum(axis=0).reshape(8, _TILE)
+    for r in range(8):
+        np.testing.assert_allclose(gathered[r], expect, rtol=1e-4, atol=1e-4)

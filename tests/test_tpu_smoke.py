@@ -84,6 +84,26 @@ CHILD = textwrap.dedent(
     jax.block_until_ready(out)
     assert np.isfinite(np.asarray(out, dtype=np.float32)).all()
     print("MOSAIC_OK flash_ring", flush=True)
+
+    # ZeRO-1 on the ring data plane: the whole ring=True step program
+    # (ring RS + sharded adam + ring AG) must lower at world=1
+    import optax
+    from adapcc_tpu.parallel.fsdp import Zero1Optimizer, zero1_train_step
+
+    params = {"w": jnp.ones((64, 64), jnp.float32)}
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    opt = Zero1Optimizer(optax.adam(1e-2), ring_mesh, ring=True)
+    master, opt_state = opt.init(params)
+    step = zero1_train_step(loss_fn, opt, ring_mesh)
+    b = (jnp.ones((4, 64), jnp.float32), jnp.zeros((4, 64), jnp.float32))
+    p2, master, opt_state, losses = step(params, master, opt_state, b)
+    jax.block_until_ready(p2)
+    assert np.isfinite(np.asarray(losses, dtype=np.float32)).all()
+    print("MOSAIC_OK zero1_ring", flush=True)
     """
 )
 
@@ -167,3 +187,7 @@ def test_flash_attention_lowers_through_mosaic():
 
 def test_flash_ring_lowers_through_mosaic():
     assert "MOSAIC_OK flash_ring" in _smoke_stdout()
+
+
+def test_zero1_ring_lowers_through_mosaic():
+    assert "MOSAIC_OK zero1_ring" in _smoke_stdout()
