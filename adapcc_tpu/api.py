@@ -107,9 +107,13 @@ class AdapCC:
 
     @classmethod
     def alltoall(
-        cls, tensor: jnp.ndarray, size: Optional[int] = None, chunk_bytes: Optional[int] = None
+        cls,
+        tensor: jnp.ndarray,
+        size: Optional[int] = None,
+        chunk_bytes: Optional[int] = None,
+        active_gpus: Optional[Sequence[int]] = None,
     ) -> jnp.ndarray:
-        return cls.communicator.alltoall(tensor, size, chunk_bytes)
+        return cls.communicator.alltoall(tensor, size, chunk_bytes, active_gpus)
 
     @classmethod
     def reconstruct_topology(cls, args: Any, prim: int) -> None:

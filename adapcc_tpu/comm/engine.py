@@ -726,57 +726,105 @@ class CollectiveEngine:
 
     broadcast = boardcast
 
-    # -- primitives the reference declared but never implemented --------------
+    # -- primitives the reference only declared (trans.h:27-36 enum stubs) ----
+    # implemented here at full adaptive depth: active-subset masking with the
+    # same relay contract as all_reduce (inactive ranks contribute identity
+    # but stay on the forwarding path and receive results), plus hierarchical
+    # DCN×ICI shaping on two-level worlds
 
-    def all_gather(self, stacked: jnp.ndarray) -> jnp.ndarray:
-        """Native XLA all-gather (reference stub: trans.h ALLGATHER enum).
+    def _my_flat_rank(self):
+        """Flat rank inside shard_map, on flat or two-level meshes."""
+        if self.two_level:
+            dcn_axis, ici_axis = self.axis_name
+            return lax.axis_index(dcn_axis) * self.ici_size + lax.axis_index(ici_axis)
+        return lax.axis_index(self.axis_name)
+
+    def all_gather(
+        self, stacked: jnp.ndarray, active_gpus: Optional[Sequence[int]] = None
+    ) -> jnp.ndarray:
+        """All-gather with subset semantics (reference stub: trans.h ALLGATHER).
 
         Input ``[world, *payload]`` (row r = rank r's shard) → output
         ``[world, world, *payload]`` (row r = the full gathered stack as seen
-        by rank r).
+        by rank r).  With ``active_gpus``, inactive ranks contribute zeros
+        (the gather identity) but still receive the gathered stack — the
+        relay contract of :meth:`all_reduce`.  Two-level worlds gather
+        hierarchically (DCN first, so each payload crosses DCN once).
         """
-
         self._check_world_dim(stacked, "all_gather")
+        mask = self._active_to_mask(active_gpus)
+        masked = active_gpus is not None
 
-        def per_shard(x):  # x: [1, *payload]
-            return lax.all_gather(x[0], self.axis_name, axis=0)[None]
+        if self.two_level:
+            from adapcc_tpu.comm.two_level import all_gather_two_level_shard
 
-        key = ("allgather", stacked.shape, stacked.dtype.name)
+            def per_shard(x, m):  # x: [1, *payload]
+                v = x[0]
+                if masked:
+                    v = jnp.where(m[self._my_flat_rank()], v, jnp.zeros_like(v))
+                return all_gather_two_level_shard(
+                    v, self.num_slices, self.ici_size
+                )[None]
+
+            key = ("allgather2l", stacked.shape, stacked.dtype.name, masked)
+            self._record("all_gather", "two_level", stacked)
+            return self._shard_mapped(key, per_shard, 2)(stacked, mask)
+
+        def per_shard(x, m):  # x: [1, *payload]
+            v = x[0]
+            if masked:
+                v = jnp.where(m[self._my_flat_rank()], v, jnp.zeros_like(v))
+            return lax.all_gather(v, self.axis_name, axis=0)[None]
+
+        key = ("allgather", stacked.shape, stacked.dtype.name, masked)
         self._record("all_gather", "xla", stacked)
-        return self._shard_mapped(key, per_shard, 1)(stacked)
+        return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
-    def all_to_all(self, stacked: jnp.ndarray) -> jnp.ndarray:
-        """Native XLA all-to-all over ICI.
+    def all_to_all(
+        self, stacked: jnp.ndarray, active_gpus: Optional[Sequence[int]] = None
+    ) -> jnp.ndarray:
+        """All-to-all over ICI with subset semantics.
 
         ``stacked[src, dst]`` blocks are exchanged so each rank ``r`` ends up
         with ``stacked[:, r]`` — the expert-parallel shuffle the reference
         delegates to fastmoe/NCCL (models/moe/train_moe.py, AdapCC.alltoall
-        stub adapcc.py:59-61).  Expects ``stacked.shape[1] == world``.
+        stub adapcc.py:59-61).  Expects ``stacked.shape[1] == world``.  With
+        ``active_gpus``, blocks *originating* from inactive ranks are zeroed
+        (they contribute identity); every rank, active or not, still receives
+        its incoming blocks — inactive ranks stay on the fabric as relays.
         """
         self._check_world_dim(stacked, "all_to_all")
         if stacked.shape[1] != self.world_size:
             raise ValueError(
                 f"all_to_all needs a [world, world, ...] stacked array, got {stacked.shape}"
             )
+        mask = self._active_to_mask(active_gpus)
+        masked = active_gpus is not None
 
         if self.two_level:
             from adapcc_tpu.comm.two_level import all_to_all_two_level_shard
 
-            def per_shard(x):  # x: [1, world, *payload]
+            def per_shard(x, m):  # x: [1, world, *payload]
+                v = x[0]
+                if masked:
+                    v = jnp.where(m[self._my_flat_rank()], v, jnp.zeros_like(v))
                 return all_to_all_two_level_shard(
-                    x[0], self.num_slices, self.ici_size
+                    v, self.num_slices, self.ici_size
                 )[None]
 
-            key = ("alltoall2l", stacked.shape, stacked.dtype.name)
+            key = ("alltoall2l", stacked.shape, stacked.dtype.name, masked)
             self._record("all_to_all", "two_level", stacked)
-            return self._shard_mapped(key, per_shard, 1)(stacked)
+            return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
-        def per_shard(x):  # x: [1, world, *payload]
-            return lax.all_to_all(x[0], self.axis_name, split_axis=0, concat_axis=0)[None]
+        def per_shard(x, m):  # x: [1, world, *payload]
+            v = x[0]
+            if masked:
+                v = jnp.where(m[self._my_flat_rank()], v, jnp.zeros_like(v))
+            return lax.all_to_all(v, self.axis_name, split_axis=0, concat_axis=0)[None]
 
-        key = ("alltoall", stacked.shape, stacked.dtype.name)
+        key = ("alltoall", stacked.shape, stacked.dtype.name, masked)
         self._record("all_to_all", "xla", stacked)
-        return self._shard_mapped(key, per_shard, 1)(stacked)
+        return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
     def ring_allreduce(self, stacked: jnp.ndarray, interpret: Optional[bool] = None) -> jnp.ndarray:
         """Pallas ICI ring allreduce (hand-tuned data plane; see
@@ -868,22 +916,70 @@ class CollectiveEngine:
         self._record("all_gather", "pallas_ring", stacked)
         return self._shard_mapped(key, per_shard, 1)(stacked)
 
-    def reduce_scatter(self, stacked: jnp.ndarray, op: ReduceOp = ReduceOp.SUM) -> jnp.ndarray:
-        """Native XLA reduce-scatter (reference stub: REDUCESCATTER enum).
+    def reduce_scatter(
+        self,
+        stacked: jnp.ndarray,
+        active_gpus: Optional[Sequence[int]] = None,
+        op: ReduceOp = ReduceOp.SUM,
+    ) -> jnp.ndarray:
+        """Reduce-scatter with subset semantics (reference stub: REDUCESCATTER).
 
         Row ``r`` of the result is the reduction of everyone's ``r``-th
         world-slice: input ``[world, n]`` → output ``[world, n // world]``.
+        With ``active_gpus``, inactive ranks contribute the reduction
+        identity but still receive their chunk (the relay contract);
+        ``ReduceOp.AVG`` averages over the *active* count.  Two-level worlds
+        scatter hierarchically (ICI first, so DCN carries only ``1/ici`` of
+        the buffer).
         """
-
         self._check_world_dim(stacked, "reduce_scatter")
+        if op is ReduceOp.MAX:
+            raise ValueError(
+                "reduce_scatter supports SUM/AVG (psum_scatter has no max "
+                "variant); use reduce + a local slice for MAX"
+            )
+        n = int(np.prod(stacked.shape[1:]))
+        if n % self.world_size:
+            raise ValueError(
+                f"reduce_scatter payload ({n} elems) must divide the world "
+                f"({self.world_size})"
+            )
+        mask = self._active_to_mask(active_gpus)
+        masked = active_gpus is not None
 
-        def per_shard(x):  # x: [1, n]
-            flat = x.reshape(-1)
-            out = lax.psum_scatter(flat, self.axis_name, scatter_dimension=0, tiled=True)
+        def _contrib(v, m):
+            if masked:
+                v = jnp.where(m[self._my_flat_rank()], v, jnp.zeros_like(v))
+            return v
+
+        def _norm(out, m):
             if op is ReduceOp.AVG:
-                out = out / self.world_size
-            return out[None, :]
+                denom = (
+                    jnp.maximum(jnp.sum(m.astype(out.dtype)), 1)
+                    if masked else self.world_size
+                )
+                out = out / denom
+            return out
 
-        key = ("reducescatter", stacked.shape, stacked.dtype.name, op)
+        if self.two_level:
+            from adapcc_tpu.comm.two_level import reduce_scatter_two_level_shard
+
+            def per_shard(x, m):  # x: [1, n]
+                v = _contrib(x.reshape(-1), m)
+                out = reduce_scatter_two_level_shard(
+                    v, self.num_slices, self.ici_size
+                )
+                return _norm(out, m)[None, :]
+
+            key = ("reducescatter2l", stacked.shape, stacked.dtype.name, op, masked)
+            self._record("reduce_scatter", "two_level", stacked)
+            return self._shard_mapped(key, per_shard, 2)(stacked, mask)
+
+        def per_shard(x, m):  # x: [1, n]
+            v = _contrib(x.reshape(-1), m)
+            out = lax.psum_scatter(v, self.axis_name, scatter_dimension=0, tiled=True)
+            return _norm(out, m)[None, :]
+
+        key = ("reducescatter", stacked.shape, stacked.dtype.name, op, masked)
         self._record("reduce_scatter", "xla", stacked)
-        return self._shard_mapped(key, per_shard, 1)(stacked)
+        return self._shard_mapped(key, per_shard, 2)(stacked, mask)

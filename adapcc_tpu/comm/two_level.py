@@ -293,6 +293,61 @@ def all_to_all_two_level_shard(
     return y2.reshape((S * I,) + payload)
 
 
+def all_gather_two_level_shard(
+    x: jnp.ndarray,
+    num_slices: int,
+    ici_size: int,
+    dcn_axis: str = DCN_AXIS,
+    ici_axis: str = ICI_AXIS,
+) -> jnp.ndarray:
+    """DCN-light hierarchical all-gather; call inside shard_map.
+
+    ``x`` is this rank's payload; returns ``[world, *payload]`` in flat rank
+    order (``slice * ici_size + lane``).  Gathers over the DCN axis *first*
+    — each payload crosses DCN exactly once — then replicates slice stacks
+    over ICI; a flat ``lax.all_gather`` on the combined axes would instead
+    let GSPMD route intra-slice reshuffling across DCN.  The final transpose
+    from (lane, slice) to (slice, lane) order is a local relabel.
+    """
+    g_dcn = lax.all_gather(x, dcn_axis, axis=0)       # [S, *p]  per (·, lane)
+    g = lax.all_gather(g_dcn, ici_axis, axis=0)       # [I, S, *p]
+    return jnp.swapaxes(g, 0, 1).reshape((num_slices * ici_size,) + x.shape)
+
+
+def reduce_scatter_two_level_shard(
+    x: jnp.ndarray,
+    num_slices: int,
+    ici_size: int,
+    dcn_axis: str = DCN_AXIS,
+    ici_axis: str = ICI_AXIS,
+) -> jnp.ndarray:
+    """ICI-first hierarchical reduce-scatter (sum); call inside shard_map.
+
+    ``x`` is this rank's flat ``[n]`` contribution (``n % world == 0``);
+    returns this rank's fully reduced ``[n / world]`` chunk, in flat rank
+    order: rank ``(s, i)`` receives world-chunk ``s·I + i``, matching the
+    flat engine's :meth:`reduce_scatter` row semantics.
+
+    The ICI scatter runs first so DCN carries only ``1/ici_size`` of the
+    buffer; a local chunk pre-permutation (a reshape/transpose, no
+    collective) makes the two-hop scatter land the flat chunk order.
+    """
+    S, I = num_slices, ici_size
+    world = S * I
+    if x.size % world:
+        raise ValueError(
+            f"reduce_scatter payload ({x.size} elems) must divide the world "
+            f"({world})"
+        )
+    c = x.size // world
+    # chunk (i·S + s) of the permuted buffer ← flat chunk (s·I + i): after
+    # the ici-then-dcn scatter, rank (s, i) holds permuted chunk (i·S + s),
+    # i.e. exactly flat chunk (s·I + i)
+    xp = x.reshape(S, I, c).swapaxes(0, 1).reshape(-1)
+    part = lax.psum_scatter(xp, ici_axis, scatter_dimension=0, tiled=True)
+    return lax.psum_scatter(part, dcn_axis, scatter_dimension=0, tiled=True)
+
+
 def reduce_two_level_shard(
     x: jnp.ndarray,
     active_mask: jnp.ndarray,

@@ -296,15 +296,28 @@ class Communicator:
         return self._engine(BOARDCAST).boardcast(tensor, active_gpus=active_gpus)
 
     def alltoall(
-        self, tensor: jnp.ndarray, size: Optional[int] = None, chunk_bytes: Optional[int] = None
+        self,
+        tensor: jnp.ndarray,
+        size: Optional[int] = None,
+        chunk_bytes: Optional[int] = None,
+        active_gpus: Optional[Sequence[int]] = None,
     ) -> jnp.ndarray:
-        return self._engine(ALLTOALL).all_to_all(tensor)
+        return self._engine(ALLTOALL).all_to_all(tensor, active_gpus=active_gpus)
 
-    def all_gather(self, tensor: jnp.ndarray) -> jnp.ndarray:
-        return self._engine(ALLGATHER).all_gather(tensor)
+    def all_gather(
+        self, tensor: jnp.ndarray, active_gpus: Optional[Sequence[int]] = None
+    ) -> jnp.ndarray:
+        return self._engine(ALLGATHER).all_gather(tensor, active_gpus=active_gpus)
 
-    def reduce_scatter(self, tensor: jnp.ndarray, op: ReduceOp = ReduceOp.SUM) -> jnp.ndarray:
-        return self._engine(REDUCESCATTER).reduce_scatter(tensor, op=op)
+    def reduce_scatter(
+        self,
+        tensor: jnp.ndarray,
+        active_gpus: Optional[Sequence[int]] = None,
+        op: ReduceOp = ReduceOp.SUM,
+    ) -> jnp.ndarray:
+        return self._engine(REDUCESCATTER).reduce_scatter(
+            tensor, active_gpus=active_gpus, op=op
+        )
 
     # -- coordinator plane -----------------------------------------------------
 

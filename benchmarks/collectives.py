@@ -134,10 +134,31 @@ def _make_ops(engine, elems: int, dtype=jnp.float32) -> Dict[str, tuple]:
         ("all_gather", "xla"): (lambda: engine.all_gather(flat), total),
         ("reduce_scatter", "xla"): (lambda: engine.reduce_scatter(flat), per_rank),
     }
+    # subset rows: one rank masked out — regression-pins the cost of the
+    # active-mask relay path on the gather/scatter primitives (VERDICT r4
+    # item 3); same bytes accounting as the full-world rows
+    subset = list(range(world - 1))
+    ops[("all_gather", "subset")] = (
+        lambda: engine.all_gather(flat, active_gpus=subset), total,
+    )
+    if elems % world == 0:
+        ops[("reduce_scatter", "subset")] = (
+            lambda: engine.reduce_scatter(flat, active_gpus=subset), per_rank,
+        )
     if not two_level:
         ops[("allreduce", "pallas_ring")] = (
             lambda: engine.ring_allreduce(flat), per_rank,
         )
+        if elems % world == 0:
+            ops[("reduce_scatter", "pallas_ring")] = (
+                lambda: engine.ring_reduce_scatter(flat), per_rank,
+            )
+        from adapcc_tpu.comm.pallas_ring import _tile_elems
+
+        if elems % _tile_elems(dtype) == 0:
+            ops[("all_gather", "pallas_ring")] = (
+                lambda: engine.ring_all_gather(flat), total,
+            )
         # active_gpus pins the schedule path; bare calls ride the XLA
         # fastpath (flat meshes only — see docstring)
         ops[("reduce", "xla")] = (lambda: engine.reduce(flat), per_rank)
@@ -153,6 +174,9 @@ def _make_ops(engine, elems: int, dtype=jnp.float32) -> Dict[str, tuple]:
             np.asarray(flat).reshape(world, world, elems // world), sharding
         )
         ops[("all_to_all", "xla")] = (lambda: engine.all_to_all(blocked), total)
+        ops[("all_to_all", "subset")] = (
+            lambda: engine.all_to_all(blocked, active_gpus=subset), total,
+        )
     return ops
 
 

@@ -288,3 +288,53 @@ def test_two_level_adaptive_workflow_e2e(tmp_path):
     x = jnp.stack([jnp.full((8,), float(r + 1)) for r in range(8)])
     out = np.asarray(comm.all_reduce(x))
     np.testing.assert_allclose(out, 36.0)
+
+
+def test_two_level_gather_scatter_are_hierarchical(mesh2x4):
+    """all_gather / reduce_scatter on a (dcn, ici) mesh route through the
+    hierarchical shards (trace impl "two_level", VERDICT r4 item 3) and
+    match the flat contracts on random payloads."""
+    from adapcc_tpu.utils.observability import CollectiveTrace
+
+    trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh2x4, hier_strategy(), trace=trace)
+    rng = np.random.default_rng(21)
+
+    shards = rng.normal(size=(8, 3)).astype(np.float32)
+    out = np.asarray(eng.all_gather(jnp.asarray(shards)))
+    for r in range(8):
+        np.testing.assert_allclose(out[r], shards, atol=1e-6)
+
+    stacked = rng.normal(size=(8, 16)).astype(np.float32)
+    rs = np.asarray(eng.reduce_scatter(jnp.asarray(stacked)))
+    expect = stacked.sum(axis=0).reshape(8, 2)
+    np.testing.assert_allclose(rs, expect, rtol=1e-5, atol=1e-5)
+
+    impls = {(ev.primitive, ev.impl) for ev in trace.events()}
+    assert ("all_gather", "two_level") in impls
+    assert ("reduce_scatter", "two_level") in impls
+
+
+def test_two_level_gather_scatter_subset(mesh2x4):
+    """Active-mask relay semantics on the hierarchical gather/scatter —
+    the same contract the flat engine pins, on the (dcn, ici) mesh."""
+    eng = CollectiveEngine(mesh2x4, hier_strategy())
+    x = jnp.stack([jnp.full((4,), float(r + 1)) for r in range(8)])
+
+    gathered = np.asarray(eng.all_gather(x, active_gpus=[0, 1, 2, 3, 6, 7]))
+    expect = (np.arange(8) + 1.0)[:, None] * np.ones((8, 4))
+    expect[4] = expect[5] = 0.0
+    for r in range(8):
+        np.testing.assert_allclose(gathered[r], expect, err_msg=f"rank {r}")
+
+    x16 = jnp.stack([jnp.full((16,), float(r + 1)) for r in range(8)])
+    avg = np.asarray(
+        eng.reduce_scatter(x16, active_gpus=[1, 5], op=ReduceOp.AVG)
+    )
+    np.testing.assert_allclose(avg, np.full((8, 2), 4.0))  # (2+6)/2
+
+    a2a = jnp.arange(8 * 8 * 1, dtype=jnp.float32).reshape(8, 8, 1) + 1.0
+    out = np.asarray(eng.all_to_all(a2a, active_gpus=[0, 1, 2, 3, 4, 5, 6]))
+    expect_a2a = np.transpose(np.asarray(a2a), (1, 0, 2)).copy()
+    expect_a2a[:, 7] = 0.0
+    np.testing.assert_allclose(out, expect_a2a)
