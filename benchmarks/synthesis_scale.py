@@ -1,0 +1,246 @@
+"""Pod-scale synthesizer benchmark: milp vs partrees vs ring at 32-64 ranks.
+
+The reference ships strategy fixtures up to 24 GPUs (`strategy/`, 17 files)
+and its Gurobi study compares solver vs heuristic makespans
+(gurobi/solver.py:190-208).  This sweep reproduces that comparison at pod
+scale on synthetic two-level topologies, putting all three synthesis
+policies on one modeled scale:
+
+- **policy wall time** — synthesis latency with the solver's own runtime
+  budget (`ROUTING_MILP_TIME_LIMIT_S`) in force, i.e. what topology
+  reconstruction would actually stall;
+- **modeled makespan** — the routing MILP's pipeline-aware bottleneck
+  objective evaluated on every policy's output
+  (:func:`adapcc_tpu.strategy.solver.modeled_makespan`);
+- **lowering** — rounds per tree through ``reduce_rounds`` /
+  ``broadcast_rounds``; at >= ``Tree.NATIVE_LOWERING_THRESHOLD`` (64) ranks
+  this exercises the native C++ lowering engine when ``libadapcc_rt.so`` is
+  built (strategy/ir.py:162);
+- optional ``--exec``: relative busbw of each policy's allreduce executed on
+  a virtual CPU pod of the same world size (NOT a hardware number — an
+  ordering regression pin, like busbw_virtual8).
+
+The degraded-link topologies are where the policies genuinely diverge: one
+host pair's DCN bandwidth is cut to a fraction, so bandwidth-aware synthesis
+(milp / partrees BDP sort) should beat the oblivious ring on the modeled
+makespan.
+
+Usage::
+
+    python -m benchmarks.synthesis_scale --worlds 32,64 --json
+    XLA_FLAGS=--xla_force_host_platform_device_count=32 JAX_PLATFORMS=cpu \
+        python -m benchmarks.synthesis_scale --worlds 32 --exec --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from typing import List, Optional, Sequence, Tuple
+
+from adapcc_tpu.primitives import ALLREDUCE
+
+#: intra-host (ICI) / healthy inter-host (DCN) link model, in GB/s and s —
+#: the same two-tier shape the reference's cluster profiles have
+#: (strategy/cluster_*.xml: NVLink vs 100GbE)
+ICI_BW, ICI_LAT = 400.0, 1e-6
+DCN_BW, DCN_LAT = 25.0, 5e-5
+
+
+def synthetic_topology(
+    num_hosts: int, per_host: int, degraded_pair: Optional[Tuple[int, int]] = (0, 1),
+    degrade_factor: float = 0.25,
+):
+    """(ip_table, bandwidth_graph, latency_graph) for a two-level pod.
+
+    ``degraded_pair`` cuts one host pair's DCN bandwidth by
+    ``degrade_factor`` — the adaptive-routing case the synthesizers exist
+    for (reference README: "adapts to dynamic network conditions").
+    """
+    world = num_hosts * per_host
+    ip_table = [f"10.8.{h}.1" for h in range(num_hosts) for _ in range(per_host)]
+    host_of = [r // per_host for r in range(world)]
+    bw = [[0.0] * world for _ in range(world)]
+    lat = [[0.0] * world for _ in range(world)]
+    for i in range(world):
+        for j in range(world):
+            if i == j:
+                continue
+            if host_of[i] == host_of[j]:
+                bw[i][j], lat[i][j] = ICI_BW, ICI_LAT
+            else:
+                b, l = DCN_BW, DCN_LAT
+                if degraded_pair is not None and {host_of[i], host_of[j]} == set(
+                    degraded_pair
+                ):
+                    b, l = DCN_BW * degrade_factor, DCN_LAT * 4
+                bw[i][j], lat[i][j] = b, l
+    return ip_table, bw, lat
+
+
+def crosshost_makespan(
+    strategy,
+    bw: Sequence[Sequence[float]],
+    lat: Sequence[Sequence[float]],
+    transmission_size: int,
+) -> float:
+    """Policy-agnostic bottleneck-edge time in SECONDS: max over every tree
+    edge of ``lat + share·size/(bw·1e9)`` (bw in GB/s, the profiler's
+    convention).  Unlike :func:`modeled_makespan` — which projects to
+    inter-master edges and so scores a master-chain ring as zero — this
+    walks ALL edges, making ring vs tree strategies comparable."""
+    import numpy as np
+
+    b = np.asarray(bw, float)
+    l = np.asarray(lat, float)
+    worst = 0.0
+    for tree, share in zip(strategy.trees, strategy.tree_shares()):
+        if share <= 0.0:
+            continue
+        for p, cs in tree.children.items():
+            for c in cs:
+                t = l[p][c] + share * transmission_size / (max(b[p][c], 1e-9) * 1e9)
+                worst = max(worst, float(t))
+    return worst
+
+
+def bench_policy(
+    policy: str,
+    ip_table: Sequence[str],
+    bw: Sequence[Sequence[float]],
+    lat: Sequence[Sequence[float]],
+    parallel_degree: int = 2,
+    transmission_size: int = 4 << 20,
+) -> dict:
+    """Synthesize + score one policy; returns one artifact row."""
+    from adapcc_tpu import native
+    from adapcc_tpu.strategy.solver import modeled_makespan
+    from adapcc_tpu.strategy.synthesizer import Synthesizer, _infer_local_rank0s
+
+    world = len(ip_table)
+    masters = _infer_local_rank0s(ip_table)
+    t0 = time.perf_counter()
+    strategy = Synthesizer(None, ip_table, policy).synthesize(
+        ALLREDUCE, parallel_degree, transmission_size, bw, lat
+    )
+    synth_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    rounds = sum(
+        len(t.reduce_rounds()) + len(t.broadcast_rounds()) for t in strategy.trees
+    )
+    lower_s = time.perf_counter() - t0
+    return {
+        "world": world,
+        "hosts": len(masters),
+        "policy": policy,
+        "synthesis": strategy.synthesis,
+        "num_trees": len(strategy.trees),
+        "synth_ms": round(synth_s * 1e3, 2),
+        "lowering_ms": round(lower_s * 1e3, 2),
+        "rounds": rounds,
+        "native_lowering": bool(
+            native.available()
+            and world >= type(strategy.trees[0]).NATIVE_LOWERING_THRESHOLD
+        ),
+        # raw model units (reference gurobi objective) — inter-master edges
+        # only, comparable between milp and partrees
+        "modeled_makespan": float(
+            modeled_makespan(
+                strategy, masters, ALLREDUCE, transmission_size, bw, lat
+            )
+        ),
+        # seconds → ms, every edge scored — comparable across ALL policies
+        "crosshost_makespan_ms": round(
+            crosshost_makespan(strategy, bw, lat, transmission_size) * 1e3, 4
+        ),
+    }
+
+
+def exec_relative_busbw(
+    policy: str,
+    ip_table: Sequence[str],
+    bw,
+    lat,
+    elems: int = 16384,
+    iters: int = 3,
+) -> dict:
+    """Execute the policy's allreduce on a virtual pod of the same world
+    size; returns a timing row (ordering evidence only, not hardware)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.comm.mesh import build_world_mesh
+    from adapcc_tpu.strategy.synthesizer import Synthesizer
+
+    world = len(ip_table)
+    if len(jax.devices()) < world:
+        raise RuntimeError(
+            f"--exec needs {world} devices "
+            f"(set XLA_FLAGS=--xla_force_host_platform_device_count={world})"
+        )
+    strategy = Synthesizer(None, ip_table, policy).synthesize(
+        ALLREDUCE, 2, 4 << 20, bw, lat
+    )
+    mesh = build_world_mesh(world)
+    eng = CollectiveEngine(mesh, strategy, use_xla_fastpath=False)
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(world, elems)), jnp.float32
+    )
+    active = list(range(world))
+    jax.block_until_ready(eng.all_reduce(x, active_gpus=active))  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(eng.all_reduce(x, active_gpus=active))
+    per_op = (time.perf_counter() - t0) / iters
+    return {
+        "world": world,
+        "policy": policy,
+        "exec_virtual_ms": round(per_op * 1e3, 2),
+        "elems": elems,
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--worlds", default="32,64",
+                    help="comma list of world sizes (8 ranks per host)")
+    ap.add_argument("--per-host", type=int, default=8)
+    ap.add_argument("--policies", default="par-trees,milp,ring")
+    ap.add_argument("--degrade", type=float, default=0.25,
+                    help="bandwidth factor for the degraded host pair (1.0 = healthy)")
+    ap.add_argument("--exec", action="store_true", dest="exec_",
+                    help="also execute each policy's allreduce on a virtual pod")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    rows: List[dict] = []
+    for world in (int(w) for w in args.worlds.split(",") if w):
+        if world % args.per_host:
+            raise SystemExit(f"world {world} must divide per-host {args.per_host}")
+        hosts = world // args.per_host
+        degraded = (0, 1) if args.degrade < 1.0 and hosts >= 2 else None
+        ip_table, bw, lat = synthetic_topology(
+            hosts, args.per_host, degraded_pair=degraded,
+            degrade_factor=args.degrade,
+        )
+        for policy in (p for p in args.policies.split(",") if p):
+            row = bench_policy(policy, ip_table, bw, lat)
+            row["degrade_factor"] = args.degrade if degraded else 1.0
+            rows.append(row)
+            if args.exec_:
+                rows.append(exec_relative_busbw(policy, ip_table, bw, lat))
+
+    for r in rows:
+        if args.json:
+            print(json.dumps(r))
+        else:
+            print(r)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
