@@ -1,0 +1,126 @@
+"""Flash-attention tile autotuning.
+
+Round 4 found the best flash block empirically (256 beat 128 by ~11% on the
+v5e flagship shape) via a *manual* battery A/B; the default was then pinned
+statically (VERDICT r4, "What's weak" #3).  This module makes that sweep a
+first-class, cached measurement: for a given (seq, d_head, dtype) it times a
+short jitted forward+backward of the real kernel at each candidate tile and
+returns the fastest.
+
+Measurement methodology matters on remote-tunnel backends (PERF_NOTES):
+a fresh compiled program's first TWO executions pay the executable+buffer
+migration transient (~30 s each through the axon tunnel), so each candidate
+runs ``warmup >= 2`` untimed executions before the timed ones, and timing is
+forced-sync (``jax.device_get`` on a scalar closes the window).
+
+Off-TPU the sweep is skipped entirely — the Pallas interpreter's timings
+say nothing about Mosaic and would take minutes — and the static default
+resolution is returned.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+#: measured-best static default (round-4 battery, v5e, T=512)
+DEFAULT_BLOCK = 256
+
+#: candidate tile edges swept by the autotuner
+CANDIDATES = (128, 256, 512)
+
+_cache: Dict[Tuple, Tuple[int, Dict[int, float]]] = {}
+
+
+def resolve_block(seq: int, want: int) -> int:
+    """Largest 8-aligned tile <= ``want`` that divides ``seq``; falls back
+    to the full sequence when no aligned divisor exists."""
+    b = min(max(8, want - want % 8), seq)
+    while b >= 8 and seq % b:
+        b -= 8
+    return b if b >= 8 and seq % b == 0 else seq
+
+
+def autotune_flash_block(
+    seq: int,
+    d_head: int = 64,
+    dtype=None,
+    batch: int = 2,
+    heads: int = 8,
+    candidates: Sequence[int] = CANDIDATES,
+    warmup: int = 2,
+    iters: int = 3,
+    causal: bool = True,
+) -> int:
+    """Fastest seq-compatible flash tile for this backend, measured.
+
+    Returns the winning block edge; the per-candidate timings are kept in
+    :func:`last_timings` for artifact/bench reporting.  Results are cached
+    per (platform, seq, d_head, dtype) for the process lifetime — the sweep
+    runs once per shape, not once per call.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.bfloat16
+    platform = jax.devices()[0].platform
+    key = (platform, seq, d_head, jnp.dtype(dtype).name, causal)
+    if key in _cache:
+        return _cache[key][0]
+
+    resolved = []
+    for c in candidates:
+        r = resolve_block(seq, c)
+        if r not in resolved:
+            resolved.append(r)
+    if platform != "tpu" or len(resolved) == 1:
+        # interpreter timings are meaningless for Mosaic tile choice
+        best = resolve_block(seq, DEFAULT_BLOCK)
+        _cache[key] = (best, {})
+        return best
+
+    from adapcc_tpu.ops import flash_attention
+
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (batch, seq, heads, d_head), dtype)
+    timings: Dict[int, float] = {}
+    for block in resolved:
+        def loss(q, k, v, block=block):
+            return jnp.sum(
+                flash_attention(
+                    q, k, v, causal=causal, block_q=block, block_k=block
+                ).astype(jnp.float32)
+            )
+
+        try:
+            fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+            for _ in range(max(warmup, 2)):  # tunnel migration transient
+                jax.block_until_ready(fn(x, x, x))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                val, _ = fn(x, x, x)
+                jax.device_get(val)  # forced sync closes the window
+            timings[block] = (time.perf_counter() - t0) / iters
+        except Exception:  # noqa: BLE001 — e.g. VMEM overflow at 512
+            timings[block] = float("inf")
+    finite = {b: t for b, t in timings.items() if t != float("inf")}
+    best = min(finite, key=finite.get) if finite else resolve_block(seq, DEFAULT_BLOCK)
+    _cache[key] = (best, timings)
+    return best
+
+
+def last_timings(
+    seq: int, d_head: int = 64, dtype=None, causal: bool = True
+) -> Optional[Dict[int, float]]:
+    """Per-candidate seconds from the cached sweep for this shape (None if
+    the sweep has not run; empty dict if it was skipped off-TPU)."""
+    import jax
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.bfloat16
+    key = (
+        jax.devices()[0].platform, seq, d_head, jnp.dtype(dtype).name, causal
+    )
+    hit = _cache.get(key)
+    return hit[1] if hit else None

@@ -33,7 +33,9 @@ Size knobs via env (defaults target a single v5e chip):
     BENCH_STEPS, BENCH_WORLD, BENCH_PEAK_TFLOPS, BENCH_ATTN (flash|xla),
     BENCH_PARAM_DTYPE (bf16|f32), BENCH_LOSS (dense|chunked),
     BENCH_REMAT (off|full|dots|dots_no_batch), BENCH_SCAN (1|0), BENCH_ACCUM,
-    BENCH_FLASH_BLOCK (flash tile edge, default 256 — measured best on v5e),
+    BENCH_FLASH_BLOCK (flash tile edge, default 256 — measured best on v5e;
+    "auto" runs the measured tile sweep, ops/flash_autotune.py),
+    BENCH_OPT_MOMENTS (f32|bf16 adam first-moment dtype),
     BENCH_GRAD_COMPRESS (off|bf16 gradient-sync wire dtype),
     BENCH_PREFLIGHT_S, BENCH_ATTEMPTS, BENCH_DEADLINE
 """
@@ -226,7 +228,28 @@ def flash_block_for(seq: int) -> int:
     clamps to a compatible tile instead of silently downgrading to xla
     attention.  When no aligned divisor exists (seq itself not a multiple
     of 8, or a pathological knob value), fall back to the full sequence as
-    one block — always kernel-legal; the probe-compile guards VMEM."""
+    one block — always kernel-legal; the probe-compile guards VMEM.
+
+    ``BENCH_FLASH_BLOCK=auto`` runs the measured tile sweep instead
+    (ops/flash_autotune.py): each candidate is timed on the live backend
+    (transient-aware warmup) and the per-candidate seconds land in the
+    artifact under ``flash_autotune``."""
+    raw = os.environ.get("BENCH_FLASH_BLOCK", "").strip().lower()
+    if raw == "auto":
+        from adapcc_tpu.ops.flash_autotune import autotune_flash_block, last_timings
+
+        d_head = _env_int("BENCH_DMODEL", 1024) // _env_int("BENCH_HEADS", 16)
+        best = autotune_flash_block(seq, d_head=d_head)
+        timings = last_timings(seq, d_head=d_head)
+        _RESULT["flash_autotune"] = {
+            "best": best,
+            "timings_ms": {
+                str(b): (round(t * 1e3, 3) if t != float("inf") else None)
+                for b, t in (timings or {}).items()
+            },
+        }
+        _progress(f"flash autotune: best block {best} of {timings}")
+        return best
     want = _env_int("BENCH_FLASH_BLOCK", _DEFAULT_FLASH_BLOCK)
     b = min(max(8, want - want % 8), seq)
     while b >= 8 and seq % b:
@@ -377,7 +400,19 @@ def main() -> None:
             def loss_fn(p, b):
                 return lm_loss(model.apply(p, b), b)
 
-        tx = optax.adamw(3e-4)
+        # BENCH_OPT_MOMENTS=bf16 stores adam's first moment in bf16 — a
+        # third less optimizer HBM traffic per step for ~bf16-eps update
+        # noise (the second moment stays fp32: optax's mu_dtype knob)
+        opt_moments = os.environ.get("BENCH_OPT_MOMENTS", "f32")
+        if opt_moments not in ("f32", "bf16"):
+            raise ValueError(
+                f"BENCH_OPT_MOMENTS={opt_moments!r}: expected f32/bf16"
+            )
+        _RESULT["opt_moments"] = opt_moments
+        tx = optax.adamw(
+            3e-4,
+            mu_dtype=jnp.bfloat16 if opt_moments == "bf16" else None,
+        )
 
         use_scan = _env_int("BENCH_SCAN", 1)
         _RESULT["dispatch"] = "scan" if use_scan else "loop"

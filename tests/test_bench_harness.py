@@ -155,3 +155,45 @@ def test_attach_last_live_bench_never_raises(monkeypatch):
     monkeypatch.setitem(bench._RESULT, "last_live_bench", None)
     bench._attach_last_live_bench()  # must not raise
     assert "surprise artifact shape" in bench._RESULT["last_live_bench_error"]
+
+
+def test_flash_autotune_resolution_and_cpu_skip(monkeypatch):
+    """Off-TPU the autotuner must skip timing entirely (interpreter timings
+    say nothing about Mosaic) and return the static default resolution."""
+    from adapcc_tpu.ops import flash_autotune as fa
+
+    fa._cache.clear()
+    assert fa.resolve_block(512, 256) == 256
+    assert fa.resolve_block(384, 256) == 192
+    assert fa.resolve_block(300, 256) == 300  # no aligned divisor: full seq
+    best = fa.autotune_flash_block(512)
+    assert best == fa.resolve_block(512, fa.DEFAULT_BLOCK)
+    assert fa.last_timings(512) == {}  # swept-off marker, not None
+    # cached: a second call must not re-enter the sweep
+    assert fa.autotune_flash_block(512) == best
+
+
+def test_bench_flash_block_auto_env(monkeypatch):
+    import bench
+
+    monkeypatch.setenv("BENCH_FLASH_BLOCK", "auto")
+    monkeypatch.setitem(bench._RESULT, "flash_autotune", None)
+    b = bench.flash_block_for(512)
+    assert b == 256  # cpu skip path resolves the static default
+    assert bench._RESULT["flash_autotune"]["best"] == 256
+
+
+def test_bench_rejects_bad_opt_moments_env():
+    env = dict(os.environ)
+    env["BENCH_OPT_MOMENTS"] = "fp8"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update({"BENCH_LAYERS": "1", "BENCH_DMODEL": "32", "BENCH_HEADS": "2",
+                "BENCH_SEQ": "32", "BENCH_BATCH": "2", "BENCH_STEPS": "1",
+                "BENCH_ATTN": "xla"})
+    out = subprocess.run(
+        [sys.executable, "/root/repo/bench.py"],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert out.returncode != 0
+    line = out.stdout.strip().splitlines()[-1]
+    assert "BENCH_OPT_MOMENTS" in json.loads(line)["error"]
