@@ -1,7 +1,8 @@
 """The docs' code blocks execute — documentation that cannot drift.
 
-Every ```python block in docs/PARALLELISM.md runs verbatim on the virtual
-pod.  A snippet that stops compiling or produces wrong shapes fails here.
+Every ```python block in docs/PARALLELISM.md and docs/OPERATIONS.md runs
+verbatim on the virtual pod.  A snippet that stops compiling or produces
+wrong shapes fails here.
 """
 
 import os
@@ -9,22 +10,45 @@ import re
 
 import pytest
 
-_DOC = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "docs", "PARALLELISM.md",
+_DOCS_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "docs"
 )
+_PARALLELISM = os.path.join(_DOCS_DIR, "PARALLELISM.md")
+_OPERATIONS = os.path.join(_DOCS_DIR, "OPERATIONS.md")
 
 
-def _blocks():
-    text = open(_DOC).read()
+def _blocks(path):
+    text = open(path).read()
     return re.findall(r"```python\n(.*?)```", text, re.DOTALL)
 
 
-def test_doc_has_snippets():
-    assert len(_blocks()) >= 6
+def test_parallelism_doc_has_snippets():
+    assert len(_blocks(_PARALLELISM)) >= 6
 
 
-@pytest.mark.parametrize("idx", range(len(_blocks())))
+def test_operations_doc_has_snippets():
+    assert len(_blocks(_OPERATIONS)) >= 4
+
+
+def test_operations_doc_covers_the_contract():
+    """The operator topics VERDICT r4 item 8 names must all be present."""
+    text = open(_OPERATIONS).read()
+    for needle in (
+        "ADAPCC_NUM_PROCESSES", "ADAPCC_RESTART_GEN", "ADAPCC_MERGE_ROUNDS",
+        "ip_table.txt", "topo_detect_<r>.xml", "logical_graph.xml",
+        "strategy.xml", "reconstruct_topology", "hw_watch.py", "hw_session",
+        "BENCH_FLASH_BLOCK", "--entry_point", "--dry-run",
+    ):
+        assert needle in text, f"OPERATIONS.md lost its {needle!r} coverage"
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_PARALLELISM))))
 def test_parallelism_doc_snippet_runs(idx):
-    code = _blocks()[idx]
-    exec(compile(code, f"{_DOC}:block{idx}", "exec"), {})
+    code = _blocks(_PARALLELISM)[idx]
+    exec(compile(code, f"{_PARALLELISM}:block{idx}", "exec"), {})
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_OPERATIONS))))
+def test_operations_doc_snippet_runs(idx):
+    code = _blocks(_OPERATIONS)[idx]
+    exec(compile(code, f"{_OPERATIONS}:block{idx}", "exec"), {})
