@@ -102,6 +102,10 @@ class DDPTrainer:
         # and 1/world optimizer memory in ONE compiled program.  States come
         # from :meth:`init_state` (not TrainState.create).
         zero1: bool = False,
+        # zero1's param all-gather rides the Pallas ICI ring kernel instead
+        # of XLA's (the hand-tuned data plane); shards become VMEM-tile
+        # aligned in the ring's chunk ownership — see Zero1Optimizer(ring=)
+        zero1_ring: bool = False,
         # "bf16" halves gradient-sync wire bytes (torch bf16_compress_hook
         # analog); adds ~bf16-eps relative error to the synced mean
         grad_compress: str = "off",
@@ -135,6 +139,9 @@ class DDPTrainer:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
         self.accum_steps = accum_steps
         self.zero1 = zero1
+        if zero1_ring and not zero1:
+            raise ValueError("zero1_ring=True requires zero1=True")
+        self.zero1_ring = zero1_ring
         self.hook = GradSyncHook(
             strategy,
             axis_name=axis_name,
@@ -186,7 +193,9 @@ class DDPTrainer:
             return TrainState.create(params, self.tx, model_state=model_state)
         from adapcc_tpu.parallel.fsdp import Zero1Optimizer
 
-        opt = Zero1Optimizer(self.tx, self.mesh, self.axis_name)
+        opt = Zero1Optimizer(
+            self.tx, self.mesh, self.axis_name, ring=self.zero1_ring
+        )
         master, opt_state = opt.init(params)
         return TrainState(
             params=params,
@@ -255,17 +264,26 @@ class DDPTrainer:
         )
 
         world = self.mesh.shape[self.axis_name]
-        meta = _flatten_meta(state.params, world)
+        if self.zero1_ring:
+            from adapcc_tpu.comm.pallas_ring import _tile_elems
+
+            align = _tile_elems(jnp.float32)
+            ring_interpret = jax.devices()[0].platform != "tpu"
+        else:
+            align, ring_interpret = 1, False
+        meta = _flatten_meta(state.params, world, align)
         master, opt_state = state.opt_state  # [1, L] / [1, ...] per shard
         master = master[0]
         opt_state = jax.tree_util.tree_map(lambda x: x[0], opt_state)
         # the hook already allreduced: every rank holds the same synced
-        # grads, so its slice is a free local read
+        # grads, so its slice is a free local read (ring ownership = offset 1)
         g_shard = local_grad_shard(
-            _flatten(synced, meta), meta, world, self.axis_name
+            _flatten(synced, meta), meta, world, self.axis_name,
+            offset=1 if self.zero1_ring else 0,
         )
         master, opt_state, params = zero1_apply_shard(
-            self.tx, master, opt_state, g_shard, meta, self.axis_name
+            self.tx, master, opt_state, g_shard, meta, self.axis_name,
+            ring=self.zero1_ring, ring_interpret=ring_interpret,
         )
         return TrainState(
             params=params,

@@ -718,3 +718,44 @@ def test_stateful_loss_masked_step_semantics(mesh4):
         st_m.params, st_f.params,
     )
     assert any(d > 0 for d in jax.tree_util.tree_leaves(diffs))
+
+
+def test_zero1_ring_ddp_matches_xla_path(mesh8):
+    """DDPTrainer(zero1=True, zero1_ring=True): the Pallas-ring data plane
+    trains to the same params as the XLA path (VERDICT r4 item 4)."""
+    import optax
+    from adapcc_tpu.strategy.ir import Strategy
+
+    def loss_fn(p, b):
+        return jnp.mean((b @ p["w"]) ** 2)
+
+    tx = optax.adam(0.05)
+    p0 = {"w": jnp.ones((4, 2), jnp.float32)}
+    batch = jnp.asarray(np.random.default_rng(5).normal(size=(16, 4)), jnp.float32)
+
+    states = {}
+    for ring in (False, True):
+        tr = DDPTrainer(
+            loss_fn, tx, mesh8, Strategy.ring(8), zero1=True, zero1_ring=ring,
+        )
+        st = tr.init_state(p0)
+        for _ in range(2):
+            st, loss = tr.step(st, batch)
+        states[ring] = st
+    np.testing.assert_allclose(
+        np.asarray(states[True].params["w"]),
+        np.asarray(states[False].params["w"]),
+        rtol=2e-6, atol=1e-7,
+    )
+
+
+def test_zero1_ring_requires_zero1():
+    import optax
+    from adapcc_tpu.strategy.ir import Strategy
+
+    with pytest.raises(ValueError, match="zero1_ring"):
+        DDPTrainer(
+            lambda p, b: jnp.zeros(()), optax.sgd(0.1),
+            jax.sharding.Mesh(np.array(jax.devices()[:8]), (RANKS_AXIS,)),
+            Strategy.ring(8), zero1_ring=True,
+        )
