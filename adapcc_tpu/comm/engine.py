@@ -877,14 +877,21 @@ class CollectiveEngine:
         world = self.world_size
 
         def per_shard(x):  # x: [1, *payload]
-            return ring_reduce_scatter_shard(
+            out = ring_reduce_scatter_shard(
                 x[0], world, self.axis_name, interpret=interpret
-            )[None]
+            )
+            # relabel to chunk order INSIDE the compiled program: the kernel
+            # leaves rank r holding chunk (r+1) % world; one [chunk]-sized
+            # ppermute hop lands chunk r on rank r (an eager host-side roll
+            # would dispatch a second, uncached cross-device permute per call)
+            out = lax.ppermute(
+                out, self.axis_name, [(i, (i + 1) % world) for i in range(world)]
+            )
+            return out[None]
 
         key = ("ring_rs", stacked.shape, stacked.dtype.name, bool(interpret))
         self._record("reduce_scatter", "pallas_ring", stacked)
-        out = self._shard_mapped(key, per_shard, 1)(stacked)
-        return jnp.roll(out, 1, axis=0)
+        return self._shard_mapped(key, per_shard, 1)(stacked)
 
     def ring_all_gather(
         self, stacked: jnp.ndarray, interpret: Optional[bool] = None

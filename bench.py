@@ -236,11 +236,21 @@ def flash_block_for(seq: int) -> int:
     artifact under ``flash_autotune``."""
     raw = os.environ.get("BENCH_FLASH_BLOCK", "").strip().lower()
     if raw == "auto":
+        import jax.numpy as jnp
+
         from adapcc_tpu.ops.flash_autotune import autotune_flash_block, last_timings
 
         d_head = _env_int("BENCH_DMODEL", 1024) // _env_int("BENCH_HEADS", 16)
-        best = autotune_flash_block(seq, d_head=d_head)
-        timings = last_timings(seq, d_head=d_head)
+        # sweep at the bench's REAL shape: per-rank batch, head count, and
+        # the activation dtype (GPT2Config.dtype — bf16 regardless of the
+        # BENCH_PARAM_DTYPE param cast), so the crowned tile's VMEM
+        # footprint matches what the flagship step actually runs
+        batch = _env_int("BENCH_BATCH", 16)
+        heads = _env_int("BENCH_HEADS", 16)
+        best = autotune_flash_block(
+            seq, d_head=d_head, dtype=jnp.bfloat16, batch=batch, heads=heads
+        )
+        timings = last_timings(seq, d_head=d_head, dtype=jnp.bfloat16)
         _RESULT["flash_autotune"] = {
             "best": best,
             "timings_ms": {

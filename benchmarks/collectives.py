@@ -136,15 +136,18 @@ def _make_ops(engine, elems: int, dtype=jnp.float32) -> Dict[str, tuple]:
     }
     # subset rows: one rank masked out — regression-pins the cost of the
     # active-mask relay path on the gather/scatter primitives (VERDICT r4
-    # item 3); same bytes accounting as the full-world rows
+    # item 3); same bytes accounting as the full-world rows.  world >= 2
+    # only: at world=1 the "subset" would be empty and the rows would time
+    # an all-zeros identity program masquerading as the relay path
     subset = list(range(world - 1))
-    ops[("all_gather", "subset")] = (
-        lambda: engine.all_gather(flat, active_gpus=subset), total,
-    )
-    if elems % world == 0:
-        ops[("reduce_scatter", "subset")] = (
-            lambda: engine.reduce_scatter(flat, active_gpus=subset), per_rank,
+    if world >= 2:
+        ops[("all_gather", "subset")] = (
+            lambda: engine.all_gather(flat, active_gpus=subset), total,
         )
+        if elems % world == 0:
+            ops[("reduce_scatter", "subset")] = (
+                lambda: engine.reduce_scatter(flat, active_gpus=subset), per_rank,
+            )
     if not two_level:
         ops[("allreduce", "pallas_ring")] = (
             lambda: engine.ring_allreduce(flat), per_rank,
@@ -174,9 +177,10 @@ def _make_ops(engine, elems: int, dtype=jnp.float32) -> Dict[str, tuple]:
             np.asarray(flat).reshape(world, world, elems // world), sharding
         )
         ops[("all_to_all", "xla")] = (lambda: engine.all_to_all(blocked), total)
-        ops[("all_to_all", "subset")] = (
-            lambda: engine.all_to_all(blocked, active_gpus=subset), total,
-        )
+        if world >= 2:
+            ops[("all_to_all", "subset")] = (
+                lambda: engine.all_to_all(blocked, active_gpus=subset), total,
+            )
     return ops
 
 

@@ -205,6 +205,12 @@ def exec_relative_busbw(
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    # honor JAX_PLATFORMS despite the axon sitecustomize platform pin —
+    # without this the --exec path tries the TPU tunnel even on a cpu run
+    from adapcc_tpu.launch.launcher import apply_platform_env
+
+    apply_platform_env()
+
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--worlds", default="32,64",
                     help="comma list of world sizes (8 ranks per host)")
