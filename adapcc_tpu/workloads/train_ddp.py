@@ -56,6 +56,12 @@ def build_parser() -> argparse.ArgumentParser:
         "optimizer on a flat fp32 master (both beyond the reference)",
     )
     p.add_argument(
+        "--zero1-ring", action="store_true",
+        help="zero1: ride the Pallas ICI ring kernels for the "
+        "reduce-scatter/all-gather pair instead of XLA's (the hand-tuned "
+        "data plane; shards become VMEM-tile aligned)",
+    )
+    p.add_argument(
         "--min-shard-elems", type=int, default=2**14,
         help="fsdp: leaves smaller than this stay replicated",
     )
@@ -164,6 +170,8 @@ def main(argv=None) -> None:
                 "--coordinator/--no-bsp/--profile_freq require --dp-mode ddp "
                 "(relay and re-adaptation ride the DDP gradient hook)"
             )
+    if args.zero1_ring and args.dp_mode != "zero1":
+        raise ValueError("--zero1-ring requires --dp-mode zero1")
     # join the multi-host world if the launcher set the coordinator env
     from adapcc_tpu.launch import maybe_initialize_distributed
 
@@ -208,7 +216,7 @@ def main(argv=None) -> None:
     elif args.dp_mode == "zero1":
         from adapcc_tpu.parallel import Zero1Optimizer, zero1_train_step
 
-        z_opt = Zero1Optimizer(tx, mesh)
+        z_opt = Zero1Optimizer(tx, mesh, ring=args.zero1_ring)
         master, z_state = z_opt.init(params)
         z_step = zero1_train_step(loss_fn, z_opt, mesh)
 

@@ -418,6 +418,29 @@ def test_train_ddp_sharded_dp_modes(mode, capsys):
         assert m and int(m.group(1)) > 0, out
 
 
+def test_train_ddp_zero1_ring_cli(capsys):
+    """--zero1-ring rides the Pallas ring data plane through the CLI."""
+    from adapcc_tpu.workloads.train_ddp import main as ddp_main
+
+    ddp_main([
+        "--model", "mlp", "--steps", "2", "--batch", "16",
+        "--dp-mode", "zero1", "--zero1-ring", "--entry_point", "-1",
+        "--world", "4",
+    ])
+    out = capsys.readouterr().out
+    assert "mode=zero1" in out and "step    1" in out
+
+
+def test_train_ddp_zero1_ring_requires_zero1_mode():
+    from adapcc_tpu.workloads.train_ddp import main as ddp_main
+
+    with pytest.raises(ValueError, match="--zero1-ring requires"):
+        ddp_main([
+            "--model", "mlp", "--steps", "1", "--dp-mode", "ddp",
+            "--zero1-ring", "--entry_point", "-1", "--world", "4",
+        ])
+
+
 def test_train_ddp_sharded_mode_rejects_relay_flags():
     """The incompatible-flag error fires before any AdapCC/coordinator side
     effects (no gRPC server or engine is started for the doomed run)."""
