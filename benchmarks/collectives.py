@@ -125,14 +125,17 @@ def _make_ops(engine, elems: int, dtype=jnp.float32) -> Dict[str, tuple]:
     total = per_rank * world
 
     two_level = getattr(engine, "two_level", False)
+    # gather/scatter route hierarchically on a (dcn, ici) mesh — label the
+    # rows with the impl that actually runs, not the flat default
+    gs_impl = "two_level" if two_level else "xla"
     ops: Dict[str, tuple] = {
         ("allreduce", "xla"): (lambda: engine.all_reduce(flat), per_rank),
         ("allreduce", "strategy"): (
             lambda: engine.all_reduce(flat, active_gpus=list(range(world))),
             per_rank,
         ),
-        ("all_gather", "xla"): (lambda: engine.all_gather(flat), total),
-        ("reduce_scatter", "xla"): (lambda: engine.reduce_scatter(flat), per_rank),
+        ("all_gather", gs_impl): (lambda: engine.all_gather(flat), total),
+        ("reduce_scatter", gs_impl): (lambda: engine.reduce_scatter(flat), per_rank),
     }
     # subset rows: one rank masked out — regression-pins the cost of the
     # active-mask relay path on the gather/scatter primitives (VERDICT r4
@@ -176,7 +179,7 @@ def _make_ops(engine, elems: int, dtype=jnp.float32) -> Dict[str, tuple]:
         blocked = jax.device_put(
             np.asarray(flat).reshape(world, world, elems // world), sharding
         )
-        ops[("all_to_all", "xla")] = (lambda: engine.all_to_all(blocked), total)
+        ops[("all_to_all", gs_impl)] = (lambda: engine.all_to_all(blocked), total)
         if world >= 2:
             ops[("all_to_all", "subset")] = (
                 lambda: engine.all_to_all(blocked, active_gpus=subset), total,
@@ -268,7 +271,11 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     ap.add_argument("--world", type=int, default=0, help="mesh size (default: all devices)")
     ap.add_argument("--sizes", default="4K,64K,1M,16M", help="comma list, K/M/G suffixes")
     ap.add_argument("--collectives", default="", help="comma subset (default: all)")
-    ap.add_argument("--impls", default="", help="comma subset of xla,strategy,pallas_ring")
+    ap.add_argument(
+        "--impls", default="",
+        help="comma subset of xla,strategy,pallas_ring,subset "
+        "(plus two_level on a --two-level mesh)",
+    )
     ap.add_argument("--iters", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=2)
     ap.add_argument("--strategy", choices=["ring", "binary"], default="binary")
@@ -321,8 +328,10 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         strategy = Synthesizer(None, mesh_ip_table(mesh)).synthesize(
             ALLREDUCE, args.trans, 4 << 20, ones, ones
         )
-        if impls is None:
-            impls = ["xla", "strategy"]  # the Pallas ring is a flat-mesh kernel
+        # impls stays None (no filter): _make_ops already emits only the
+        # surfaces a two-level mesh supports (no pallas_ring rows there),
+        # and a hardcoded label list would silently drop any future impl —
+        # exactly the bug that once hid the two_level/subset rows
     else:
         world = args.world or len(jax.devices())
         mesh = build_world_mesh(world)
