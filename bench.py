@@ -30,7 +30,8 @@ the round's measured number.
 
 Size knobs via env (defaults target a single v5e chip):
     BENCH_LAYERS, BENCH_DMODEL, BENCH_HEADS, BENCH_SEQ, BENCH_BATCH,
-    BENCH_STEPS, BENCH_WORLD, BENCH_PEAK_TFLOPS, BENCH_ATTN (flash|xla),
+    BENCH_STEPS, BENCH_WORLD, BENCH_PEAK_TFLOPS, BENCH_HBM_GBPS,
+    BENCH_ATTN (flash|xla),
     BENCH_PARAM_DTYPE (bf16|f32), BENCH_LOSS (dense|chunked),
     BENCH_REMAT (off|full|dots|dots_no_batch), BENCH_SCAN (1|0), BENCH_ACCUM,
     BENCH_FLASH_BLOCK (flash tile edge, default 256 — measured best on v5e;
@@ -191,17 +192,37 @@ _PEAK_TFLOPS = (
 )
 
 
-def chip_peak_tflops() -> float:
+#: advertised HBM bandwidth GB/s per chip, by device_kind substring
+_HBM_GBPS = (
+    ("v5 lite", 819.0),  # v5e
+    ("v5litepod", 819.0),
+    ("v5e", 819.0),
+    ("v5p", 2765.0),
+    ("v4", 1228.0),
+    ("v6", 1640.0),  # trillium
+)
+
+
+def _chip_lookup(env_var: str, table, default: float) -> float:
+    """Env override, else device_kind substring table, else the v5e value."""
     import jax
 
-    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    env = os.environ.get(env_var)
     if env:
         return float(env)
     kind = getattr(jax.devices()[0], "device_kind", "").lower()
-    for sub, peak in _PEAK_TFLOPS:
+    for sub, value in table:
         if sub in kind:
-            return peak
-    return 197.0  # assume v5e when unrecognizable
+            return value
+    return default
+
+
+def chip_peak_tflops() -> float:
+    return _chip_lookup("BENCH_PEAK_TFLOPS", _PEAK_TFLOPS, 197.0)
+
+
+def chip_hbm_gbps() -> float:
+    return _chip_lookup("BENCH_HBM_GBPS", _HBM_GBPS, 819.0)
 
 
 def train_flops_per_token(cfg) -> float:

@@ -197,3 +197,22 @@ def test_bench_rejects_bad_opt_moments_env():
     assert out.returncode != 0
     line = out.stdout.strip().splitlines()[-1]
     assert "BENCH_OPT_MOMENTS" in json.loads(line)["error"]
+
+
+def test_chip_hbm_gbps_env_override_and_table(monkeypatch):
+    import bench
+
+    monkeypatch.setenv("BENCH_HBM_GBPS", "1234.5")
+    assert bench.chip_hbm_gbps() == 1234.5
+    monkeypatch.delenv("BENCH_HBM_GBPS")
+
+    # table path without touching a live backend (a dead tunnel must not
+    # hang this unit test): fake the device_kind lookup
+    class _Dev:
+        device_kind = "TPU v5 lite"
+
+    import jax
+
+    monkeypatch.setattr(jax, "devices", lambda: [_Dev()])
+    assert bench.chip_hbm_gbps() == 819.0
+    assert bench.chip_peak_tflops() == 197.0
