@@ -216,3 +216,50 @@ def test_chip_hbm_gbps_env_override_and_table(monkeypatch):
     monkeypatch.setattr(jax, "devices", lambda: [_Dev()])
     assert bench.chip_hbm_gbps() == 819.0
     assert bench.chip_peak_tflops() == 197.0
+
+
+def test_flash_autotune_sweep_selection_logic(monkeypatch):
+    """The sweep picks the fastest candidate and treats a per-candidate
+    failure (e.g. VMEM overflow at 512) as infinitely slow — exercised with
+    a fake platform + fake kernel so no TPU is needed."""
+    import jax
+
+    import adapcc_tpu.ops as ops
+    from adapcc_tpu.ops import flash_autotune as fa
+
+    class _Dev:
+        platform = "tpu"
+
+    monkeypatch.setattr(jax, "devices", lambda: [_Dev()])
+
+    calls = []
+
+    def fake_flash(q, k, v, causal=True, block_q=128, block_k=128):
+        calls.append(block_q)
+        if block_q == 512:
+            raise RuntimeError("VMEM overflow")
+        # "time" is simulated by work volume: block 256 does the least
+        import jax.numpy as jnp
+
+        reps = {128: 40, 256: 1}[block_q]
+        out = q
+        for _ in range(reps):
+            out = out + q * 1e-6
+        return out
+
+    monkeypatch.setattr(ops, "flash_attention", fake_flash)
+    fa._cache.clear()
+    try:
+        best = fa.autotune_flash_block(
+            512, d_head=8, batch=1, heads=1, warmup=2, iters=2
+        )
+        timings = fa.last_timings(512, d_head=8)
+        assert best == 256, timings
+        assert timings[512] == float("inf")  # failed candidate marked slow
+        assert {128, 256, 512} <= set(calls)  # all candidates attempted
+        # cached: no new kernel calls on the second query
+        n = len(calls)
+        assert fa.autotune_flash_block(512, d_head=8, batch=1, heads=1) == 256
+        assert len(calls) == n
+    finally:
+        fa._cache.clear()
