@@ -25,18 +25,21 @@ proven round-4 A/Bs last):
   8. bench_t16k    — long context: T=16384, flash + chunked CE + remat dots
   9. bench_t8k_xla — T=8192 with DENSE attention: documents the memory wall
                      flash removes (expected OOM/fallback — rc may be != 0)
- 10. longcontext   — benchmarks/longcontext.py world=1: single vs ring-flash
-                     attention ms + score-memory curve at 2K/8K
- 11. zero1_ab      — benchmarks/zero1_ab.py: ZeRO-1 step, XLA vs Pallas
+ 10. longcontext   — benchmarks/longcontext.py world=1: ring-flash attention
+                     ms + score-memory curve at 2K/8K/16K
+ 11. longcontext_single — the dense single-device baseline at 2K/8K, in its
+                     own process (the 8K score tensor may OOM — that IS the
+                     memory-wall row, isolated so it can't kill flash rows)
+ 12. zero1_ab      — benchmarks/zero1_ab.py: ZeRO-1 step, XLA vs Pallas
                      ring data plane (world=1: plumbing-cost statement)
- 12. bench_chunk   — bench.py with BENCH_LOSS=chunked
- 13. bench_remat   — bench.py with BENCH_REMAT=dots
- 14. bench_loop    — bench.py with BENCH_SCAN=0: per-step dispatch instead of
+ 13. bench_chunk   — bench.py with BENCH_LOSS=chunked
+ 14. bench_remat   — bench.py with BENCH_REMAT=dots
+ 15. bench_loop    — bench.py with BENCH_SCAN=0: per-step dispatch instead of
                      the scanned window; (bench_loop.step_ms - bench.step_ms)
                      IS the tunnel's per-dispatch tax (PERF_NOTES hyp. 2/5)
- 15. bench_fblk128 — bench.py with BENCH_FLASH_BLOCK=128: flash tile A/B vs
+ 16. bench_fblk128 — bench.py with BENCH_FLASH_BLOCK=128: flash tile A/B vs
                      the 256 default (VMEM residency vs grid parallelism)
- 16. busbw         — benchmarks/collectives.py on the real chip (world=1)
+ 17. busbw         — benchmarks/collectives.py on the real chip (world=1)
 
 Usage::
 
@@ -96,6 +99,17 @@ def _run(name: str, cmd, timeout: int, out_path: str, extra_env=None) -> dict:
             rec["parsed"] = json.loads(rec["last_line"])
         except (json.JSONDecodeError, ValueError):
             rec["stderr_tail"] = (p.stderr or "")[-400:]
+        # sweep phases (longcontext, zero1_ab, busbw --json) print one JSON
+        # row per measurement — persist them ALL, not just the last line
+        # (tunnel time must never produce rows the artifact then drops)
+        rows = []
+        for line in tail:
+            try:
+                rows.append(json.loads(line))
+            except (json.JSONDecodeError, ValueError):
+                continue
+        if len(rows) > 1:
+            rec["rows"] = rows
     except subprocess.TimeoutExpired:
         rec["rc"] = -1
         rec["secs"] = round(time.time() - t0, 1)
@@ -167,12 +181,22 @@ def main() -> int:
         {"BENCH_DEADLINE": "600", "BENCH_SEQ": "8192", "BENCH_BATCH": "2",
          "BENCH_LOSS": "chunked", "BENCH_ATTN": "xla", "BENCH_STEPS": "5"},
     )
+    # flash rows first and in their own process: the dense "single" scheme
+    # at 8K materializes a ~4 GB score tensor and may OOM — that row is the
+    # memory-wall documentation and must not take the flash rows with it
     _run(
         "longcontext",
         [py, "-m", "benchmarks.longcontext", "--world", "1",
-         "--seqs", "2K,8K", "--schemes", "single,ring-flash",
+         "--seqs", "2K,8K,16K", "--schemes", "ring-flash",
          "--heads", "16", "--head-dim", "64", "--batch", "1", "--json"],
         900, out,
+    )
+    _run(
+        "longcontext_single",
+        [py, "-m", "benchmarks.longcontext", "--world", "1",
+         "--seqs", "2K,8K", "--schemes", "single",
+         "--heads", "16", "--head-dim", "64", "--batch", "1", "--json"],
+        700, out,
     )
     _run(
         "zero1_ab", [py, "-m", "benchmarks.zero1_ab", "--json"], 900, out,

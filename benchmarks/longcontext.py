@@ -141,26 +141,31 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     args = ap.parse_args(argv)
 
     world = args.world or len(jax.devices())
-    results = run_sweep(
-        world,
-        [parse_size(s) for s in args.seqs.split(",") if s],
-        heads=args.heads,
-        head_dim=args.head_dim,
-        batch=args.batch,
-        iters=args.iters,
-        schemes=[s for s in args.schemes.split(",") if s] or None,
-    )
-    if args.json:
-        for r in results:
-            print(r.to_json())
-    else:
+    if not args.json:
         print(f"# world={world} platform={jax.devices()[0].platform}")
         print(f"{'scheme':<12}{'seq':>8}{'fwd+bwd(ms)':>14}{'score-bytes/dev':>18}")
+    # one run_sweep per seq, rows flushed as they land: an OOM at a later
+    # sequence length (the dense path's expected fate at 8K+) must not eat
+    # the measurements already taken at the shorter ones
+    for seq in (parse_size(s) for s in args.seqs.split(",") if s):
+        results = run_sweep(
+            world,
+            [seq],
+            heads=args.heads,
+            head_dim=args.head_dim,
+            batch=args.batch,
+            iters=args.iters,
+            schemes=[s for s in args.schemes.split(",") if s] or None,
+        )
         for r in results:
-            print(
-                f"{r.scheme:<12}{r.seq:>8}{r.fwd_bwd_ms:>14.1f}"
-                f"{r.score_bytes_per_device:>18,}"
-            )
+            if args.json:
+                print(r.to_json(), flush=True)
+            else:
+                print(
+                    f"{r.scheme:<12}{r.seq:>8}{r.fwd_bwd_ms:>14.1f}"
+                    f"{r.score_bytes_per_device:>18,}",
+                    flush=True,
+                )
 
 
 if __name__ == "__main__":

@@ -395,3 +395,40 @@ def test_committed_busbw_r05_artifact_has_subset_and_ring_rows():
         ("all_gather", "pallas_ring"), ("allreduce", "pallas_ring"),
     ):
         assert want in seen, f"busbw_virtual8_r05 lost {want}"
+
+
+def test_hw_session_run_persists_all_json_rows(tmp_path):
+    """Sweep phases print one JSON row per measurement; _run must persist
+    every parseable row, not just the last line (tunnel time must never
+    produce rows the artifact then drops)."""
+    import json as _json
+    import sys
+
+    from benchmarks.hw_session import _run
+
+    out = str(tmp_path / "hw_test.jsonl")
+    code = (
+        "import json\n"
+        "for i in range(3):\n"
+        "    print(json.dumps({'row': i}))\n"
+    )
+    rec = _run("fake_sweep", [sys.executable, "-c", code], 60, out)
+    assert rec["rc"] == 0
+    assert rec["parsed"] == {"row": 2}  # last-line contract intact
+    assert rec["rows"] == [{"row": 0}, {"row": 1}, {"row": 2}]
+    on_disk = [_json.loads(l) for l in open(out)]
+    assert on_disk[-1]["rows"][0] == {"row": 0}
+
+
+def test_longcontext_streams_rows_per_seq(capsys):
+    """Rows flush per sequence length: an OOM at a later seq must not eat
+    the earlier measurements (battery longcontext_single contract)."""
+    import json as _json
+
+    from benchmarks.longcontext import main as lc_main
+
+    lc_main(["--world", "2", "--seqs", "128,256", "--heads", "2",
+             "--head-dim", "8", "--batch", "1", "--iters", "1",
+             "--schemes", "ring", "--json"])
+    rows = [_json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert [r["seq"] for r in rows] == [128, 256]
