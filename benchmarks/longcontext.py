@@ -129,6 +129,12 @@ def run_sweep(
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
+    # honor JAX_PLATFORMS despite the axon sitecustomize platform pin —
+    # a cpu run must not stall on a dead TPU tunnel's backend init
+    from adapcc_tpu.launch.launcher import apply_platform_env
+
+    apply_platform_env()
+
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--world", type=int, default=0)
     ap.add_argument("--seqs", default="1K,4K")
