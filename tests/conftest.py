@@ -47,10 +47,12 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
     them."""
     wall = time.time() - _SUITE_T0
     # budget history: r3 421 tests / 936 s (budget 960); r4 468 tests /
-    # ~1080 s standalone — growth is accounted coverage (ResNet family,
-    # SyncBN stateful trainer, hardware-artifact pins, doc snippet), so the
-    # ceiling moves once, to 1200 s.  The guard's job is unexplained growth.
-    budget = float(os.environ.get("ADAPCC_SUITE_BUDGET_S", "1200"))
+    # ~1080 s standalone (ceiling 1200); r5 ~520 tests / ~1330 s — growth
+    # is accounted coverage (ring RS/AG + ZeRO-1 ring data plane, fault
+    # drill, pod-scale synthesis + fixtures, subset collective oracles,
+    # OPERATIONS doc snippets, bench knob subprocess tests), so the
+    # ceiling moves to 1500 s.  The guard's job is unexplained growth.
+    budget = float(os.environ.get("ADAPCC_SUITE_BUDGET_S", "1500"))
     # count tests that RAN (deselected fast-lane tests must not trip the
     # full-suite gate; stats keys are public API, unlike _numcollected)
     n_run = sum(
