@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test clean
+.PHONY: all native test sim-bench clean
 
 all: native
 
@@ -17,6 +17,13 @@ $(LIB): $(SRCS)
 
 test: native
 	python -m pytest tests/ -q
+
+# Hardware-free collective sweep on the calibrated α-β simulator
+# (docs/SIMULATION.md).  Deterministic: same calibration artifact →
+# byte-identical rows, so it runs in CI alongside the tier-1 tests.
+sim-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--world 8 --sizes 4K,1M,16M --json
 
 clean:
 	rm -f $(LIB)

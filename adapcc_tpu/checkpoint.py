@@ -32,6 +32,12 @@ from flax import serialization
 
 # --- snapshot container (reference State, main_elastic.py:188-237) ------------
 
+#: ``extra`` keys that describe the *layout* of the stored tensors: when the
+#: in-memory state declares one (e.g. Zero1Optimizer.checkpoint_extra's
+#: "zero1_layout"), a loaded snapshot must match it exactly — restoring a
+#: chunk-permuted master under a flipped layout must fail loudly, not load
+LAYOUT_GUARD_KEYS = ("zero1_layout",)
+
 
 @dataclass
 class TrainCheckpointState:
@@ -57,7 +63,16 @@ class TrainCheckpointState:
         }
 
     def apply_snapshot(self, obj: Dict[str, Any]) -> None:
-        """Mutates this state from a snapshot (reference apply_snapshot)."""
+        """Mutates this state from a snapshot (reference apply_snapshot).
+
+        Layout-guard keys declared by the in-memory ``extra`` are enforced
+        against the snapshot before anything mutates: every load funnel
+        (load_checkpoint, CheckpointManager.restore, the elastic rendezvous
+        broadcast) routes through here, so a resume whose optimizer layout
+        (ring/world/align) differs from what was saved raises instead of
+        silently loading permuted tensors.
+        """
+        self._enforce_layout_guard(obj.get("extra"))
         self.epoch = int(obj["epoch"])
         self.step = int(obj["step"])
         self.best_metric = float(obj["best_metric"])
@@ -65,12 +80,55 @@ class TrainCheckpointState:
         self.opt_state = obj["opt_state"]
         self.extra = dict(obj.get("extra", {}))
 
+    def _enforce_layout_guard(self, incoming_extra: Any) -> None:
+        incoming = dict(incoming_extra or {})
+        for key in LAYOUT_GUARD_KEYS:
+            expected = (self.extra or {}).get(key)
+            if expected is not None and incoming.get(key) != expected:
+                raise ValueError(
+                    f"checkpoint layout mismatch on extra[{key!r}]: "
+                    f"saved={incoming.get(key)!r} vs resuming="
+                    f"{expected!r}; restoring would load permuted tensors "
+                    "— resume with the matching configuration or re-shard "
+                    "offline"
+                )
+            if (
+                expected is None
+                and incoming.get(key) is not None
+                and self.opt_state is not None
+            ):
+                # the checkpoint's optimizer state was saved under a sharded
+                # layout this resume never declared: restoring it blind is
+                # the silent chunk-permutation hazard the tag exists to
+                # close.  Params-only loads (opt_state=None templates, e.g.
+                # inference) are unaffected — params are not permuted.
+                raise ValueError(
+                    f"checkpoint carries a layout tag extra[{key!r}] but "
+                    "this resume declares none; stamp the resuming state's "
+                    "extra (DDPTrainer.checkpoint_extra() / "
+                    "Zero1Optimizer.checkpoint_extra()) so the layout can "
+                    "be verified, or load with opt_state=None for "
+                    "params-only use"
+                )
+
     def to_bytes(self) -> bytes:
         return serialization.to_bytes(self.capture_snapshot())
 
     def load_bytes(self, blob: bytes) -> None:
         template = self.capture_snapshot()
-        self.apply_snapshot(serialization.from_bytes(template, blob))
+        # decode once, then guard on the RAW extra before flax template
+        # matching (from_bytes is msgpack_restore + from_state_dict).  The
+        # raw peek is load-bearing in both guard directions: a declaring
+        # state resuming an untagged legacy blob must get the guard's
+        # actionable message (not flax's raw key-mismatch), and a tagged
+        # blob restored into an undeclared optimizer-carrying state must
+        # refuse — from_state_dict silently DROPS unknown extra keys, so
+        # apply_snapshot alone would never see the tag
+        raw = serialization.msgpack_restore(blob)
+        self._enforce_layout_guard(
+            raw.get("extra") if isinstance(raw, dict) else None
+        )
+        self.apply_snapshot(serialization.from_state_dict(template, raw))
 
 
 # --- single-file atomic checkpoints (main_elastic.py:395-410) -----------------

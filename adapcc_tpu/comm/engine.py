@@ -617,9 +617,13 @@ class CollectiveEngine:
     def all_reduce(
         self,
         stacked: jnp.ndarray,
+        *,
         active_gpus: Optional[Sequence[int]] = None,
         op: ReduceOp = ReduceOp.SUM,
     ) -> jnp.ndarray:
+        # keyword-only for the same reason as reduce_scatter: a positional
+        # all_reduce(t, ReduceOp.AVG) must fail at the call site, not bind
+        # the enum to active_gpus
         self._check_world_dim(stacked, "all_reduce")
         mask = self._active_to_mask(active_gpus)
         if self.use_xla_fastpath and active_gpus is None:
@@ -653,6 +657,7 @@ class CollectiveEngine:
     def reduce(
         self,
         stacked: jnp.ndarray,
+        *,
         active_gpus: Optional[Sequence[int]] = None,
         op: ReduceOp = ReduceOp.SUM,
     ) -> jnp.ndarray:
@@ -926,10 +931,16 @@ class CollectiveEngine:
     def reduce_scatter(
         self,
         stacked: jnp.ndarray,
+        *,
         active_gpus: Optional[Sequence[int]] = None,
         op: ReduceOp = ReduceOp.SUM,
     ) -> jnp.ndarray:
         """Reduce-scatter with subset semantics (reference stub: REDUCESCATTER).
+
+        ``active_gpus``/``op`` are keyword-only: a positional
+        ``reduce_scatter(t, ReduceOp.AVG)`` predates the active_gpus
+        parameter and must fail loudly rather than bind the enum to the
+        mask (ADVICE r5).
 
         Row ``r`` of the result is the reduction of everyone's ``r``-th
         world-slice: input ``[world, n]`` → output ``[world, n // world]``.

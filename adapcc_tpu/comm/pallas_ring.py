@@ -155,6 +155,17 @@ def _pad_chunks(flat: jnp.ndarray, world: int):
 
 def _run_ring_chunks(chunks: jnp.ndarray, *, world, axis_name, rs, ag, interpret):
     """Run the ring kernel on a pre-chunked ``[world, S, 128]`` array."""
+    from adapcc_tpu.compat import ring_kernels_supported
+
+    if not ring_kernels_supported():
+        # the one funnel every ring entry point (and so --zero1-ring,
+        # engine.ring_*, the benchmarks) routes through: fail with guidance
+        # here rather than a cryptic Mosaic/legacy-pallas error deeper in
+        raise RuntimeError(
+            "Pallas ICI ring kernels need a real TPU or the Mosaic TPU "
+            "interpret mode (jax >= 0.5); this build has neither — use the "
+            "XLA collective path instead (e.g. drop --zero1-ring)"
+        )
     kernel = functools.partial(
         _ring_kernel,
         world=world,

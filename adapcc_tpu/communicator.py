@@ -274,6 +274,13 @@ class Communicator:
         parity only — shapes are static under jit, and chunking belongs to
         the compiled program (synthesis-time ``self.chunk_bytes``), so a
         per-call value is ignored rather than mutating communicator state."""
+        if isinstance(size, ReduceOp) or isinstance(chunk_bytes, ReduceOp):
+            raise TypeError(
+                "pass op= by keyword: the reference-parity positional slots "
+                "are (tensor, size, chunk_bytes, active_gpus), so a "
+                "positional ReduceOp would silently land in one of them and "
+                "the reduction would run as SUM"
+            )
         return self._engine(ALLREDUCE).all_reduce(tensor, active_gpus=active_gpus, op=op)
 
     def reduce(
@@ -284,6 +291,12 @@ class Communicator:
         active_gpus: Optional[Sequence[int]] = None,
         op: ReduceOp = ReduceOp.SUM,
     ) -> jnp.ndarray:
+        if isinstance(size, ReduceOp) or isinstance(chunk_bytes, ReduceOp):
+            raise TypeError(
+                "pass op= by keyword: a positional ReduceOp would silently "
+                "land in 'size'/'chunk_bytes' and the reduction would run "
+                "as SUM"
+            )
         return self._engine(REDUCE).reduce(tensor, active_gpus=active_gpus, op=op)
 
     def boardcast(
@@ -312,9 +325,14 @@ class Communicator:
     def reduce_scatter(
         self,
         tensor: jnp.ndarray,
+        *,
         active_gpus: Optional[Sequence[int]] = None,
         op: ReduceOp = ReduceOp.SUM,
     ) -> jnp.ndarray:
+        # keyword-only: ``active_gpus`` was inserted before the pre-existing
+        # ``op`` parameter, so a legacy positional ``reduce_scatter(t,
+        # ReduceOp.AVG)`` would silently bind the enum to active_gpus; now it
+        # fails at the call site instead (ADVICE r5)
         return self._engine(REDUCESCATTER).reduce_scatter(
             tensor, active_gpus=active_gpus, op=op
         )

@@ -1,0 +1,102 @@
+"""Version compatibility shims for the jax API surface this repo targets.
+
+The codebase is written against the modern ``jax.shard_map(f, mesh=...,
+in_specs=..., out_specs=..., check_vma=...)`` entry point.  Older jax
+releases (< 0.6) only ship ``jax.experimental.shard_map.shard_map`` with the
+``check_rep`` spelling of the replication-check knob.  Installing the alias
+here — imported from ``adapcc_tpu/__init__`` — keeps every call site on the
+one modern spelling instead of sprinkling try/except at 20+ call sites.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def ensure_shard_map() -> None:
+    """Install ``jax.shard_map`` on jax builds that predate it."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    @functools.wraps(_legacy)
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+        if check_vma is not None and "check_rep" not in kwargs:
+            kwargs["check_rep"] = check_vma
+        return _legacy(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+
+    jax.shard_map = shard_map
+
+
+def ensure_pallas_tpu_params() -> None:
+    """Alias ``pltpu.CompilerParams`` on jax builds that still call it
+    ``TPUCompilerParams`` (renamed upstream around jax 0.5)."""
+    try:
+        import jax.experimental.pallas.tpu as pltpu
+    except ImportError:  # pallas not available on this build at all
+        return
+    if not hasattr(pltpu, "CompilerParams") and hasattr(pltpu, "TPUCompilerParams"):
+        import dataclasses
+
+        legacy_fields = {f.name for f in dataclasses.fields(pltpu.TPUCompilerParams)}
+
+        def _compiler_params(**kwargs):
+            # drop knobs the legacy dataclass doesn't know (has_side_effects
+            # moved into CompilerParams upstream; legacy pallas_call keeps
+            # the kernel alive through its data dependency instead)
+            return pltpu.TPUCompilerParams(
+                **{k: v for k, v in kwargs.items() if k in legacy_fields}
+            )
+
+        pltpu.CompilerParams = _compiler_params
+    if not hasattr(pltpu, "InterpretParams"):
+        class _InterpretParams:
+            """Stand-in for the Mosaic TPU interpret-mode params (jax >= 0.5).
+
+            Legacy pallas_call only understands ``interpret: bool``; kernels
+            that need the TPU interpreter's cross-device semantics
+            (semaphores, remote DMA) cannot run on this build and surface
+            their own errors.  Truthiness routes the generic interpreter.
+            """
+
+            _adapcc_shim = True
+
+            def __init__(self, **kwargs):
+                self.kwargs = kwargs
+
+            def __bool__(self):
+                return True
+
+        pltpu.InterpretParams = _InterpretParams
+
+
+def tpu_interpret_mode_available() -> bool:
+    """Whether this jax build ships the Mosaic TPU interpreter (semaphores,
+    remote DMA) rather than the shimmed stand-in above."""
+    try:
+        import jax.experimental.pallas.tpu as pltpu
+    except ImportError:
+        return False
+    return not getattr(
+        getattr(pltpu, "InterpretParams", None), "_adapcc_shim", False
+    )
+
+
+def ring_kernels_supported() -> bool:
+    """Whether the Pallas ICI ring kernels can execute here: a real TPU runs
+    them through Mosaic; anywhere else they need the TPU interpret mode
+    (cross-device semaphore/remote-DMA emulation, jax >= 0.5)."""
+    import jax
+
+    if jax.devices()[0].platform == "tpu":
+        return True
+    return tpu_interpret_mode_available()
+
+
+def install() -> None:
+    ensure_shard_map()
+    ensure_pallas_tpu_params()

@@ -25,7 +25,9 @@ class CommArgs:
     profile_freq: int = 0
     #: directory holding the XML/CSV topology artifacts
     topology_dir: str = "topology"
-    #: synthesis policy: par-trees | milp | ring | binary
+    #: synthesis policy: par-trees | milp | ring | binary | sim-rank
+    #: (sim-rank commits to whichever candidate the calibrated α-β replay
+    #: predicts fastest — docs/SIMULATION.md)
     policy: str = "par-trees"
     #: BSP mode: stragglers skip the collective and reuse stale gradients;
     #: async mode replays their buckets through relay buffers later

@@ -183,6 +183,7 @@ class DDPTrainer:
         self.measure_gns = measure_gns
         self._gns: Optional[Any] = None
         self._gns_pending: list = []
+        self._zero1_opt: Optional[Any] = None
 
     # -- step program ----------------------------------------------------------
 
@@ -193,7 +194,7 @@ class DDPTrainer:
             return TrainState.create(params, self.tx, model_state=model_state)
         from adapcc_tpu.parallel.fsdp import Zero1Optimizer
 
-        opt = Zero1Optimizer(
+        opt = self._zero1_opt = Zero1Optimizer(
             self.tx, self.mesh, self.axis_name, ring=self.zero1_ring
         )
         master, opt_state = opt.init(params)
@@ -203,6 +204,22 @@ class DDPTrainer:
             step=jnp.zeros((), jnp.int32),
             model_state=model_state,
         )
+
+    def checkpoint_extra(self, extra: Optional[dict] = None) -> dict:
+        """``TrainCheckpointState.extra`` payload for this trainer's state.
+
+        In ZeRO-1 mode it stamps the optimizer's layout tag (ring/world/
+        align), which ``checkpoint.py``'s layout guard enforces on every
+        load — a resume with ``--zero1-ring`` flipped fails loudly instead
+        of silently loading a chunk-permuted master."""
+        if not self.zero1:
+            return dict(extra or {})
+        if self._zero1_opt is None:
+            raise ValueError(
+                "call init_state(params) before checkpoint_extra(): the "
+                "layout tag records the constructed optimizer's geometry"
+            )
+        return self._zero1_opt.checkpoint_extra(extra)
 
     def _check_state(self, state: TrainState) -> None:
         """Catch the common zero1 misuse (TrainState.create's replicated

@@ -57,15 +57,18 @@ def autotune_flash_block(
 
     Returns the winning block edge; the per-candidate timings are kept in
     :func:`last_timings` for artifact/bench reporting.  Results are cached
-    per (platform, seq, d_head, dtype) for the process lifetime — the sweep
-    runs once per shape, not once per call.
+    per (platform, seq, d_head, dtype, causal, batch, heads) for the process
+    lifetime — the sweep runs once per full problem shape, not once per call.
     """
     import jax
     import jax.numpy as jnp
 
     dtype = dtype or jnp.bfloat16
     platform = jax.devices()[0].platform
-    key = (platform, seq, d_head, jnp.dtype(dtype).name, causal)
+    # batch/heads are part of the key: timings depend on the full problem
+    # shape, and a second call at a different batch/head count must re-sweep
+    # rather than silently reuse the first shape's winner (ADVICE r5)
+    key = (platform, seq, d_head, jnp.dtype(dtype).name, causal, batch, heads)
     if key in _cache:
         return _cache[key][0]
 
@@ -111,16 +114,24 @@ def autotune_flash_block(
 
 
 def last_timings(
-    seq: int, d_head: int = 64, dtype=None, causal: bool = True
+    seq: int,
+    d_head: int = 64,
+    dtype=None,
+    causal: bool = True,
+    batch: int = 2,
+    heads: int = 8,
 ) -> Optional[Dict[int, float]]:
     """Per-candidate seconds from the cached sweep for this shape (None if
-    the sweep has not run; empty dict if it was skipped off-TPU)."""
+    the sweep has not run; empty dict if it was skipped off-TPU).  The
+    ``batch``/``heads`` defaults mirror :func:`autotune_flash_block` so the
+    bare lookup matches the bare sweep."""
     import jax
     import jax.numpy as jnp
 
     dtype = dtype or jnp.bfloat16
     key = (
-        jax.devices()[0].platform, seq, d_head, jnp.dtype(dtype).name, causal
+        jax.devices()[0].platform, seq, d_head, jnp.dtype(dtype).name, causal,
+        batch, heads,
     )
     hit = _cache.get(key)
     return hit[1] if hit else None

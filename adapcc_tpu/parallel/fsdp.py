@@ -28,7 +28,7 @@ with ``jax.jit`` donation for in-place updates.
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Optional, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -442,6 +442,68 @@ class Zero1Optimizer:
             check_vma=False,
         )
         return jax.jit(fn, donate_argnums=(0, 1))
+
+    # -- checkpoint layout tagging ---------------------------------------------
+
+    #: key under which the layout tag rides in ``TrainCheckpointState.extra``
+    LAYOUT_KEY = "zero1_layout"
+
+    def layout_metadata(self) -> Dict[str, Any]:
+        """The master/opt-state layout this optimizer produces: ring mode
+        permutes chunk ownership (row ``r`` holds chunk ``(r+1) % world``)
+        and tile-aligns shards, so ring and non-ring checkpoints are NOT
+        interchangeable — the tag makes a flipped ``--zero1-ring`` resume
+        fail loudly instead of silently loading permuted master weights."""
+        return {"ring": self.ring, "align": self._align(), "world": self.world}
+
+    def checkpoint_extra(self, extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """``TrainCheckpointState.extra`` payload with the layout recorded."""
+        out = dict(extra or {})
+        out[self.LAYOUT_KEY] = self.layout_metadata()
+        return out
+
+    def validate_checkpoint_extra(self, extra: Optional[Dict[str, Any]]) -> None:
+        """Raise unless the checkpoint's recorded layout matches this
+        optimizer's.  A checkpoint with no tag is also rejected: an untagged
+        ZeRO-1 master is exactly the silent-corruption hazard the tag
+        exists to close."""
+        recorded = (extra or {}).get(self.LAYOUT_KEY)
+        if recorded is None:
+            raise ValueError(
+                "checkpoint has no zero1 layout tag (extra["
+                f"{self.LAYOUT_KEY!r}]); refusing to restore a ZeRO-1 master "
+                "of unknown chunk layout — re-save with "
+                "Zero1Optimizer.checkpoint_extra()"
+            )
+        expected = self.layout_metadata()
+        mismatches = {
+            k: (recorded.get(k), v)
+            for k, v in expected.items()
+            if recorded.get(k) != v
+        }
+        if mismatches:
+            detail = ", ".join(
+                f"{k}: checkpoint={a!r} vs optimizer={b!r}"
+                for k, (a, b) in sorted(mismatches.items())
+            )
+            raise ValueError(
+                f"ZeRO-1 checkpoint layout mismatch ({detail}); restoring "
+                "would load chunk-permuted master weights — resume with the "
+                "matching ring/world configuration or re-shard offline"
+            )
+
+    def restore(self, ckpt: Any) -> Tuple[jnp.ndarray, Any]:
+        """Validated restore from a :class:`TrainCheckpointState`-shaped
+        object whose ``opt_state`` is the ``(master, opt shard)`` pair and
+        whose ``extra`` carries the layout tag; returns the pair placed on
+        this optimizer's sharding."""
+        self.validate_checkpoint_extra(getattr(ckpt, "extra", None))
+        master, opt_state = ckpt.opt_state
+        sharding = NamedSharding(self.mesh, P(self.axis_name))
+        return (
+            jax.device_put(jnp.asarray(master), sharding),
+            jax.device_put(opt_state, sharding),
+        )
 
     def apply(
         self, master: jnp.ndarray, opt_state: Any, grads: Any

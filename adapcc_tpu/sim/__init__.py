@@ -1,0 +1,86 @@
+"""Trace-driven collective simulator and calibrated α-β cost model.
+
+Round 5 landed every performance lever with the TPU tunnel dead: nothing
+could be ranked or regressed because every number needed live hardware.
+This package is the hardware-free half of the profile → synthesize → execute
+loop: an analytical per-link α-β (latency + inverse-bandwidth) cost model
+calibrated from the profiler's probe CSVs or committed hardware-battery
+traces, a discrete-event engine that replays schedule-IR rounds with chunk
+pipelining and link contention, and a ranking API the synthesizer and the
+bench harness use when the backend is unreachable.
+
+The same modeling family TACCL and SCCL (PAPERS.md) use to rank candidate
+schedules offline — here wired to this repo's strategy IR, relay masks, and
+artifact formats.
+
+Layers:
+
+- :mod:`adapcc_tpu.sim.cost_model` — per-link α-β coefficients with ICI/DCN
+  link classes, least-squares fit from probe points;
+- :mod:`adapcc_tpu.sim.events` — discrete-event replay of communication
+  rounds (chunk pipelining, merged-tree round coloring, link/port
+  contention);
+- :mod:`adapcc_tpu.sim.replay` — lower strategies / XML schedules / flow-LP
+  solutions into simulated timelines;
+- :mod:`adapcc_tpu.sim.rank` — strategy ranking + straggler/relay
+  degradation prediction;
+- :mod:`adapcc_tpu.sim.calibrate` — fit + persist calibration artifacts so
+  simulated numbers stay anchored to the last good hardware round.
+"""
+
+from adapcc_tpu.sim.cost_model import (
+    DCN,
+    ICI,
+    LinkCoeffs,
+    LinkCostModel,
+    fit_alpha_beta,
+)
+from adapcc_tpu.sim.events import EventSimulator, SimReport, Transfer, TreeSchedule
+from adapcc_tpu.sim.replay import (
+    SimTimeline,
+    simulate_broadcast,
+    simulate_flow_broadcast,
+    simulate_reduce,
+    simulate_strategy,
+    simulate_xml,
+)
+from adapcc_tpu.sim.rank import (
+    RankedCandidate,
+    predict_degradation,
+    rank_candidates,
+    relay_latency,
+)
+from adapcc_tpu.sim.calibrate import (
+    Calibration,
+    calibrate_from_battery,
+    calibrate_from_matrices,
+    calibrate_from_profile_dir,
+    load_calibration,
+)
+
+__all__ = [
+    "DCN",
+    "ICI",
+    "LinkCoeffs",
+    "LinkCostModel",
+    "fit_alpha_beta",
+    "EventSimulator",
+    "SimReport",
+    "Transfer",
+    "TreeSchedule",
+    "SimTimeline",
+    "simulate_broadcast",
+    "simulate_flow_broadcast",
+    "simulate_reduce",
+    "simulate_strategy",
+    "simulate_xml",
+    "RankedCandidate",
+    "predict_degradation",
+    "rank_candidates",
+    "relay_latency",
+    "Calibration",
+    "calibrate_from_battery",
+    "calibrate_from_matrices",
+    "calibrate_from_profile_dir",
+    "load_calibration",
+]

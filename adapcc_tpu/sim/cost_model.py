@@ -1,0 +1,271 @@
+"""Per-link α-β transfer cost model with ICI/DCN link classes.
+
+The α-β (a.k.a. postal / LogP-degenerate) model prices one point-to-point
+transfer of ``n`` bytes over a link as
+
+    t(n) = α + β·n          α = fixed latency [s], β = inverse bandwidth [s/B]
+
+which is exactly the information content of the profiler's two probe points
+(:mod:`adapcc_tpu.topology.profile`): the 64-float round times the latency
+term, the 1M-float round times the bandwidth term, and a least-squares line
+through the (bytes, seconds) points recovers (α, β) per directed link.
+TACCL's and SCCL's synthesizers (PAPERS.md) rank candidate schedules with
+the same model; here it also prices relay-masked and degraded scenarios.
+
+Links are classed **ICI** (same host/slice — fast mesh) or **DCN**
+(cross-host) by the rank→ip table, mirroring ``Tree.is_cross_host``.  Links
+without their own probe points inherit their class's mean coefficients, so
+a partial profile (or a class-level calibration artifact) still prices every
+edge.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from adapcc_tpu.topology.profile import BANDWIDTH_PROBE_FLOATS, LATENCY_PROBE_FLOATS
+
+#: link-class labels (the TPU reading of the reference's intra/inter-host split)
+ICI = "ici"
+DCN = "dcn"
+
+#: probe payload sizes in bytes (float32 payloads, profile.cu:120-158 analog)
+LATENCY_PROBE_BYTES = LATENCY_PROBE_FLOATS * 4
+BANDWIDTH_PROBE_BYTES = BANDWIDTH_PROBE_FLOATS * 4
+
+#: fallback coefficients when nothing was ever measured: ~v5e ICI link
+#: (α ≈ 1 µs, β ≈ 1/45 GB/s) and a conservative DCN link (α ≈ 25 µs,
+#: β ≈ 1/12.5 GB/s) — deliberately round numbers, replaced by any calibration
+DEFAULT_COEFFS = {
+    ICI: (1e-6, 1.0 / 45e9),
+    DCN: (25e-6, 1.0 / 12.5e9),
+}
+
+Link = Tuple[int, int]
+
+
+@dataclass(frozen=True)
+class LinkCoeffs:
+    """α [s] + β [s/byte] for one link (or one link class)."""
+
+    alpha: float
+    beta: float
+
+    def time(self, nbytes: float) -> float:
+        return self.alpha + self.beta * float(nbytes)
+
+    def scaled(self, factor: float) -> "LinkCoeffs":
+        """Both terms slowed by ``factor`` (degraded-link modeling)."""
+        return LinkCoeffs(self.alpha * factor, self.beta * factor)
+
+
+def fit_alpha_beta(points: Sequence[Tuple[float, float]]) -> LinkCoeffs:
+    """Least-squares line ``t = α + β·bytes`` through (bytes, seconds) points.
+
+    Negative coefficients (noisy probes, e.g. a big transfer that timed
+    *faster* than a small one) clamp to zero — a cost model must never pay
+    you to send data.  A single point is read as pure latency.
+    """
+    pts = [(float(b), float(t)) for b, t in points]
+    if not pts:
+        raise ValueError("need at least one (bytes, seconds) probe point")
+    if len(pts) == 1:
+        return LinkCoeffs(alpha=max(0.0, pts[0][1]), beta=0.0)
+    a = np.array([[1.0, b] for b, _ in pts])
+    y = np.array([t for _, t in pts])
+    (alpha, beta), *_ = np.linalg.lstsq(a, y, rcond=None)
+    return LinkCoeffs(alpha=max(0.0, float(alpha)), beta=max(0.0, float(beta)))
+
+
+class LinkCostModel:
+    """Prices point-to-point transfers: per-link coefficients where probed,
+    class means elsewhere, :data:`DEFAULT_COEFFS` as the last resort."""
+
+    def __init__(
+        self,
+        world: int,
+        links: Optional[Mapping[Link, LinkCoeffs]] = None,
+        classes: Optional[Mapping[str, LinkCoeffs]] = None,
+        ips: Optional[Mapping[int, str]] = None,
+        source: str = "unspecified",
+    ) -> None:
+        if world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        self.world = world
+        self.links: Dict[Link, LinkCoeffs] = dict(links or {})
+        self.classes: Dict[str, LinkCoeffs] = {
+            cls: LinkCoeffs(*DEFAULT_COEFFS[cls]) for cls in (ICI, DCN)
+        }
+        self.classes.update(classes or {})
+        self.ips = dict(ips) if ips else None
+        #: provenance stamp carried into artifacts ("profile:<dir>",
+        #: "battery:<file>", "synthetic", ...)
+        self.source = source
+
+    # -- pricing ---------------------------------------------------------------
+
+    def link_class_of(self, src: int, dst: int) -> str:
+        """Directed link → class, computed from the ip table on demand (an
+        eager world² matrix is hostile to pod-scale ranking).  No ip table
+        means one flat fast domain: everything is ICI."""
+        if self.ips is None:
+            return ICI
+        return ICI if self.ips.get(src) == self.ips.get(dst) else DCN
+
+    def coeffs(self, src: int, dst: int) -> LinkCoeffs:
+        hit = self.links.get((src, dst))
+        if hit is not None:
+            return hit
+        return self.classes[self.link_class_of(src, dst)]
+
+    def time_for(self, src: int, dst: int, nbytes: float) -> float:
+        """Seconds to move ``nbytes`` over the (src → dst) link, uncontended."""
+        return self.coeffs(src, dst).time(nbytes)
+
+    # -- derived models --------------------------------------------------------
+
+    def degraded(
+        self, slow_ranks: Sequence[int], slowdown: float
+    ) -> "LinkCostModel":
+        """A copy with every link touching a slow rank stretched by
+        ``slowdown`` ≥ 1 — the straggler scenario the relay controller prices
+        when deciding whether to demote a rank to a forwarding relay."""
+        if slowdown < 1.0:
+            raise ValueError(f"slowdown must be >= 1, got {slowdown}")
+        slow = set(slow_ranks)
+        links = dict(self.links)
+        classes = dict(self.classes)
+        model = LinkCostModel(
+            self.world, links, classes, self.ips, source=self.source
+        )
+        for r in slow:
+            for other in range(self.world):
+                if other == r:
+                    continue
+                for link in ((r, other), (other, r)):
+                    model.links[link] = self.coeffs(*link).scaled(slowdown)
+        return model
+
+    def with_ips(self, ips: Optional[Mapping[int, str]]) -> "LinkCostModel":
+        """A copy pricing the same coefficients under ``ips``'s host layout
+        — the one way callers (sim_collectives.sweep, the sim-rank policy's
+        fallback) attach a host split to a calibration that carries none,
+        so candidate shapes and replay pricing see the same network."""
+        return LinkCostModel(
+            self.world, links=self.links, classes=self.classes, ips=ips,
+            source=self.source,
+        )
+
+    # -- construction from profiles --------------------------------------------
+
+    @classmethod
+    def from_matrices(
+        cls,
+        lat: np.ndarray,
+        bw: np.ndarray,
+        ips: Optional[Mapping[int, str]] = None,
+        source: str = "matrices",
+    ) -> "LinkCostModel":
+        """Fit per-link (α, β) from the profiler's matrices.
+
+        ``lat[s][d]`` is the measured small-probe round time [s]; ``bw[s][d]``
+        the large-probe rate [GB/s].  Each off-diagonal pair with usable
+        readings yields two (bytes, seconds) points; pairs with no usable
+        readings fall back to their class coefficients.  Class means are
+        recomputed from the fitted links so unprobed links of a probed class
+        stay consistent with their peers.
+        """
+        lat = np.asarray(lat, dtype=float)
+        bw = np.asarray(bw, dtype=float)
+        world = lat.shape[0]
+        if lat.shape != (world, world) or bw.shape != (world, world):
+            raise ValueError(f"expected square world matrices, got {lat.shape}/{bw.shape}")
+        model = cls(world, ips=ips, source=source)
+        per_class: Dict[str, list] = {ICI: [], DCN: []}
+        for s in range(world):
+            for d in range(world):
+                if s == d:
+                    continue
+                points = []
+                if lat[s][d] > 0:
+                    points.append((LATENCY_PROBE_BYTES, lat[s][d]))
+                if bw[s][d] > 0:
+                    points.append(
+                        (BANDWIDTH_PROBE_BYTES, BANDWIDTH_PROBE_BYTES / (bw[s][d] * 1e9))
+                    )
+                if not points:
+                    continue
+                coeffs = fit_alpha_beta(points)
+                model.links[(s, d)] = coeffs
+                per_class[model.link_class_of(s, d)].append(coeffs)
+        for cls_name, fitted in per_class.items():
+            if fitted:
+                model.classes[cls_name] = LinkCoeffs(
+                    alpha=float(np.mean([c.alpha for c in fitted])),
+                    beta=float(np.mean([c.beta for c in fitted])),
+                )
+        return model
+
+    @classmethod
+    def from_topo_profile_dir(
+        cls,
+        topology_dir: str,
+        world: int,
+        ips: Optional[Mapping[int, str]] = None,
+    ) -> "LinkCostModel":
+        """Fit from on-disk ``topo_profile_*`` CSV shards (the artifact chain
+        the adaptive bootstrap writes, docs/OPERATIONS.md §2)."""
+        from adapcc_tpu.topology.profile import gather_topo_profile
+
+        lat, bw = gather_topo_profile(topology_dir, world)
+        return cls.from_matrices(lat, bw, ips, source=f"profile:{topology_dir}")
+
+    @classmethod
+    def uniform(
+        cls,
+        world: int,
+        alpha: float = DEFAULT_COEFFS[ICI][0],
+        beta: float = DEFAULT_COEFFS[ICI][1],
+        ips: Optional[Mapping[int, str]] = None,
+        source: str = "synthetic",
+    ) -> "LinkCostModel":
+        """Every same-class link identical — the deterministic default the
+        simulated bench uses when no calibration artifact exists."""
+        return cls(
+            world,
+            classes={ICI: LinkCoeffs(alpha, beta)},
+            ips=ips,
+            source=source,
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"LinkCostModel(world={self.world}, links={len(self.links)}, "
+            f"source={self.source!r})"
+        )
+
+
+def ring_allreduce_time(
+    world: int, nbytes: float, coeffs: LinkCoeffs, chunks: int = 1
+) -> float:
+    """Analytical latency of the chain-tree ("ring"-schedule) allreduce.
+
+    ``Strategy.ring`` lowers to a depth-(w−1) reduce chain plus a
+    depth-(w−1) broadcast chain; with ``chunks`` pipelined chunks of
+    ``nbytes / chunks`` each, the steady-state makespan is
+
+        (2·(w−1) + chunks − 1) · (α + β·nbytes/chunks)
+
+    — the oracle the simulator's event replay is tested against.  Exact at
+    ``chunks=1``; for ``chunks>1`` it is the multi-port lower bound — the
+    replay's single-port model (a rank receives one transfer at a time, the
+    SCCL/TACCL assumption) adds a bounded constant of port-conflict hops
+    where the reduce tail overlaps the broadcast head.
+    """
+    if world < 2:
+        return 0.0
+    per_hop = coeffs.time(nbytes / chunks)
+    return (2 * (world - 1) + chunks - 1) * per_hop

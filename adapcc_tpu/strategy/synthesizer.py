@@ -5,11 +5,18 @@ default heuristic is ParTrees; an exact MILP formulation is available when a
 solver backend exists.  Two TPU-native fixed policies (``ring`` and
 ``binary``) are added because on an ICI torus the regular schedules are often
 optimal and need no profile data.
+
+A fifth policy, ``sim-rank``, synthesizes every cheap candidate (ParTrees,
+ring, binary) and commits to whichever the calibrated α-β replay
+(:mod:`adapcc_tpu.sim`) predicts fastest — the TACCL-style offline ranking
+pass that keeps strategy selection *measured* even when no hardware is
+reachable (docs/SIMULATION.md).
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+import sys
+from typing import List, Optional, Sequence, Tuple
 
 from adapcc_tpu.primitives import DEFAULT_CHUNK_BYTES
 from adapcc_tpu.strategy.ir import Strategy
@@ -71,6 +78,11 @@ class Synthesizer:
                 bandwidth_graph,
                 latency_graph,
             )
+        if self.policy == "sim-rank":
+            return self._sim_ranked(
+                prim, parallel_degree, transmission_size, bandwidth_graph,
+                latency_graph, local_rank0_list,
+            )
         ips = {r: ip for r, ip in enumerate(self.ip_table)}
         if self.policy == "ring":
             s = Strategy.ring(world, max(1, parallel_degree), ips)
@@ -80,6 +92,123 @@ class Synthesizer:
             raise ValueError(f"unknown synthesis policy {self.policy!r}")
         s.synthesis = self.policy
         return s
+
+    # -- simulated ranking pass ------------------------------------------------
+
+    def candidates(
+        self,
+        parallel_degree: int,
+        bandwidth_graph: Sequence[Sequence[float]],
+        latency_graph: Sequence[Sequence[float]],
+        local_rank0_list: Optional[Sequence[int]] = None,
+    ) -> List[Tuple[str, Strategy]]:
+        """Every cheap candidate shape, ParTrees first so a predicted tie
+        keeps the default heuristic (and the compiled-program cache warm)."""
+        world = len(self.ip_table)
+        if local_rank0_list is None:
+            local_rank0_list = _infer_local_rank0s(self.ip_table)
+        ips = {r: ip for r, ip in enumerate(self.ip_table)}
+        degree = max(1, parallel_degree)
+        out: List[Tuple[str, Strategy]] = []
+        try:
+            out.append((
+                "par-trees",
+                ParTrees().synthesize(
+                    self.ip_table, local_rank0_list, degree,
+                    bandwidth_graph, latency_graph,
+                ),
+            ))
+        except Exception as e:  # noqa: BLE001
+            # degenerate topology: the fixed shapes still compete — but say
+            # so (on stderr: stdout may be a --json row stream), or a real
+            # ParTrees regression silently shrinks the field
+            print(
+                f"[synthesizer] par-trees candidate dropped: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+                flush=True,
+            )
+        out.append(("ring", Strategy.ring(world, degree, ips)))
+        out.append(("binary", Strategy.binary(world, degree, ips)))
+        return out
+
+    def rank(
+        self,
+        candidates: Sequence[Tuple[str, Strategy]],
+        nbytes: int,
+        bandwidth_graph: Optional[Sequence[Sequence[float]]] = None,
+        latency_graph: Optional[Sequence[Sequence[float]]] = None,
+        collective: str = "allreduce",
+    ):
+        """Order labeled candidates fastest-first on the α-β replay.
+
+        The cost model comes from the profiled matrices when given (the
+        exact inputs ``synthesize`` receives from the bootstrap), else from
+        the persisted calibration artifact / synthetic defaults.  Returns
+        :class:`adapcc_tpu.sim.rank.RankedCandidate` rows.
+        """
+        from adapcc_tpu import sim
+
+        model = self._cost_model(bandwidth_graph, latency_graph)
+        return sim.rank_candidates(
+            list(candidates), model, max(1, int(nbytes)), collective
+        )
+
+    def _cost_model(self, bandwidth_graph, latency_graph):
+        import numpy as np
+
+        from adapcc_tpu.sim.calibrate import load_or_default
+        from adapcc_tpu.sim.cost_model import LinkCostModel
+
+        world = len(self.ip_table)
+        ips = {r: ip for r, ip in enumerate(self.ip_table)}
+        if bandwidth_graph is not None and latency_graph is not None:
+            bw = np.asarray(bandwidth_graph, dtype=float)
+            lat = np.asarray(latency_graph, dtype=float)
+            if bw.shape == (world, world) and (bw > 0).any():
+                return LinkCostModel.from_matrices(
+                    lat, bw, ips, source="profile-graphs"
+                )
+        model = load_or_default(world=world)
+        if model.ips is None:
+            # the fallback must still price cross-host edges as DCN: attach
+            # the synthesizer's own ip table (battery calibrations and the
+            # world-resize path carry none), same as sim_collectives.sweep
+            model = model.with_ips(ips)
+        return model
+
+    def _sim_ranked(
+        self,
+        prim: int,
+        parallel_degree: int,
+        transmission_size: int,
+        bandwidth_graph: Sequence[Sequence[float]],
+        latency_graph: Sequence[Sequence[float]],
+        local_rank0_list: Optional[Sequence[int]],
+    ) -> Strategy:
+        from adapcc_tpu.primitives import BROADCAST, REDUCE
+
+        # rank on the primitive actually being synthesized; primitives the
+        # replay can't lower (scatter/gather family) rank on allreduce, the
+        # superset schedule both halves of those collectives ride
+        collective = {REDUCE: "reduce", BROADCAST: "broadcast"}.get(
+            prim, "allreduce"
+        )
+        nbytes = transmission_size if transmission_size > 0 else DEFAULT_CHUNK_BYTES
+        ranked = self.rank(
+            self.candidates(
+                parallel_degree, bandwidth_graph, latency_graph, local_rank0_list
+            ),
+            nbytes,
+            bandwidth_graph,
+            latency_graph,
+            collective=collective,
+        )
+        winner = ranked[0]
+        # provenance: the emitted XML records both the winning shape and
+        # that a simulated ranking (not a measurement) chose it
+        winner.strategy.synthesis = f"{winner.label}+sim-rank"
+        return winner.strategy
 
 
 def _infer_local_rank0s(ip_table: Sequence[str]) -> List[int]:

@@ -324,6 +324,14 @@ def run(args) -> Tuple[float, float]:
                 TrainCheckpointState(
                     params=state.params, opt_state=state.opt_state,
                     epoch=epoch, step=int(state.step),
+                    # --zero1 runs stamp the optimizer layout so a resume
+                    # with --zero1-ring flipped fails loudly (checkpoint.py's
+                    # apply_snapshot guard) instead of loading permuted
+                    # master weights
+                    extra=(
+                        trainer.checkpoint_extra() if trainer is not None
+                        else {}
+                    ),
                 ),
                 args.checkpoint_file,
             )

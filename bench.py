@@ -271,7 +271,9 @@ def flash_block_for(seq: int) -> int:
         best = autotune_flash_block(
             seq, d_head=d_head, dtype=jnp.bfloat16, batch=batch, heads=heads
         )
-        timings = last_timings(seq, d_head=d_head, dtype=jnp.bfloat16)
+        timings = last_timings(
+            seq, d_head=d_head, dtype=jnp.bfloat16, batch=batch, heads=heads
+        )
         _RESULT["flash_autotune"] = {
             "best": best,
             "timings_ms": {

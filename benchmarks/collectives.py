@@ -152,19 +152,25 @@ def _make_ops(engine, elems: int, dtype=jnp.float32) -> Dict[str, tuple]:
                 lambda: engine.reduce_scatter(flat, active_gpus=subset), per_rank,
             )
     if not two_level:
-        ops[("allreduce", "pallas_ring")] = (
-            lambda: engine.ring_allreduce(flat), per_rank,
-        )
-        if elems % world == 0:
-            ops[("reduce_scatter", "pallas_ring")] = (
-                lambda: engine.ring_reduce_scatter(flat), per_rank,
-            )
-        from adapcc_tpu.comm.pallas_ring import _tile_elems
+        from adapcc_tpu.compat import ring_kernels_supported
 
-        if elems % _tile_elems(dtype) == 0:
-            ops[("all_gather", "pallas_ring")] = (
-                lambda: engine.ring_all_gather(flat), total,
+        # the ring kernels need Mosaic (real TPU) or the TPU interpret mode
+        # (jax >= 0.5); on builds with neither, emitting the rows would turn
+        # the whole sweep into a crash instead of a sweep minus three rows
+        if ring_kernels_supported():
+            ops[("allreduce", "pallas_ring")] = (
+                lambda: engine.ring_allreduce(flat), per_rank,
             )
+            if elems % world == 0:
+                ops[("reduce_scatter", "pallas_ring")] = (
+                    lambda: engine.ring_reduce_scatter(flat), per_rank,
+                )
+            from adapcc_tpu.comm.pallas_ring import _tile_elems
+
+            if elems % _tile_elems(dtype) == 0:
+                ops[("all_gather", "pallas_ring")] = (
+                    lambda: engine.ring_all_gather(flat), total,
+                )
         # active_gpus pins the schedule path; bare calls ride the XLA
         # fastpath (flat meshes only — see docstring)
         ops[("reduce", "xla")] = (lambda: engine.reduce(flat), per_rank)
@@ -294,6 +300,17 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     args = ap.parse_args(argv)
 
     impls = [i for i in args.impls.split(",") if i] or None
+    if impls and "pallas_ring" in impls:
+        from adapcc_tpu.compat import ring_kernels_supported
+
+        if not ring_kernels_supported():
+            # an explicitly requested impl must fail loudly, not produce a
+            # zero-row sweep that reads as "ran fine, no data"
+            ap.error(
+                "pallas_ring was requested but this build can't run the "
+                "ring kernels (needs a real TPU or the Mosaic TPU "
+                "interpret mode, jax >= 0.5); drop it from --impls"
+            )
     if args.two_level:
         import re
 

@@ -79,13 +79,15 @@ def hw_env() -> dict:
     return env
 
 
-def _run(name: str, cmd, timeout: int, out_path: str, extra_env=None) -> dict:
+def _run(
+    name: str, cmd, timeout: int, out_path: str, extra_env=None, rec_extra=None
+) -> dict:
     # base on hw_env(), not raw os.environ: a leaked JAX_PLATFORMS=cpu /
     # XLA_FLAGS pin from the test regime must not silently turn the
     # hardware battery into a CPU battery when invoked directly
     env = {**hw_env(), **(extra_env or {})}
     t0 = time.time()
-    rec: dict = {"phase": name, "cmd": " ".join(cmd)}
+    rec: dict = {"phase": name, "cmd": " ".join(cmd), **(rec_extra or {})}
     try:
         p = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout, cwd=REPO, env=env
@@ -120,6 +122,26 @@ def _run(name: str, cmd, timeout: int, out_path: str, extra_env=None) -> dict:
     return rec
 
 
+def run_simulated_fallback(py: str, out_path: str, world: int = 8) -> dict:
+    """Dead-tunnel fallback: record *model-predicted* collective rows so the
+    round still ranks its schedule levers (docs/SIMULATION.md).
+
+    The phase record and every row inside it are stamped ``"mode":
+    "simulated"`` — the reader contract that a prediction can never be
+    mistaken for a measurement.  Pinned to CPU (the simulator is analytic;
+    it must not race a half-alive tunnel for the chip) and deterministic:
+    the same calibration artifact reproduces byte-identical rows.
+    """
+    return _run(
+        "sim_busbw",
+        [py, "-m", "benchmarks.sim_collectives", "--world", str(world),
+         "--sizes", "4K,1M,16M,128M", "--json"],
+        600, out_path,
+        extra_env={"JAX_PLATFORMS": "cpu"},
+        rec_extra={"mode": "simulated"},
+    )
+
+
 def main() -> int:
     tag = sys.argv[1] if len(sys.argv) > 1 else "r03"
     out = os.path.join(REPO, "benchmarks", "results", f"hw_{tag}.jsonl")
@@ -128,7 +150,9 @@ def main() -> int:
 
     probe = _run("probe", [py, "-c", PROBE_CODE], 120, out)
     if probe.get("rc") != 0:
-        print("[hw] tunnel dead at probe; aborting battery", flush=True)
+        print("[hw] tunnel dead at probe; recording simulated rows instead",
+              flush=True)
+        run_simulated_fallback(py, out)
         return 1
     # a CPU-fallback probe must not masquerade as a hardware window
     # (HW_EXPECT_PLATFORM=any opts out, e.g. for harness smoke tests)
@@ -136,7 +160,8 @@ def main() -> int:
     got = (probe.get("parsed") or {}).get("platform", "?")
     if expect != "any" and got != expect:
         print(f"[hw] probe platform {got!r} != expected {expect!r}; "
-              "aborting battery", flush=True)
+              "aborting battery (simulated rows recorded instead)", flush=True)
+        run_simulated_fallback(py, out)
         return 1
 
     # headline number first: a short window must still yield the canonical

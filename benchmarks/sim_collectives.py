@@ -1,0 +1,258 @@
+"""Hardware-free collective sweep on the calibrated α-β simulator.
+
+The simulated twin of :mod:`benchmarks.collectives`: the same collectives ×
+sizes × strategies grid, but every number is a model *prediction* from
+:mod:`adapcc_tpu.sim` instead of a wall-clock measurement — so the sweep
+runs (and ranks the schedule levers) even when the TPU tunnel is dead,
+which is exactly the regime that nulled every round-5 number.
+
+Rows carry ``"mode": "simulated"`` and ``pred_time_us`` (never ``time_us``)
+so a reader — human or the battery post-processor — can never mistake a
+prediction for a measurement.  Predictions are anchored to the last good
+hardware round through the calibration artifact
+(``topology/calibration.json``, see docs/SIMULATION.md); without one, the
+deterministic synthetic defaults price the sweep.
+
+The sweep is fully deterministic: the replay is analytic (no wall clock,
+no RNG), and the ParTrees/flow-LP candidates are synthesized from the
+calibrated link matrices, so two runs over the same calibration emit
+byte-identical rows — the property the tier-1 rig asserts.
+
+Usage (any backend, typically ``JAX_PLATFORMS=cpu``)::
+
+    python -m benchmarks.sim_collectives --world 8 --sizes 4K,1M,16M --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from adapcc_tpu.sim.calibrate import DEFAULT_CALIBRATION_PATH, load_or_default
+from adapcc_tpu.sim.cost_model import LinkCostModel
+from adapcc_tpu.sim.replay import simulate_flow_broadcast, simulate_strategy
+from adapcc_tpu.strategy.ir import Strategy
+
+from benchmarks.collectives import BUS_FACTORS, parse_size
+
+#: collectives the tree replay lowers (the engine's ppermute-schedule subset)
+SIM_COLLECTIVES = ("allreduce", "reduce", "broadcast")
+
+#: candidate schedules swept side by side, mirroring the measured sweep's
+#: impl axis (xla/strategy/pallas_ring → here: schedule shapes); labels
+#: match Synthesizer.candidates so artifact rows and sim-rank-stamped XML
+#: group under one name ("partrees" is accepted as a CLI alias)
+SIM_STRATEGIES = ("ring", "binary", "par-trees")
+
+_STRATEGY_ALIASES = {"partrees": "par-trees"}
+
+
+def _ip_table(world: int, hosts: int) -> List[str]:
+    """Synthetic rank→ip table: ``world`` ranks over ``hosts`` hosts in
+    contiguous runs (the launcher's placement)."""
+    hosts = max(1, min(hosts, world))
+    per = -(-world // hosts)
+    return [f"10.0.0.{r // per}" for r in range(world)]
+
+
+def _graphs_from_model(
+    model: LinkCostModel,
+) -> Tuple[List[List[float]], List[List[float]]]:
+    """(bandwidth [GB/s], latency [s]) matrices for the synthesizers, read
+    off the calibrated coefficients so candidate *shapes* see the same
+    network the replay prices."""
+    w = model.world
+    bw = [[0.0] * w for _ in range(w)]
+    lat = [[0.0] * w for _ in range(w)]
+    for s in range(w):
+        for d in range(w):
+            if s == d:
+                continue
+            c = model.coeffs(s, d)
+            lat[s][d] = c.alpha
+            bw[s][d] = 1.0 / (c.beta * 1e9) if c.beta > 0 else 1e6
+    return bw, lat
+
+
+def strategy_candidates(
+    world: int,
+    names: Sequence[str],
+    model: LinkCostModel,
+    ips: Optional[Dict[int, str]] = None,
+    degree: int = 1,
+) -> List[Tuple[str, Strategy]]:
+    """Labeled candidate strategies for the sweep — the synthesizer's own
+    candidate pool (so the sweep and the sim-rank policy can never drift),
+    filtered to ``names``.  ParTrees is skipped (not fatal) when synthesis
+    fails on a degenerate topology; Synthesizer.candidates handles that."""
+    from adapcc_tpu.strategy.synthesizer import Synthesizer
+
+    if ips is None:
+        # a calibration artifact may carry its own ip table — candidate
+        # shapes must be synthesized for the network the replay prices
+        ips = model.ips
+    table = (
+        [ips[r] for r in range(world)] if ips else _ip_table(world, 1)
+    )
+    bw, lat = _graphs_from_model(model)
+    pool = dict(Synthesizer(None, table).candidates(degree, bw, lat))
+    out: List[Tuple[str, Strategy]] = []
+    for name in names:
+        label = _STRATEGY_ALIASES.get(name, name)
+        if label not in SIM_STRATEGIES:
+            raise ValueError(
+                f"unknown strategy {name!r}; expected one of {SIM_STRATEGIES}"
+            )
+        if label in pool:
+            out.append((label, pool[label]))
+    return out
+
+
+def _solve_flow(world: int, model: LinkCostModel):
+    """Flow-LP broadcast solution on the calibrated complete graph; None
+    when the LP backend (scipy) is unavailable.  The LP depends only on the
+    topology, so callers solve once and re-simulate per message size."""
+    try:
+        from adapcc_tpu.strategy.flow_lp import solve_broadcast_lp
+    except ImportError:
+        return None
+    edges = [(s, d) for s in range(world) for d in range(world) if s != d]
+    bandwidth = [
+        1.0 / max(model.coeffs(s, d).beta, 1e-15) for s, d in edges
+    ]
+    try:
+        return solve_broadcast_lp(world, edges, bandwidth)
+    except Exception:
+        return None
+
+
+def _finish_row(row: dict, collective: str, world: int) -> dict:
+    row["impl"] = "sim"
+    row["busbw_gbps"] = round(
+        row["algbw_gbps"] * BUS_FACTORS[collective](world), 6
+    )
+    return row
+
+
+def sweep(
+    world: int,
+    sizes: Sequence[int],
+    collectives: Sequence[str] = SIM_COLLECTIVES,
+    strategies: Sequence[str] = SIM_STRATEGIES,
+    model: Optional[LinkCostModel] = None,
+    hosts: int = 1,
+    degree: int = 1,
+    flow_lp: bool = True,
+) -> List[dict]:
+    """The full prediction grid as artifact rows (pure function — the CLI
+    and the battery fallback both call this)."""
+    ips = (
+        {r: ip for r, ip in enumerate(_ip_table(world, hosts))}
+        if hosts > 1
+        else None
+    )
+    if model is None:
+        model = load_or_default(world=world)
+    elif model.world != world:
+        raise ValueError(f"model world {model.world} != sweep world {world}")
+    if ips is not None and model.ips is None:
+        # the synthetic host split must actually price cross-host edges as
+        # DCN; a calibration carrying its own ip table keeps it
+        model = model.with_ips(ips)
+    elif ips is not None and model.ips != ips:
+        # candidate shapes and replay pricing must see the SAME host layout;
+        # silently synthesizing for one network and pricing on another makes
+        # the ranking meaningless
+        raise ValueError(
+            f"--hosts {hosts} conflicts with the host layout recorded in "
+            f"the calibration ({model.source}); drop --hosts to sweep the "
+            "calibrated layout"
+        )
+    candidates = strategy_candidates(world, strategies, model, ips, degree)
+    flow = (
+        _solve_flow(world, model)
+        if flow_lp and "broadcast" in collectives
+        else None
+    )
+    rows: List[dict] = []
+    for collective in collectives:
+        if collective not in SIM_COLLECTIVES:
+            raise ValueError(
+                f"unknown collective {collective!r}; "
+                f"expected one of {SIM_COLLECTIVES}"
+            )
+        for nbytes in sizes:
+            for label, strategy in candidates:
+                timeline = simulate_strategy(
+                    strategy, model, nbytes, collective, keep_transfers=False
+                )
+                row = _finish_row(timeline.to_row(), collective, world)
+                row["strategy"] = label
+                rows.append(row)
+            if collective == "broadcast" and flow is not None:
+                lp = _finish_row(
+                    simulate_flow_broadcast(flow, model, nbytes).to_row(),
+                    "broadcast", world,
+                )
+                lp["strategy"] = "flow-lp"
+                rows.append(lp)
+    if not rows:
+        # an explicitly requested strategy that failed to synthesize (or an
+        # empty grid) must not read as "ran fine, no data" — same
+        # fail-loudly rule as collectives.py's --impls validation
+        raise ValueError(
+            f"sweep produced no rows: none of strategies={list(strategies)} "
+            f"synthesized for world={world} and no flow-lp row applied"
+        )
+    for row in rows:
+        row["calibration"] = model.source
+    return rows
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--sizes", default="4K,1M,16M")
+    ap.add_argument("--collectives", default=",".join(SIM_COLLECTIVES))
+    ap.add_argument("--strategies", default=",".join(SIM_STRATEGIES))
+    ap.add_argument(
+        "--hosts", type=int, default=1,
+        help="synthetic host count (>1 prices DCN edges between hosts)",
+    )
+    ap.add_argument(
+        "--degree", type=int, default=1, help="parallel transmissions per strategy"
+    )
+    ap.add_argument(
+        "--calibration", default=DEFAULT_CALIBRATION_PATH,
+        help="calibration artifact path (synthetic defaults when absent)",
+    )
+    ap.add_argument("--no-flow-lp", action="store_true")
+    ap.add_argument("--json", action="store_true", help="one JSON row per line")
+    args = ap.parse_args(argv)
+
+    model = load_or_default(args.calibration, world=args.world)
+    rows = sweep(
+        world=args.world,
+        sizes=[parse_size(s) for s in args.sizes.split(",")],
+        collectives=[c.strip() for c in args.collectives.split(",") if c.strip()],
+        strategies=[s.strip() for s in args.strategies.split(",") if s.strip()],
+        model=model,
+        hosts=args.hosts,
+        degree=args.degree,
+        flow_lp=not args.no_flow_lp,
+    )
+    for row in rows:
+        if args.json:
+            print(json.dumps(row))
+        else:
+            print(
+                f"[sim] {row['collective']:<14} {row['strategy']:<10} "
+                f"{row['size_bytes']:>12}B  pred={row['pred_time_us']:>10.1f}us  "
+                f"busbw={row['busbw_gbps']:>8.3f}GB/s  ({row['calibration']})"
+            )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -256,6 +256,13 @@ def test_collectives_dtype_sweep(capsys):
     out = capsys.readouterr().out
     assert "allreduce" in out and "dtype=bf16" in out
 
+    from adapcc_tpu.compat import ring_kernels_supported
+
+    if not ring_kernels_supported():
+        # a visible partial skip, not a silent green: the int8 pallas_ring
+        # half needs the Mosaic TPU interpreter
+        pytest.skip("pallas_ring int8 sweep needs a TPU / Mosaic interpreter")
+
     coll_main(["--world", "4", "--sizes", "2K", "--iters", "1", "--warmup", "1",
                "--dtype", "int8", "--collectives", "allreduce",
                "--impls", "pallas_ring", "--json"])

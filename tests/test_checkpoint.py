@@ -41,6 +41,100 @@ def test_snapshot_roundtrip():
     _assert_tree_equal(s0.params, s1.params)
 
 
+def test_apply_snapshot_enforces_layout_guard():
+    """apply_snapshot is the one funnel every load path uses; a state whose
+    extra declares a zero1 layout must reject a snapshot saved under a
+    different (or missing) layout before mutating anything."""
+    flat = {"ring": False, "align": 1, "world": 8}
+    ring = {"ring": True, "align": 128, "world": 8}
+    snap = TrainCheckpointState(
+        params=_params(), epoch=2, extra={"zero1_layout": flat, "note": "kept"}
+    ).capture_snapshot()
+
+    # matching layout restores and carries the saved extra through
+    same = TrainCheckpointState(params=_params(seed=1), extra={"zero1_layout": flat})
+    same.apply_snapshot(snap)
+    assert same.epoch == 2 and same.extra["note"] == "kept"
+
+    # flipped layout — or an untagged snapshot — fails loudly, pre-mutation
+    flipped = TrainCheckpointState(
+        params=_params(seed=2), extra={"zero1_layout": ring}
+    )
+    with pytest.raises(ValueError, match="layout mismatch"):
+        flipped.apply_snapshot(snap)
+    untagged = TrainCheckpointState(params=_params(), epoch=7).capture_snapshot()
+    with pytest.raises(ValueError, match="layout mismatch"):
+        flipped.apply_snapshot(untagged)
+    assert flipped.epoch == -1  # nothing mutated on either rejection
+
+    # states that declare no layout (non-zero1 runs) are unaffected
+    plain = TrainCheckpointState(params=_params(seed=3))
+    plain.apply_snapshot(snap)
+    assert plain.epoch == 2
+
+
+def test_layout_guard_covers_on_disk_funnel(tmp_path):
+    """The guard fires through save_checkpoint/load_checkpoint too — the
+    path a real --zero1-ring flip takes on resume."""
+    flat = {"ring": False, "align": 1, "world": 8}
+    ring = {"ring": True, "align": 128, "world": 8}
+    path = str(tmp_path / "z.ckpt")
+    save_checkpoint(
+        TrainCheckpointState(params=_params(), epoch=3, extra={"zero1_layout": flat}),
+        path,
+    )
+    resuming = TrainCheckpointState(
+        params=_params(seed=1), extra={"zero1_layout": ring}
+    )
+    with pytest.raises(ValueError, match="layout mismatch"):
+        load_checkpoint(resuming, path)
+    ok = TrainCheckpointState(params=_params(seed=2), extra={"zero1_layout": flat})
+    assert load_checkpoint(ok, path)
+    assert ok.epoch == 3
+
+
+def test_tagged_checkpoint_refuses_undeclared_optimizer_resume(tmp_path):
+    """The guard also fires in the opposite direction: restoring a ZeRO-1
+    tagged checkpoint's optimizer state into a resume that never declared a
+    layout must refuse (flax silently drops unknown extra keys, so without
+    the pre-decode peek the permuted restore would be silent).  Params-only
+    templates (inference) stay loadable — params are not permuted."""
+    import optax
+
+    params = _params()
+    tx = optax.sgd(0.1)
+    path = str(tmp_path / "tagged.ckpt")
+    save_checkpoint(
+        TrainCheckpointState(
+            params=params, opt_state=tx.init(params), epoch=1,
+            extra={"zero1_layout": {"ring": False, "align": 1, "world": 8}},
+        ),
+        path,
+    )
+    blind = TrainCheckpointState(
+        params=_params(seed=1), opt_state=tx.init(_params(seed=1))
+    )
+    with pytest.raises(ValueError, match="declares none"):
+        load_checkpoint(blind, path)
+    inference = TrainCheckpointState(params=_params(seed=2))
+    assert load_checkpoint(inference, path)
+    assert inference.epoch == 1
+
+
+def test_legacy_untagged_checkpoint_gets_guard_message(tmp_path):
+    """A pre-guard checkpoint (extra={}) resumed by a layout-declaring state
+    must fail with the guard's actionable message — not flax's raw
+    'dict keys do not match' from the template mismatch."""
+    path = str(tmp_path / "legacy.ckpt")
+    save_checkpoint(TrainCheckpointState(params=_params(), epoch=2), path)
+    resuming = TrainCheckpointState(
+        params=_params(seed=1),
+        extra={"zero1_layout": {"ring": False, "align": 1, "world": 8}},
+    )
+    with pytest.raises(ValueError, match="layout mismatch"):
+        load_checkpoint(resuming, path)
+
+
 def test_bytes_roundtrip_through_template():
     s0 = TrainCheckpointState(params=_params(scale=2.0), epoch=7)
     blob = s0.to_bytes()

@@ -15,6 +15,7 @@ _DOCS_DIR = os.path.join(
 )
 _PARALLELISM = os.path.join(_DOCS_DIR, "PARALLELISM.md")
 _OPERATIONS = os.path.join(_DOCS_DIR, "OPERATIONS.md")
+_SIMULATION = os.path.join(_DOCS_DIR, "SIMULATION.md")
 
 
 def _blocks(path):
@@ -52,3 +53,24 @@ def test_parallelism_doc_snippet_runs(idx):
 def test_operations_doc_snippet_runs(idx):
     code = _blocks(_OPERATIONS)[idx]
     exec(compile(code, f"{_OPERATIONS}:block{idx}", "exec"), {})
+
+
+def test_simulation_doc_has_snippets():
+    assert len(_blocks(_SIMULATION)) >= 4
+
+
+def test_simulation_doc_covers_the_contract():
+    """The simulator topics the dead-tunnel runbook leans on must exist."""
+    text = open(_SIMULATION).read()
+    for needle in (
+        '"mode": "simulated"', "pred_time_us", "topology/calibration.json",
+        "sim-rank", "calibrate_from_battery", "make sim-bench",
+        "relay_latency", "predict_degradation",
+    ):
+        assert needle in text, f"SIMULATION.md lost its {needle!r} coverage"
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_SIMULATION))))
+def test_simulation_doc_snippet_runs(idx):
+    code = _blocks(_SIMULATION)[idx]
+    exec(compile(code, f"{_SIMULATION}:block{idx}", "exec"), {})

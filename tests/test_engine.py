@@ -264,3 +264,50 @@ def test_all_to_all_subset_zeroes_inactive_sources(mesh8):
     expect = np.transpose(np.asarray(x), (1, 0, 2)).copy()
     expect[:, 3] = 0.0  # blocks originating at the inactive source
     np.testing.assert_allclose(out, expect)
+
+
+def test_reduce_scatter_args_are_keyword_only():
+    """The legacy positional ``reduce_scatter(t, ReduceOp.AVG)`` predates
+    ``active_gpus``; binding the enum to the mask must be impossible — and
+    the same invariant holds for the sibling engine collectives."""
+    import inspect
+
+    from adapcc_tpu.communicator import Communicator
+
+    for fn in (
+        Communicator.reduce_scatter,
+        CollectiveEngine.reduce_scatter,
+        CollectiveEngine.all_reduce,
+        CollectiveEngine.reduce,
+    ):
+        params = inspect.signature(fn).parameters
+        assert params["active_gpus"].kind is inspect.Parameter.KEYWORD_ONLY
+        assert params["op"].kind is inspect.Parameter.KEYWORD_ONLY
+
+
+def test_reduce_scatter_positional_op_raises(mesh8):
+    engine = CollectiveEngine(mesh8, Strategy.ring(8))
+    x = stacked_inputs(8)
+    with pytest.raises(TypeError):
+        engine.reduce_scatter(x, ReduceOp.AVG)
+    with pytest.raises(TypeError):
+        engine.all_reduce(x, ReduceOp.AVG)
+    with pytest.raises(TypeError):
+        engine.reduce(x, ReduceOp.MAX)
+    # the keyword spelling still works
+    out = engine.reduce_scatter(x, op=ReduceOp.AVG)
+    assert out.shape == (8, 2)
+
+
+def test_communicator_positional_reduceop_in_size_slot_raises():
+    """Communicator keeps the reference's positional (tensor, size,
+    chunk_bytes, active_gpus) parity, so a positional ReduceOp would land
+    in 'size' and be silently ignored — it must raise instead."""
+    from adapcc_tpu.communicator import Communicator
+
+    for name in ("all_reduce", "reduce"):
+        fn = getattr(Communicator, name)
+        with pytest.raises(TypeError, match="op= by keyword"):
+            fn(object.__new__(Communicator), None, ReduceOp.AVG)
+        with pytest.raises(TypeError, match="op= by keyword"):
+            fn(object.__new__(Communicator), None, 1024, ReduceOp.AVG)

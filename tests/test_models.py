@@ -118,11 +118,19 @@ def test_gpt2_remat_policies_match(policy):
     cfg_r = dataclasses.replace(cfg, remat=True, remat_policy=policy)
     out_a = GPT2(cfg).apply(params, tokens)
     out_b = GPT2(cfg_r).apply(params, tokens)
-    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), atol=1e-5)
+    # bf16 activations: what a dots policy *recomputes* in backward/refused
+    # fusions may re-round differently from the saved value, so equality
+    # holds only to bf16 resolution (~2^-8), not fp32 eps
+    tol = 1e-2 if cfg.dtype == jnp.bfloat16 else 1e-5
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b), atol=tol)
     ga = jax.grad(lambda p: lm_loss(GPT2(cfg).apply(p, tokens), tokens))(params)
     gb = jax.grad(lambda p: lm_loss(GPT2(cfg_r).apply(p, tokens), tokens))(params)
+    # gradients compare RELATIVELY (bf16 re-rounding scales with magnitude;
+    # a flat atol=1e-2 would pass 100%-wrong small gradients), with an
+    # absolute floor of one bf16 ulp-at-1 (2^-8) for near-zero leaves
+    rtol, atol = (2e-2, 4e-3) if cfg.dtype == jnp.bfloat16 else (1e-6, 1e-5)
     for a, b in zip(jax.tree_util.tree_leaves(ga), jax.tree_util.tree_leaves(gb)):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol)
 
 
 def test_gpt2_remat_policy_validated():

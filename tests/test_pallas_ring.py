@@ -11,6 +11,14 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from adapcc_tpu.compat import ring_kernels_supported
+
+pytestmark = pytest.mark.skipif(
+    not ring_kernels_supported(),
+    reason="ring kernels need a real TPU or the Mosaic TPU interpret mode "
+    "(jax >= 0.5); this build has neither",
+)
+
 from adapcc_tpu.comm.engine import CollectiveEngine
 from adapcc_tpu.comm.mesh import RANKS_AXIS
 from adapcc_tpu.comm.pallas_ring import (

@@ -96,6 +96,33 @@ def test_train_gpt2_learns_structure(capsys):
 
 
 @pytest.mark.slow
+def test_zero1_checkpoint_carries_layout_tag(tmp_path):
+    """--zero1 runs stamp the optimizer layout into the checkpoint's extra,
+    so a resume under a flipped layout hits checkpoint.py's apply_snapshot
+    guard instead of silently loading a chunk-permuted master."""
+    from flax import serialization
+
+    ckpt = str(tmp_path / "z.ckpt")
+    args = build_parser().parse_args(
+        [
+            "--epochs", "1", "--batch", "4", "--vocab", "64", "--seq", "16",
+            "--layers", "1", "--heads", "2", "--dmodel", "32",
+            "--corpus-tokens", "1200", "--world", "2", "--zero1",
+            "--checkpoint-file", ckpt,
+        ]
+    )
+    run(args)
+
+    with open(ckpt, "rb") as f:
+        raw = serialization.msgpack_restore(f.read())
+    tag = raw["extra"]["zero1_layout"]
+    assert bool(tag["ring"]) is False
+    assert int(tag["world"]) == 2
+    # enforcement of the tag on resume is covered by
+    # test_checkpoint.test_apply_snapshot_enforces_layout_guard
+
+
+@pytest.mark.slow
 def test_hits_at_1_beats_chance_after_training(capsys):
     """The ConvAI candidate-ranking metric (convai_evaluation.py hits@1): a
     trained model must rank the gold continuation above distractors far more
