@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench clean
+.PHONY: all native test sim-bench ring-sweep clean
 
 all: native
 
@@ -24,6 +24,14 @@ test: native
 sim-bench:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--world 8 --sizes 4K,1M,16M --json
+
+# Chunk-size sweep for the staged HBM-streaming Pallas ring on the same
+# simulator (docs/RING.md): deterministic "mode": "simulated" rows over a
+# chunk_bytes grid, so ring chunk tuning has a hardware-free regression
+# artifact.  Path/tile per row come from the kernel's own planner.
+ring-sweep:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--world 8 --sizes 16M,128M --ring-sweep --chunks 256K,1M,4M,16M --json
 
 clean:
 	rm -f $(LIB)

@@ -831,10 +831,52 @@ class CollectiveEngine:
         self._record("all_to_all", "xla", stacked)
         return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
-    def ring_allreduce(self, stacked: jnp.ndarray, interpret: Optional[bool] = None) -> jnp.ndarray:
+    def _ring_plan(
+        self, stacked: jnp.ndarray, chunk_bytes: Optional[int], rs: bool, ag: bool
+    ):
+        """The executed ring schedule for a stacked call: the synthesized
+        ``Strategy.chunk_bytes`` is the default granularity, an explicit
+        argument overrides it, and the ``ADAPCC_RING_CHUNK_BYTES`` sweep env
+        (resolved inside the planner) overrides both.  The plan decides the
+        VMEM vs HBM-streaming path and is recorded into the dispatch trace —
+        the chunk size a ring collective ran at is an artifact, not a
+        guess."""
+        from adapcc_tpu.comm.pallas_ring import plan_ring_schedule
+
+        per_rank = int(np.prod(stacked.shape[1:]))
+        # allreduce / reduce-scatter shards carry the full payload per rank;
+        # a pure all-gather's shard is one chunk of a world × chunk payload
+        nelems = per_rank if rs else per_rank * self.world_size
+        return plan_ring_schedule(
+            nelems,
+            stacked.dtype,
+            self.world_size,
+            chunk_bytes if chunk_bytes is not None else self.strategy.chunk_bytes,
+            rs=rs,
+            ag=ag,
+        )
+
+    def _record_ring(self, primitive: str, plan, stacked: jnp.ndarray) -> None:
+        if self.trace is not None:
+            self.trace.record(
+                primitive,
+                f"pallas_ring[{plan.path}]",
+                int(stacked.nbytes),
+                chunk_bytes=plan.chunk_bytes,
+                stage_bytes=plan.stage_bytes,
+                n_tiles=plan.n_tiles,
+            )
+
+    def ring_allreduce(
+        self,
+        stacked: jnp.ndarray,
+        interpret: Optional[bool] = None,
+        chunk_bytes: Optional[int] = None,
+    ) -> jnp.ndarray:
         """Pallas ICI ring allreduce (hand-tuned data plane; see
         :mod:`adapcc_tpu.comm.pallas_ring`).  ``interpret=None`` auto-selects
-        the interpreter off-TPU so the same call works on the virtual pod."""
+        the interpreter off-TPU so the same call works on the virtual pod.
+        ``chunk_bytes=None`` uses the strategy's synthesized granularity."""
         from adapcc_tpu.comm.pallas_ring import ring_allreduce_shard
 
         if self.two_level:
@@ -846,18 +888,26 @@ class CollectiveEngine:
         if interpret is None:
             interpret = jax.devices()[0].platform != "tpu"
         world = self.world_size
+        plan = self._ring_plan(stacked, chunk_bytes, rs=True, ag=True)
 
         def per_shard(x):  # x: [1, *payload]
             return ring_allreduce_shard(
-                x[0], world, self.axis_name, interpret=interpret
+                x[0], world, self.axis_name, interpret=interpret,
+                chunk_bytes=plan.chunk_bytes,
             )[None]
 
-        key = ("ring_allreduce", stacked.shape, stacked.dtype.name, bool(interpret))
-        self._record("allreduce", "pallas_ring", stacked)
+        key = (
+            "ring_allreduce", stacked.shape, stacked.dtype.name,
+            bool(interpret), plan.path, plan.stage_bytes,
+        )
+        self._record_ring("allreduce", plan, stacked)
         return self._shard_mapped(key, per_shard, 1)(stacked)
 
     def ring_reduce_scatter(
-        self, stacked: jnp.ndarray, interpret: Optional[bool] = None
+        self,
+        stacked: jnp.ndarray,
+        interpret: Optional[bool] = None,
+        chunk_bytes: Optional[int] = None,
     ) -> jnp.ndarray:
         """Pallas ICI ring reduce-scatter (the RS half of the hand-tuned ring,
         :func:`adapcc_tpu.comm.pallas_ring.ring_reduce_scatter_shard`).
@@ -880,10 +930,12 @@ class CollectiveEngine:
         if interpret is None:
             interpret = jax.devices()[0].platform != "tpu"
         world = self.world_size
+        plan = self._ring_plan(stacked, chunk_bytes, rs=True, ag=False)
 
         def per_shard(x):  # x: [1, *payload]
             out = ring_reduce_scatter_shard(
-                x[0], world, self.axis_name, interpret=interpret
+                x[0], world, self.axis_name, interpret=interpret,
+                chunk_bytes=plan.chunk_bytes,
             )
             # relabel to chunk order INSIDE the compiled program: the kernel
             # leaves rank r holding chunk (r+1) % world; one [chunk]-sized
@@ -894,12 +946,18 @@ class CollectiveEngine:
             )
             return out[None]
 
-        key = ("ring_rs", stacked.shape, stacked.dtype.name, bool(interpret))
-        self._record("reduce_scatter", "pallas_ring", stacked)
+        key = (
+            "ring_rs", stacked.shape, stacked.dtype.name, bool(interpret),
+            plan.path, plan.stage_bytes,
+        )
+        self._record_ring("reduce_scatter", plan, stacked)
         return self._shard_mapped(key, per_shard, 1)(stacked)
 
     def ring_all_gather(
-        self, stacked: jnp.ndarray, interpret: Optional[bool] = None
+        self,
+        stacked: jnp.ndarray,
+        interpret: Optional[bool] = None,
+        chunk_bytes: Optional[int] = None,
     ) -> jnp.ndarray:
         """Pallas ICI ring all-gather (the AG half of the hand-tuned ring).
 
@@ -918,14 +976,19 @@ class CollectiveEngine:
         if interpret is None:
             interpret = jax.devices()[0].platform != "tpu"
         world = self.world_size
+        plan = self._ring_plan(stacked, chunk_bytes, rs=False, ag=True)
 
         def per_shard(x):  # x: [1, chunk]
             return ring_all_gather_shard(
-                x[0], world, self.axis_name, interpret=interpret
+                x[0], world, self.axis_name, interpret=interpret,
+                chunk_bytes=plan.chunk_bytes,
             )[None]
 
-        key = ("ring_ag", stacked.shape, stacked.dtype.name, bool(interpret))
-        self._record("all_gather", "pallas_ring", stacked)
+        key = (
+            "ring_ag", stacked.shape, stacked.dtype.name, bool(interpret),
+            plan.path, plan.stage_bytes,
+        )
+        self._record_ring("all_gather", plan, stacked)
         return self._shard_mapped(key, per_shard, 1)(stacked)
 
     def reduce_scatter(

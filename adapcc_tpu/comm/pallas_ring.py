@@ -18,6 +18,29 @@ reduce-scatter / all-gather / allreduce kernels with:
 - **neighbor barrier** on entry so no device writes into a peer that has not
   allocated its buffers yet.
 
+Two execution paths share those mechanics, selected per payload by
+:func:`plan_ring_schedule`:
+
+- **vmem** — the whole payload is VMEM-resident (input + work + comm slots),
+  the right program when everything fits in one ``chunk_bytes`` staging
+  budget;
+- **hbm-stream** — the payload lives in HBM (``pltpu.ANY``) and a grid over
+  (ring step × tile) streams ``chunk_bytes``-sized tiles through fixed VMEM
+  staging: local DMA in → remote RDMA → accumulate → local DMA out, with the
+  credit protocol carried across grid steps.  This is the TPU analog of the
+  reference's fixed ``MAX_BUF_SIZE`` staging design (include/init.h:14-25):
+  collective payload size is bounded by HBM, not by on-device scratch.
+
+The tile granularity is the strategy plane's synthesized ``chunk_bytes``
+(``Strategy.chunk_bytes`` → ``engine.ring_*`` → here), overridable for
+sweeps with ``ADAPCC_RING_CHUNK_BYTES``.  The executed tile is a
+near-budget whole-VMEM-tile size covering the per-rank chunk with minimal
+zero padding (< one tile per chunk, sliced back out by the wrappers), so
+the external chunk layout (and with it the ZeRO-1 shard layout) is
+byte-identical across every chunk size — which also makes results
+bit-identical: each element sees the same adds in the same ring order
+regardless of tiling.
+
 Everything is testable off-hardware: ``interpret=True`` runs the kernels
 under the Pallas TPU interpreter on a virtual CPU mesh **with race detection
 enabled** — a sanitizer the reference never had (SURVEY §5.2).
@@ -26,6 +49,8 @@ enabled** — a sanitizer the reference never had (SURVEY §5.2).
 from __future__ import annotations
 
 import functools
+import os
+from dataclasses import dataclass
 from typing import Optional
 
 import jax
@@ -36,11 +61,16 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from adapcc_tpu.comm.mesh import RANKS_AXIS
+from adapcc_tpu.primitives import DEFAULT_CHUNK_BYTES
 
 #: VMEM tiles are (sublanes, 128) with sublanes scaling inversely with item
 #: width: fp32 → (8, 128), bf16 → (16, 128), int8/fp8 → (32, 128).  Chunks
 #: are padded to whole tiles of the payload dtype (``_tile_elems``).
 _LANES = 128
+
+#: env override for the ring staging granularity (chunk-size sweeps); wins
+#: over both the caller's value and the strategy's synthesized chunk_bytes
+RING_CHUNK_ENV = "ADAPCC_RING_CHUNK_BYTES"
 
 
 def _tile_elems(dtype) -> int:
@@ -55,8 +85,139 @@ def _interpret_params(interpret):
     return interpret  # False or a caller-provided InterpretParams
 
 
+def resolve_chunk_bytes(chunk_bytes: Optional[int] = None) -> int:
+    """The staging granularity actually in force: the ``ADAPCC_RING_CHUNK_
+    BYTES`` sweep override wins, then the caller's (synthesized) value, then
+    the default.  A malformed override raises — a typo silently falling back
+    to the default would invalidate a chunk-size sweep (same policy as
+    ADAPCC_MERGE_ROUNDS)."""
+    env = os.environ.get(RING_CHUNK_ENV)
+    if env is not None and env.strip():
+        try:
+            value = int(env)
+        except ValueError:
+            raise ValueError(
+                f"{RING_CHUNK_ENV}={env!r}: expected a positive byte count"
+            ) from None
+        if value <= 0:
+            raise ValueError(
+                f"{RING_CHUNK_ENV}={env!r}: expected a positive byte count"
+            )
+        return value
+    if chunk_bytes is not None:
+        if chunk_bytes <= 0:
+            raise ValueError(f"chunk_bytes must be positive, got {chunk_bytes}")
+        return int(chunk_bytes)
+    return DEFAULT_CHUNK_BYTES
+
+
+@dataclass(frozen=True)
+class RingSchedule:
+    """The executed ring schedule — the observable contract for traces,
+    benchmarks, and tests: which path ran, at what staging granularity."""
+
+    path: str              #: "vmem" | "hbm-stream"
+    world: int
+    steps: int             #: ring steps (RS + AG walks)
+    chunk_bytes: int       #: requested staging budget (resolved)
+    stage_bytes: int       #: executed tile bytes (near-budget, minimal padding)
+    n_tiles: int           #: tiles per ring step on the hbm-stream path
+    payload_bytes: int     #: caller bytes before padding
+    padded_bytes: int      #: world × tile-padded chunk bytes
+    dtype: str = "float32"
+
+    @property
+    def vmem_bound_bytes(self) -> int:
+        """Peak VMEM the data buffers need: the whole payload three times
+        over (pallas input + output + work scratch) plus 2 comm slots on
+        the vmem path, 4 staging tiles (1 send + 1 accumulate + 2 comm) on
+        the stream path."""
+        chunk = self.padded_bytes // self.world
+        if self.path == "vmem":
+            return 3 * self.padded_bytes + 2 * chunk
+        return 4 * self.stage_bytes
+
+    def to_row(self) -> dict:
+        return {
+            "ring_path": self.path,
+            "chunk_bytes": self.chunk_bytes,
+            "stage_bytes": self.stage_bytes,
+            "n_tiles": self.n_tiles,
+            "steps": self.steps,
+            "world": self.world,
+            "payload_bytes": self.payload_bytes,
+            "padded_bytes": self.padded_bytes,
+        }
+
+
+def _stage_rows_for(chunk_rows: int, sublanes: int, budget_bytes: int, row_bytes: int) -> int:
+    """Near-budget whole-tile staging size with minimal padding: the chunk
+    is covered by ``n = ceil(k / target)`` tiles of ``s = ceil(k / n)``
+    native tiles each — the smallest tile achieving the minimal tile count,
+    so zero-padding waste is bounded by ``n − 1`` native tiles per chunk
+    (< one staging tile) instead of collapsing to single-tile staging when
+    the chunk's tile count has no divisor near the budget (e.g. a prime
+    count).  When the budget divides the chunk exactly, this is the budget
+    itself and padding is zero.  The wrappers slice the padding back out,
+    so the external chunk layout (and the ZeRO-1 shard layout built on it)
+    is identical on both paths, for every chunk size."""
+    k = chunk_rows // sublanes  # chunk is tile-aligned by construction
+    target = max(1, budget_bytes // (row_bytes * sublanes))
+    n = -(-k // target)
+    return -(-k // n) * sublanes
+
+
+def plan_ring_schedule(
+    nelems: int,
+    dtype,
+    world: int,
+    chunk_bytes: Optional[int] = None,
+    rs: bool = True,
+    ag: bool = True,
+) -> RingSchedule:
+    """Pure planning: path selection + executed tile size for a ring
+    collective over ``nelems`` elements of ``dtype`` (total payload across
+    the ``world`` ring chunks).
+
+    Selection rule: the **vmem** path runs when the whole padded payload
+    fits inside one ``chunk_bytes`` staging budget ("payloads under one
+    chunk" — its VMEM need is then bounded by ~3× the budget); anything
+    larger takes the **hbm-stream** path, whose VMEM need is 4 staging
+    tiles regardless of payload size.
+    """
+    dtype = jnp.dtype(dtype)
+    itemsize = dtype.itemsize
+    tile = _tile_elems(dtype)
+    sublanes = tile // _LANES
+    chunk = -(-max(1, int(nelems)) // max(1, world))  # ceil elems per rank
+    chunk = -(-chunk // tile) * tile                  # whole dtype tiles
+    padded_bytes = world * chunk * itemsize
+    budget = resolve_chunk_bytes(chunk_bytes)
+    steps = (world - 1 if rs else 0) + (world - 1 if ag else 0)
+    if world == 1 or padded_bytes <= budget:
+        return RingSchedule(
+            path="vmem", world=world, steps=steps, chunk_bytes=budget,
+            stage_bytes=chunk * itemsize, n_tiles=1,
+            payload_bytes=int(nelems) * itemsize, padded_bytes=padded_bytes,
+            dtype=dtype.name,
+        )
+    chunk_rows = chunk // _LANES
+    stage_rows = _stage_rows_for(chunk_rows, sublanes, budget, _LANES * itemsize)
+    n_tiles = -(-chunk_rows // stage_rows)
+    return RingSchedule(
+        path="hbm-stream", world=world, steps=steps, chunk_bytes=budget,
+        stage_bytes=stage_rows * _LANES * itemsize,
+        n_tiles=n_tiles,
+        payload_bytes=int(nelems) * itemsize,
+        # the kernel's working footprint: each chunk zero-padded to whole
+        # staging tiles (the wrappers slice the padding back out)
+        padded_bytes=world * n_tiles * stage_rows * _LANES * itemsize,
+        dtype=dtype.name,
+    )
+
+
 # --------------------------------------------------------------------------- #
-# kernel body
+# kernel bodies
 # --------------------------------------------------------------------------- #
 
 def _ring_kernel(
@@ -73,7 +234,8 @@ def _ring_kernel(
     do_reduce_scatter: bool,
     do_all_gather: bool,
 ):
-    """Unidirectional ring walk: reduce-scatter phase then all-gather phase.
+    """VMEM-resident unidirectional ring walk: reduce-scatter phase then
+    all-gather phase.
 
     ``x_ref``/``work`` are ``[world, S, 128]`` (chunk-major); ``comm`` is the
     ``[2, S, 128]`` double-buffered staging area written by the left
@@ -140,6 +302,132 @@ def _ring_kernel(
     out_ref[...] = work[...]
 
 
+def _stream_ring_kernel(
+    x_ref,
+    out_ref,
+    send_stage,
+    acc,
+    comm,
+    local_sem,
+    send_sem,
+    recv_sem,
+    cap_sem,
+    *,
+    world: int,
+    axis_name: str,
+    do_reduce_scatter: bool,
+    do_all_gather: bool,
+    n_tiles: int,
+    stage_rows: int,
+    total_iters: int,
+):
+    """HBM-streaming ring walk: grid = (ring step, tile within the chunk).
+
+    ``x_ref``/``out_ref`` are HBM-resident ``[world, R, 128]``; ``out_ref``
+    doubles as the work buffer (seeded from ``x_ref`` at the first grid
+    iteration).  Each grid iteration moves one ``[stage_rows, 128]`` tile:
+    local DMA stages the outbound tile into VMEM, one RDMA ships it to the
+    right neighbor's double-buffered ``comm`` slot, and the landed inbound
+    tile is folded back into HBM (accumulate during reduce-scatter, adopt
+    during all-gather).  The credit protocol is the VMEM kernel's, carried
+    across grid steps over the flattened (step × tile) counter: slot ``i %
+    2`` is reused only after the downstream neighbor's credit from
+    iteration ``i − 2`` arrives, so a fast sender can never clobber an
+    unconsumed staging slot — the reference's fixed-staging flow control
+    (trans.cu:73-98) at grid scope.
+    """
+    step = pl.program_id(0)
+    tile = pl.program_id(1)
+    it = step * n_tiles + tile
+    my_id = lax.axis_index(axis_name)
+    right = (my_id + 1) % world
+    left = (my_id + world - 1) % world
+
+    n_rs = world - 1 if do_reduce_scatter else 0
+
+    @pl.when(it == 0)
+    def _enter():
+        # entry barrier with both neighbors, then seed the HBM work buffer
+        # (out_ref) from the input — the one whole-payload DMA of the path
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right)
+        pltpu.semaphore_wait(barrier, 2)
+        seed = pltpu.make_async_copy(x_ref, out_ref, local_sem)
+        seed.start()
+        seed.wait()
+
+    # chunk walk indices (the VMEM kernel's formulas on a traced step; the
+    # +2·world keeps every branch of the where non-negative under floor-mod)
+    in_rs = step < n_rs
+    own = 1 if do_reduce_scatter else 0
+    ag = step - n_rs
+    send_idx = jnp.where(
+        in_rs,
+        (my_id + 2 * world - step) % world,
+        (my_id + 2 * world + own - ag) % world,
+    )
+    recv_idx = jnp.where(
+        in_rs,
+        (my_id + 2 * world - step - 1) % world,
+        (my_id + 2 * world + own - ag - 1) % world,
+    )
+    slot = it % 2
+    row0 = tile * stage_rows
+    rows = pl.ds(row0, stage_rows)
+
+    # stage the outbound tile: HBM work → fixed VMEM staging.  One buffer
+    # suffices: the RDMA below completes (send side included) inside this
+    # iteration, so the staging is always free for the next tile — the
+    # double buffering that matters for flow control is the *comm* slots,
+    # which the left neighbor writes asynchronously
+    stage_in = pltpu.make_async_copy(
+        out_ref.at[send_idx, rows], send_stage, local_sem
+    )
+    stage_in.start()
+    stage_in.wait()
+
+    @pl.when(it >= 2)
+    def _credit_wait():
+        pltpu.semaphore_wait(cap_sem, 1)
+
+    rdma = pltpu.make_async_remote_copy(
+        src_ref=send_stage,
+        dst_ref=comm.at[slot],
+        send_sem=send_sem.at[slot],
+        recv_sem=recv_sem.at[slot],
+        device_id=right,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    rdma.start()
+    rdma.wait()  # outbound sent AND left neighbor's tile landed
+
+    @pl.when(in_rs)
+    def _reduce():
+        # accumulate: HBM tile → VMEM, add the landed tile, DMA back
+        acc_in = pltpu.make_async_copy(out_ref.at[recv_idx, rows], acc, local_sem)
+        acc_in.start()
+        acc_in.wait()
+        acc[...] = acc[...] + comm[slot]
+        acc_out = pltpu.make_async_copy(acc, out_ref.at[recv_idx, rows], local_sem)
+        acc_out.start()
+        acc_out.wait()
+
+    @pl.when(jnp.logical_not(in_rs))
+    def _adopt():
+        adopt = pltpu.make_async_copy(comm.at[slot], out_ref.at[recv_idx, rows], local_sem)
+        adopt.start()
+        adopt.wait()
+
+    # return a capacity credit upstream: slot is free for reuse
+    pltpu.semaphore_signal(cap_sem, inc=1, device_id=left)
+
+    @pl.when(it == total_iters - 1)
+    def _drain():
+        for _ in range(min(2, total_iters)):
+            pltpu.semaphore_wait(cap_sem, 1)
+
+
 # --------------------------------------------------------------------------- #
 # shard-level wrappers (call inside shard_map)
 # --------------------------------------------------------------------------- #
@@ -153,8 +441,7 @@ def _pad_chunks(flat: jnp.ndarray, world: int):
     return padded.reshape(world, chunk // _LANES, _LANES), chunk
 
 
-def _run_ring_chunks(chunks: jnp.ndarray, *, world, axis_name, rs, ag, interpret):
-    """Run the ring kernel on a pre-chunked ``[world, S, 128]`` array."""
+def _check_ring_supported() -> None:
     from adapcc_tpu.compat import ring_kernels_supported
 
     if not ring_kernels_supported():
@@ -166,36 +453,103 @@ def _run_ring_chunks(chunks: jnp.ndarray, *, world, axis_name, rs, ag, interpret
             "interpret mode (jax >= 0.5); this build has neither — use the "
             "XLA collective path instead (e.g. drop --zero1-ring)"
         )
+
+
+def _run_ring_chunks(
+    chunks: jnp.ndarray,
+    *,
+    world,
+    axis_name,
+    rs,
+    ag,
+    interpret,
+    chunk_bytes: Optional[int] = None,
+):
+    """Run the ring on a pre-chunked ``[world, S, 128]`` array, dispatching
+    to the VMEM-resident or HBM-streaming kernel per the planned schedule."""
+    _check_ring_supported()
+    plan = plan_ring_schedule(
+        chunks.size, chunks.dtype, world, chunk_bytes, rs=rs, ag=ag
+    )
+    if plan.path == "vmem":
+        kernel = functools.partial(
+            _ring_kernel,
+            world=world,
+            axis_name=axis_name,
+            do_reduce_scatter=rs,
+            do_all_gather=ag,
+        )
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(chunks.shape, chunks.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=[
+                pltpu.VMEM(chunks.shape, chunks.dtype),                # work
+                pltpu.VMEM((2,) + chunks.shape[1:], chunks.dtype),     # comm slots
+                pltpu.SemaphoreType.DMA((2,)),                         # send
+                pltpu.SemaphoreType.DMA((2,)),                         # recv
+                pltpu.SemaphoreType.REGULAR,                           # capacity
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True, collective_id=0
+            ),
+            interpret=_interpret_params(interpret),
+        )(chunks)
+
+    stage_rows = plan.stage_bytes // (_LANES * jnp.dtype(chunks.dtype).itemsize)
+    total_iters = plan.steps * plan.n_tiles
+    # zero-pad each chunk to whole staging tiles (bounded by < one tile per
+    # chunk, see _stage_rows_for) and slice the padding back out below, so
+    # callers see the legacy tile-aligned layout on both paths
+    chunk_rows = chunks.shape[1]
+    padded_rows = plan.n_tiles * stage_rows
+    if padded_rows != chunk_rows:
+        chunks = jnp.pad(chunks, ((0, 0), (0, padded_rows - chunk_rows), (0, 0)))
     kernel = functools.partial(
-        _ring_kernel,
+        _stream_ring_kernel,
         world=world,
         axis_name=axis_name,
         do_reduce_scatter=rs,
         do_all_gather=ag,
+        n_tiles=plan.n_tiles,
+        stage_rows=stage_rows,
+        total_iters=total_iters,
     )
-    return pl.pallas_call(
+    tile_shape = (stage_rows, _LANES)
+    out = pl.pallas_call(
         kernel,
+        grid=(plan.steps, plan.n_tiles),
         out_shape=jax.ShapeDtypeStruct(chunks.shape, chunks.dtype),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
         scratch_shapes=[
-            pltpu.VMEM(chunks.shape, chunks.dtype),                # work
-            pltpu.VMEM((2,) + chunks.shape[1:], chunks.dtype),     # comm slots
-            pltpu.SemaphoreType.DMA((2,)),                         # send
-            pltpu.SemaphoreType.DMA((2,)),                         # recv
-            pltpu.SemaphoreType.REGULAR,                           # capacity
+            pltpu.VMEM(tile_shape, chunks.dtype),          # send staging
+            pltpu.VMEM(tile_shape, chunks.dtype),          # accumulate staging
+            pltpu.VMEM((2,) + tile_shape, chunks.dtype),   # comm slots
+            pltpu.SemaphoreType.DMA(()),                   # local DMAs
+            pltpu.SemaphoreType.DMA((2,)),                 # send
+            pltpu.SemaphoreType.DMA((2,)),                 # recv
+            pltpu.SemaphoreType.REGULAR,                   # capacity
         ],
         compiler_params=pltpu.CompilerParams(
-            has_side_effects=True, collective_id=0
+            has_side_effects=True,
+            collective_id=0,
+            # the ring walk is stateful: both grid dims must run in order
+            dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=_interpret_params(interpret),
     )(chunks)
+    return out[:, :chunk_rows] if padded_rows != chunk_rows else out
 
 
-def _run_ring(x: jnp.ndarray, *, world, axis_name, rs, ag, interpret):
+def _run_ring(
+    x: jnp.ndarray, *, world, axis_name, rs, ag, interpret, chunk_bytes=None
+):
     chunks, chunk = _pad_chunks(x.reshape(-1), world)
     out = _run_ring_chunks(
-        chunks, world=world, axis_name=axis_name, rs=rs, ag=ag, interpret=interpret
+        chunks, world=world, axis_name=axis_name, rs=rs, ag=ag,
+        interpret=interpret, chunk_bytes=chunk_bytes,
     )
     return out, chunk
 
@@ -205,16 +559,22 @@ def ring_allreduce_shard(
     world: int,
     axis_name: str = RANKS_AXIS,
     interpret: bool = False,
+    chunk_bytes: Optional[int] = None,
 ) -> jnp.ndarray:
     """Sum-allreduce via ring reduce-scatter + ring all-gather.
 
     Bandwidth-optimal (2·(world−1)/world of the buffer per link), the same
     schedule family the reference benchmarks against NCCL rings
-    (nccl-perf/tree/all_reduce.cu).
+    (nccl-perf/tree/all_reduce.cu).  ``chunk_bytes`` is the staging
+    granularity (synthesized by the strategy plane; env-overridable): payloads
+    above it stream through HBM, below it stay VMEM-resident.
     """
     if world == 1:
         return x
-    out, _ = _run_ring(x, world=world, axis_name=axis_name, rs=True, ag=True, interpret=interpret)
+    out, _ = _run_ring(
+        x, world=world, axis_name=axis_name, rs=True, ag=True,
+        interpret=interpret, chunk_bytes=chunk_bytes,
+    )
     return out.reshape(-1)[: x.size].reshape(x.shape)
 
 
@@ -223,13 +583,17 @@ def ring_reduce_scatter_shard(
     world: int,
     axis_name: str = RANKS_AXIS,
     interpret: bool = False,
+    chunk_bytes: Optional[int] = None,
 ) -> jnp.ndarray:
     """Ring reduce-scatter: returns this rank's reduced chunk (padded shape
     ``[chunk]``); rank r owns chunk ``(r + 1) % world`` of the flattened,
     tile-padded input."""
     if world == 1:
         return x.reshape(-1)
-    out, chunk = _run_ring(x, world=world, axis_name=axis_name, rs=True, ag=False, interpret=interpret)
+    out, chunk = _run_ring(
+        x, world=world, axis_name=axis_name, rs=True, ag=False,
+        interpret=interpret, chunk_bytes=chunk_bytes,
+    )
     my_id = lax.axis_index(axis_name)
     own = (my_id + 1) % world
     return out.reshape(world, chunk)[own]
@@ -240,6 +604,7 @@ def ring_all_gather_shard(
     world: int,
     axis_name: str = RANKS_AXIS,
     interpret: bool = False,
+    chunk_bytes: Optional[int] = None,
 ) -> jnp.ndarray:
     """Ring all-gather of per-rank chunks: input is this rank's ``[chunk]``
     payload (tile-aligned), output is ``[world, chunk]`` in rank order."""
@@ -255,6 +620,7 @@ def ring_all_gather_shard(
     chunks = lax.dynamic_update_index_in_dim(chunks, x.reshape(-1), my_id, 0)
     chunks = chunks.reshape(world, x.size // _LANES, _LANES)
     out = _run_ring_chunks(
-        chunks, world=world, axis_name=axis_name, rs=False, ag=True, interpret=interpret
+        chunks, world=world, axis_name=axis_name, rs=False, ag=True,
+        interpret=interpret, chunk_bytes=chunk_bytes,
     )
     return out.reshape(world, -1)

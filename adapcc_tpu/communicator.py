@@ -222,6 +222,12 @@ class Communicator:
             return self._strategy
         if self.args.strategy_file and os.path.exists(self.args.strategy_file):
             self._strategy = parse_strategy_xml(self.args.strategy_file, self.chunk_bytes)
+            # a persisted strategy fully determines ring execution: when the
+            # XML carries its own chunk_bytes (emitted since the staged
+            # pipeline landed), it overrides this communicator's default and
+            # becomes the granularity every engine built from this strategy
+            # hands to the ring kernels
+            self.chunk_bytes = self._strategy.chunk_bytes
         else:
             # no strategy artifact: default ring over the mesh (TPU-idiomatic)
             ips = {r: ip for r, ip in enumerate(self.ip_table)}

@@ -106,6 +106,10 @@ class DDPTrainer:
         # of XLA's (the hand-tuned data plane); shards become VMEM-tile
         # aligned in the ring's chunk ownership — see Zero1Optimizer(ring=)
         zero1_ring: bool = False,
+        # ring staging granularity (strategy plane's synthesized
+        # chunk_bytes; None = default).  Payloads above it stream through
+        # fixed HBM→VMEM staging instead of living VMEM-resident
+        zero1_ring_chunk_bytes: Optional[int] = None,
         # "bf16" halves gradient-sync wire bytes (torch bf16_compress_hook
         # analog); adds ~bf16-eps relative error to the synced mean
         grad_compress: str = "off",
@@ -142,6 +146,7 @@ class DDPTrainer:
         if zero1_ring and not zero1:
             raise ValueError("zero1_ring=True requires zero1=True")
         self.zero1_ring = zero1_ring
+        self.zero1_ring_chunk_bytes = zero1_ring_chunk_bytes
         self.hook = GradSyncHook(
             strategy,
             axis_name=axis_name,
@@ -195,7 +200,8 @@ class DDPTrainer:
         from adapcc_tpu.parallel.fsdp import Zero1Optimizer
 
         opt = self._zero1_opt = Zero1Optimizer(
-            self.tx, self.mesh, self.axis_name, ring=self.zero1_ring
+            self.tx, self.mesh, self.axis_name, ring=self.zero1_ring,
+            ring_chunk_bytes=self.zero1_ring_chunk_bytes,
         )
         master, opt_state = opt.init(params)
         return TrainState(
@@ -301,6 +307,7 @@ class DDPTrainer:
         master, opt_state, params = zero1_apply_shard(
             self.tx, master, opt_state, g_shard, meta, self.axis_name,
             ring=self.zero1_ring, ring_interpret=ring_interpret,
+            ring_chunk_bytes=self.zero1_ring_chunk_bytes,
         )
         return TrainState(
             params=params,

@@ -287,6 +287,7 @@ def zero1_apply_shard(
     axis_name: str,
     ring: bool = False,
     ring_interpret: bool = False,
+    ring_chunk_bytes: Optional[int] = None,
 ):
     """The in-shard ZeRO-1 update cycle, shared by every composition site
     (Zero1Optimizer.apply, zero1_train_step, DDPTrainer(zero1=True)):
@@ -297,7 +298,9 @@ def zero1_apply_shard(
     ``ring=True`` rides the Pallas ICI ring all-gather instead of XLA's
     (the hand-tuned data plane): rank ``r`` then owns chunk ``(r+1) % world``
     (the ring's natural ownership), and the gathered rank-ordered rows are
-    rolled back into chunk order before unflattening.
+    rolled back into chunk order before unflattening.  ``ring_chunk_bytes``
+    is the staging granularity handed down from the strategy plane (None =
+    default; payloads above it stream through HBM staging).
     """
     updates, opt_state = tx.update(g_shard, opt_state, master)
     master = optax.apply_updates(master, updates)
@@ -306,7 +309,8 @@ def zero1_apply_shard(
 
         world = meta.padded // master.size
         gathered = ring_all_gather_shard(
-            master, world, axis_name, interpret=ring_interpret
+            master, world, axis_name, interpret=ring_interpret,
+            chunk_bytes=ring_chunk_bytes,
         )
         # gathered[i] = rank i's payload = chunk (i+1) % world
         flat_p = jnp.roll(gathered, 1, axis=0).reshape(-1)
@@ -364,6 +368,7 @@ class Zero1Optimizer:
         axis_name: str = RANKS_AXIS,
         ring: bool = False,
         ring_interpret: Optional[bool] = None,
+        ring_chunk_bytes: Optional[int] = None,
     ) -> None:
         self.tx = tx
         self.mesh = mesh
@@ -373,6 +378,13 @@ class Zero1Optimizer:
         if ring_interpret is None:
             ring_interpret = jax.devices()[0].platform != "tpu"
         self.ring_interpret = ring_interpret
+        #: staging granularity for the ring collectives (strategy plane's
+        #: synthesized chunk_bytes; None = default, env-overridable for
+        #: sweeps).  Payloads above it ride the HBM-streaming kernel, so
+        #: gradient size is bounded by HBM, not VMEM — chunk *layout* is
+        #: unaffected (the executed tile divides the shard), so this knob
+        #: never invalidates a checkpoint.
+        self.ring_chunk_bytes = ring_chunk_bytes
         self._meta: Optional[_FlatMeta] = None
         self._compiled: Optional[Callable] = None
 
@@ -412,6 +424,7 @@ class Zero1Optimizer:
         shard_len = meta.padded // world
 
         ring, ring_interpret = self.ring, self.ring_interpret
+        ring_chunk_bytes = self.ring_chunk_bytes
 
         def per_shard(master, opt_state, grads_tree):
             # strip the [1] shard dim shard_map leaves on the leading axis
@@ -427,6 +440,7 @@ class Zero1Optimizer:
             master, opt_state, new_params = zero1_apply_shard(
                 tx, master, opt_state, g_shard, meta, axis,
                 ring=ring, ring_interpret=ring_interpret,
+                ring_chunk_bytes=ring_chunk_bytes,
             )
             return (
                 master[None],
@@ -543,6 +557,7 @@ def zero1_train_step(
         shard_len = meta.padded // world
         tx = opt.tx
         ring, ring_interpret = opt.ring, opt.ring_interpret
+        ring_chunk_bytes = opt.ring_chunk_bytes
 
         def per_shard(params, master, opt_state, batch):
             master = master[0]
@@ -557,7 +572,8 @@ def zero1_train_step(
                 # the Pallas ring leaves rank r with reduced chunk
                 # (r+1) % world — exactly this mode's master/opt layout
                 g_shard = ring_reduce_scatter_shard(
-                    flat_g, world, axis_name, interpret=ring_interpret
+                    flat_g, world, axis_name, interpret=ring_interpret,
+                    chunk_bytes=ring_chunk_bytes,
                 )
             else:
                 g_shard = lax.psum_scatter(
@@ -567,6 +583,7 @@ def zero1_train_step(
             master, opt_state, new_params = zero1_apply_shard(
                 tx, master, opt_state, g_shard, meta, axis_name,
                 ring=ring, ring_interpret=ring_interpret,
+                ring_chunk_bytes=ring_chunk_bytes,
             )
             return (
                 new_params,

@@ -248,6 +248,56 @@ class LinkCostModel:
         )
 
 
+#: HBM streaming rate used to price the staged pipeline's local DMAs when
+#: no measured rate exists (~v5e class HBM; a deliberately round number,
+#: replaced by any calibration the operator provides)
+DEFAULT_HBM_BYTES_PER_S = 800e9
+
+
+def staged_ring_allreduce_time(
+    world: int,
+    nbytes: float,
+    coeffs: LinkCoeffs,
+    chunk_bytes: float,
+    hbm_bytes_per_s: float = DEFAULT_HBM_BYTES_PER_S,
+) -> float:
+    """Analytical latency of the HBM-streaming staged ring allreduce
+    (``pallas_ring``'s hbm-stream path), pricing the pipeline fill/drain the
+    fixed VMEM staging adds on top of the wire time.
+
+    Per rank the payload splits into ``world`` chunks of ``nbytes/world``;
+    each ring step moves one chunk as ``ceil(chunk / chunk_bytes)`` staging
+    tiles.  One tile iteration is synchronous in the kernel:
+
+    - **fill** — HBM work tile → VMEM send staging (1 tile over HBM),
+    - wire — the RDMA hop (α + β·tile),
+    - **drain** — accumulate read+write during reduce-scatter (2 tiles over
+      HBM), or the adopt write during all-gather (1 tile),
+
+    plus the one-time whole-payload seed copy (input → HBM work buffer).
+    Small tiles pay the α fixed cost per tile, so predicted time falls as
+    ``chunk_bytes`` grows and flattens once α is amortized — while the VMEM
+    staging footprint (4 tiles) keeps growing linearly.  The sweep over
+    ``chunk_bytes`` exposes that knee hardware-free: the right chunk is the
+    smallest one on the flat part of the curve.  Degenerates to
+    :func:`ring_allreduce_time`'s per-hop structure as ``chunk_bytes →
+    chunk`` with the HBM terms added.
+    """
+    if world < 2:
+        return 0.0
+    if chunk_bytes <= 0:
+        raise ValueError(f"chunk_bytes must be positive, got {chunk_bytes}")
+    chunk = nbytes / world
+    tiles = max(1, int(-(-chunk // chunk_bytes)))
+    tile_bytes = chunk / tiles
+    hbm = tile_bytes / hbm_bytes_per_s
+    wire = coeffs.time(tile_bytes)
+    rs_iter = hbm + wire + 2.0 * hbm       # fill + RDMA + accumulate in/out
+    ag_iter = hbm + wire + hbm             # fill + RDMA + adopt write
+    seed = nbytes / hbm_bytes_per_s        # input → HBM work buffer
+    return seed + (world - 1) * tiles * (rs_iter + ag_iter)
+
+
 def ring_allreduce_time(
     world: int, nbytes: float, coeffs: LinkCoeffs, chunks: int = 1
 ) -> float:

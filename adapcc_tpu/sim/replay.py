@@ -87,16 +87,21 @@ def lower_strategy(
     active: Optional[Iterable[int]] = None,
 ) -> List[TreeSchedule]:
     """Strategy → per-tree schedules: payload split by tree shares
-    (``1/num_trans`` unless the MILP optimized unequal shares), chunked at
-    the strategy's ``chunk_bytes`` for pipelining."""
+    (``1/num_trans`` unless the MILP optimized unequal shares), each tree
+    chunked at its own granularity — the solver's per-tree c_m when the
+    strategy carries one (``Strategy.chunk_bytes_for_tree``), else the
+    global ``chunk_bytes`` — so a skewed share pipelines at a comparable
+    depth instead of one oversized chunk."""
     act = frozenset(active) if active is not None else None
     schedules = []
-    for tree, share in zip(strategy.trees, strategy.tree_shares()):
+    for i, (tree, share) in enumerate(
+        zip(strategy.trees, strategy.tree_shares())
+    ):
         schedules.append(
             TreeSchedule(
                 rounds=_tree_rounds(tree, collective, act),
                 nbytes=nbytes * share,
-                chunk_bytes=strategy.chunk_bytes,
+                chunk_bytes=strategy.chunk_bytes_for_tree(i),
                 label=f"tree@{tree.root}",
             )
         )

@@ -296,6 +296,12 @@ class Strategy:
     #: by the MILP solver when it optimizes unequal shares (the reference's
     #: per-tree sizes s_m, gurobi/solver.py objective).
     shares: Optional[List[float]] = None
+    #: per-tree chunk granularity in bytes; None = every tree pipelines at
+    #: the global ``chunk_bytes``.  Set by the MILP solver (the reference's
+    #: per-tree chunk output c_m, gurobi/solver.py:211) so a skewed share
+    #: keeps a comparable pipeline depth, and round-tripped through the
+    #: strategy XML so a persisted strategy fully determines ring execution.
+    tree_chunk_bytes: Optional[List[int]] = None
     #: which formulation produced this strategy ("milp-routing",
     #: "milp-rotation", "partrees", "partrees-fallback", "ring", "binary",
     #: …).  Recorded into the emitted XML so a production fallback is
@@ -318,6 +324,19 @@ class Strategy:
             if total <= 0:
                 raise ValueError("shares must sum to a positive value")
             self.shares = [s / total for s in self.shares]
+        if self.tree_chunk_bytes is not None:
+            if len(self.tree_chunk_bytes) != len(self.trees):
+                raise ValueError("tree_chunk_bytes must have one entry per tree")
+            bad = [c for c in self.tree_chunk_bytes if c <= 0]
+            if bad:
+                raise ValueError(f"tree_chunk_bytes must be positive, got {bad}")
+
+    def chunk_bytes_for_tree(self, index: int) -> int:
+        """The chunk granularity tree ``index``'s segment pipelines at: its
+        solver-assigned c_m when present, else the global ``chunk_bytes``."""
+        if self.tree_chunk_bytes is not None:
+            return self.tree_chunk_bytes[index]
+        return self.chunk_bytes
 
     def tree_shares(self) -> List[float]:
         if self.shares is not None:

@@ -41,10 +41,21 @@ class Synthesizer:
         latency_graph: Sequence[Sequence[float]],
         local_rank0_list: Optional[Sequence[int]] = None,
     ) -> int:
-        """Synthesize + persist the strategy XML; returns chunk bytes."""
+        """Synthesize + persist the strategy XML; returns chunk bytes.
+
+        The persisted ``chunk_bytes`` is the ring data plane's staging
+        granularity (docs/RING.md §2), clamped to the transmission size it
+        pipelines — a chunk larger than the payload is just the payload.
+        The XML carries it (plus any per-tree c_m the solver emitted), so
+        the artifact alone determines ring execution on every process.
+        """
         strategy = self.synthesize(
             prim, parallel_degree, transmission_size, bandwidth_graph, latency_graph, local_rank0_list
         )
+        if transmission_size and transmission_size > 0:
+            strategy.chunk_bytes = max(
+                1, min(strategy.chunk_bytes, int(transmission_size))
+            )
         if self.strategy_file:
             emit_strategy_xml(strategy, self.strategy_file)
         return strategy.chunk_bytes

@@ -41,12 +41,34 @@ def _lenient_fromstring(text: str) -> ET.Element:
         return ET.fromstring(_MISSING_SPACE.sub(r"\1 ", text))
 
 
+def _positive_chunk(raw: str, element: str) -> int:
+    """Validate a chunk_bytes attribute at parse time: a corrupted artifact
+    must fail at the file that carries it, not deep inside a later ring
+    dispatch."""
+    try:
+        value = int(raw)
+    except ValueError:
+        value = -1
+    if value <= 0:
+        raise ValueError(
+            f"<{element} chunk_bytes={raw!r}>: expected a positive byte count"
+        )
+    return value
+
+
 # --------------------------------------------------------------------------- #
 # strategy trees
 # --------------------------------------------------------------------------- #
 
 def parse_strategy_xml(text_or_path: str, chunk_bytes: int = 4 * 1024 * 1024) -> Strategy:
-    """Parse a strategy XML document (or file path) into a :class:`Strategy`."""
+    """Parse a strategy XML document (or file path) into a :class:`Strategy`.
+
+    ``chunk_bytes`` is only a default: a ``chunk_bytes`` attribute persisted
+    on ``<trees>`` (and per-tree on ``<root>``, the solver's c_m output —
+    reference gurobi/solver.py:211) wins, so a persisted strategy fully
+    determines ring execution without out-of-band state.  Reference XMLs
+    without the attribute keep the caller's default.
+    """
     text = _maybe_read(text_or_path)
     doc = _lenient_fromstring(text)
     if doc.tag != "trees":
@@ -54,6 +76,7 @@ def parse_strategy_xml(text_or_path: str, chunk_bytes: int = 4 * 1024 * 1024) ->
 
     trees: List[Tree] = []
     all_ranks: set = set()
+    per_tree_chunks: List[Optional[int]] = []
     for root_el in doc.findall("root"):
         children: Dict[int, List[int]] = {}
         ips: Dict[int, str] = {}
@@ -70,25 +93,43 @@ def parse_strategy_xml(text_or_path: str, chunk_bytes: int = 4 * 1024 * 1024) ->
         root_rank = int(root_el.attrib["id"])
         trees.append(Tree(root_rank, children, ips))
         all_ranks |= trees[-1].ranks
+        raw = root_el.attrib.get("chunk_bytes")
+        per_tree_chunks.append(_positive_chunk(raw, "root") if raw else None)
 
     world_size = max(all_ranks) + 1 if all_ranks else 0
+    doc_chunk = doc.attrib.get("chunk_bytes")
+    if doc_chunk:
+        chunk_bytes = _positive_chunk(doc_chunk, "trees")
+    tree_chunk_bytes: Optional[List[int]] = None
+    if any(c is not None for c in per_tree_chunks):
+        # a tree without its own attribute pipelines at the document chunk
+        tree_chunk_bytes = [
+            c if c is not None else chunk_bytes for c in per_tree_chunks
+        ]
     return Strategy(
-        trees, world_size, chunk_bytes, synthesis=doc.attrib.get("synthesis") or None
+        trees, world_size, chunk_bytes,
+        synthesis=doc.attrib.get("synthesis") or None,
+        tree_chunk_bytes=tree_chunk_bytes,
     )
 
 
 def emit_strategy_xml(strategy: Strategy, path: Optional[str] = None) -> str:
-    """Serialize a :class:`Strategy` back to the reference XML schema."""
+    """Serialize a :class:`Strategy` back to the reference XML schema, plus
+    the chunk-granularity attributes (`<trees chunk_bytes=…>` and per-tree
+    on `<root>`) that make the artifact self-contained for ring execution."""
     doc = ET.Element("trees")
     if strategy.synthesis:
         # provenance: which formulation produced this strategy (a solver
         # fallback in production must be distinguishable from an optimum)
         doc.set("synthesis", strategy.synthesis)
-    for tree in strategy.trees:
+    doc.set("chunk_bytes", str(strategy.chunk_bytes))
+    for i, tree in enumerate(strategy.trees):
         def build(rank: int, parent_el: ET.Element, tag: str) -> None:
             el = ET.SubElement(parent_el, tag)
             el.set("id", str(rank))
             el.set("ip", tree.ips.get(rank, ""))
+            if tag == "root" and strategy.tree_chunk_bytes is not None:
+                el.set("chunk_bytes", str(strategy.tree_chunk_bytes[i]))
             for c in tree.children.get(rank, ()):
                 build(c, el, "gpu")
 

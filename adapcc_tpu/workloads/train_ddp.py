@@ -62,6 +62,12 @@ def build_parser() -> argparse.ArgumentParser:
         "data plane; shards become VMEM-tile aligned)",
     )
     p.add_argument(
+        "--ring-chunk-bytes", type=int, default=0,
+        help="zero1-ring staging granularity in bytes (0 = the synthesized "
+        "default); payloads above it stream through fixed HBM→VMEM staging. "
+        "ADAPCC_RING_CHUNK_BYTES overrides for sweeps",
+    )
+    p.add_argument(
         "--min-shard-elems", type=int, default=2**14,
         help="fsdp: leaves smaller than this stay replicated",
     )
@@ -216,7 +222,10 @@ def main(argv=None) -> None:
     elif args.dp_mode == "zero1":
         from adapcc_tpu.parallel import Zero1Optimizer, zero1_train_step
 
-        z_opt = Zero1Optimizer(tx, mesh, ring=args.zero1_ring)
+        z_opt = Zero1Optimizer(
+            tx, mesh, ring=args.zero1_ring,
+            ring_chunk_bytes=args.ring_chunk_bytes or None,
+        )
         master, z_state = z_opt.init(params)
         z_step = zero1_train_step(loss_fn, z_opt, mesh)
 

@@ -30,7 +30,7 @@ import json
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from adapcc_tpu.sim.calibrate import DEFAULT_CALIBRATION_PATH, load_or_default
-from adapcc_tpu.sim.cost_model import LinkCostModel
+from adapcc_tpu.sim.cost_model import DEFAULT_HBM_BYTES_PER_S, LinkCostModel
 from adapcc_tpu.sim.replay import simulate_flow_broadcast, simulate_strategy
 from adapcc_tpu.strategy.ir import Strategy
 
@@ -210,6 +210,80 @@ def sweep(
     return rows
 
 
+def ring_chunk_sweep(
+    world: int,
+    sizes: Sequence[int],
+    chunk_sizes: Sequence[int],
+    model: Optional[LinkCostModel] = None,
+) -> List[dict]:
+    """Predicted staged-ring rows over a chunk-size grid — the hardware-free
+    regression artifact for ring chunk tuning (``make ring-sweep``).
+
+    Each row prices the Pallas ring at one ``chunk_bytes`` staging
+    granularity with :func:`adapcc_tpu.sim.cost_model.
+    staged_ring_allreduce_time`, on the *bottleneck* ring link (a lockstep
+    ring advances at its slowest hop).  The executed path and tile come from
+    the kernel's own planner (:func:`adapcc_tpu.comm.pallas_ring.
+    plan_ring_schedule` — pure planning, no kernel execution), so a sweep
+    row can never disagree with what the data plane would actually run.
+    Deterministic: same calibration → byte-identical rows.
+    """
+    from adapcc_tpu.comm.pallas_ring import plan_ring_schedule
+    from adapcc_tpu.sim.cost_model import staged_ring_allreduce_time
+
+    if model is None:
+        model = load_or_default(world=world)
+    elif model.world != world:
+        raise ValueError(f"model world {model.world} != sweep world {world}")
+    # lockstep ring: the slowest (src → src+1) hop paces every step
+    ring_links = [(r, (r + 1) % world) for r in range(world)]
+    coeffs = max(
+        (model.coeffs(s, d) for s, d in ring_links),
+        key=lambda c: c.time(1 << 20),
+    )
+    rows: List[dict] = []
+    for nbytes in sizes:
+        for chunk in chunk_sizes:
+            plan = plan_ring_schedule(
+                -(-int(nbytes) // 4), "float32", world, int(chunk)
+            )
+            # both paths execute the same 2(w−1)-step ring walk, so both are
+            # priced with the staged model; the vmem path just pays no HBM
+            # staging (payload already VMEM-resident) — pricing them with
+            # different schedule shapes would invert the vmem/stream knee
+            seconds = staged_ring_allreduce_time(
+                world, nbytes, coeffs, plan.stage_bytes,
+                hbm_bytes_per_s=(
+                    float("inf") if plan.path == "vmem" else
+                    DEFAULT_HBM_BYTES_PER_S
+                ),
+            )
+            algbw = nbytes / seconds / 1e9 if seconds > 0 else 0.0
+            rows.append({
+                "mode": "simulated",
+                "collective": "allreduce",
+                "impl": "pallas_ring",
+                "strategy": "ring",
+                "world": world,
+                "size_bytes": int(nbytes),
+                "chunk_bytes": int(chunk),
+                "ring_path": plan.path,
+                "stage_bytes": plan.stage_bytes,
+                "n_tiles": plan.n_tiles,
+                "vmem_bound_bytes": plan.vmem_bound_bytes,
+                "pred_time_us": round(seconds * 1e6, 3),
+                "algbw_gbps": round(algbw, 6),
+                "busbw_gbps": round(algbw * BUS_FACTORS["allreduce"](world), 6),
+                "calibration": model.source,
+            })
+    if not rows:
+        raise ValueError(
+            f"ring sweep produced no rows: sizes={list(sizes)} "
+            f"chunks={list(chunk_sizes)}"
+        )
+    return rows
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--world", type=int, default=8)
@@ -228,10 +302,37 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="calibration artifact path (synthetic defaults when absent)",
     )
     ap.add_argument("--no-flow-lp", action="store_true")
+    ap.add_argument(
+        "--ring-sweep", action="store_true",
+        help="sweep the staged Pallas ring over --chunks instead of the "
+        "strategy grid (chunk-size tuning rows, make ring-sweep)",
+    )
+    ap.add_argument(
+        "--chunks", default="256K,1M,4M,16M",
+        help="ring-sweep staging granularities (chunk_bytes grid)",
+    )
     ap.add_argument("--json", action="store_true", help="one JSON row per line")
     args = ap.parse_args(argv)
 
     model = load_or_default(args.calibration, world=args.world)
+    if args.ring_sweep:
+        rows = ring_chunk_sweep(
+            world=args.world,
+            sizes=[parse_size(s) for s in args.sizes.split(",")],
+            chunk_sizes=[parse_size(c) for c in args.chunks.split(",") if c],
+            model=model,
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            else:
+                print(
+                    f"[sim] ring {row['size_bytes']:>12}B chunk="
+                    f"{row['chunk_bytes']:>10}B  path={row['ring_path']:<10} "
+                    f"pred={row['pred_time_us']:>10.1f}us  "
+                    f"busbw={row['busbw_gbps']:>8.3f}GB/s"
+                )
+        return 0
     rows = sweep(
         world=args.world,
         sizes=[parse_size(s) for s in args.sizes.split(",")],

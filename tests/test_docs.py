@@ -1,8 +1,8 @@
 """The docs' code blocks execute — documentation that cannot drift.
 
-Every ```python block in docs/PARALLELISM.md and docs/OPERATIONS.md runs
-verbatim on the virtual pod.  A snippet that stops compiling or produces
-wrong shapes fails here.
+Every ```python block in docs/PARALLELISM.md, docs/OPERATIONS.md,
+docs/SIMULATION.md and docs/RING.md runs verbatim on the virtual pod.  A
+snippet that stops compiling or produces wrong shapes fails here.
 """
 
 import os
@@ -16,6 +16,7 @@ _DOCS_DIR = os.path.join(
 _PARALLELISM = os.path.join(_DOCS_DIR, "PARALLELISM.md")
 _OPERATIONS = os.path.join(_DOCS_DIR, "OPERATIONS.md")
 _SIMULATION = os.path.join(_DOCS_DIR, "SIMULATION.md")
+_RING = os.path.join(_DOCS_DIR, "RING.md")
 
 
 def _blocks(path):
@@ -74,3 +75,24 @@ def test_simulation_doc_covers_the_contract():
 def test_simulation_doc_snippet_runs(idx):
     code = _blocks(_SIMULATION)[idx]
     exec(compile(code, f"{_SIMULATION}:block{idx}", "exec"), {})
+
+
+def test_ring_doc_has_snippets():
+    assert len(_blocks(_RING)) >= 5
+
+
+def test_ring_doc_covers_the_contract():
+    """The staged-pipeline topics the tuning runbook leans on must exist."""
+    text = open(_RING).read()
+    for needle in (
+        "hbm-stream", "vmem", "chunk_bytes", "ADAPCC_RING_CHUNK_BYTES",
+        "plan_ring_schedule", "make ring-sweep", "Zero1Optimizer",
+        "ring_chunk_sweep", "credit", "c_m",
+    ):
+        assert needle in text, f"RING.md lost its {needle!r} coverage"
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_RING))))
+def test_ring_doc_snippet_runs(idx):
+    code = _blocks(_RING)[idx]
+    exec(compile(code, f"{_RING}:block{idx}", "exec"), {})

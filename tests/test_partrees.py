@@ -76,9 +76,14 @@ def test_synthesizer_partrees_policy(tmp_path):
     ip_table, masters, bw, lat = two_hosts()
     out = tmp_path / "s.xml"
     syn = Synthesizer(str(out), ip_table)
+    # the persisted chunk is clamped to the transmission size it pipelines
+    # (a chunk larger than the payload is just the payload) and round-trips
+    # through the XML, so the artifact determines ring execution
     chunk = syn.generate_strategy(ALLREDUCE, 2, 1 << 20, bw, lat)
-    assert chunk == DEFAULT_CHUNK_BYTES
-    assert parse_strategy_xml(str(out)).world_size == 8
+    assert chunk == min(DEFAULT_CHUNK_BYTES, 1 << 20)
+    persisted = parse_strategy_xml(str(out))
+    assert persisted.world_size == 8
+    assert persisted.chunk_bytes == chunk
 
 
 def test_milp_solver_prefers_fast_root():

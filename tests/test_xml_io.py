@@ -117,3 +117,44 @@ def test_reject_wrong_root_tag():
         parse_strategy_xml("<graph></graph>")
     with pytest.raises(ValueError):
         parse_logical_graph_xml("<trees></trees>")
+
+
+def test_chunk_bytes_roundtrips_through_xml(tmp_path):
+    """The staging granularity is part of the persisted artifact: a strategy
+    XML fully determines ring execution (VERDICT r5 #8)."""
+    s = Strategy.ring(4, num_trans=2, ips={i: "h0" for i in range(4)})
+    s.chunk_bytes = 1 << 20
+    s.tree_chunk_bytes = [1 << 20, 1 << 18]
+    p = tmp_path / "s.xml"
+    text = emit_strategy_xml(s, str(p))
+    assert 'chunk_bytes="1048576"' in text
+    back = parse_strategy_xml(str(p), chunk_bytes=999)  # default must lose
+    assert back.chunk_bytes == 1 << 20
+    assert back.tree_chunk_bytes == [1 << 20, 1 << 18]
+    assert back.chunk_bytes_for_tree(1) == 1 << 18
+
+
+def test_legacy_xml_without_chunk_keeps_caller_default():
+    """Reference-era XMLs (no chunk attributes) keep the communicator's
+    default — artifact compatibility is not broken."""
+    s = parse_strategy_xml(
+        "<trees><root id='0' ip='a'><gpu id='1' ip='a'/></root></trees>",
+        chunk_bytes=4321,
+    )
+    assert s.chunk_bytes == 4321
+    assert s.tree_chunk_bytes is None
+    assert s.chunk_bytes_for_tree(0) == 4321
+
+
+def test_corrupt_chunk_attribute_fails_at_parse():
+    """A corrupted chunk_bytes attribute must fail at the artifact that
+    carries it, not deep inside a later ring dispatch."""
+    for bad in ("0", "-4096", "lots"):
+        with pytest.raises(ValueError, match="chunk_bytes"):
+            parse_strategy_xml(
+                f"<trees chunk_bytes='{bad}'><root id='0' ip='a'/></trees>"
+            )
+    with pytest.raises(ValueError, match="chunk_bytes"):
+        parse_strategy_xml(
+            "<trees><root id='0' ip='a' chunk_bytes='0'/></trees>"
+        )
