@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench ring-sweep clean
+.PHONY: all native test sim-bench ring-sweep quant-bench clean
 
 all: native
 
@@ -32,6 +32,14 @@ sim-bench:
 ring-sweep:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--world 8 --sizes 16M,128M --ring-sweep --chunks 256K,1M,4M,16M --json
+
+# Wire-codec sweep for the quantized ring allreduce on the same simulator
+# (docs/QUANT.md): deterministic "mode": "simulated" rows over the codec
+# grid, priced by the sim-rank cost-model term (reduced wire bytes vs
+# per-hop codec overhead), with the chosen dtype flagged per size.
+quant-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--world 8 --sizes 1M,16M,128M --wire-dtype off,bf16,int8 --json
 
 clean:
 	rm -f $(LIB)

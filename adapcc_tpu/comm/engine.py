@@ -865,18 +865,74 @@ class CollectiveEngine:
                 chunk_bytes=plan.chunk_bytes,
                 stage_bytes=plan.stage_bytes,
                 n_tiles=plan.n_tiles,
+                wire_dtype="off",  # pallas kernels ship the payload dtype
             )
+
+    def _resolved_wire_dtype(self, wire_dtype: Optional[str]) -> str:
+        """The wire codec a ring dispatch runs: ADAPCC_WIRE_DTYPE override >
+        explicit argument > the strategy's synthesized ``wire_dtype`` — the
+        same precedence ladder as the ring chunk size."""
+        from adapcc_tpu.quant import resolve_wire_dtype
+
+        return resolve_wire_dtype(
+            wire_dtype if wire_dtype is not None else self.strategy.wire_dtype
+        )
+
+    def _wire_ring_allreduce(
+        self, stacked: jnp.ndarray, wire_dtype: str, block_size: int
+    ) -> jnp.ndarray:
+        """Ring allreduce over codec-compressed chunks (the EQuARX shape):
+        reduce-scatter dequant-accumulate-requants at every hop, all-gather
+        ships each reduced chunk's encoded blocks once.  ppermute-based —
+        any backend, no Pallas requirement — and recorded in the dispatch
+        trace with the executed ``wire_dtype``."""
+        from adapcc_tpu.quant import get_codec, wire_ring_allreduce_shard
+        from adapcc_tpu.sim.cost_model import wire_bytes_per_element
+
+        codec = get_codec(wire_dtype)  # fail before tracing, not inside
+        world = self.world_size
+
+        def per_shard(x):  # x: [1, *payload]
+            return wire_ring_allreduce_shard(
+                x[0], world, self.axis_name,
+                wire_dtype=codec.name, block_size=block_size,
+            )[None]
+
+        key = (
+            "quant_ring_allreduce", stacked.shape, stacked.dtype.name,
+            codec.name, block_size,
+        )
+        if self.trace is not None:
+            per_rank = int(np.prod(stacked.shape[1:]))
+            self.trace.record(
+                "allreduce",
+                f"quant_ring[{codec.name}]",
+                int(stacked.nbytes),
+                wire_dtype=codec.name,
+                block_size=block_size,
+                wire_bytes=int(
+                    per_rank * wire_bytes_per_element(codec.name, block_size)
+                ),
+            )
+        return self._shard_mapped(key, per_shard, 1)(stacked)
 
     def ring_allreduce(
         self,
         stacked: jnp.ndarray,
         interpret: Optional[bool] = None,
         chunk_bytes: Optional[int] = None,
+        wire_dtype: Optional[str] = None,
+        quant_block_size: Optional[int] = None,
     ) -> jnp.ndarray:
         """Pallas ICI ring allreduce (hand-tuned data plane; see
         :mod:`adapcc_tpu.comm.pallas_ring`).  ``interpret=None`` auto-selects
         the interpreter off-TPU so the same call works on the virtual pod.
-        ``chunk_bytes=None`` uses the strategy's synthesized granularity."""
+        ``chunk_bytes=None`` uses the strategy's synthesized granularity.
+
+        ``wire_dtype=None`` adopts the strategy's synthesized codec
+        (``ADAPCC_WIRE_DTYPE`` overrides both): a non-"off" codec reroutes
+        to the quantized ppermute ring (:meth:`_wire_ring_allreduce`) —
+        compressed chunks on the wire, fp32 accumulation at every hop."""
         from adapcc_tpu.comm.pallas_ring import ring_allreduce_shard
 
         if self.two_level:
@@ -885,6 +941,13 @@ class CollectiveEngine:
                 "two-level worlds use the strategy allreduce"
             )
         self._check_world_dim(stacked, "ring_allreduce")
+        wd = self._resolved_wire_dtype(wire_dtype)
+        if wd != "off":
+            from adapcc_tpu.quant import DEFAULT_BLOCK_SIZE
+
+            return self._wire_ring_allreduce(
+                stacked, wd, quant_block_size or DEFAULT_BLOCK_SIZE
+            )
         if interpret is None:
             interpret = jax.devices()[0].platform != "tpu"
         world = self.world_size

@@ -50,6 +50,8 @@ class GradSyncHook:
         communicator: Optional[Any] = None,
         mode: str = "auto",
         compress: str = "off",
+        error_feedback: bool = False,
+        quant_block_size: int = 256,
     ) -> None:
         """``mode``: ``"psum"`` = per-leaf masked psum (one XLA collective per
         leaf — no bucketing copies, optimal on a flat ICI mesh and still
@@ -58,15 +60,35 @@ class GradSyncHook:
         ``"auto"`` = psum when fastpath is allowed and the strategy spans a
         single host group, schedule otherwise.
 
-        ``compress``: ``"bf16"`` casts gradients to bfloat16 for the wire
-        (halving ICI/DCN bytes) and back afterwards — the torch-DDP
-        ``bf16_compress_hook`` analog (the XLA-native cousin of quantized
-        allreduce, PAPERS.md EQuARX).  Accumulation then happens in bf16,
-        adding ~bf16-eps relative error to the synced mean; ``"off"`` keeps
-        the gradient dtype end to end.
+        ``compress`` names a wire codec from the quant registry
+        (:mod:`adapcc_tpu.quant` — ``"off" | "bf16" | "int8"`` plus anything
+        registered later), or ``"strategy"`` to adopt the synthesized
+        ``Strategy.wire_dtype``; ``ADAPCC_WIRE_DTYPE`` overrides either.
+        ``"bf16"`` casts gradients to bfloat16 for the wire (halving ICI/DCN
+        bytes, the torch-DDP ``bf16_compress_hook`` analog) and back
+        afterwards — accumulation then happens in bf16, adding ~bf16-eps
+        relative error to the synced mean.  ``"int8"`` gives every
+        contribution its block-wise quantized wire *value* (per-block fp32
+        scales over ``quant_block_size`` elements, deterministic rounding)
+        before the fp32 collective — the XLA-plane realization of the
+        quantized allreduce (the ring engine moves actual int8 bytes; see
+        docs/QUANT.md).  ``"off"`` keeps the gradient dtype end to end.
+
+        ``error_feedback``: carry each rank's quantization error in a
+        residual buffer folded into the next step's gradient (the
+        :func:`adapcc_tpu.quant.error_feedback_step` loop) — drive it via
+        :meth:`sync_error_feedback`; the trainer threads the buffer.
         """
-        if compress not in ("off", "bf16"):
-            raise ValueError(f"compress must be off|bf16, got {compress!r}")
+        from adapcc_tpu.quant import get_codec
+
+        if compress != "strategy":
+            get_codec(compress)  # loud, lists the registered codecs
+        if quant_block_size < 1:
+            raise ValueError(
+                f"quant_block_size must be >= 1, got {quant_block_size}"
+            )
+        self.error_feedback = error_feedback
+        self.quant_block_size = quant_block_size
         self.strategy = strategy
         self.axis_name = axis_name
         self.op = op
@@ -111,6 +133,28 @@ class GradSyncHook:
 
     # -- device half -----------------------------------------------------------
 
+    def effective_compress(self) -> str:
+        """The wire codec this hook runs: ``ADAPCC_WIRE_DTYPE`` override >
+        (``compress="strategy"`` → the strategy's synthesized wire_dtype) >
+        the constructor's ``compress`` — the engine ring's precedence
+        ladder, so hook and engine can never disagree about the codec a
+        strategy asked for."""
+        from adapcc_tpu.quant import resolve_wire_dtype
+
+        value = (
+            self.strategy.wire_dtype
+            if self.compress == "strategy"
+            else self.compress
+        )
+        return resolve_wire_dtype(value)
+
+    def _codec_apply(self, g: jnp.ndarray) -> jnp.ndarray:
+        from adapcc_tpu.quant import get_codec
+
+        return get_codec(self.effective_compress()).apply(
+            g, self.quant_block_size
+        )
+
     def sync(self, grads: Any, active_mask: Optional[jnp.ndarray]) -> Any:
         """Allreduce a gradient pytree; call inside shard_map.
 
@@ -120,7 +164,8 @@ class GradSyncHook:
         """
         import jax as _jax
 
-        if self.compress == "bf16":
+        codec = self.effective_compress()
+        if codec == "bf16":
             orig_dtypes = _jax.tree_util.tree_map(lambda g: g.dtype, grads)
             wire = _jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.bfloat16), grads
@@ -129,7 +174,44 @@ class GradSyncHook:
             return _jax.tree_util.tree_map(
                 lambda s, dt: s.astype(dt), synced, orig_dtypes
             )
+        if codec != "off":
+            # quantized wire values, fp32 accumulation: each contribution is
+            # replaced by its decode(encode(·)) before the collective — the
+            # value contract the quantized ring engine also honors
+            grads = _jax.tree_util.tree_map(self._codec_apply, grads)
         return self._sync_impl(grads, active_mask)
+
+    def sync_error_feedback(
+        self, grads: Any, residual: Any, active_mask: Optional[jnp.ndarray]
+    ) -> tuple:
+        """Error-feedback sync; call inside shard_map.  Returns ``(synced,
+        new_residual)``: the wire carries ``codec(grads + residual)`` and
+        the per-rank quantization error is banked for the next step, so no
+        gradient mass is ever dropped (codec ``"off"`` keeps the residual
+        identically zero and reduces to :meth:`sync`).
+
+        Dtype contract: the residual accumulates in fp32 (a narrow bank
+        would lose the very mass it defers), but the wire and the synced
+        result keep each gradient leaf's own dtype — the fp32 compensation
+        must not silently widen a bf16 program's collective operands, and
+        the residual absorbs the cast-back error along with the codec's.
+        """
+        import jax as _jax
+
+        tm = _jax.tree_util.tree_map
+        orig_dtypes = tm(lambda g: g.dtype, grads)
+        compensated = tm(
+            lambda g, r: g.astype(jnp.float32) + r, grads, residual
+        )
+        wire = tm(
+            lambda c, dt: self._codec_apply(c).astype(dt),
+            compensated, orig_dtypes,
+        )
+        new_residual = tm(
+            lambda c, w: c - w.astype(jnp.float32), compensated, wire
+        )
+        synced = self._sync_impl(wire, active_mask)
+        return tm(lambda s, dt: s.astype(dt), synced, orig_dtypes), new_residual
 
     def _sync_impl(self, grads: Any, active_mask: Optional[jnp.ndarray]) -> Any:
         import jax as _jax

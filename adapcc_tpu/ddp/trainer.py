@@ -110,9 +110,19 @@ class DDPTrainer:
         # chunk_bytes; None = default).  Payloads above it stream through
         # fixed HBM→VMEM staging instead of living VMEM-resident
         zero1_ring_chunk_bytes: Optional[int] = None,
-        # "bf16" halves gradient-sync wire bytes (torch bf16_compress_hook
-        # analog); adds ~bf16-eps relative error to the synced mean
+        # gradient-sync wire codec (quant registry: "off" | "bf16" | "int8",
+        # or "strategy" to adopt the synthesized Strategy.wire_dtype).
+        # "bf16" halves wire bytes (torch bf16_compress_hook analog, ~bf16-
+        # eps error on the synced mean); "int8" quantizes block-wise with
+        # per-block fp32 scales (docs/QUANT.md)
         grad_compress: str = "off",
+        # carry each rank's quantization error into the next step's gradient
+        # (adapcc_tpu.quant error-feedback loop): closes the deterministic-
+        # rounding accuracy gap of int8.  The residual rides the compiled
+        # step as a per-rank [world, ...] buffer, exactly like the async
+        # relay bank; requires BSP mode (the deferred bank and the residual
+        # would otherwise double-carry the same missed-gradient mass)
+        error_feedback: bool = False,
         # stateful losses carry non-gradient model collections (BatchNorm
         # running stats): ``loss_fn(params, model_state, batch) -> (loss,
         # new_model_state)``, with the state riding in
@@ -147,6 +157,13 @@ class DDPTrainer:
             raise ValueError("zero1_ring=True requires zero1=True")
         self.zero1_ring = zero1_ring
         self.zero1_ring_chunk_bytes = zero1_ring_chunk_bytes
+        if error_feedback and not bsp:
+            raise ValueError(
+                "error_feedback=True requires BSP mode: the async relay "
+                "bank already defers gradient mass, and layering the "
+                "quantization residual on top would double-carry it"
+            )
+        self.error_feedback = error_feedback
         self.hook = GradSyncHook(
             strategy,
             axis_name=axis_name,
@@ -155,7 +172,18 @@ class DDPTrainer:
             communicator=communicator,
             mode=sync_mode,
             compress=grad_compress,
+            error_feedback=error_feedback,
         )
+        if error_feedback and self.hook.effective_compress() == "off":
+            # the residual of a no-op codec is provably zero, but the bank
+            # would still thread (and donate) a world-sized fp32 copy of
+            # every param through each compiled step
+            raise ValueError(
+                "error_feedback=True with an 'off' wire codec banks an "
+                "identically-zero residual at world x params x 4 bytes per "
+                "step; pass grad_compress='int8' (or 'strategy' / set "
+                "ADAPCC_WIRE_DTYPE) or drop error_feedback"
+            )
         self.bsp = bsp
         self._dynamic_mask = (
             dynamic_mask
@@ -171,6 +199,7 @@ class DDPTrainer:
                 "negotiated active set"
             )
         self._deferred: Optional[Any] = None
+        self._residual: Optional[Any] = None  # error-feedback bank
         self._bank_dirty = False  # some rank holds banked (deferred) grads
         self._coord_calibrated = False
         self._compiled: Optional[Callable] = None
@@ -380,6 +409,7 @@ class DDPTrainer:
         # no mask input and the masking folds away
         dynamic_mask = self._dynamic_mask
         deferred_relay = not self.bsp
+        error_feedback = self.error_feedback
 
         def per_shard(state: TrainState, batch: Any, *extra: Any):
             loss, grads, new_ms = self._value_and_grad(
@@ -393,6 +423,16 @@ class DDPTrainer:
                 deferred = jax.tree_util.tree_map(lambda d: d[0], extra[-1])
                 synced, new_deferred = self.hook.sync_deferred(grads, deferred, mask)
                 outs.append(jax.tree_util.tree_map(lambda d: d[None], new_deferred))
+            elif error_feedback:
+                # the residual bank rides like the deferred bank: per-rank,
+                # sharded [world] leading dim, replaced wholesale every step
+                residual = jax.tree_util.tree_map(lambda r: r[0], extra[-1])
+                synced, new_residual = self.hook.sync_error_feedback(
+                    grads, residual, mask
+                )
+                outs.append(
+                    jax.tree_util.tree_map(lambda r: r[None], new_residual)
+                )
             else:
                 synced = self.hook.sync(grads, mask)
             new_state = self._apply_synced(state, synced, new_ms)
@@ -404,15 +444,16 @@ class DDPTrainer:
             # [1] per rank → stacked [world] losses
             return (new_state, loss[None], *outs)
 
+        banked = deferred_relay or error_feedback
         in_specs = (
             (self._state_spec(), P(self.axis_name))
             + ((P(),) if dynamic_mask else ())
-            + ((P(self.axis_name),) if deferred_relay else ())
+            + ((P(self.axis_name),) if banked else ())
         )
         out_specs = (
             (self._state_spec(), P(self.axis_name))
             + ((P(),) if self.measure_gns else ())
-            + ((P(self.axis_name),) if deferred_relay else ())
+            + ((P(self.axis_name),) if banked else ())
         )
         fn = jax.shard_map(
             per_shard,
@@ -424,9 +465,9 @@ class DDPTrainer:
             check_vma=False,
         )
         donate = (0,) if self.donate_state else ()
-        if deferred_relay:
-            # the deferred bank is replaced wholesale every step; donating it
-            # avoids holding two world-sized gradient copies per dispatch
+        if banked:
+            # the deferred/residual bank is replaced wholesale every step;
+            # donating it avoids holding two world-sized copies per dispatch
             donate = donate + (len(in_specs) - 1,)
         return jax.jit(fn, donate_argnums=donate)
 
@@ -486,9 +527,21 @@ class DDPTrainer:
                     lambda p: jnp.zeros((world,) + p.shape, p.dtype), state.params
                 )
             args.append(self._deferred)
+        elif self.error_feedback:
+            if self._residual is None:
+                world = self.mesh.devices.size
+                # fp32 regardless of param dtype: a residual accumulated in
+                # a narrow dtype would itself lose the mass it exists to keep
+                self._residual = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros((world,) + p.shape, jnp.float32),
+                    state.params,
+                )
+            args.append(self._residual)
         out = self._compiled(*args)
         if not self.bsp:
             *out, self._deferred = out
+        elif self.error_feedback:
+            *out, self._residual = out
         if not self.measure_gns:
             return tuple(out) if isinstance(out, list) else out
         new_state, loss, norms = out
@@ -513,6 +566,11 @@ class DDPTrainer:
             raise ValueError(
                 "scan_steps runs a static full-world program: incompatible "
                 "with dynamic_mask, async relay (bsp=False), and measure_gns"
+            )
+        if self.error_feedback:
+            raise ValueError(
+                "scan_steps does not thread the error-feedback residual "
+                "across scanned steps; use step() with error_feedback=True"
             )
         self._check_state(state)
         key = ("scan", int(n_steps))
@@ -584,6 +642,7 @@ class DDPTrainer:
         the compile cache on throwaway state before a measured run."""
         self._host_step = 0
         self._deferred = None
+        self._residual = None
         self._bank_dirty = False
 
     # -- re-adaptation ---------------------------------------------------------

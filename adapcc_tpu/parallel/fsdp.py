@@ -369,6 +369,7 @@ class Zero1Optimizer:
         ring: bool = False,
         ring_interpret: Optional[bool] = None,
         ring_chunk_bytes: Optional[int] = None,
+        wire_dtype: Optional[str] = None,
     ) -> None:
         self.tx = tx
         self.mesh = mesh
@@ -378,6 +379,14 @@ class Zero1Optimizer:
         if ring_interpret is None:
             ring_interpret = jax.devices()[0].platform != "tpu"
         self.ring_interpret = ring_interpret
+        # gradient-sync wire codec (quant registry; None/"off" = payload
+        # dtype, ADAPCC_WIRE_DTYPE overrides — the ring_chunk_bytes
+        # precedence).  zero1_train_step applies the codec's wire value to
+        # each rank's gradient contribution before the reduce-scatter;
+        # resolved eagerly so a typo'd codec dies at construction
+        from adapcc_tpu.quant import resolve_wire_dtype
+
+        self.wire_dtype = resolve_wire_dtype(wire_dtype)
         #: staging granularity for the ring collectives (strategy plane's
         #: synthesized chunk_bytes; None = default, env-overridable for
         #: sweeps).  Payloads above it ride the HBM-streaming kernel, so
@@ -559,6 +568,13 @@ def zero1_train_step(
         ring, ring_interpret = opt.ring, opt.ring_interpret
         ring_chunk_bytes = opt.ring_chunk_bytes
 
+        if opt.wire_dtype != "off":
+            from adapcc_tpu.quant import get_codec
+
+            codec_apply = get_codec(opt.wire_dtype).apply
+        else:
+            codec_apply = None
+
         def per_shard(params, master, opt_state, batch):
             master = master[0]
             opt_state = jax.tree_util.tree_map(lambda x: x[0], opt_state)
@@ -566,6 +582,11 @@ def zero1_train_step(
             # unsynced per-rank grads: the reduce-scatter both averages and
             # slices (the bandwidth-optimal half of a ring allreduce)
             flat_g = _flatten(grads, meta) / world
+            if codec_apply is not None:
+                # wire codec on the contribution (value semantics): the
+                # scattered sum is the sum of quantized per-rank gradients,
+                # matching the quantized ring's accumulation contract
+                flat_g = codec_apply(flat_g)
             if ring:
                 from adapcc_tpu.comm.pallas_ring import ring_reduce_scatter_shard
 

@@ -1,0 +1,40 @@
+"""Wire-codec subsystem: block-wise quantized collectives + error feedback.
+
+See docs/QUANT.md for the codec math, the wire format, the error-feedback
+loop, and when the sim-rank policy picks int8.
+"""
+
+from adapcc_tpu.quant.codec import (
+    DEFAULT_BLOCK_SIZE,
+    WIRE_DTYPE_ENV,
+    WireCodec,
+    codec_names,
+    dequantize_int8,
+    error_feedback_step,
+    get_codec,
+    int8_error_bound,
+    int8_roundtrip,
+    quantize_int8,
+    register_codec,
+    resolve_wire_dtype,
+    timed_roundtrip,
+)
+from adapcc_tpu.quant.ring import ring_error_bound, wire_ring_allreduce_shard
+
+__all__ = [
+    "DEFAULT_BLOCK_SIZE",
+    "WIRE_DTYPE_ENV",
+    "WireCodec",
+    "codec_names",
+    "dequantize_int8",
+    "error_feedback_step",
+    "get_codec",
+    "int8_error_bound",
+    "int8_roundtrip",
+    "quantize_int8",
+    "register_codec",
+    "resolve_wire_dtype",
+    "ring_error_bound",
+    "timed_roundtrip",
+    "wire_ring_allreduce_shard",
+]

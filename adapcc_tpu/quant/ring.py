@@ -1,0 +1,127 @@
+"""Wire-codec ring allreduce: quantized chunks over a ppermute ring.
+
+The EQuARX dual-quantization shape (PAPERS.md) mapped onto the engine's
+collective contract:
+
+- **reduce-scatter phase** (``world - 1`` hops): each rank keeps its payload
+  as fp32 chunks and, per hop, *encodes* the chunk in flight, ships the wire
+  arrays (int8 codes + fp32 block scales, or a bf16 cast) one ring step, and
+  the receiver *decodes and accumulates* into its fp32 partial — which is
+  re-encoded when it moves on the next hop.  Accumulation error therefore
+  grows with ring depth, never compounds inside a chunk (fp32 carries the
+  running sum; only the wire is narrow).
+- **all-gather phase** (``world - 1`` hops): the fully reduced chunk is
+  encoded ONCE by its owner and the encoded blocks are forwarded verbatim;
+  every rank — owner included — decodes the same bits, so the result is
+  bit-identical across ranks.
+
+This is the ppermute realization (any mesh, any backend, subset of no one's
+VMEM) — the strategy plane selects it via ``Strategy.wire_dtype`` and the
+engine records the executed codec in the dispatch trace.  The uncompressed
+(``"off"``) ring stays on the hand-tuned Pallas kernels
+(:mod:`adapcc_tpu.comm.pallas_ring`); this module exists for the wire
+dtypes those kernels do not speak.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from adapcc_tpu.comm.mesh import RANKS_AXIS
+from adapcc_tpu.quant.codec import DEFAULT_BLOCK_SIZE, get_codec
+
+
+def wire_ring_allreduce_shard(
+    x: jnp.ndarray,
+    world: int,
+    axis_name: str = RANKS_AXIS,
+    wire_dtype: str = "int8",
+    block_size: int = DEFAULT_BLOCK_SIZE,
+) -> jnp.ndarray:
+    """SUM-allreduce ``x`` over ``axis_name`` with the wire codec applied
+    per hop; call inside shard_map.  Any input shape; result in the input's
+    shape and dtype on every rank.
+
+    ``world == 1`` degenerates to the identity (no wire, no codec error).
+    """
+    codec = get_codec(wire_dtype)
+    if world == 1:
+        return x
+    orig_dtype = x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    # chunk layout: world chunks, each padded to whole codec blocks so one
+    # chunk's scales never straddle another's elements
+    chunk = -(-n // world)
+    chunk = -(-chunk // block_size) * block_size
+    acc = jnp.pad(flat, (0, world * chunk - n)).reshape(world, chunk)
+    me = lax.axis_index(axis_name)
+    ring = [(i, (i + 1) % world) for i in range(world)]
+
+    def ship(chunk_val):
+        """Encode -> one ring hop -> the received wire arrays."""
+        wire = codec.encode(chunk_val, block_size)
+        return tuple(lax.ppermute(w, axis_name, ring) for w in wire)
+
+    # -- reduce-scatter: dequant-accumulate-requant at every hop ------------
+    for s in range(world - 1):
+        send_idx = (me - s) % world
+        recvd = ship(lax.dynamic_index_in_dim(acc, send_idx, keepdims=False))
+        recv_idx = (me - s - 1) % world
+        cur = lax.dynamic_index_in_dim(acc, recv_idx, keepdims=False)
+        acc = lax.dynamic_update_index_in_dim(
+            acc, cur + codec.decode(recvd, chunk, block_size), recv_idx, 0
+        )
+
+    # -- all-gather: encode once, forward the encoded blocks verbatim ------
+    own_idx = (me + 1) % world  # the chunk this rank finished reducing
+    own_wire = codec.encode(
+        lax.dynamic_index_in_dim(acc, own_idx, keepdims=False), block_size
+    )
+    # the owner adopts its own DECODED chunk: every rank must see the same
+    # post-codec value, owner included
+    out = lax.dynamic_update_index_in_dim(
+        jnp.zeros_like(acc), codec.decode(own_wire, chunk, block_size),
+        own_idx, 0,
+    )
+    wire = own_wire
+    for s in range(world - 1):
+        wire = tuple(lax.ppermute(w, axis_name, ring) for w in wire)
+        # the block arriving at hop s originated at rank (me - 1 - s) and
+        # carries that rank's owned chunk, index (me - s) % world
+        idx = (me - s) % world
+        out = lax.dynamic_update_index_in_dim(
+            out, codec.decode(wire, chunk, block_size), idx, 0
+        )
+    return out.reshape(-1)[:n].reshape(x.shape).astype(orig_dtype)
+
+
+def ring_error_bound(
+    xs, world: Optional[int] = None, block_size: int = DEFAULT_BLOCK_SIZE
+):
+    """Elementwise |quantized ring - fp32 sum| bound for the int8 ring.
+
+    Each element's running partial is re-quantized at most ``world`` times
+    (``world - 1`` reduce-scatter hops + the single all-gather encode), each
+    costing at most half a step of the *largest* partial sum its block ever
+    holds, which is bounded by the block max of ``sum_r |x_r|``.  Loose but
+    shape-correct: tight enough to catch a broken codec, robust to the
+    ring's hop order.
+    """
+    import numpy as np
+
+    xs = np.asarray(xs, dtype=np.float32)  # [world, n]
+    if world is None:
+        world = xs.shape[0]
+    n = xs[0].reshape(-1).shape[0]
+    mass = np.abs(xs).reshape(world, -1).sum(axis=0)
+    chunk = -(-n // world)
+    chunk = -(-chunk // block_size) * block_size
+    padded = np.pad(mass, (0, world * chunk - n)).reshape(-1, block_size)
+    per_block = np.max(padded, axis=1) / 127.0
+    bound = 0.5 * world * np.repeat(per_block, block_size)[:n]
+    return bound + 1e-6  # absolute slack for fp32 accumulation noise

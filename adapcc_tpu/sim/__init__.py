@@ -33,7 +33,10 @@ from adapcc_tpu.sim.cost_model import (
     ICI,
     LinkCoeffs,
     LinkCostModel,
+    choose_wire_dtype,
     fit_alpha_beta,
+    quantized_ring_allreduce_time,
+    wire_bytes_per_element,
 )
 from adapcc_tpu.sim.events import EventSimulator, SimReport, Transfer, TreeSchedule
 from adapcc_tpu.sim.replay import (
@@ -63,7 +66,10 @@ __all__ = [
     "ICI",
     "LinkCoeffs",
     "LinkCostModel",
+    "choose_wire_dtype",
     "fit_alpha_beta",
+    "quantized_ring_allreduce_time",
+    "wire_bytes_per_element",
     "EventSimulator",
     "SimReport",
     "Transfer",

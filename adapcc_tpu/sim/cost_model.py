@@ -298,6 +298,105 @@ def staged_ring_allreduce_time(
     return seed + (world - 1) * tiles * (rs_iter + ag_iter)
 
 
+# --------------------------------------------------------------------------- #
+# wire-codec pricing (adapcc_tpu/quant): reduced wire bytes vs codec overhead
+# --------------------------------------------------------------------------- #
+
+#: quantization block the pricing assumes when none is given; mirrors
+#: ``adapcc_tpu.quant.codec.DEFAULT_BLOCK_SIZE`` (drift pinned by a test —
+#: the simulator must price the block geometry the data plane ships)
+DEFAULT_QUANT_BLOCK = 256
+
+#: throughput of one elementwise codec pass (quantize, or dequantize +
+#: accumulate) over fp32 payload bytes.  A deliberately round number well
+#: below HBM streaming rate: the ppermute-ring codec is XLA elementwise
+#: work, not a fused kernel — replaced by any measured calibration.  Its
+#: magnitude sets the break-even point: on a ~45 GB/s ICI link the saved
+#: wire time does NOT pay for 4 codec passes, on a ~12.5 GB/s DCN link it
+#: does — which is exactly the sim-rank flip the regression tests pin.
+DEFAULT_CODEC_BYTES_PER_S = 100e9
+
+#: candidate wire codecs the chooser prices, cheapest-risk first ("off"
+#: leads so a predicted tie keeps the uncompressed plane)
+WIRE_DTYPE_CANDIDATES = ("off", "bf16", "int8")
+
+
+def wire_bytes_per_element(
+    wire_dtype: str,
+    block_size: int = DEFAULT_QUANT_BLOCK,
+    elem_bytes: float = 4.0,
+) -> float:
+    """Wire bytes one payload element costs under a codec: fp32 passthrough,
+    a bf16 cast, or int8 codes + the amortized per-block fp32 scale.  Must
+    agree with the quant registry's own accounting (pinned by a test)."""
+    if wire_dtype == "off":
+        return float(elem_bytes)
+    if wire_dtype == "bf16":
+        return 2.0
+    if wire_dtype == "int8":
+        return 1.0 + 4.0 / block_size
+    raise ValueError(
+        f"unknown wire_dtype {wire_dtype!r}; "
+        f"expected one of {WIRE_DTYPE_CANDIDATES}"
+    )
+
+
+def quantized_ring_allreduce_time(
+    world: int,
+    nbytes: float,
+    coeffs: LinkCoeffs,
+    wire_dtype: str = "int8",
+    block_size: int = DEFAULT_QUANT_BLOCK,
+    codec_bytes_per_s: float = DEFAULT_CODEC_BYTES_PER_S,
+) -> float:
+    """Analytical latency of the wire-codec ppermute ring allreduce
+    (:func:`adapcc_tpu.quant.ring.wire_ring_allreduce_shard`), pricing
+    reduced wire bytes against per-hop codec overhead.
+
+    Per rank the payload splits into ``world`` chunks of ``nbytes/world``;
+    each of the ``world - 1`` reduce-scatter hops pays **encode** (1 fp32
+    pass) + the wire transfer of the *compressed* chunk + **decode &
+    accumulate** (2 fp32 passes); each all-gather hop forwards encoded
+    blocks verbatim and pays only the wire + the **decode write** (1 pass).
+    ``wire_dtype="off"`` pays zero codec passes and degenerates to the plain
+    chunked ring wire time — so one formula prices the whole A/B.
+    """
+    if world < 2:
+        return 0.0
+    chunk_bytes = nbytes / world
+    elems = chunk_bytes / 4.0
+    wire_chunk = elems * wire_bytes_per_element(wire_dtype, block_size)
+    codec_pass = 0.0 if wire_dtype == "off" else chunk_bytes / codec_bytes_per_s
+    rs_hop = 3.0 * codec_pass + coeffs.time(wire_chunk)
+    ag_hop = 1.0 * codec_pass + coeffs.time(wire_chunk)
+    return (world - 1) * (rs_hop + ag_hop)
+
+
+def choose_wire_dtype(
+    world: int,
+    nbytes: float,
+    coeffs: LinkCoeffs,
+    block_size: int = DEFAULT_QUANT_BLOCK,
+    candidates: Sequence[str] = WIRE_DTYPE_CANDIDATES,
+    codec_bytes_per_s: float = DEFAULT_CODEC_BYTES_PER_S,
+) -> Tuple[str, Dict[str, float]]:
+    """Pick the cheapest wire codec for a ring allreduce on ``coeffs`` —
+    the cost-model term the sim-rank policy uses to set
+    ``Strategy.wire_dtype``.  Returns ``(winner, {codec: seconds})``; ties
+    break by candidate order, so "off" survives a prediction-identical
+    alternative (no churn of the uncompressed plane)."""
+    if not candidates:
+        raise ValueError("need at least one wire_dtype candidate")
+    times = {
+        wd: quantized_ring_allreduce_time(
+            world, nbytes, coeffs, wd, block_size, codec_bytes_per_s
+        )
+        for wd in candidates
+    }
+    winner = min(candidates, key=lambda wd: times[wd])
+    return winner, times
+
+
 def ring_allreduce_time(
     world: int, nbytes: float, coeffs: LinkCoeffs, chunks: int = 1
 ) -> float:

@@ -307,6 +307,12 @@ class Strategy:
     #: …).  Recorded into the emitted XML so a production fallback is
     #: distinguishable from an optimized result.
     synthesis: Optional[str] = None
+    #: wire codec for the data plane ("off" | "bf16" | "int8" — any name in
+    #: the quant registry).  Chosen by the synthesizer's sim-rank pricing
+    #: pass (sim/cost_model.choose_wire_dtype), round-tripped through the
+    #: strategy XML, executed by the engine's ring path, and adopted by a
+    #: ``GradSyncHook(compress="strategy")``.  "off" = the payload dtype.
+    wire_dtype: str = "off"
 
     def __post_init__(self) -> None:
         if not self.trees:
@@ -330,6 +336,17 @@ class Strategy:
             bad = [c for c in self.tree_chunk_bytes if c <= 0]
             if bad:
                 raise ValueError(f"tree_chunk_bytes must be positive, got {bad}")
+        # wire_dtype names must exist in the codec registry at construction
+        # time — a strategy carrying a codec no engine can decode must die
+        # here, not at the first traced collective.  The default "off" is
+        # trivially valid and skips the registry import entirely, so
+        # control-plane Strategy construction (solvers, XML parsing of
+        # pre-quant artifacts) stays jax-free; any other name pulls the
+        # registry, whose caller is about to execute the codec anyway.
+        if self.wire_dtype != "off":
+            from adapcc_tpu.quant.codec import get_codec
+
+            get_codec(self.wire_dtype)
 
     def chunk_bytes_for_tree(self, index: int) -> int:
         """The chunk granularity tree ``index``'s segment pipelines at: its

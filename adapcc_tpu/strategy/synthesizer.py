@@ -219,7 +219,43 @@ class Synthesizer:
         # provenance: the emitted XML records both the winning shape and
         # that a simulated ranking (not a measurement) chose it
         winner.strategy.synthesis = f"{winner.label}+sim-rank"
+        winner.strategy.wire_dtype = self._choose_wire_dtype(
+            winner.strategy, nbytes, bandwidth_graph, latency_graph
+        )
         return winner.strategy
+
+    def _choose_wire_dtype(
+        self,
+        strategy: Strategy,
+        nbytes: int,
+        bandwidth_graph,
+        latency_graph,
+    ) -> str:
+        """Price the wire codecs on the strategy's bottleneck link and keep
+        the cheapest — the quant half of the sim-rank pass.  A lockstep
+        schedule advances at its slowest edge, so the codec's break-even is
+        judged there: fat ICI links keep the fp32 wire (codec passes cost
+        more than the saved bytes), a DCN-bottlenecked or degraded fabric
+        flips to int8.  The choice rides the strategy XML, so the engine
+        and hook execute exactly what was priced."""
+        from adapcc_tpu.sim.cost_model import choose_wire_dtype
+
+        model = self._cost_model(bandwidth_graph, latency_graph)
+        edges = [
+            (parent, child)
+            for tree in strategy.trees
+            for child, parent in tree.parent.items()
+        ]
+        if not edges:  # world=1: nothing crosses a wire
+            return "off"
+        bottleneck = max(
+            (model.coeffs(s, d) for s, d in edges),
+            key=lambda c: c.time(1 << 20),
+        )
+        choice, _ = choose_wire_dtype(
+            strategy.world_size, max(1, int(nbytes)), bottleneck
+        )
+        return choice
 
 
 def _infer_local_rank0s(ip_table: Sequence[str]) -> List[int]:

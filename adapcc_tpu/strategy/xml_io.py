@@ -41,6 +41,20 @@ def _lenient_fromstring(text: str) -> ET.Element:
         return ET.fromstring(_MISSING_SPACE.sub(r"\1 ", text))
 
 
+def _valid_wire_dtype(raw: str) -> str:
+    """Validate a wire_dtype attribute at parse time: a corrupted artifact
+    must fail at the file that carries it, not deep inside a later engine
+    dispatch (the chunk_bytes precedent)."""
+    from adapcc_tpu.quant.codec import codec_names
+
+    if raw not in codec_names():
+        raise ValueError(
+            f"<trees wire_dtype={raw!r}>: expected one of "
+            f"{'|'.join(codec_names())}"
+        )
+    return raw
+
+
 def _positive_chunk(raw: str, element: str) -> int:
     """Validate a chunk_bytes attribute at parse time: a corrupted artifact
     must fail at the file that carries it, not deep inside a later ring
@@ -106,10 +120,12 @@ def parse_strategy_xml(text_or_path: str, chunk_bytes: int = 4 * 1024 * 1024) ->
         tree_chunk_bytes = [
             c if c is not None else chunk_bytes for c in per_tree_chunks
         ]
+    raw_wire = doc.attrib.get("wire_dtype")
     return Strategy(
         trees, world_size, chunk_bytes,
         synthesis=doc.attrib.get("synthesis") or None,
         tree_chunk_bytes=tree_chunk_bytes,
+        wire_dtype=_valid_wire_dtype(raw_wire) if raw_wire else "off",
     )
 
 
@@ -123,6 +139,10 @@ def emit_strategy_xml(strategy: Strategy, path: Optional[str] = None) -> str:
         # fallback in production must be distinguishable from an optimum)
         doc.set("synthesis", strategy.synthesis)
     doc.set("chunk_bytes", str(strategy.chunk_bytes))
+    if strategy.wire_dtype != "off":
+        # only a non-default codec is persisted: reference XMLs and pre-quant
+        # artifacts stay byte-stable, and absence unambiguously means "off"
+        doc.set("wire_dtype", strategy.wire_dtype)
     for i, tree in enumerate(strategy.trees):
         def build(rank: int, parent_el: ET.Element, tag: str) -> None:
             el = ET.SubElement(parent_el, tag)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import random
 import re
 import threading
 import time
@@ -73,13 +74,28 @@ class ProgressMeter:
 
 
 class MetricsRegistry:
-    """Named counters/gauges/timers with JSON export; thread-safe."""
+    """Named counters/gauges/timers with JSON export; thread-safe.
+
+    Timings keep running count/total/max exactly, plus a **bounded
+    reservoir** of samples (Vitter's algorithm R, deterministic seed) so
+    :meth:`snapshot` can report p50/p99 with O(1) memory per timing — a
+    long-running trainer recording per-step codec timings must not grow a
+    list without bound, and tail latency (the p99 a straggler policy keys
+    on) is invisible to count/mean/max alone.
+    """
+
+    #: samples retained per timing for the percentile estimate; above this
+    #: count, reservoir sampling keeps a uniform subset
+    RESERVOIR_SIZE = 512
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counters: Dict[str, float] = defaultdict(float)
         self._gauges: Dict[str, float] = {}
-        self._timings: Dict[str, List[float]] = defaultdict(list)
+        self._timings: Dict[str, Dict[str, Any]] = {}
+        # deterministic reservoir replacement: two identical runs snapshot
+        # identical percentiles (the sim-bench byte-stability policy)
+        self._rng = random.Random(0x5EED)
 
     def incr(self, name: str, by: float = 1.0) -> None:
         with self._lock:
@@ -99,21 +115,45 @@ class MetricsRegistry:
 
     def observe(self, name: str, seconds: float) -> None:
         """Record an externally measured duration into the ``name`` timing."""
+        s = float(seconds)
         with self._lock:
-            self._timings[name].append(float(seconds))
+            t = self._timings.get(name)
+            if t is None:
+                t = self._timings[name] = {
+                    "count": 0, "total": 0.0, "max": s, "reservoir": [],
+                }
+            t["count"] += 1
+            t["total"] += s
+            t["max"] = max(t["max"], s)
+            res = t["reservoir"]
+            if len(res) < self.RESERVOIR_SIZE:
+                res.append(s)
+            else:
+                j = self._rng.randrange(t["count"])
+                if j < self.RESERVOIR_SIZE:
+                    res[j] = s
+
+    @staticmethod
+    def _percentile(sorted_samples: List[float], q: float) -> float:
+        """Nearest-rank percentile over the (sorted) reservoir."""
+        rank = max(0, int(-(-q * len(sorted_samples) // 1)) - 1)
+        return sorted_samples[min(rank, len(sorted_samples) - 1)]
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
-            timings = {
-                k: {
-                    "count": len(v),
-                    "total_s": sum(v),
-                    "mean_s": sum(v) / len(v),
-                    "max_s": max(v),
+            timings = {}
+            for k, t in self._timings.items():
+                if not t["count"]:
+                    continue
+                res = sorted(t["reservoir"])
+                timings[k] = {
+                    "count": t["count"],
+                    "total_s": t["total"],
+                    "mean_s": t["total"] / t["count"],
+                    "max_s": t["max"],
+                    "p50_s": self._percentile(res, 0.50),
+                    "p99_s": self._percentile(res, 0.99),
                 }
-                for k, v in self._timings.items()
-                if v
-            }
             return {
                 "counters": dict(self._counters),
                 "gauges": dict(self._gauges),

@@ -77,9 +77,24 @@ def build_parser() -> argparse.ArgumentParser:
         "into their next active step instead of dropped (reference is_bsp)",
     )
     p.add_argument(
-        "--grad-compress", choices=["off", "bf16"], default="off",
-        help="bf16 gradient-sync wire compression (torch bf16_compress_hook "
-        "analog): halves ICI/DCN bytes, ~bf16-eps error on the synced mean",
+        "--grad-compress", choices=["off", "bf16", "int8"], default="off",
+        help="gradient-sync wire codec (quant registry): bf16 halves ICI/DCN "
+        "bytes (~bf16-eps error on the synced mean); int8 quantizes "
+        "block-wise with per-block fp32 scales (docs/QUANT.md)",
+    )
+    p.add_argument(
+        "--wire-dtype", choices=["off", "bf16", "int8", "strategy"],
+        default=None,
+        help="wire codec for the data plane, overriding --grad-compress "
+        "when given: ddp mode feeds the gradient hook ('strategy' adopts "
+        "the synthesized Strategy.wire_dtype); zero1 mode feeds the "
+        "reduce-scatter contribution.  ADAPCC_WIRE_DTYPE overrides for "
+        "sweeps (malformed value -> loud error)",
+    )
+    p.add_argument(
+        "--error-feedback", action="store_true",
+        help="carry the per-rank quantization residual into the next step's "
+        "gradient (closes the int8 accuracy gap; requires --dp-mode ddp)",
     )
     p.add_argument(
         "--sync-mode", choices=["auto", "psum", "schedule"], default="auto",
@@ -178,6 +193,19 @@ def main(argv=None) -> None:
             )
     if args.zero1_ring and args.dp_mode != "zero1":
         raise ValueError("--zero1-ring requires --dp-mode zero1")
+    # one wire-codec knob across modes: --wire-dtype wins over the older
+    # --grad-compress spelling when both are given
+    wire_dtype = args.wire_dtype if args.wire_dtype is not None else args.grad_compress
+    if args.error_feedback and args.dp_mode != "ddp":
+        raise ValueError(
+            "--error-feedback requires --dp-mode ddp (the residual bank "
+            "rides the DDP gradient hook)"
+        )
+    if wire_dtype == "strategy" and args.dp_mode != "ddp":
+        raise ValueError(
+            "--wire-dtype strategy requires --dp-mode ddp (only the "
+            "gradient hook carries a synthesized strategy to adopt)"
+        )
     # join the multi-host world if the launcher set the coordinator env
     from adapcc_tpu.launch import maybe_initialize_distributed
 
@@ -225,6 +253,7 @@ def main(argv=None) -> None:
         z_opt = Zero1Optimizer(
             tx, mesh, ring=args.zero1_ring,
             ring_chunk_bytes=args.ring_chunk_bytes or None,
+            wire_dtype=wire_dtype,
         )
         master, z_state = z_opt.init(params)
         z_step = zero1_train_step(loss_fn, z_opt, mesh)
@@ -244,7 +273,8 @@ def main(argv=None) -> None:
             use_xla_fastpath=comm_args.use_xla_fastpath,
             bsp=comm_args.is_bsp,
             sync_mode=args.sync_mode,
-            grad_compress=args.grad_compress,
+            grad_compress=wire_dtype,
+            error_feedback=args.error_feedback,
             # loop-owned state: see train_gpt2 donation note
             donate_state=True,
         )

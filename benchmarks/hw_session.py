@@ -152,11 +152,18 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
       kernels have only ever run multi-device under the interpreter);
     - ``ring_chunk_sweep`` — the staged ring at 128 MB across staging
       granularities via ``ADAPCC_RING_CHUNK_BYTES`` (the hardware twin of
-      ``make ring-sweep``).
+      ``make ring-sweep``);
+    - ``busbw_wire_dtype`` — the ring at 128 MB across wire codecs via
+      ``ADAPCC_WIRE_DTYPE`` (int8 vs bf16 vs fp32: the hardware twin of
+      ``make quant-bench``; off rides the Pallas kernels, the codecs ride
+      the quantized ppermute ring).
     """
     gate = f"world={world} (needs multi-chip ICI)"
     if world < 2:
-        for name in ("busbw_ici_128m", "ring_smoke", "ring_chunk_sweep"):
+        for name in (
+            "busbw_ici_128m", "ring_smoke", "ring_chunk_sweep",
+            "busbw_wire_dtype",
+        ):
             _skip(name, gate, out_path)
         return
     _run(
@@ -179,6 +186,21 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
             900, out_path,
             extra_env={"ADAPCC_RING_CHUNK_BYTES": chunk},
             rec_extra={"chunk_bytes": int(chunk)},
+        )
+    # wire-codec A/B on the same 128 MB ring payload: "off" is the fp32
+    # Pallas path, "bf16"/"int8" reroute engine.ring_allreduce onto the
+    # quantized ppermute ring via the env override — one knob, same sweep.
+    # Allreduce ONLY: the override affects no other primitive, so RS/AG
+    # rows here would measure the identical fp32 path under a codec label
+    for wire in ("off", "bf16", "int8"):
+        _run(
+            "busbw_wire_dtype",
+            [py, "-m", "benchmarks.collectives", "--world", str(world),
+             "--sizes", "128M", "--impls", "pallas_ring",
+             "--collectives", "allreduce"],
+            900, out_path,
+            extra_env={"ADAPCC_WIRE_DTYPE": wire},
+            rec_extra={"wire_dtype": wire},
         )
 
 

@@ -284,6 +284,80 @@ def ring_chunk_sweep(
     return rows
 
 
+def wire_dtype_sweep(
+    world: int,
+    sizes: Sequence[int],
+    wire_dtypes: Sequence[str],
+    model: Optional[LinkCostModel] = None,
+    block_size: Optional[int] = None,
+) -> List[dict]:
+    """Predicted wire-codec rows over the allreduce ring — the hardware-free
+    regression artifact for codec selection (``make quant-bench``).
+
+    Each row prices the quantized ppermute ring at one wire dtype with
+    :func:`adapcc_tpu.sim.cost_model.quantized_ring_allreduce_time` — the
+    exact term the sim-rank policy uses to set ``Strategy.wire_dtype`` — on
+    the bottleneck ring link (a lockstep ring advances at its slowest hop).
+    ``chosen`` marks the dtype :func:`choose_wire_dtype` would commit for
+    that size, so the artifact shows not just the curve but the decision.
+    Deterministic: same calibration → byte-identical rows.
+    """
+    from adapcc_tpu.quant import DEFAULT_BLOCK_SIZE, get_codec
+    from adapcc_tpu.sim.cost_model import (
+        choose_wire_dtype,
+        quantized_ring_allreduce_time,
+        wire_bytes_per_element,
+    )
+
+    if block_size is None:
+        block_size = DEFAULT_BLOCK_SIZE
+    for wd in wire_dtypes:
+        get_codec(wd)  # loud on a typo'd codec, before any row is emitted
+    if model is None:
+        model = load_or_default(world=world)
+    elif model.world != world:
+        raise ValueError(f"model world {model.world} != sweep world {world}")
+    ring_links = [(r, (r + 1) % world) for r in range(world)]
+    coeffs = max(
+        (model.coeffs(s, d) for s, d in ring_links),
+        key=lambda c: c.time(1 << 20),
+    )
+    rows: List[dict] = []
+    for nbytes in sizes:
+        chosen, _ = choose_wire_dtype(
+            world, nbytes, coeffs, block_size, candidates=tuple(wire_dtypes)
+        )
+        for wd in wire_dtypes:
+            seconds = quantized_ring_allreduce_time(
+                world, nbytes, coeffs, wd, block_size
+            )
+            algbw = nbytes / seconds / 1e9 if seconds > 0 else 0.0
+            rows.append({
+                "mode": "simulated",
+                "collective": "allreduce",
+                "impl": "quant_ring",
+                "strategy": "ring",
+                "world": world,
+                "size_bytes": int(nbytes),
+                "wire_dtype": wd,
+                "block_size": int(block_size),
+                "wire_bytes_per_elem": round(
+                    wire_bytes_per_element(wd, block_size), 6
+                ),
+                "chosen": wd == chosen,
+                "pred_time_us": round(seconds * 1e6, 3),
+                "algbw_gbps": round(algbw, 6),
+                "busbw_gbps": round(algbw * BUS_FACTORS["allreduce"](world), 6),
+                "calibration": model.source,
+            })
+    if not rows:
+        raise ValueError(
+            f"wire-dtype sweep produced no rows: sizes={list(sizes)} "
+            f"wire_dtypes={list(wire_dtypes)}"
+        )
+    return rows
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--world", type=int, default=8)
@@ -311,10 +385,41 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "--chunks", default="256K,1M,4M,16M",
         help="ring-sweep staging granularities (chunk_bytes grid)",
     )
+    ap.add_argument(
+        "--wire-dtype", default="",
+        help="comma list of wire codecs (off,bf16,int8): sweep the "
+        "quantized ring's codec A/B instead of the strategy grid, priced "
+        "by the sim-rank cost-model term (make quant-bench)",
+    )
     ap.add_argument("--json", action="store_true", help="one JSON row per line")
     args = ap.parse_args(argv)
 
+    if args.wire_dtype and args.ring_sweep:
+        # two different sweep grids over one --sizes axis: silently running
+        # one and dropping the other would read as "ran fine, no data"
+        ap.error("--wire-dtype and --ring-sweep are mutually exclusive; "
+                 "run one sweep per invocation")
     model = load_or_default(args.calibration, world=args.world)
+    if args.wire_dtype:
+        rows = wire_dtype_sweep(
+            world=args.world,
+            sizes=[parse_size(s) for s in args.sizes.split(",")],
+            wire_dtypes=[w.strip() for w in args.wire_dtype.split(",") if w.strip()],
+            model=model,
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            else:
+                star = "*" if row["chosen"] else " "
+                print(
+                    f"[sim] quant {row['size_bytes']:>12}B "
+                    f"wire={row['wire_dtype']:<5}{star} "
+                    f"({row['wire_bytes_per_elem']:.3f} B/elem)  "
+                    f"pred={row['pred_time_us']:>10.1f}us  "
+                    f"busbw={row['busbw_gbps']:>8.3f}GB/s"
+                )
+        return 0
     if args.ring_sweep:
         rows = ring_chunk_sweep(
             world=args.world,

@@ -1,8 +1,9 @@
 """The docs' code blocks execute — documentation that cannot drift.
 
 Every ```python block in docs/PARALLELISM.md, docs/OPERATIONS.md,
-docs/SIMULATION.md and docs/RING.md runs verbatim on the virtual pod.  A
-snippet that stops compiling or produces wrong shapes fails here.
+docs/SIMULATION.md, docs/RING.md and docs/QUANT.md runs verbatim on the
+virtual pod.  A snippet that stops compiling or produces wrong shapes
+fails here.
 """
 
 import os
@@ -17,6 +18,7 @@ _PARALLELISM = os.path.join(_DOCS_DIR, "PARALLELISM.md")
 _OPERATIONS = os.path.join(_DOCS_DIR, "OPERATIONS.md")
 _SIMULATION = os.path.join(_DOCS_DIR, "SIMULATION.md")
 _RING = os.path.join(_DOCS_DIR, "RING.md")
+_QUANT = os.path.join(_DOCS_DIR, "QUANT.md")
 
 
 def _blocks(path):
@@ -96,3 +98,24 @@ def test_ring_doc_covers_the_contract():
 def test_ring_doc_snippet_runs(idx):
     code = _blocks(_RING)[idx]
     exec(compile(code, f"{_RING}:block{idx}", "exec"), {})
+
+
+def test_quant_doc_has_snippets():
+    assert len(_blocks(_QUANT)) >= 5
+
+
+def test_quant_doc_covers_the_contract():
+    """The wire-codec topics the quantization runbook leans on must exist."""
+    text = open(_QUANT).read()
+    for needle in (
+        "block_size", "wire_dtype", "ADAPCC_WIRE_DTYPE", "error_feedback",
+        "error-feedback", "sim-rank", "make quant-bench", "int8",
+        "stochastic", "choose_wire_dtype", "busbw_wire_dtype", "p99",
+    ):
+        assert needle in text, f"QUANT.md lost its {needle!r} coverage"
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_QUANT))))
+def test_quant_doc_snippet_runs(idx):
+    code = _blocks(_QUANT)[idx]
+    exec(compile(code, f"{_QUANT}:block{idx}", "exec"), {})

@@ -50,6 +50,53 @@ def test_metrics_registry():
     assert snap["timings"]["step"]["mean_s"] >= 0
 
 
+def test_metrics_registry_percentiles():
+    """observe() keeps count/total exact AND p50/p99 over a bounded
+    reservoir: 1..1000ms observed once each must snapshot a median near
+    500ms and a p99 near the tail, not just a mean."""
+    reg = MetricsRegistry()
+    for ms in range(1, 1001):
+        reg.observe("sync", ms / 1000.0)
+    t = reg.snapshot()["timings"]["sync"]
+    assert t["count"] == 1000 and t["max_s"] == 1.0
+    assert t["total_s"] == pytest.approx(500.5)
+    # the reservoir is a uniform subsample: percentiles are approximate
+    assert 0.35 <= t["p50_s"] <= 0.65
+    assert t["p99_s"] >= 0.9
+    assert t["p50_s"] <= t["p99_s"] <= t["max_s"]
+
+
+def test_metrics_registry_reservoir_is_bounded_and_deterministic():
+    def fill():
+        reg = MetricsRegistry()
+        for i in range(5 * MetricsRegistry.RESERVOIR_SIZE):
+            reg.observe("t", float(i))
+        return reg
+
+    a, b = fill(), fill()
+    assert len(a._timings["t"]["reservoir"]) == MetricsRegistry.RESERVOIR_SIZE
+    # deterministic replacement: identical runs snapshot identical stats
+    assert a.snapshot() == b.snapshot()
+
+
+def test_codec_timings_flow_through_registry():
+    """The quant satellite: per-codec quantize/dequantize wall times are
+    recorded through MetricsRegistry.observe and surface with percentiles."""
+    import jax.numpy as _jnp
+
+    from adapcc_tpu.quant import timed_roundtrip
+
+    reg = MetricsRegistry()
+    x = _jnp.ones((4096,), _jnp.float32)
+    for _ in range(3):
+        out = timed_roundtrip("int8", x, registry=reg)
+    np.testing.assert_allclose(np.asarray(out), 1.0, rtol=1e-2)
+    snap = reg.snapshot()["timings"]
+    for name in ("quant.int8.quantize", "quant.int8.dequantize"):
+        assert snap[name]["count"] == 3
+        assert 0 <= snap[name]["p50_s"] <= snap[name]["p99_s"]
+
+
 def test_collective_trace_roundtrip(tmp_path):
     tr = CollectiveTrace()
     tr.record("allreduce", "psum", 4096, step=3, strategy="ring")

@@ -1,0 +1,574 @@
+"""Wire-codec subsystem: block-wise int8, error feedback, quantized ring.
+
+Pins the properties docs/QUANT.md promises:
+
+- codec round-trip error bounded by half a step of the *block* max (and the
+  bound scales with it), deterministic rounding bit-exact, stochastic
+  rounding unbiased in expectation;
+- error feedback never loses gradient mass (shipped + residual == truth);
+- int8 allreduce parity on BOTH data planes (the hook's XLA collectives and
+  the engine's quantized ring), plus a DDP train loop where int8 + error
+  feedback lands within 2% of the uncompressed loss in the same budget;
+- wire_dtype flows Synthesizer → strategy XML → engine dispatch trace →
+  hook, and sim-rank demonstrably flips to int8 when the calibrated link
+  bandwidth drops;
+- the ADAPCC_WIRE_DTYPE override and every validation funnel fail loudly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from adapcc_tpu.comm.mesh import build_world_mesh
+from adapcc_tpu.ddp import DDPTrainer, TrainState
+from adapcc_tpu.ddp.hook import GradSyncHook
+from adapcc_tpu.quant import (
+    DEFAULT_BLOCK_SIZE,
+    WIRE_DTYPE_ENV,
+    codec_names,
+    dequantize_int8,
+    error_feedback_step,
+    get_codec,
+    int8_error_bound,
+    quantize_int8,
+    resolve_wire_dtype,
+    ring_error_bound,
+    wire_ring_allreduce_shard,
+)
+from adapcc_tpu.strategy.ir import Strategy
+from adapcc_tpu.strategy.xml_io import emit_strategy_xml, parse_strategy_xml
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return build_world_mesh(8)
+
+
+# --------------------------------------------------------------------------- #
+# codec round-trip properties
+# --------------------------------------------------------------------------- #
+
+def test_roundtrip_error_bounded_and_scales_with_block_max():
+    rng = np.random.default_rng(0)
+    # blocks of wildly different magnitude: the bound must track each
+    # block's own max, not the tensor max
+    small = rng.normal(size=(128,)) * 0.01
+    large = rng.normal(size=(128,)) * 100.0
+    x = jnp.asarray(np.concatenate([small, large]), jnp.float32)
+    q, scales = quantize_int8(x, block_size=128)
+    back = dequantize_int8(q, scales, n=256)
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    bound = int8_error_bound(x, block_size=128)
+    assert (err <= bound + 1e-7).all()
+    # the small block's bound (and achieved error) is ~1e4x tighter than
+    # the large block's: one outlier only coarsens its own block
+    assert bound[:128].max() < bound[128:].max() / 1e3
+    assert err[:128].max() < np.abs(large).max() / 127.0
+
+
+def test_all_zero_block_roundtrips_exactly():
+    x = jnp.zeros((512,), jnp.float32)
+    q, scales = quantize_int8(x)
+    assert (np.asarray(scales) == 1.0).all()  # no div-by-zero scale
+    np.testing.assert_array_equal(np.asarray(dequantize_int8(q, scales, 512)), 0.0)
+
+
+def test_deterministic_rounding_is_bit_exact():
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(1000,)), jnp.float32)
+    q1, s1 = quantize_int8(x, 64)
+    q2, s2 = quantize_int8(x, 64)
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q2))
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    # and under jit: the traced program must produce the same bits
+    q3, s3 = jax.jit(lambda v: quantize_int8(v, 64))(x)
+    np.testing.assert_array_equal(np.asarray(q1), np.asarray(q3))
+
+
+def test_stochastic_rounding_unbiased_in_expectation():
+    # anchor the block max at 1.0 so scale = 1/127 and 0.3/scale = 38.1
+    # sits strictly between two codes: deterministic rounding is biased
+    # there, the stochastic mean must recover the value
+    x = jnp.asarray([1.0] + [0.3] * 63, jnp.float32)
+    vals = [
+        float(dequantize_int8(*quantize_int8(
+            x, 64, stochastic=True, key=jax.random.PRNGKey(s)), 64)[1])
+        for s in range(300)
+    ]
+    scale = 1.0 / 127.0
+    assert abs(np.mean(vals) - 0.3) < 0.2 * scale
+    assert np.std(vals) > 0  # it actually randomizes
+
+
+def test_stochastic_rounding_requires_key():
+    with pytest.raises(ValueError, match="PRNG key"):
+        quantize_int8(jnp.ones((8,)), 8, stochastic=True)
+
+
+def test_wire_bytes_accounting_matches_cost_model():
+    """The registry's transport accounting and the simulator's pricing term
+    must agree — a drift would price a codec the data plane doesn't ship."""
+    from adapcc_tpu.sim.cost_model import (
+        DEFAULT_QUANT_BLOCK,
+        wire_bytes_per_element,
+    )
+
+    assert DEFAULT_QUANT_BLOCK == DEFAULT_BLOCK_SIZE
+    for name in ("off", "bf16", "int8"):
+        for block in (64, 256, 1024):
+            assert get_codec(name).wire_bytes_per_element(block) == (
+                wire_bytes_per_element(name, block)
+            )
+
+
+# --------------------------------------------------------------------------- #
+# error feedback
+# --------------------------------------------------------------------------- #
+
+def test_error_feedback_residual_sums_to_true_gradient():
+    apply = lambda g: get_codec("int8").apply(g, 64)
+    rng = np.random.default_rng(2)
+    residual = {"w": jnp.zeros((300,), jnp.float32)}
+    shipped = np.zeros((300,), np.float32)
+    truth = np.zeros((300,), np.float32)
+    for _ in range(6):
+        grad = {"w": jnp.asarray(rng.normal(size=(300,)), jnp.float32)}
+        wire, residual = error_feedback_step(grad, residual, apply)
+        shipped += np.asarray(wire["w"])
+        truth += np.asarray(grad["w"])
+    np.testing.assert_allclose(
+        shipped + np.asarray(residual["w"]), truth, rtol=1e-5, atol=1e-5
+    )
+
+
+def test_error_feedback_off_codec_keeps_zero_residual():
+    wire, residual = error_feedback_step(
+        {"w": jnp.ones((8,))}, {"w": jnp.zeros((8,))},
+        lambda g: get_codec("off").apply(g),
+    )
+    np.testing.assert_array_equal(np.asarray(residual["w"]), 0.0)
+    np.testing.assert_array_equal(np.asarray(wire["w"]), 1.0)
+
+
+# --------------------------------------------------------------------------- #
+# registry / env / XML validation funnels
+# --------------------------------------------------------------------------- #
+
+def test_registry_names_and_loud_unknown():
+    assert set(codec_names()) >= {"off", "bf16", "int8"}
+    with pytest.raises(ValueError, match="off|bf16"):
+        get_codec("fp8")
+
+
+def test_hook_compress_validates_via_registry():
+    with pytest.raises(ValueError, match="off|bf16"):
+        GradSyncHook(Strategy.ring(8), compress="fp8")
+    GradSyncHook(Strategy.ring(8), compress="strategy")  # adoption spelling
+
+
+def test_env_override_wins_and_malformed_is_loud(monkeypatch):
+    monkeypatch.setenv(WIRE_DTYPE_ENV, "int8")
+    assert resolve_wire_dtype("off") == "int8"
+    monkeypatch.setenv(WIRE_DTYPE_ENV, "int7")
+    with pytest.raises(ValueError, match="ADAPCC_WIRE_DTYPE"):
+        resolve_wire_dtype("off")
+
+
+def test_strategy_validates_wire_dtype():
+    with pytest.raises(ValueError, match="off|bf16"):
+        Strategy(Strategy.ring(4).trees, 4, wire_dtype="float3")
+
+
+def test_xml_wire_dtype_roundtrip_and_corrupt_rejection(tmp_path):
+    s = Strategy.ring(4, 2)
+    s.wire_dtype = "int8"
+    path = str(tmp_path / "strategy.xml")
+    text = emit_strategy_xml(s, path)
+    assert 'wire_dtype="int8"' in text
+    back = parse_strategy_xml(path)
+    assert back.wire_dtype == "int8"
+    assert back.fingerprint() == s.fingerprint()
+    # default stays implicit: pre-quant artifacts parse to "off"
+    plain = emit_strategy_xml(Strategy.ring(4))
+    assert "wire_dtype" not in plain
+    assert parse_strategy_xml(plain).wire_dtype == "off"
+    # corrupt artifact dies at the file that carries it
+    with pytest.raises(ValueError, match="wire_dtype"):
+        parse_strategy_xml(text.replace("int8", "int7"))
+
+
+# --------------------------------------------------------------------------- #
+# data-plane parity: hook (XLA collectives) and engine (quantized ring)
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("mode", ["psum", "schedule"])
+def test_hook_int8_parity_with_fp32(mesh8, mode):
+    """XLA data plane: the synced mean under int8 wire values stays within
+    the summed block-wise bound of the fp32 path, masked ranks included."""
+    strat = Strategy.ring(8, 4)
+    rng = np.random.default_rng(3)
+    grads = jnp.asarray(rng.normal(size=(8, 157)).astype(np.float32))
+    mask = jnp.asarray(np.array([1, 1, 1, 0, 1, 1, 1, 1], bool))
+
+    def run(compress):
+        hook = GradSyncHook(strat, mode=mode, compress=compress)
+        fn = jax.jit(jax.shard_map(
+            lambda g, m: hook.sync(g, m), mesh=mesh8,
+            in_specs=(P("ranks"), P()), out_specs=P("ranks"), check_vma=False,
+        ))
+        return np.asarray(fn(grads, mask))
+
+    plain, quant = run("off"), run("int8")
+    # AVG over 7 active ranks of per-rank roundtrip errors, each bounded by
+    # that rank's block-wise bound
+    bound = np.stack(
+        [int8_error_bound(np.asarray(grads[r]), DEFAULT_BLOCK_SIZE)
+         for r in range(8)]
+    ).sum(axis=0) / 7.0
+    assert (np.abs(plain - quant) <= bound + 1e-6).all()
+
+
+def test_engine_quant_ring_parity_and_trace(mesh8):
+    """Ring-engine data plane: quantized ring vs the exact sum, within the
+    hop-accumulated block-wise bound, with the wire dtype in the trace."""
+    from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.utils.observability import CollectiveTrace
+
+    strat = Strategy.ring(8)
+    strat.wire_dtype = "int8"
+    trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh8, strat, trace=trace)
+    xs = jnp.asarray(
+        np.random.default_rng(4).normal(size=(8, 700)).astype(np.float32)
+    )
+    out = np.asarray(eng.ring_allreduce(xs))
+    ref = np.asarray(xs).sum(axis=0)
+    assert (np.abs(out[0] - ref) <= ring_error_bound(xs)).all()
+    # bit-identical across ranks: the all-gather forwards encoded blocks
+    for r in range(1, 8):
+        np.testing.assert_array_equal(out[r], out[0])
+    ev = trace.events()[-1]
+    assert ev.primitive == "allreduce"
+    assert ev.impl == "quant_ring[int8]"
+    assert ev.extra["wire_dtype"] == "int8"
+    assert ev.extra["wire_bytes"] < ev.nbytes // 3  # the wire really shrank
+
+
+def test_engine_env_override_reroutes_to_quant_ring(mesh8, monkeypatch):
+    from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.utils.observability import CollectiveTrace
+
+    monkeypatch.setenv(WIRE_DTYPE_ENV, "bf16")
+    trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh8, Strategy.ring(8), trace=trace)
+    xs = jnp.ones((8, 64), jnp.float32)
+    out = np.asarray(eng.ring_allreduce(xs))
+    np.testing.assert_allclose(out, 8.0, rtol=1e-2)
+    assert trace.events()[-1].extra["wire_dtype"] == "bf16"
+
+
+def test_wire_ring_matches_sum_for_bf16(mesh8):
+    xs = jnp.asarray(
+        np.random.default_rng(5).normal(size=(8, 333)).astype(np.float32)
+    )
+    fn = jax.jit(jax.shard_map(
+        lambda v: wire_ring_allreduce_shard(v[0], 8, "ranks", "bf16")[None],
+        mesh=mesh8, in_specs=P("ranks"), out_specs=P("ranks"), check_vma=False,
+    ))
+    out = np.asarray(fn(xs))
+    np.testing.assert_allclose(out[0], np.asarray(xs).sum(0), rtol=0.05, atol=0.05)
+
+
+def test_wire_ring_world_one_is_identity():
+    x = jnp.asarray(np.random.default_rng(6).normal(size=(40,)), jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(wire_ring_allreduce_shard(x, 1, "ranks", "int8")),
+        np.asarray(x),
+    )
+
+
+# --------------------------------------------------------------------------- #
+# wire_dtype flow: Synthesizer → XML → engine trace → hook
+# --------------------------------------------------------------------------- #
+
+def _graphs(world, gbps):
+    bw = [[0.0 if i == j else gbps for j in range(world)] for i in range(world)]
+    lat = [[0.0 if i == j else 2e-6 for j in range(world)] for i in range(world)]
+    return bw, lat
+
+
+def test_sim_rank_flips_to_int8_when_bandwidth_drops():
+    """The regression the acceptance criteria name: healthy ICI-class links
+    keep the fp32 wire; scaling the calibrated bandwidth down flips the
+    sim-rank choice to int8."""
+    from adapcc_tpu.primitives import ALLREDUCE
+    from adapcc_tpu.strategy.synthesizer import Synthesizer
+
+    world = 8
+    table = ["10.0.0.1"] * 4 + ["10.0.0.2"] * 4
+    nbytes = 64 << 20
+
+    def choice(gbps):
+        syn = Synthesizer(None, table, policy="sim-rank")
+        bw, lat = _graphs(world, gbps)
+        return syn.synthesize(ALLREDUCE, 2, nbytes, bw, lat).wire_dtype
+
+    assert choice(45.0) == "off"
+    assert choice(2.0) == "int8"
+
+
+def test_cost_model_choice_is_stable_and_prices_all_candidates():
+    from adapcc_tpu.sim.cost_model import LinkCoeffs, choose_wire_dtype
+
+    winner, times = choose_wire_dtype(
+        8, 64 << 20, LinkCoeffs(alpha=1e-6, beta=1.0 / 45e9)
+    )
+    assert winner == "off" and set(times) == {"off", "bf16", "int8"}
+    winner_dcn, _ = choose_wire_dtype(
+        8, 64 << 20, LinkCoeffs(alpha=25e-6, beta=1.0 / 12.5e9)
+    )
+    assert winner_dcn == "int8"
+
+
+def test_wire_dtype_flows_synthesizer_to_hook_and_trace(mesh8, tmp_path):
+    """End to end: a low-bandwidth synthesis persists int8 into the XML; the
+    parsed strategy drives the engine's quantized ring (recorded in the
+    dispatch trace) and a compress="strategy" hook adopts it."""
+    from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.primitives import ALLREDUCE
+    from adapcc_tpu.strategy.synthesizer import Synthesizer
+    from adapcc_tpu.utils.observability import CollectiveTrace
+
+    table = ["10.0.0.%d" % r for r in range(8)]  # every edge slow/DCN
+    syn = Synthesizer(
+        str(tmp_path / "strategy.xml"), table, policy="sim-rank"
+    )
+    bw, lat = _graphs(8, 1.0)
+    syn.generate_strategy(ALLREDUCE, 1, 64 << 20, bw, lat)
+    loaded = parse_strategy_xml(str(tmp_path / "strategy.xml"))
+    assert loaded.wire_dtype == "int8"
+
+    trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh8, loaded, trace=trace)
+    eng.ring_allreduce(jnp.ones((8, 32), jnp.float32))
+    assert trace.events()[-1].extra["wire_dtype"] == "int8"
+
+    hook = GradSyncHook(loaded, compress="strategy")
+    assert hook.effective_compress() == "int8"
+
+
+# --------------------------------------------------------------------------- #
+# training: parity and convergence
+# --------------------------------------------------------------------------- #
+
+def _mlp_workload(seed=0):
+    from adapcc_tpu.models import MLP
+
+    model = MLP(features=(32, 32, 10))
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.normal(size=(32, 16)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, size=(32,)))
+    params = model.init(jax.random.PRNGKey(seed), x[:1])
+
+    def loss_fn(p, b):
+        bx, by = b
+        logits = model.apply(p, bx)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, by
+        ).mean()
+
+    return loss_fn, params, (x, y)
+
+
+def test_ddp_mlp_int8_error_feedback_converges_within_2pct(mesh8):
+    """The acceptance criterion: a DDP MLP loop with compress="int8",
+    error_feedback=True reaches a loss within 2% of the uncompressed run in
+    the same step budget."""
+    loss_fn, params, batch = _mlp_workload()
+    steps = 25
+
+    def run(compress, ef):
+        tr = DDPTrainer(
+            loss_fn, optax.sgd(0.1), mesh8, Strategy.ring(8),
+            grad_compress=compress, error_feedback=ef,
+        )
+        st = tr.init_state(jax.tree_util.tree_map(jnp.array, params))
+        for _ in range(steps):
+            st, losses = tr.step(st, batch)
+        return float(jnp.mean(losses))
+
+    plain = run("off", False)
+    quant = run("int8", True)
+    assert quant == pytest.approx(plain, rel=0.02)
+    assert quant < 2.0  # it actually learned (CE starts ~ln(10) ≈ 2.3)
+
+
+def test_trainer_error_feedback_residual_threading(mesh8):
+    """The residual bank is created lazily, carried in fp32 regardless of
+    param dtype, replaced every step, and cleared by reset()."""
+    def loss_fn(p, b):
+        return jnp.mean((b @ p["w"]) ** 2)
+
+    params = {"w": jnp.ones((6, 3), jnp.float32)}
+    tr = DDPTrainer(
+        loss_fn, optax.sgd(0.05), mesh8, Strategy.ring(8),
+        grad_compress="int8", error_feedback=True,
+    )
+    st = tr.init_state(params)
+    batch = jnp.asarray(
+        np.random.default_rng(7).normal(size=(16, 6)), jnp.float32
+    )
+    assert tr._residual is None
+    st, _ = tr.step(st, batch)
+    leaves = jax.tree_util.tree_leaves(tr._residual)
+    assert {l.dtype for l in leaves} == {jnp.dtype(jnp.float32)}
+    assert any(float(jnp.abs(l).max()) > 0 for l in leaves)  # banked error
+    tr.reset()
+    assert tr._residual is None
+
+
+def test_sync_error_feedback_keeps_gradient_dtype(mesh8):
+    """A bf16 program's collective operands and synced result stay bf16
+    under error feedback (only the residual bank is fp32) — the fp32
+    compensation must not silently widen the wire."""
+    hook = GradSyncHook(Strategy.ring(8), mode="psum", compress="int8")
+    grads = {"w": jnp.ones((8, 64), jnp.bfloat16)}
+    residual = {"w": jnp.zeros((8, 64), jnp.float32)}
+
+    def per_shard(g, r):
+        return hook.sync_error_feedback(g, r, None)
+
+    synced, new_res = jax.jit(jax.shard_map(
+        per_shard, mesh=mesh8,
+        in_specs=(P("ranks"), P("ranks")), out_specs=P("ranks"),
+        check_vma=False,
+    ))(grads, residual)
+    assert synced["w"].dtype == jnp.bfloat16
+    assert new_res["w"].dtype == jnp.float32
+    # the collective itself ran on bf16 operands, not widened fp32 ones
+    # (test_grad_compress.test_wire_is_actually_bf16's HLO check, EF flavor)
+    lowered = jax.jit(jax.shard_map(
+        per_shard, mesh=mesh8,
+        in_specs=(P("ranks"), P("ranks")), out_specs=P("ranks"),
+        check_vma=False,
+    )).lower(grads, residual).as_text()
+    # stablehlo.all_reduce is a region op: the operand/result types live on
+    # the region's closing `}) : (tensor<...>) -> ...` signature
+    sigs = [
+        part.split("}) : ", 1)[1].splitlines()[0]
+        for part in lowered.split('"stablehlo.all_reduce"')[1:]
+    ]
+    assert sigs and all("bf16" in s and "f32" not in s for s in sigs), sigs
+
+
+def test_trainer_error_feedback_rejects_noop_codec(mesh8):
+    with pytest.raises(ValueError, match="identically-zero residual"):
+        DDPTrainer(
+            lambda p, b: jnp.mean(b @ p["w"]), optax.sgd(0.1), mesh8,
+            Strategy.ring(8), grad_compress="off", error_feedback=True,
+        )
+
+
+def test_wire_dtype_sweep_cli_conflicts_with_ring_sweep():
+    from benchmarks.sim_collectives import main
+
+    with pytest.raises(SystemExit):
+        main(["--wire-dtype", "off,int8", "--ring-sweep"])
+
+
+def test_trainer_error_feedback_rejects_async_relay(mesh8):
+    with pytest.raises(ValueError, match="error_feedback"):
+        DDPTrainer(
+            lambda p, b: jnp.mean(b @ p["w"]), optax.sgd(0.1), mesh8,
+            Strategy.ring(8), grad_compress="int8", error_feedback=True,
+            bsp=False, dynamic_mask=True,
+        )
+
+
+def test_scan_steps_rejects_error_feedback(mesh8):
+    def loss_fn(p, b):
+        return jnp.mean((b @ p["w"]) ** 2)
+
+    tr = DDPTrainer(
+        loss_fn, optax.sgd(0.05), mesh8, Strategy.ring(8),
+        grad_compress="int8", error_feedback=True,
+    )
+    st = tr.init_state({"w": jnp.ones((4, 2), jnp.float32)})
+    with pytest.raises(ValueError, match="residual"):
+        tr.scan_steps(st, jnp.ones((8, 4), jnp.float32), 2)
+
+
+def test_zero1_wire_dtype_step_stays_close_to_fp32(mesh8):
+    """Zero1Optimizer(wire_dtype=...) quantizes the reduce-scatter
+    contribution; one int8 step stays within quantization tolerance of the
+    fp32 step and the optimizer resolves/validates the codec eagerly."""
+    from adapcc_tpu.parallel import Zero1Optimizer, zero1_train_step
+
+    def loss_fn(p, b):
+        return jnp.mean((b @ p["w"]) ** 2)
+
+    params = {"w": jnp.asarray(
+        np.random.default_rng(8).normal(size=(6, 3)), jnp.float32
+    )}
+    batch = jnp.asarray(
+        np.random.default_rng(9).normal(size=(16, 6)), jnp.float32
+    )
+
+    def one_step(wire_dtype):
+        opt = Zero1Optimizer(optax.sgd(0.05), mesh8, wire_dtype=wire_dtype)
+        master, z_state = opt.init(
+            jax.tree_util.tree_map(jnp.array, params)
+        )
+        step = zero1_train_step(loss_fn, opt, mesh8)
+        new_params, *_ = step(params, master, z_state, batch)
+        return np.asarray(new_params["w"])
+
+    np.testing.assert_allclose(
+        one_step("int8"), one_step(None), rtol=2e-2, atol=2e-3
+    )
+    with pytest.raises(ValueError, match="off|bf16"):
+        Zero1Optimizer(optax.sgd(0.05), mesh8, wire_dtype="fp8")
+
+
+# --------------------------------------------------------------------------- #
+# simulated bench rows (make quant-bench)
+# --------------------------------------------------------------------------- #
+
+def test_wire_dtype_sweep_rows_are_deterministic_and_flagged():
+    from benchmarks.sim_collectives import wire_dtype_sweep
+
+    rows = wire_dtype_sweep(8, [1 << 20, 128 << 20], ("off", "bf16", "int8"))
+    again = wire_dtype_sweep(8, [1 << 20, 128 << 20], ("off", "bf16", "int8"))
+    assert rows == again  # byte-identical: the tier-1 determinism contract
+    assert all(r["mode"] == "simulated" and "pred_time_us" in r for r in rows)
+    # exactly one chosen dtype per size, and it is the cheapest prediction
+    for size in (1 << 20, 128 << 20):
+        group = [r for r in rows if r["size_bytes"] == size]
+        chosen = [r for r in group if r["chosen"]]
+        assert len(chosen) == 1
+        assert chosen[0]["pred_time_us"] == min(r["pred_time_us"] for r in group)
+
+
+def test_wire_dtype_sweep_rejects_unknown_codec():
+    from benchmarks.sim_collectives import wire_dtype_sweep
+
+    with pytest.raises(ValueError, match="off|bf16"):
+        wire_dtype_sweep(8, [1 << 20], ("off", "fp8"))
+
+
+def test_wire_dtype_sweep_cli_json(capsys):
+    from benchmarks.sim_collectives import main
+
+    assert main([
+        "--world", "4", "--sizes", "1M", "--wire-dtype", "off,int8", "--json",
+    ]) == 0
+    import json as _json
+
+    lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    rows = [_json.loads(l) for l in lines]
+    assert {r["wire_dtype"] for r in rows} == {"off", "int8"}
+    assert all(r["mode"] == "simulated" for r in rows)

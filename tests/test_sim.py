@@ -688,7 +688,8 @@ def test_hw_session_multichip_phases_skip_cleanly_at_world1(tmp_path):
     run_multichip_phases(sys.executable, str(out), world=1)
     rows = [_json.loads(l) for l in open(out)]
     assert {r["phase"] for r in rows} == {
-        "busbw_ici_128m", "ring_smoke", "ring_chunk_sweep"
+        "busbw_ici_128m", "ring_smoke", "ring_chunk_sweep",
+        "busbw_wire_dtype",
     }
     for r in rows:
         assert "world=1" in r["skipped"]
