@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench ring-sweep quant-bench clean
+.PHONY: all native test sim-bench ring-sweep quant-bench tune-bench trace-export clean
 
 all: native
 
@@ -40,6 +40,21 @@ ring-sweep:
 quant-bench:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--world 8 --sizes 1M,16M,128M --wire-dtype off,bf16,int8 --json
+
+# Autotuner convergence replay on a deterministic synthetic cost surface
+# (docs/TUNER.md): "mode": "simulated" rows over the (chunk x codec) grid
+# with the policy's chosen plan flagged per size — the hardware-free
+# regression artifact for the measurement-driven plan tuner.
+tune-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--world 8 --sizes 1M,16M,128M --tune-replay --json
+
+# Perfetto/chrome://tracing export of a recorded dispatch trace: run a
+# short virtual-pod collective session under ADAPCC_TUNER=record and emit
+# benchmarks/results/trace_export.json (open in ui.perfetto.dev).
+trace-export:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	python -m scripts.trace_export
 
 clean:
 	rm -f $(LIB)

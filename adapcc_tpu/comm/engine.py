@@ -25,6 +25,7 @@ collectives.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -521,6 +522,7 @@ class CollectiveEngine:
         axis_name: str = RANKS_AXIS,
         use_xla_fastpath: bool = True,
         trace: Optional[Any] = None,
+        tuner: Optional[Any] = None,
     ) -> None:
         if mesh.devices.size != strategy.world_size:
             raise ValueError(
@@ -530,6 +532,17 @@ class CollectiveEngine:
         # fail fast on a typo'd A/B knob: dying here costs nothing, dying at
         # the first traced collective costs the whole backend/model setup
         _merged_env_disabled()
+        from adapcc_tpu.tuner import CollectiveTuner, tuner_mode
+
+        # same fail-fast policy for ADAPCC_TUNER; additionally, a non-off
+        # mode with no caller-provided tuner auto-builds one for this mesh,
+        # so `ADAPCC_TUNER=record benchmarks.collectives ...` measures into
+        # the database with zero wiring at the call site
+        if tuner is None and tuner_mode() != "off":
+            tuner = CollectiveTuner.for_mesh(mesh)
+        #: optional CollectiveTuner: consulted by ring_allreduce when
+        #: ADAPCC_TUNER=choose, fed dispatch walltimes when record|choose
+        self.tuner = tuner
         self.mesh = mesh
         self.strategy = strategy
         # two-level world: a ("dcn", "ici") mesh executes strategies
@@ -559,6 +572,11 @@ class CollectiveEngine:
 
     def clear(self) -> None:
         self._cache.clear()
+        if self.tuner is not None:
+            # dropped programs recompile on next dispatch; the timer must
+            # re-discard those first calls or a compile walltime lands in
+            # the database as a steady-state sample
+            self.tuner.timer.reset()
 
     def _active_to_mask(self, active_gpus: Optional[Sequence[int]]) -> jnp.ndarray:
         if active_gpus is None:
@@ -856,16 +874,25 @@ class CollectiveEngine:
             ag=ag,
         )
 
+    @staticmethod
+    def _ring_extras(plan) -> Dict[str, Any]:
+        """Trace payload for a Pallas-ring dispatch — ONE definition shared
+        by allreduce/RS/AG so the three primitives' artifacts cannot
+        drift."""
+        return {
+            "chunk_bytes": plan.chunk_bytes,
+            "stage_bytes": plan.stage_bytes,
+            "n_tiles": plan.n_tiles,
+            "wire_dtype": "off",  # pallas kernels ship the payload dtype
+        }
+
     def _record_ring(self, primitive: str, plan, stacked: jnp.ndarray) -> None:
         if self.trace is not None:
             self.trace.record(
                 primitive,
                 f"pallas_ring[{plan.path}]",
                 int(stacked.nbytes),
-                chunk_bytes=plan.chunk_bytes,
-                stage_bytes=plan.stage_bytes,
-                n_tiles=plan.n_tiles,
-                wire_dtype="off",  # pallas kernels ship the payload dtype
+                **self._ring_extras(plan),
             )
 
     def _resolved_wire_dtype(self, wire_dtype: Optional[str]) -> str:
@@ -880,12 +907,13 @@ class CollectiveEngine:
 
     def _wire_ring_allreduce(
         self, stacked: jnp.ndarray, wire_dtype: str, block_size: int
-    ) -> jnp.ndarray:
+    ) -> Tuple[jnp.ndarray, Tuple, Dict[str, Any]]:
         """Ring allreduce over codec-compressed chunks (the EQuARX shape):
         reduce-scatter dequant-accumulate-requants at every hop, all-gather
         ships each reduced chunk's encoded blocks once.  ppermute-based —
-        any backend, no Pallas requirement — and recorded in the dispatch
-        trace with the executed ``wire_dtype``."""
+        any backend, no Pallas requirement.  Returns ``(result, cache_key,
+        trace_extras)`` so :meth:`ring_allreduce` can fold tuner timing and
+        provenance into one trace record."""
         from adapcc_tpu.quant import get_codec, wire_ring_allreduce_shard
         from adapcc_tpu.sim.cost_model import wire_bytes_per_element
 
@@ -902,19 +930,15 @@ class CollectiveEngine:
             "quant_ring_allreduce", stacked.shape, stacked.dtype.name,
             codec.name, block_size,
         )
-        if self.trace is not None:
-            per_rank = int(np.prod(stacked.shape[1:]))
-            self.trace.record(
-                "allreduce",
-                f"quant_ring[{codec.name}]",
-                int(stacked.nbytes),
-                wire_dtype=codec.name,
-                block_size=block_size,
-                wire_bytes=int(
-                    per_rank * wire_bytes_per_element(codec.name, block_size)
-                ),
-            )
-        return self._shard_mapped(key, per_shard, 1)(stacked)
+        per_rank = int(np.prod(stacked.shape[1:]))
+        extras = {
+            "wire_dtype": codec.name,
+            "block_size": block_size,
+            "wire_bytes": int(
+                per_rank * wire_bytes_per_element(codec.name, block_size)
+            ),
+        }
+        return self._shard_mapped(key, per_shard, 1)(stacked), key, extras
 
     def ring_allreduce(
         self,
@@ -932,7 +956,16 @@ class CollectiveEngine:
         ``wire_dtype=None`` adopts the strategy's synthesized codec
         (``ADAPCC_WIRE_DTYPE`` overrides both): a non-"off" codec reroutes
         to the quantized ppermute ring (:meth:`_wire_ring_allreduce`) —
-        compressed chunks on the wire, fp32 accumulation at every hop."""
+        compressed chunks on the wire, fp32 accumulation at every hop.
+
+        With a tuner attached (:mod:`adapcc_tpu.tuner`), ``ADAPCC_TUNER=
+        choose`` lets the measured policy fill the knobs the caller left
+        open — precedence **env > explicit arg > tuner > strategy** — and
+        ``record``/``choose`` time every dispatch (``block_until_ready``
+        walltime, compile warmup discarded) into the tuning database.  The
+        dispatch trace carries the decision (``tuner={chosen, source,
+        applied}``) next to the executed values, so precedence is visible
+        in the artifact."""
         from adapcc_tpu.comm.pallas_ring import ring_allreduce_shard
 
         if self.two_level:
@@ -941,30 +974,82 @@ class CollectiveEngine:
                 "two-level worlds use the strategy allreduce"
             )
         self._check_world_dim(stacked, "ring_allreduce")
+        # the single source of the key vocabulary: candidates(), live
+        # recording, and trace replay must all spell one cell identically
+        from adapcc_tpu.tuner.policy import NO_CHUNK, QUANT_PATH
+
+        per_rank_bytes = int(np.prod(stacked.shape[1:])) * stacked.dtype.itemsize
+        tuner = self.tuner
+        tplan = None
+        if tuner is not None and tuner.choosing:
+            tplan = tuner.choose(
+                "allreduce", per_rank_bytes, stacked.dtype.name
+            )
+            # the tuner only fills knobs the caller left open; the env
+            # overrides (resolved inside resolve_chunk_bytes /
+            # resolve_wire_dtype) still win over everything
+            if wire_dtype is None:
+                wire_dtype = tplan.wire_dtype
+            if chunk_bytes is None and tplan.chunk_bytes is not None:
+                chunk_bytes = tplan.chunk_bytes
         wd = self._resolved_wire_dtype(wire_dtype)
+        timing = tuner is not None and tuner.recording
+        t0 = time.perf_counter()
         if wd != "off":
             from adapcc_tpu.quant import DEFAULT_BLOCK_SIZE
 
-            return self._wire_ring_allreduce(
+            out, cache_key, extras = self._wire_ring_allreduce(
                 stacked, wd, quant_block_size or DEFAULT_BLOCK_SIZE
             )
-        if interpret is None:
-            interpret = jax.devices()[0].platform != "tpu"
-        world = self.world_size
-        plan = self._ring_plan(stacked, chunk_bytes, rs=True, ag=True)
+            impl = f"quant_ring[{wd}]"
+            executed_path, executed_chunk = QUANT_PATH, NO_CHUNK
+        else:
+            if interpret is None:
+                interpret = jax.devices()[0].platform != "tpu"
+            world = self.world_size
+            plan = self._ring_plan(stacked, chunk_bytes, rs=True, ag=True)
 
-        def per_shard(x):  # x: [1, *payload]
-            return ring_allreduce_shard(
-                x[0], world, self.axis_name, interpret=interpret,
-                chunk_bytes=plan.chunk_bytes,
-            )[None]
+            def per_shard(x):  # x: [1, *payload]
+                return ring_allreduce_shard(
+                    x[0], world, self.axis_name, interpret=interpret,
+                    chunk_bytes=plan.chunk_bytes,
+                )[None]
 
-        key = (
-            "ring_allreduce", stacked.shape, stacked.dtype.name,
-            bool(interpret), plan.path, plan.stage_bytes,
-        )
-        self._record_ring("allreduce", plan, stacked)
-        return self._shard_mapped(key, per_shard, 1)(stacked)
+            cache_key = (
+                "ring_allreduce", stacked.shape, stacked.dtype.name,
+                bool(interpret), plan.path, plan.stage_bytes,
+            )
+            out = self._shard_mapped(cache_key, per_shard, 1)(stacked)
+            impl = f"pallas_ring[{plan.path}]"
+            executed_path, executed_chunk = plan.path, plan.chunk_bytes
+            extras = self._ring_extras(plan)
+        if timing:
+            # measurement semantics: the sample is the full dispatch-to-
+            # completion walltime.  The block serializes the host loop by
+            # design — that is what "record" mode buys its database with
+            jax.block_until_ready(out)
+            duration = time.perf_counter() - t0
+            extras["duration_s"] = duration
+            tuner.observe_dispatch(
+                tuner.key_for(
+                    "allreduce", per_rank_bytes, executed_path,
+                    # a vmem dispatch is ONE cell regardless of budget (the
+                    # knob is inert there); keying by the resolved budget
+                    # would split its samples away from the candidate grid
+                    NO_CHUNK if executed_path == "vmem" else executed_chunk,
+                    wd,
+                ),
+                cache_key,
+                duration,
+            )
+        if tplan is not None:
+            applied = wd == tplan.wire_dtype and (
+                tplan.chunk_bytes is None or executed_chunk == tplan.chunk_bytes
+            )
+            extras["tuner"] = tplan.trace_extra(applied=applied)
+        if self.trace is not None:
+            self.trace.record("allreduce", impl, int(stacked.nbytes), **extras)
+        return out
 
     def ring_reduce_scatter(
         self,

@@ -101,6 +101,31 @@ class Communicator:
                 write_ip_table(self.ip_table, ip_table_path)
 
         self.synthesizer = Synthesizer(args.strategy_file, self.ip_table, policy=args.policy)
+        # measurement-driven plan autotuner (adapcc_tpu/tuner): owned here so
+        # every engine this communicator builds shares one database view and
+        # one hysteresis state.  Fingerprinted with the ip table — a tuning
+        # median from one host layout must not rank plans for another.  The
+        # database lands next to the other topology artifacts unless
+        # ADAPCC_TUNER_DB points elsewhere; ADAPCC_TUNER gates whether any
+        # dispatch consults or feeds it (off = this is inert state).
+        from adapcc_tpu.tuner import TUNER_DB_ENV, CollectiveTuner
+        from adapcc_tpu.tuner.db import topology_fingerprint
+
+        dev = next(iter(self.mesh.devices.flat))
+        self.tuner = CollectiveTuner(
+            world=self.world_size,
+            topology=topology_fingerprint(
+                self.world_size,
+                {r: ip for r, ip in enumerate(self.ip_table)},
+                platform=f"{getattr(dev, 'platform', '?')}:"
+                f"{getattr(dev, 'device_kind', '?')}",
+            ),
+            db_path=(
+                None  # let ADAPCC_TUNER_DB win
+                if os.environ.get(TUNER_DB_ENV)
+                else os.path.join(args.topology_dir, "tuning.jsonl")
+            ),
+        )
         self._engines: Dict[int, CollectiveEngine] = {}
         self._strategy: Optional[Strategy] = None
         self._profiler: Optional[NetworkProfiler] = None
@@ -135,6 +160,7 @@ class Communicator:
                 self._load_strategy(),
                 axis_name=self.axis_name,
                 use_xla_fastpath=self.args.use_xla_fastpath,
+                tuner=self.tuner,
             )
         else:
             raise ValueError(f"unknown primitive {prim}")
@@ -215,6 +241,9 @@ class Communicator:
             eng.clear()
         self._engines.clear()
         self._strategy = None
+        # re-synthesis follows: plans should be re-decided from the
+        # database, not inherited from the torn-down world's incumbency
+        self.tuner.reset()
         self.stop_coordinator()
 
     def _load_strategy(self) -> Strategy:

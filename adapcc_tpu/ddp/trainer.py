@@ -137,6 +137,17 @@ class DDPTrainer:
         # and full-axis stats stay bit-identical across ranks (a masked
         # pmean would fork per-rank state and violate the replication spec).
         stateful_loss: bool = False,
+        # measurement-driven tuning (adapcc_tpu/tuner): record each step's
+        # dispatch walltime into the tuning database under the executed
+        # (wire codec, ring chunk) cell, and every ``tune_every`` steps let
+        # the policy re-choose the gradient-sync codec — the trainer adopts
+        # a winning challenger by recompiling with the new codec (hysteresis
+        # in the policy keeps that rare).  ADAPCC_TUNER=off still disables
+        # everything globally; an attached communicator's tuner is reused so
+        # engine dispatches and step timings share one database.
+        tune: bool = False,
+        tuner: Optional[Any] = None,
+        tune_every: int = 16,
     ) -> None:
         self.loss_fn = loss_fn
         self.stateful_loss = stateful_loss
@@ -218,6 +229,37 @@ class DDPTrainer:
         self._gns: Optional[Any] = None
         self._gns_pending: list = []
         self._zero1_opt: Optional[Any] = None
+        # -- autotuning state --------------------------------------------------
+        if tune_every < 1:
+            raise ValueError(f"tune_every must be >= 1, got {tune_every}")
+        self.tune_every = tune_every
+        if tune and tuner is None:
+            tuner = getattr(communicator, "tuner", None)
+        if tune and tuner is None:
+            from adapcc_tpu.tuner import CollectiveTuner
+
+            tuner = CollectiveTuner.for_mesh(mesh)
+        if tune and tuner.explicit_mode is None:
+            # tune=True is an explicit opt-in: with ADAPCC_TUNER unset the
+            # tuner must actually choose — for the per-step codec AND the
+            # Zero1Optimizer chunk gate (which reads tuner.choosing).  A
+            # caller-pinned mode (e.g. an explicit record-only tuner) is
+            # respected; the env still overrides either way.
+            tuner = tuner.with_mode("choose")
+        self.tune = tune
+        self.tuner = tuner if tune else None
+        self._grad_bytes: Optional[float] = None
+        # warmup-discard token: bumped on every recompile so the first step
+        # of each compiled program (which pays tracing + XLA compile) never
+        # lands in the database as a steady-state sample
+        self._build_gen = 0
+
+    def _tuning(self) -> bool:
+        """Is per-step tuning live right now?  ``tune=True`` opts the
+        trainer in (its tuner view defaults to choose, see ``__init__``);
+        ``ADAPCC_TUNER=off`` still kills it globally (same contract as the
+        engine)."""
+        return self.tune and self.tuner is not None and self.tuner.recording
 
     # -- step program ----------------------------------------------------------
 
@@ -231,8 +273,14 @@ class DDPTrainer:
         opt = self._zero1_opt = Zero1Optimizer(
             self.tx, self.mesh, self.axis_name, ring=self.zero1_ring,
             ring_chunk_bytes=self.zero1_ring_chunk_bytes,
+            tuner=self.tuner,
         )
         master, opt_state = opt.init(params)
+        if self.zero1_ring_chunk_bytes is None:
+            # adopt the optimizer's (possibly tuner-chosen) staging
+            # granularity so the step program and the optimizer execute the
+            # same ring plan
+            self.zero1_ring_chunk_bytes = opt.ring_chunk_bytes
         return TrainState(
             params=params,
             opt_state=(master, opt_state),
@@ -487,6 +535,7 @@ class DDPTrainer:
         self._check_state(state)
         if self._compiled is None:
             self._compiled = self._build()
+            self._build_gen += 1
         if not self._coord_calibrated:
             # rent-or-buy calibration: this trainer's actual gradient volume
             # + the bootstrap's profiled link bandwidth replace the
@@ -537,7 +586,16 @@ class DDPTrainer:
                     state.params,
                 )
             args.append(self._residual)
-        out = self._compiled(*args)
+        tuning = self._tuning()
+        if tuning:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            out = self._compiled(*args)
+            jax.block_until_ready(out)
+            self._tune_observe(state, _time.perf_counter() - t0)
+        else:
+            out = self._compiled(*args)
         if not self.bsp:
             *out, self._deferred = out
         elif self.error_feedback:
@@ -599,6 +657,71 @@ class DDPTrainer:
         new_state, losses = fn(state, batch)
         self._host_step += n_steps
         return new_state, losses
+
+    # -- autotuning ------------------------------------------------------------
+
+    def _step_cell(self, grad_bytes: int):
+        """The database cell the *current* configuration's step walltimes
+        pool under: the hook's effective wire codec.  The cell must stay
+        inside ``TuningPolicy.candidates("ddp_step")`` — the codec-only
+        grid — or the posterior never forms and exploration never ends;
+        the ZeRO-1 ring chunk is a separate knob, tuned once at
+        ``Zero1Optimizer.init`` under its own "zero1_ring" cells."""
+        from adapcc_tpu.tuner.policy import HOOK_PATH, NO_CHUNK
+
+        return self.tuner.key_for(
+            "ddp_step", grad_bytes, HOOK_PATH, NO_CHUNK,
+            self.hook.effective_compress(),
+        )
+
+    def _tune_observe(self, state: TrainState, seconds: float) -> None:
+        """Record one step walltime; periodically let the policy re-choose
+        the gradient-sync codec and adopt a winning challenger (recompile).
+        Step times of different codecs share the same compute, so their
+        medians are mutually comparable — exactly the posterior the policy
+        ranks on."""
+        if self._grad_bytes is None:
+            self._grad_bytes = float(
+                sum(
+                    leaf.nbytes
+                    for leaf in jax.tree_util.tree_leaves(state.params)
+                )
+            )
+        grad_bytes = int(self._grad_bytes)
+        self.tuner.observe_dispatch(
+            self._step_cell(grad_bytes), ("ddp_step", self._build_gen), seconds
+        )
+        if not self.tuner.choosing:
+            return  # record-only mode: measure, never steer
+        if self._host_step % self.tune_every:
+            return
+        import os as _os
+
+        from adapcc_tpu.quant import WIRE_DTYPE_ENV
+
+        if _os.environ.get(WIRE_DTYPE_ENV, "").strip():
+            # ADAPCC_WIRE_DTYPE pins the executed codec (effective_compress
+            # resolves it); "adopting" would recompile the step for zero
+            # behavioral change, every tune_every boundary, forever — keep
+            # measuring the pinned cell and never steer
+            return
+        # error feedback cannot legally run the 'off' codec (the residual
+        # would bank zero at world x params); excluding it from the grid —
+        # not just from adoption — keeps the explorer from pinning on a
+        # cell that can never accrue samples
+        wire_dtypes = (
+            tuple(w for w in self.tuner.policy.wire_dtypes if w != "off")
+            if self.error_feedback
+            else None
+        )
+        plan = self.tuner.choose("ddp_step", grad_bytes, wire_dtypes=wire_dtypes)
+        wd = plan.wire_dtype
+        if wd == self.hook.effective_compress():
+            return
+        self.hook.compress = wd
+        self.hook.reset_plan()
+        self._compiled = None  # recompile with the adopted codec
+        self._scan_cache.clear()
 
     def _record_gns(self, batch: Any, norms: jnp.ndarray, active_mask) -> None:
         if self._gns is None:

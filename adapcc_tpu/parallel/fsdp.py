@@ -370,12 +370,22 @@ class Zero1Optimizer:
         ring_interpret: Optional[bool] = None,
         ring_chunk_bytes: Optional[int] = None,
         wire_dtype: Optional[str] = None,
+        tuner: Optional[Any] = None,
     ) -> None:
         self.tx = tx
         self.mesh = mesh
         self.axis_name = axis_name
         self.world = mesh.shape[axis_name]
         self.ring = ring
+        # measurement-driven chunk choice (adapcc_tpu/tuner): when the ring
+        # staging granularity is left open and ADAPCC_TUNER=choose, init()
+        # asks the tuner's policy for it (sized to the actual flat master)
+        # instead of falling to the default.  Explicit ring_chunk_bytes and
+        # the ADAPCC_RING_CHUNK_BYTES env keep their precedence — the tuner
+        # only fills the knob nobody pinned.
+        self.tuner = tuner
+        #: the TunedPlan behind an adopted chunk (None = not tuner-chosen)
+        self.tuned_plan = None
         if ring_interpret is None:
             ring_interpret = jax.devices()[0].platform != "tpu"
         self.ring_interpret = ring_interpret
@@ -404,6 +414,30 @@ class Zero1Optimizer:
 
         return _tile_elems(jnp.float32)
 
+    def tuning_key(self):
+        """The tuning-database cell this optimizer's ring collectives
+        execute, or None off the ring path / before ``init``.  Callers
+        timing zero1 steps record into THIS key — the tuner-chosen cell
+        when the tuner picked the chunk, else the executed configuration
+        via the kernel's own planner — so the measurements land where the
+        next ``init()``'s ``choose("zero1_ring", ...)`` will look (the
+        loop closes across runs through the persisted database)."""
+        if self.tuner is None or self._meta is None or not self.ring:
+            return None
+        if self.tuned_plan is not None:
+            return self.tuned_plan.key
+        from adapcc_tpu.comm.pallas_ring import plan_ring_schedule
+        from adapcc_tpu.tuner.policy import NO_CHUNK
+
+        plan = plan_ring_schedule(
+            self._meta.padded, jnp.float32, self.world, self.ring_chunk_bytes
+        )
+        return self.tuner.key_for(
+            "zero1_ring", self._meta.padded * 4, plan.path,
+            # same key vocabulary as the candidate grid: vmem is one cell
+            NO_CHUNK if plan.path == "vmem" else plan.chunk_bytes, "off",
+        )
+
     def init(self, params: Any) -> Tuple[jnp.ndarray, Any]:
         """Returns ``(flat_master [world, N/world] fp32, opt_state shard)``.
 
@@ -414,6 +448,17 @@ class Zero1Optimizer:
         """
         meta = self._meta = _flatten_meta(params, self.world, self._align())
         self._compiled = None  # re-init with a new tree invalidates the program
+        if (
+            self.ring
+            and self.ring_chunk_bytes is None
+            and self.tuner is not None
+            and self.tuner.choosing
+        ):
+            # the ring collectives move the whole padded flat master; size
+            # the cell to that payload.  "zero1_ring" cells carry only the
+            # chunk axis (no codec — the wire dtype is a separate knob)
+            self.tuned_plan = self.tuner.choose("zero1_ring", meta.padded * 4)
+            self.ring_chunk_bytes = self.tuned_plan.chunk_bytes
         flat = _flatten(params, meta)
         shard_len = meta.padded // self.world
         master = flat.reshape(self.world, shard_len)

@@ -254,6 +254,24 @@ class LinkCostModel:
 DEFAULT_HBM_BYTES_PER_S = 800e9
 
 
+def bottleneck_ring_coeffs(
+    model: "LinkCostModel", world: Optional[int] = None
+) -> LinkCoeffs:
+    """The slowest (r → r+1) ring hop's coefficients — a lockstep ring
+    advances at its slowest link, so every ring-shaped pricing (the chunk
+    sweep, the codec sweep, the tuner's prior) judges candidates there.
+    One shared definition: the benches and the tuner can never disagree
+    about which link paces the ring."""
+    w = model.world if world is None else int(world)
+    if w < 2:
+        return model.coeffs(0, 0)  # degenerate ring: the class coefficients
+    ring_links = [(r, (r + 1) % w) for r in range(w)]
+    return max(
+        (model.coeffs(s, d) for s, d in ring_links),
+        key=lambda c: c.time(1 << 20),
+    )
+
+
 def staged_ring_allreduce_time(
     world: int,
     nbytes: float,

@@ -1,0 +1,157 @@
+"""Timing harness: engine dispatches → tuning-database samples.
+
+Two feeds produce samples:
+
+- **live** — the engine calls :meth:`DispatchTimer.observe` with the
+  walltime of each dispatch (``block_until_ready`` inclusive) whenever a
+  tuner is attached and ``ADAPCC_TUNER`` is ``record`` or ``choose``.  The
+  first observation per compiled-program cache key is discarded as warmup:
+  it includes tracing + XLA compilation, which would poison the cell's
+  median for every later steady-state dispatch.
+- **offline** — :func:`replay_trace` re-reads a :class:`CollectiveTrace`
+  (or a parsed ``track.txt``) whose events carry ``duration_s`` and turns
+  them into database samples, so a run that only *recorded* can still seed
+  the database for the next run's ``choose`` mode.
+
+:func:`timed_call` is the standalone probe used by benchmarks: median-free
+raw samples, warmup discarded, one ``block_until_ready`` per iteration.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Hashable, Iterable, List, Optional, Set, Tuple, Union
+
+from adapcc_tpu.tuner.db import (
+    TuningDatabase,
+    TuningKey,
+    size_bucket,
+)
+
+
+def timed_call(fn, *args, warmup: int = 1, iters: int = 3) -> List[float]:
+    """Walltime samples for ``fn(*args)``: ``warmup`` calls discarded (the
+    compile), then ``iters`` timed calls, each blocked to completion —
+    async dispatch must not let a measurement finish before the work does.
+    """
+    import jax
+
+    if warmup < 0 or iters < 1:
+        raise ValueError(f"need warmup >= 0 and iters >= 1, got {warmup}/{iters}")
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    out: List[float] = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        out.append(time.perf_counter() - t0)
+    return out
+
+
+class DispatchTimer:
+    """Warmup-aware funnel from live dispatches into the database.
+
+    The engine hands it ``(key, cache_token, seconds)`` per dispatch; the
+    first observation for each ``cache_token`` (the engine's compiled-
+    program cache key) is dropped — that dispatch paid tracing + XLA
+    compile, not the plan's steady-state cost.
+    """
+
+    def __init__(self, db: TuningDatabase) -> None:
+        self.db = db
+        self._warmed: Set[Hashable] = set()
+        #: observations discarded as compile warmup (introspection/tests)
+        self.discarded = 0
+        #: observations recorded
+        self.recorded = 0
+
+    def observe(
+        self, key: TuningKey, cache_token: Hashable, seconds: float
+    ) -> bool:
+        """Record one dispatch walltime; returns False when the sample was
+        discarded as that program's compile warmup."""
+        if cache_token not in self._warmed:
+            self._warmed.add(cache_token)
+            self.discarded += 1
+            return False
+        self.db.record(key, seconds)
+        self.recorded += 1
+        return True
+
+    def reset(self) -> None:
+        """Forget warmup state (engine ``clear()``: recompilation follows)."""
+        self._warmed.clear()
+
+
+# --------------------------------------------------------------------------- #
+# offline feed: CollectiveTrace replay
+# --------------------------------------------------------------------------- #
+
+def _key_from_event(
+    event: Any, world: int, topology: str
+) -> Optional[TuningKey]:
+    """TraceEvent → TuningKey, or None when the event carries no timing or
+    is not a tunable dispatch (strategy/xla impls have no plan cell)."""
+    extra = getattr(event, "extra", None) or {}
+    if "duration_s" not in extra:
+        return None
+    impl = getattr(event, "impl", "")
+    per_rank = int(extra.get("per_rank_bytes", 0))
+    if per_rank <= 0:
+        # stacked nbytes = world × per-rank payload
+        per_rank = max(1, int(event.nbytes) // max(1, world))
+    from adapcc_tpu.tuner.policy import NO_CHUNK, QUANT_PATH
+
+    if impl.startswith("pallas_ring["):
+        path = impl[len("pallas_ring["):-1]
+        return TuningKey(
+            primitive=event.primitive,
+            size_bucket=size_bucket(per_rank),
+            world=world,
+            topology=topology,
+            path=path,
+            # vmem is one cell regardless of budget (the key vocabulary the
+            # engine and the candidate grid share)
+            chunk_bytes=(
+                NO_CHUNK if path == "vmem"
+                else int(extra.get("chunk_bytes", 0))
+            ),
+            wire_dtype="off",
+        )
+    if impl.startswith("quant_ring["):
+        return TuningKey(
+            primitive=event.primitive,
+            size_bucket=size_bucket(per_rank),
+            world=world,
+            topology=topology,
+            path=QUANT_PATH,
+            chunk_bytes=NO_CHUNK,
+            wire_dtype=str(extra.get("wire_dtype", impl[len("quant_ring["):-1])),
+        )
+    return None
+
+
+def replay_trace(
+    trace: Union[Any, Iterable[Any]],
+    db: TuningDatabase,
+    world: int,
+    topology: str,
+) -> Tuple[int, int]:
+    """Feed a recorded :class:`CollectiveTrace` (or an iterable of
+    :class:`TraceEvent`, e.g. from ``parse_track_log``) into ``db``.
+
+    Returns ``(ingested, skipped)``.  Skipped events are the ones with no
+    ``duration_s`` (recorded under ``ADAPCC_TUNER=off``) or with an impl
+    that has no plan cell (xla / strategy dispatches) — counted, never
+    silently vanished, so a replay that ingests nothing is diagnosable.
+    """
+    events = trace.events() if hasattr(trace, "events") else list(trace)
+    ingested = skipped = 0
+    for ev in events:
+        key = _key_from_event(ev, world, topology)
+        if key is None:
+            skipped += 1
+            continue
+        db.record(key, float(ev.extra["duration_s"]), ts=float(ev.ts))
+        ingested += 1
+    return ingested, skipped

@@ -1,0 +1,405 @@
+"""Plan selection: α-β prior + measured posterior + bounded exploration.
+
+The decision layer of the tuner.  For one ``(primitive, payload)`` request
+it builds the candidate grid — ring staging granularities from
+:func:`adapcc_tpu.comm.pallas_ring.plan_ring_schedule` crossed with the
+wire-codec registry — and picks a cell by three rules, in order:
+
+1. **Explore** (epsilon-greedy, bounded): while any cell has fewer than
+   ``trial_budget`` samples, a coin flip with probability ``epsilon``
+   returns the least-sampled cell so the database fills evenly.  Once every
+   cell has met its budget, exploration stops for good — the tuner never
+   burns steady-state steps re-proving a settled grid.
+2. **Exploit**: cells with at least ``min_samples`` measurements rank by
+   their database median (the posterior); when nothing is measured yet the
+   PR-1 sim cost model prices the grid (the prior).  The posterior
+   *replaces* the prior wholesale rather than blending: measured medians of
+   different cells are mutually comparable, model-vs-measurement deltas are
+   not.
+3. **Hysteresis**: the previous winner (the incumbent) keeps the slot
+   unless a challenger beats its median by ``hysteresis_margin`` over at
+   least ``hysteresis_min_samples`` samples — one lucky dispatch must not
+   flap the executed plan step to step (TACCL's stability argument;
+   PAPERS.md 2111.04867).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from adapcc_tpu.tuner.db import TuningDatabase, TuningKey, size_bucket
+
+#: default ring staging grid: the spread `make ring-sweep` covers, from
+#: latency-bound small tiles to near-whole-payload staging
+DEFAULT_CHUNK_GRID = (256 << 10, 1 << 20, 4 << 20, 16 << 20)
+
+#: cells with fewer samples than this rank by the prior, not their median
+DEFAULT_MIN_SAMPLES = 2
+
+#: per-cell sample budget the explorer fills before going quiet
+DEFAULT_TRIAL_BUDGET = 8
+
+#: probability one choose() call explores while the budget is unfilled
+DEFAULT_EPSILON = 0.25
+
+#: a challenger must beat the incumbent median by this fraction
+DEFAULT_HYSTERESIS_MARGIN = 0.05
+
+#: ... over at least this many samples
+DEFAULT_HYSTERESIS_MIN_SAMPLES = 3
+
+#: paths with no chunk knob store 0 in the key's chunk_bytes slot
+NO_CHUNK = 0
+
+#: the quantized ppermute ring (wire_dtype != "off") — one cell per codec
+QUANT_PATH = "quant-ring"
+
+#: gradient-hook dispatches (DDPTrainer --tune): codec is the only knob
+HOOK_PATH = "hook"
+
+
+@dataclass(frozen=True)
+class TunedPlan:
+    """What the policy committed for one dispatch.
+
+    ``source`` is part of the observable contract (the engine records it in
+    the dispatch trace): ``measured`` = the database median picked it,
+    ``prior`` = the sim cost model picked it (nothing measured yet),
+    ``explore`` = an under-sampled cell is being filled.
+    """
+
+    key: TuningKey
+    source: str                    #: "measured" | "prior" | "explore"
+    expected_s: float              #: the score that won (median or prior)
+    #: execution hint for cells whose persistent key carries no chunk: a
+    #: vmem cell is keyed chunk_bytes=0 (the knob is inert there — every
+    #: budget ≥ the payload runs the identical program), but the engine
+    #: still needs a concrete budget that RESOLVES to the vmem path
+    exec_chunk_bytes: Optional[int] = None
+
+    @property
+    def chunk_bytes(self) -> Optional[int]:
+        """Staging granularity to pass down, or None when the chosen path
+        has no chunk knob (quantized ring / hook)."""
+        if self.key.chunk_bytes > 0:
+            return self.key.chunk_bytes
+        return self.exec_chunk_bytes
+
+    @property
+    def wire_dtype(self) -> str:
+        return self.key.wire_dtype
+
+    def trace_extra(self, applied: bool = True) -> Dict[str, object]:
+        """The ``tuner=`` payload for the dispatch trace: what was chosen,
+        why, and whether precedence let it run (``applied=False`` = an env
+        var or explicit argument overrode the tuner)."""
+        return {
+            "chosen": {
+                "chunk_bytes": self.key.chunk_bytes,
+                "wire_dtype": self.key.wire_dtype,
+                "path": self.key.path,
+            },
+            "source": self.source,
+            "applied": bool(applied),
+        }
+
+
+class TuningPolicy:
+    """Ranks candidate plan cells for one fabric (world + topology)."""
+
+    def __init__(
+        self,
+        db: TuningDatabase,
+        world: int,
+        topology: str,
+        chunk_grid: Sequence[int] = DEFAULT_CHUNK_GRID,
+        wire_dtypes: Optional[Sequence[str]] = None,
+        epsilon: float = DEFAULT_EPSILON,
+        trial_budget: int = DEFAULT_TRIAL_BUDGET,
+        min_samples: int = DEFAULT_MIN_SAMPLES,
+        hysteresis_margin: float = DEFAULT_HYSTERESIS_MARGIN,
+        hysteresis_min_samples: int = DEFAULT_HYSTERESIS_MIN_SAMPLES,
+        cost_model=None,
+        seed: int = 0,
+    ) -> None:
+        if not 0.0 <= epsilon <= 1.0:
+            raise ValueError(f"epsilon must be in [0, 1], got {epsilon}")
+        if trial_budget < 1:
+            raise ValueError(f"trial_budget must be >= 1, got {trial_budget}")
+        if hysteresis_margin < 0:
+            raise ValueError(
+                f"hysteresis_margin must be >= 0, got {hysteresis_margin}"
+            )
+        self.db = db
+        self.world = int(world)
+        self.topology = topology
+        self.chunk_grid = tuple(sorted({int(c) for c in chunk_grid}))
+        if any(c <= 0 for c in self.chunk_grid):
+            raise ValueError(f"chunk grid must be positive, got {chunk_grid}")
+        if wire_dtypes is None:
+            from adapcc_tpu.quant import codec_names
+
+            wire_dtypes = codec_names()
+        self.wire_dtypes = tuple(wire_dtypes)
+        self.epsilon = float(epsilon)
+        self.trial_budget = int(trial_budget)
+        self.min_samples = int(min_samples)
+        self.hysteresis_margin = float(hysteresis_margin)
+        self.hysteresis_min_samples = int(hysteresis_min_samples)
+        self._cost_model = cost_model
+        # deterministic exploration: a seeded PRNG, not wall-clock entropy —
+        # two identical runs explore the same cells in the same order
+        self._rng = random.Random(seed)
+        #: hysteresis state: (primitive, size_bucket) → incumbent key
+        self._incumbent: Dict[Tuple[str, int], TuningKey] = {}
+
+    # -- candidate grid --------------------------------------------------------
+
+    def candidates(
+        self,
+        primitive: str,
+        nbytes: int,
+        dtype: str = "float32",
+        wire_dtypes: Optional[Sequence[str]] = None,
+    ) -> List[TuningKey]:
+        """The plan cells competing for this dispatch.
+
+        Ring primitives cross the chunk grid (``wire_dtype="off"``, path
+        from the kernel's own planner so a cell can never claim a path the
+        data plane would not run) with one cell per non-"off" codec (the
+        quantized ring has no staging knob).  ``ddp_step`` keeps only the
+        codec axis — the hook's allreduce is not chunk-steered.
+
+        ``wire_dtypes`` narrows the codec axis for this call (default: the
+        policy's full registry) — a caller whose configuration cannot
+        legally run a codec (error-feedback forbids "off") must exclude it
+        here, or the explorer pins on a cell that can never accrue samples.
+        """
+        if wire_dtypes is None:
+            wire_dtypes = self.wire_dtypes
+        bucket = size_bucket(nbytes)
+        cells: List[TuningKey] = []
+        if primitive == "ddp_step":
+            for wd in wire_dtypes:
+                cells.append(
+                    TuningKey(
+                        primitive, bucket, self.world, self.topology,
+                        HOOK_PATH, NO_CHUNK, wd,
+                    )
+                )
+            return cells
+        from adapcc_tpu.comm.pallas_ring import plan_ring_schedule
+
+        nelems = max(1, int(nbytes)) // max(
+            1, _itemsize(dtype)
+        )
+        seen_planned = set()
+        for chunk in self.chunk_grid:
+            plan = plan_ring_schedule(nelems, dtype, self.world, chunk)
+            # several budgets can resolve to the identical executed plan
+            # (every vmem-path budget does — and under an
+            # ADAPCC_RING_CHUNK_BYTES pin, every budget does); duplicate
+            # cells would split one physical configuration's samples across
+            # keys.  Cells are keyed by the PLANNER-RESOLVED budget
+            # (``plan.chunk_bytes``, exactly what the engine keys live
+            # recordings with) — vmem by 0, the budget being inert there —
+            # so a record-mode run's samples always land where choose()
+            # looks, env pin or not
+            planned = (plan.path, plan.stage_bytes)
+            if planned in seen_planned:
+                continue
+            seen_planned.add(planned)
+            cells.append(
+                TuningKey(
+                    primitive, bucket, self.world, self.topology,
+                    plan.path,
+                    NO_CHUNK if plan.path == "vmem" else int(plan.chunk_bytes),
+                    "off",
+                )
+            )
+        # measured cells OUTSIDE the grid still compete in exploitation: a
+        # record-only run under a pinned or solver-assigned chunk (any
+        # budget not in the grid) produced honest medians for a plan the
+        # data plane actually ran — ignoring them would re-explore cells
+        # the pod already paid to measure
+        for known in self.db.keys():
+            if (
+                known.primitive == primitive
+                and known.size_bucket == bucket
+                and known.world == self.world
+                and known.topology == self.topology
+                and known.wire_dtype == "off"
+                and known not in cells
+            ):
+                cells.append(known)
+        if primitive == "allreduce":
+            # only allreduce has a quantized ring variant (PR-3)
+            for wd in wire_dtypes:
+                if wd == "off":
+                    continue
+                cells.append(
+                    TuningKey(
+                        primitive, bucket, self.world, self.topology,
+                        QUANT_PATH, NO_CHUNK, wd,
+                    )
+                )
+        return cells
+
+    # -- prior -----------------------------------------------------------------
+
+    def _model(self):
+        if self._cost_model is None:
+            from adapcc_tpu.sim.calibrate import load_or_default
+
+            self._cost_model = load_or_default(world=self.world)
+        return self._cost_model
+
+    def prior_time(self, key: TuningKey, nbytes: int) -> float:
+        """Model-predicted seconds for one cell — the PR-1/2/3 cost-model
+        terms, so the tuner's prior and ``make ring-sweep`` /
+        ``make quant-bench`` can never disagree about a cell's ranking."""
+        from adapcc_tpu.sim.cost_model import (
+            DEFAULT_HBM_BYTES_PER_S,
+            bottleneck_ring_coeffs,
+            quantized_ring_allreduce_time,
+            staged_ring_allreduce_time,
+        )
+
+        model = self._model()
+        world = max(2, self.world)
+        coeffs = bottleneck_ring_coeffs(model, world)
+        if key.wire_dtype != "off":
+            return quantized_ring_allreduce_time(
+                world, float(nbytes), coeffs, key.wire_dtype
+            )
+        if key.path == HOOK_PATH:
+            return quantized_ring_allreduce_time(
+                world, float(nbytes), coeffs, "off"
+            )
+        from adapcc_tpu.comm.pallas_ring import plan_ring_schedule
+
+        nelems = max(1, int(nbytes)) // 4
+        plan = plan_ring_schedule(
+            nelems, "float32", world,
+            key.chunk_bytes if key.chunk_bytes > 0 else None,
+        )
+        if key.path == "vmem" and plan.path != "vmem":
+            # a vmem cell is keyed chunk_bytes=0; realize it with a budget
+            # covering the whole padded payload
+            plan = plan_ring_schedule(nelems, "float32", world, plan.padded_bytes)
+        return staged_ring_allreduce_time(
+            world, float(nbytes), coeffs, plan.stage_bytes,
+            hbm_bytes_per_s=(
+                float("inf") if plan.path == "vmem" else DEFAULT_HBM_BYTES_PER_S
+            ),
+        )
+
+    # -- selection -------------------------------------------------------------
+
+    def _score(self, key: TuningKey, nbytes: int) -> Tuple[float, bool]:
+        """(seconds, measured?) — median when the cell has enough samples,
+        the model prior otherwise."""
+        stats = self.db.stats(key)
+        if stats is not None and stats.count >= self.min_samples:
+            return stats.median_s, True
+        return self.prior_time(key, nbytes), False
+
+    def _exec_chunk(self, key: TuningKey, nbytes: int, dtype: str) -> Optional[int]:
+        """Execution budget for a vmem cell (keyed chunk_bytes=0): the
+        smallest grid budget the planner resolves to the vmem path, so
+        applying the plan actually runs the cell that was ranked."""
+        if key.wire_dtype != "off" or key.path != "vmem" or key.chunk_bytes > 0:
+            return None
+        from adapcc_tpu.comm.pallas_ring import plan_ring_schedule
+
+        nelems = max(1, int(nbytes)) // max(1, _itemsize(dtype))
+        for chunk in self.chunk_grid:
+            if plan_ring_schedule(nelems, dtype, self.world, chunk).path == "vmem":
+                return int(chunk)
+        return None
+
+    def _plan(
+        self, key: TuningKey, source: str, expected_s: float,
+        nbytes: int, dtype: str,
+    ) -> TunedPlan:
+        return TunedPlan(
+            key=key, source=source, expected_s=expected_s,
+            exec_chunk_bytes=self._exec_chunk(key, nbytes, dtype),
+        )
+
+    def choose(
+        self,
+        primitive: str,
+        nbytes: int,
+        dtype: str = "float32",
+        wire_dtypes: Optional[Sequence[str]] = None,
+    ) -> TunedPlan:
+        """Commit a plan cell for one dispatch (see module docstring).
+
+        ``wire_dtypes`` narrows the codec axis (see :meth:`candidates`)."""
+        cells = self.candidates(primitive, nbytes, dtype, wire_dtypes)
+        if not cells:
+            raise ValueError(
+                f"no candidate cells for primitive={primitive!r} "
+                f"(chunk grid {self.chunk_grid}, codecs "
+                f"{wire_dtypes if wire_dtypes is not None else self.wire_dtypes})"
+            )
+        # 1. bounded exploration
+        under = [c for c in cells if self.db.count(c) < self.trial_budget]
+        if under and self._rng.random() < self.epsilon:
+            cell = min(under, key=lambda c: (self.db.count(c), cells.index(c)))
+            return self._plan(
+                cell, "explore", self._score(cell, nbytes)[0], nbytes, dtype
+            )
+        # 2. posterior over prior
+        measured = {
+            c: self.db.stats(c)
+            for c in cells
+            if self.db.count(c) >= self.min_samples
+        }
+        if measured:
+            best = min(
+                measured,
+                key=lambda c: (measured[c].median_s, cells.index(c)),
+            )
+            best_s, best_src = measured[best].median_s, "measured"
+        else:
+            priors = {c: self.prior_time(c, nbytes) for c in cells}
+            best = min(cells, key=lambda c: (priors[c], cells.index(c)))
+            best_s, best_src = priors[best], "prior"
+        # 3. hysteresis against the incumbent
+        group = (primitive, size_bucket(nbytes))
+        incumbent = self._incumbent.get(group)
+        if incumbent is not None and incumbent in cells and incumbent != best:
+            inc_s, inc_measured = self._score(incumbent, nbytes)
+            challenger_stats = self.db.stats(best)
+            promotes = (
+                best_src == "measured"
+                and challenger_stats is not None
+                and challenger_stats.count >= self.hysteresis_min_samples
+                and best_s < inc_s * (1.0 - self.hysteresis_margin)
+            )
+            if not promotes:
+                return self._plan(
+                    incumbent,
+                    "measured" if inc_measured else "prior",
+                    inc_s, nbytes, dtype,
+                )
+        self._incumbent[group] = best
+        return self._plan(best, best_src, best_s, nbytes, dtype)
+
+    def incumbent(self, primitive: str, nbytes: int) -> Optional[TuningKey]:
+        return self._incumbent.get((primitive, size_bucket(nbytes)))
+
+    def reset(self) -> None:
+        """Drop hysteresis state (re-adaptation: a re-profiled fabric should
+        re-decide from the database, not from the previous incumbency)."""
+        self._incumbent.clear()
+
+
+def _itemsize(dtype) -> int:
+    import jax.numpy as jnp
+
+    return jnp.dtype(dtype).itemsize

@@ -20,7 +20,7 @@ import random
 import re
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -184,12 +184,19 @@ class CollectiveTrace:
     loop lives inside one compiled program, so the traceable boundary is the
     dispatch (one event per collective call), with Perfetto
     (:func:`profiler_trace`) covering intra-program detail.
+
+    Capacity is a bounded **ring**: at capacity the *oldest* event is
+    evicted for each new one, so a long run's trace ends with the steady
+    state it was running in, not the startup noise it left hours ago.
+    ``dropped`` counts evictions.
     """
 
     def __init__(self, capacity: int = 100_000) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = capacity
         self._lock = threading.Lock()
-        self._events: List[TraceEvent] = []
+        self._events: "deque[TraceEvent]" = deque(maxlen=capacity)
         self._dropped = 0
 
     def record(
@@ -203,8 +210,7 @@ class CollectiveTrace:
         ev = TraceEvent(time.time(), primitive, impl, nbytes, step, extra)
         with self._lock:
             if len(self._events) >= self.capacity:
-                self._dropped += 1
-                return
+                self._dropped += 1  # the deque evicts its oldest on append
             self._events.append(ev)
 
     def events(self) -> List[TraceEvent]:
@@ -224,6 +230,51 @@ class CollectiveTrace:
                     f"{e.ts:.6f} {e.primitive} {e.impl} {e.nbytes} "
                     f"{-1 if e.step is None else e.step} {json.dumps(e.extra)}\n"
                 )
+
+    def dump_chrome_trace(self, path: str) -> str:
+        """``chrome://tracing`` / Perfetto JSON: one complete ("X") event
+        per dispatch.  Events that carry a measured ``duration_s`` (the
+        tuner's record mode) render with real extent; untimed dispatches
+        render as instants.  Args carry the plan provenance — impl, bytes,
+        wire dtype, and the tuner decision — so a timeline click answers
+        "what ran here and who chose it".
+        """
+        trace_events = []
+        for e in self.events():
+            dur_us = float(e.extra.get("duration_s", 0.0)) * 1e6
+            # timed dispatches are recorded AFTER completion, so e.ts is the
+            # slice END; the slice must start duration earlier or every
+            # event renders shifted right by its own extent
+            args: Dict[str, Any] = {"impl": e.impl, "nbytes": e.nbytes}
+            if e.step is not None:
+                args["step"] = e.step
+            for k in ("chunk_bytes", "stage_bytes", "wire_dtype", "wire_bytes"):
+                if k in e.extra:
+                    args[k] = e.extra[k]
+            tuner = e.extra.get("tuner")
+            if isinstance(tuner, dict):
+                args["tuner_source"] = tuner.get("source")
+                args["tuner_applied"] = tuner.get("applied")
+                args["tuner_chosen"] = tuner.get("chosen")
+            trace_events.append(
+                {
+                    "name": e.primitive,
+                    "cat": "collective",
+                    "ph": "X",
+                    "ts": e.ts * 1e6 - dur_us,  # microseconds, start-of-slice
+                    "dur": dur_us,
+                    "pid": 0,
+                    "tid": 0,
+                    "args": args,
+                }
+            )
+        with open(path, "w") as f:
+            json.dump(
+                {"traceEvents": trace_events, "displayTimeUnit": "ms"},
+                f,
+                sort_keys=True,
+            )
+        return path
 
 
 def parse_track_log(path: str) -> List[TraceEvent]:

@@ -97,6 +97,16 @@ def build_parser() -> argparse.ArgumentParser:
         "gradient (closes the int8 accuracy gap; requires --dp-mode ddp)",
     )
     p.add_argument(
+        "--tune", action="store_true",
+        help="measurement-driven autotuning (adapcc_tpu/tuner): record each "
+        "step's walltime into the tuning database (ADAPCC_TUNER_DB, default "
+        "topology/tuning.jsonl) and adopt the policy's choices — the "
+        "gradient-sync wire codec in ddp mode, the ring staging chunk in "
+        "zero1 mode.  ADAPCC_TUNER=off disables globally; "
+        "ADAPCC_RING_CHUNK_BYTES / ADAPCC_WIRE_DTYPE still override "
+        "whatever the tuner picks (docs/TUNER.md)",
+    )
+    p.add_argument(
         "--sync-mode", choices=["auto", "psum", "schedule"], default="auto",
         help="gradient-sync data plane: psum = masked XLA collective per "
         "leaf; schedule = bucketed strategy-tree allreduce (multi-tree "
@@ -206,6 +216,11 @@ def main(argv=None) -> None:
             "--wire-dtype strategy requires --dp-mode ddp (only the "
             "gradient hook carries a synthesized strategy to adopt)"
         )
+    if args.tune and args.dp_mode == "fsdp":
+        raise ValueError(
+            "--tune requires --dp-mode ddp or zero1: fsdp syncs via GSPMD "
+            "and exposes none of the tuner's knobs (chunk/codec)"
+        )
     # join the multi-host world if the launcher set the coordinator env
     from adapcc_tpu.launch import maybe_initialize_distributed
 
@@ -250,17 +265,49 @@ def main(argv=None) -> None:
     elif args.dp_mode == "zero1":
         from adapcc_tpu.parallel import Zero1Optimizer, zero1_train_step
 
+        z_tuner = None
+        if args.tune:
+            from adapcc_tpu.tuner import CollectiveTuner
+
+            z_tuner = CollectiveTuner.for_mesh(mesh, mode="choose")
         z_opt = Zero1Optimizer(
             tx, mesh, ring=args.zero1_ring,
             ring_chunk_bytes=args.ring_chunk_bytes or None,
             wire_dtype=wire_dtype,
+            tuner=z_tuner,
         )
         master, z_state = z_opt.init(params)
+        if z_opt.tuned_plan is not None:
+            tp = z_opt.tuned_plan
+            print(
+                f"tuner: zero1 ring chunk_bytes={z_opt.ring_chunk_bytes} "
+                f"(source={tp.source})"
+            )
         z_step = zero1_train_step(loss_fn, z_opt, mesh)
+
+        # step walltimes must land in the SAME cell the next run's
+        # init()-time choose("zero1_ring", ...) ranks, or the feedback loop
+        # never closes; tuning_key() is that cell (None off the ring path —
+        # plain zero1 has no tuner knob, so nothing is recorded)
+        z_cell = z_opt.tuning_key() if z_tuner is not None else None
 
         def run_step(step):
             nonlocal params, master, z_state
-            params, master, z_state, losses = z_step(params, master, z_state, batch_fn())
+            if z_cell is not None and z_tuner.recording:
+                import time as _time
+
+                t0 = _time.perf_counter()
+                params, master, z_state, losses = z_step(
+                    params, master, z_state, batch_fn()
+                )
+                jax.block_until_ready(losses)
+                z_tuner.observe_dispatch(
+                    z_cell, ("zero1_step",), _time.perf_counter() - t0
+                )
+            else:
+                params, master, z_state, losses = z_step(
+                    params, master, z_state, batch_fn()
+                )
             return losses
 
     else:
@@ -275,6 +322,7 @@ def main(argv=None) -> None:
             sync_mode=args.sync_mode,
             grad_compress=wire_dtype,
             error_feedback=args.error_feedback,
+            tune=args.tune,
             # loop-owned state: see train_gpt2 donation note
             donate_state=True,
         )

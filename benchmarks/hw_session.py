@@ -156,13 +156,19 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
     - ``busbw_wire_dtype`` — the ring at 128 MB across wire codecs via
       ``ADAPCC_WIRE_DTYPE`` (int8 vs bf16 vs fp32: the hardware twin of
       ``make quant-bench``; off rides the Pallas kernels, the codecs ride
-      the quantized ppermute ring).
+      the quantized ppermute ring);
+    - ``tuner_convergence`` — the autotuner closing its loop on real
+      hardware: ``ADAPCC_TUNER=choose`` over a repeated 128 MB allreduce
+      sweep, the tuning database appended under ``benchmarks/results`` so
+      the artifact holds both the measured cells and what the policy
+      settled on (the hardware twin of ``make tune-bench``).  Allreduce
+      only: it is the one primitive the tuner steers.
     """
     gate = f"world={world} (needs multi-chip ICI)"
     if world < 2:
         for name in (
             "busbw_ici_128m", "ring_smoke", "ring_chunk_sweep",
-            "busbw_wire_dtype",
+            "busbw_wire_dtype", "tuner_convergence",
         ):
             _skip(name, gate, out_path)
         return
@@ -202,6 +208,25 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
             extra_env={"ADAPCC_WIRE_DTYPE": wire},
             rec_extra={"wire_dtype": wire},
         )
+    # tuner convergence: ADAPCC_TUNER=choose on a repeated allreduce-only
+    # sweep — every dispatch is timed into the tuning database (walltime,
+    # compile warmup discarded) and the policy's epsilon-greedy pass fills
+    # the (chunk x codec) grid, then settles.  The database file IS the
+    # artifact: its medians per cell plus the last chosen plan.  Allreduce
+    # ONLY — the tuner steers no other primitive, so extra rows would
+    # measure untuned paths under a tuner label
+    db_path = os.path.join(
+        os.path.dirname(out_path), f"tuning_{os.path.basename(out_path)}"
+    )
+    _run(
+        "tuner_convergence",
+        [py, "-m", "benchmarks.collectives", "--world", str(world),
+         "--sizes", "128M", "--impls", "pallas_ring",
+         "--collectives", "allreduce", "--iters", "40"],
+        1200, out_path,
+        extra_env={"ADAPCC_TUNER": "choose", "ADAPCC_TUNER_DB": db_path},
+        rec_extra={"tuner": "choose", "tuner_db": db_path},
+    )
 
 
 def run_simulated_fallback(py: str, out_path: str, world: int = 8) -> dict:

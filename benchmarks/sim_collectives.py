@@ -229,18 +229,17 @@ def ring_chunk_sweep(
     Deterministic: same calibration → byte-identical rows.
     """
     from adapcc_tpu.comm.pallas_ring import plan_ring_schedule
-    from adapcc_tpu.sim.cost_model import staged_ring_allreduce_time
+    from adapcc_tpu.sim.cost_model import (
+        bottleneck_ring_coeffs,
+        staged_ring_allreduce_time,
+    )
 
     if model is None:
         model = load_or_default(world=world)
     elif model.world != world:
         raise ValueError(f"model world {model.world} != sweep world {world}")
     # lockstep ring: the slowest (src → src+1) hop paces every step
-    ring_links = [(r, (r + 1) % world) for r in range(world)]
-    coeffs = max(
-        (model.coeffs(s, d) for s, d in ring_links),
-        key=lambda c: c.time(1 << 20),
-    )
+    coeffs = bottleneck_ring_coeffs(model, world)
     rows: List[dict] = []
     for nbytes in sizes:
         for chunk in chunk_sizes:
@@ -304,6 +303,7 @@ def wire_dtype_sweep(
     """
     from adapcc_tpu.quant import DEFAULT_BLOCK_SIZE, get_codec
     from adapcc_tpu.sim.cost_model import (
+        bottleneck_ring_coeffs,
         choose_wire_dtype,
         quantized_ring_allreduce_time,
         wire_bytes_per_element,
@@ -317,11 +317,7 @@ def wire_dtype_sweep(
         model = load_or_default(world=world)
     elif model.world != world:
         raise ValueError(f"model world {model.world} != sweep world {world}")
-    ring_links = [(r, (r + 1) % world) for r in range(world)]
-    coeffs = max(
-        (model.coeffs(s, d) for s, d in ring_links),
-        key=lambda c: c.time(1 << 20),
-    )
+    coeffs = bottleneck_ring_coeffs(model, world)
     rows: List[dict] = []
     for nbytes in sizes:
         chosen, _ = choose_wire_dtype(
@@ -358,6 +354,100 @@ def wire_dtype_sweep(
     return rows
 
 
+def tune_replay_sweep(
+    world: int,
+    sizes: Sequence[int],
+    chunk_grid: Optional[Sequence[int]] = None,
+    model: Optional[LinkCostModel] = None,
+    trial_budget: int = 4,
+    exploit_rounds: int = 8,
+) -> List[dict]:
+    """Deterministic tuner-convergence rows on a synthetic cost surface —
+    the hardware-free regression artifact for the autotuner
+    (``make tune-bench``).
+
+    For each payload size the sweep builds a fresh in-memory tuning
+    database and a :class:`adapcc_tpu.tuner.TuningPolicy`, then runs the
+    policy against a synthetic "true" cost surface: the sim cost model's
+    per-cell prediction warped by a deterministic per-cell factor (hash of
+    the cell, ±25%) so the measured optimum *disagrees* with the prior
+    somewhere — the exact situation the tuner exists for.  Exploration runs
+    at epsilon=1 until every cell meets its trial budget, then
+    ``exploit_rounds`` greedy rounds settle the incumbent.  One row per
+    cell, ``chosen`` flagging the policy's final plan and ``surface_best``
+    the true argmin, so the artifact shows both the decision and whether it
+    converged.  Everything is seeded/hashed: same inputs → byte-identical
+    rows.
+    """
+    import hashlib
+
+    from adapcc_tpu.tuner import TuningDatabase
+    from adapcc_tpu.tuner.policy import DEFAULT_CHUNK_GRID, TuningPolicy
+
+    if chunk_grid is None:
+        chunk_grid = DEFAULT_CHUNK_GRID
+    if model is None:
+        model = load_or_default(world=world)
+    elif model.world != world:
+        raise ValueError(f"model world {model.world} != sweep world {world}")
+
+    def cell_factor(key) -> float:
+        digest = hashlib.md5(repr(key).encode()).digest()
+        return 0.75 + 0.5 * (digest[0] / 255.0)  # deterministic, in [0.75, 1.25]
+
+    def sample_jitter(key, i: int) -> float:
+        digest = hashlib.md5(f"{key!r}#{i}".encode()).digest()
+        return 0.98 + 0.04 * (digest[0] / 255.0)  # ±2% around the cell truth
+
+    rows: List[dict] = []
+    for nbytes in sizes:
+        db = TuningDatabase(persist=False)  # the replay must not write repo
+        # artifacts; epsilon=1 fills the grid deterministically (seeded rng)
+        policy = TuningPolicy(
+            db, world, topology="tune-replay", chunk_grid=chunk_grid,
+            epsilon=1.0, trial_budget=trial_budget, cost_model=model, seed=0,
+        )
+        cells = policy.candidates("allreduce", int(nbytes))
+        surface = {
+            c: policy.prior_time(c, int(nbytes)) * cell_factor(c) for c in cells
+        }
+        counts = {c: 0 for c in cells}
+        for _ in range(trial_budget * len(cells) + exploit_rounds):
+            plan = policy.choose("allreduce", int(nbytes))
+            i = counts[plan.key] = counts[plan.key] + 1
+            db.record(
+                plan.key,
+                surface[plan.key] * sample_jitter(plan.key, i),
+                ts=float(i),
+            )
+        final = policy.choose("allreduce", int(nbytes))
+        best_true = min(cells, key=lambda c: (surface[c], cells.index(c)))
+        for cell in cells:
+            stats = db.stats(cell)
+            rows.append({
+                "mode": "simulated",
+                "collective": "allreduce",
+                "impl": "tuner",
+                "world": world,
+                "size_bytes": int(nbytes),
+                "path": cell.path,
+                "chunk_bytes": cell.chunk_bytes,
+                "wire_dtype": cell.wire_dtype,
+                "samples": stats.count if stats else 0,
+                "median_us": round(stats.median_s * 1e6, 3) if stats else None,
+                "surface_us": round(surface[cell] * 1e6, 3),
+                "prior_us": round(policy.prior_time(cell, int(nbytes)) * 1e6, 3),
+                "chosen": cell == final.key,
+                "choice_source": final.source if cell == final.key else None,
+                "surface_best": cell == best_true,
+                "converged": final.key == best_true,
+                "calibration": model.source,
+            })
+    if not rows:
+        raise ValueError(f"tune replay produced no rows: sizes={list(sizes)}")
+    return rows
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--world", type=int, default=8)
@@ -391,15 +481,53 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "quantized ring's codec A/B instead of the strategy grid, priced "
         "by the sim-rank cost-model term (make quant-bench)",
     )
+    ap.add_argument(
+        "--tune-replay", action="store_true",
+        help="replay the autotuner's policy against a deterministic "
+        "synthetic cost surface over the (chunk x codec) grid instead of "
+        "the strategy grid: one row per cell with the chosen plan flagged "
+        "per size (make tune-bench; docs/TUNER.md)",
+    )
     ap.add_argument("--json", action="store_true", help="one JSON row per line")
     args = ap.parse_args(argv)
 
-    if args.wire_dtype and args.ring_sweep:
-        # two different sweep grids over one --sizes axis: silently running
-        # one and dropping the other would read as "ran fine, no data"
-        ap.error("--wire-dtype and --ring-sweep are mutually exclusive; "
+    exclusive = [
+        name for name, on in (
+            ("--wire-dtype", bool(args.wire_dtype)),
+            ("--ring-sweep", args.ring_sweep),
+            ("--tune-replay", args.tune_replay),
+        ) if on
+    ]
+    if len(exclusive) > 1:
+        # different sweep grids over one --sizes axis: silently running one
+        # and dropping the others would read as "ran fine, no data"
+        ap.error(f"{' and '.join(exclusive)} are mutually exclusive; "
                  "run one sweep per invocation")
     model = load_or_default(args.calibration, world=args.world)
+    if args.tune_replay:
+        rows = tune_replay_sweep(
+            world=args.world,
+            sizes=[parse_size(s) for s in args.sizes.split(",")],
+            chunk_grid=[parse_size(c) for c in args.chunks.split(",") if c],
+            model=model,
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            else:
+                star = "*" if row["chosen"] else (
+                    "!" if row["surface_best"] else " "
+                )
+                med = row["median_us"]
+                print(
+                    f"[sim] tune {row['size_bytes']:>12}B "
+                    f"{row['path']:<11} chunk={row['chunk_bytes']:>9} "
+                    f"wire={row['wire_dtype']:<5}{star} "
+                    f"n={row['samples']:>3}  "
+                    f"median={med if med is not None else '-':>10}us  "
+                    f"true={row['surface_us']:>10}us"
+                )
+        return 0
     if args.wire_dtype:
         rows = wire_dtype_sweep(
             world=args.world,

@@ -1,9 +1,9 @@
 """The docs' code blocks execute — documentation that cannot drift.
 
 Every ```python block in docs/PARALLELISM.md, docs/OPERATIONS.md,
-docs/SIMULATION.md, docs/RING.md and docs/QUANT.md runs verbatim on the
-virtual pod.  A snippet that stops compiling or produces wrong shapes
-fails here.
+docs/SIMULATION.md, docs/RING.md, docs/QUANT.md and docs/TUNER.md runs
+verbatim on the virtual pod.  A snippet that stops compiling or produces
+wrong shapes fails here.
 """
 
 import os
@@ -19,6 +19,7 @@ _OPERATIONS = os.path.join(_DOCS_DIR, "OPERATIONS.md")
 _SIMULATION = os.path.join(_DOCS_DIR, "SIMULATION.md")
 _RING = os.path.join(_DOCS_DIR, "RING.md")
 _QUANT = os.path.join(_DOCS_DIR, "QUANT.md")
+_TUNER = os.path.join(_DOCS_DIR, "TUNER.md")
 
 
 def _blocks(path):
@@ -119,3 +120,26 @@ def test_quant_doc_covers_the_contract():
 def test_quant_doc_snippet_runs(idx):
     code = _blocks(_QUANT)[idx]
     exec(compile(code, f"{_QUANT}:block{idx}", "exec"), {})
+
+
+def test_tuner_doc_has_snippets():
+    assert len(_blocks(_TUNER)) >= 4
+
+
+def test_tuner_doc_covers_the_contract():
+    """The autotuner topics the tuning runbook leans on must exist."""
+    text = open(_TUNER).read()
+    for needle in (
+        "ADAPCC_TUNER", "ADAPCC_TUNER_DB", "topology/tuning.jsonl",
+        "trial_budget", "hysteresis", "explore", "measured", "prior",
+        "size_bucket", "replay_trace", "make tune-bench",
+        "make trace-export", "tuner_convergence", "block_until_ready",
+        "tuner > strategy",
+    ):
+        assert needle in text, f"TUNER.md lost its {needle!r} coverage"
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_TUNER))))
+def test_tuner_doc_snippet_runs(idx):
+    code = _blocks(_TUNER)[idx]
+    exec(compile(code, f"{_TUNER}:block{idx}", "exec"), {})

@@ -119,6 +119,49 @@ def test_collective_trace_bounded():
     assert tr.dropped == 3
 
 
+def test_collective_trace_evicts_oldest_first():
+    """At capacity the ring evicts the OLDEST events: a long run's trace
+    must end with the steady state, not hours-old startup noise."""
+    tr = CollectiveTrace(capacity=3)
+    for i in range(10):
+        tr.record("allreduce", "psum", i)
+    assert [e.nbytes for e in tr.events()] == [7, 8, 9]  # newest retained
+    assert tr.dropped == 7
+    tr.record("reduce", "psum", 10)
+    assert [e.nbytes for e in tr.events()] == [8, 9, 10]
+    assert tr.dropped == 8
+
+
+def test_collective_trace_rejects_degenerate_capacity():
+    with pytest.raises(ValueError, match="capacity"):
+        CollectiveTrace(capacity=0)
+
+
+def test_dump_chrome_trace(tmp_path):
+    tr = CollectiveTrace()
+    tr.record(
+        "allreduce", "pallas_ring[hbm-stream]", 1 << 20, step=4,
+        chunk_bytes=65536, wire_dtype="off", duration_s=250e-6,
+        tuner={"chosen": {"wire_dtype": "off"}, "source": "measured",
+               "applied": True},
+    )
+    tr.record("broadcast", "xla", 4096)  # untimed: renders as an instant
+    path = str(tmp_path / "trace.json")
+    assert tr.dump_chrome_trace(path) == path
+    doc = json.loads(open(path).read())
+    evs = doc["traceEvents"]
+    assert len(evs) == 2 and all(e["ph"] == "X" for e in evs)
+    timed = evs[0]
+    assert timed["name"] == "allreduce"
+    assert timed["dur"] == 250e-6 * 1e6  # microseconds
+    assert timed["args"]["impl"] == "pallas_ring[hbm-stream]"
+    assert timed["args"]["nbytes"] == 1 << 20
+    assert timed["args"]["wire_dtype"] == "off"
+    assert timed["args"]["tuner_source"] == "measured"
+    assert timed["args"]["tuner_applied"] is True
+    assert evs[1]["dur"] == 0.0
+
+
 def test_engine_records_dispatches(mesh4):
     from adapcc_tpu.comm.engine import CollectiveEngine
     from adapcc_tpu.strategy.ir import Strategy
