@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench ring-sweep quant-bench tune-bench trace-export clean
+.PHONY: all native test sim-bench ring-sweep quant-bench tune-bench overlap-bench trace-export clean
 
 all: native
 
@@ -48,6 +48,16 @@ quant-bench:
 tune-bench:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--world 8 --sizes 1M,16M,128M --tune-replay --json
+
+# Overlapped-gradient-sync sweep on the same simulator (docs/OVERLAP.md):
+# deterministic "mode": "simulated" rows over (accum x bucket cap x
+# overlap schedule), priced by overlapped_step_time — exposed comm for
+# the bucket-rolling schedule is strictly below the non-overlapped
+# baseline on every comm-bound configuration.
+overlap-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--world 8 --sizes 16M,128M --overlap-sweep --accums 1,2,4 \
+		--bucket-caps-mb 1,4 --json
 
 # Perfetto/chrome://tracing export of a recorded dispatch trace: run a
 # short virtual-pod collective session under ADAPCC_TUNER=record and emit

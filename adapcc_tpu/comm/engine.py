@@ -403,6 +403,117 @@ def allreduce_shard(
     return _avg_normalize(_run_segments(x, strategy, per_segment), active_mask, op)
 
 
+def _chunk_bounds(nelems: int, chunk_elems: int) -> List[Tuple[int, int]]:
+    """Static ``(offset, length)`` split of a flat payload at the chunk
+    granularity; the tail chunk keeps the remainder."""
+    return [
+        (off, min(chunk_elems, nelems - off))
+        for off in range(0, nelems, chunk_elems)
+    ]
+
+
+def _tree_allreduce_chunk(
+    seg: jnp.ndarray,
+    tree: Tree,
+    active_mask: jnp.ndarray,
+    axis_name: str,
+    world: int,
+    op: ReduceOp,
+) -> jnp.ndarray:
+    """One chunk's allreduce through ONE tree's round schedule — the unit
+    the chunked dispatch (and its dispatch-count tests) fan out over."""
+    acc = _mask_contribution(seg, active_mask, axis_name, op)
+    acc = _run_reduce_rounds(acc, tree.reduce_rounds(), axis_name, world, op)
+    return _run_broadcast_rounds(acc, tree.broadcast_rounds(), axis_name, world)
+
+
+def chunked_allreduce_shard(
+    x: jnp.ndarray,
+    active_mask: jnp.ndarray,
+    strategy: Strategy,
+    axis_name: str = RANKS_AXIS,
+    op: ReduceOp = ReduceOp.SUM,
+    chunk_bytes: Optional[int] = None,
+) -> jnp.ndarray:
+    """Bucket-rolling strategy allreduce: the payload splits into
+    independent per-chunk collectives of at most ``chunk_bytes`` each
+    (``ADAPCC_RING_CHUNK_BYTES`` overrides, the one chunk-knob precedence
+    ladder), so XLA's async collectives can interleave chunk transfers
+    with whatever compute still runs — the engine half of the per-bucket
+    rolling sync (docs/OVERLAP.md §2, the reference's 4 MB chunk pipeline,
+    commu.py:401-403).
+
+    Bitwise contract: the payload is first split across trees by share at
+    the SAME boundaries as the unchunked dispatch (``_segment_sizes`` over
+    the whole payload), and only then chunked within each tree's segment —
+    so every element rides the same tree and the same per-round add order
+    as :func:`allreduce_shard`, and the result is bitwise-identical on
+    single- and multi-tree strategies alike.  Chunking the flat payload
+    directly would shift the element→tree assignment and change last-bit
+    reduction order on multi-tree strategies."""
+    from adapcc_tpu.comm.pallas_ring import resolve_chunk_bytes
+
+    flat = x.reshape(-1)
+    if flat.size == 0:
+        return x
+    chunk_elems = max(1, resolve_chunk_bytes(chunk_bytes) // flat.dtype.itemsize)
+    if flat.size <= chunk_elems:
+        return allreduce_shard(x, active_mask, strategy, axis_name=axis_name, op=op)
+    world = strategy.world_size
+    sizes = _segment_sizes(flat.size, strategy.tree_shares())
+    outs: List[jnp.ndarray] = []
+    off = 0
+    for tree, size in zip(strategy.trees, sizes):
+        if size == 0:
+            continue
+        seg = flat[off : off + size]
+        off += size
+        outs.extend(
+            _tree_allreduce_chunk(
+                seg[o : o + n], tree, active_mask, axis_name, world, op
+            )
+            for o, n in _chunk_bounds(size, chunk_elems)
+        )
+    result = jnp.concatenate(outs) if len(outs) > 1 else outs[0]
+    return _avg_normalize(result, active_mask, op).reshape(x.shape)
+
+
+def chunked_psum_shard(
+    x: jnp.ndarray,
+    active_mask: Optional[jnp.ndarray],
+    axis_name: str = RANKS_AXIS,
+    op: ReduceOp = ReduceOp.SUM,
+    chunk_bytes: Optional[int] = None,
+    world: Optional[int] = None,
+) -> jnp.ndarray:
+    """Bucket-rolling XLA-collective allreduce: the psum-plane twin of
+    :func:`chunked_allreduce_shard`.  ``active_mask=None`` is the
+    statically-full-world case (``world`` supplies the AVG denominator);
+    a mask routes each chunk through :func:`masked_psum_shard` with the
+    usual relay semantics."""
+    from adapcc_tpu.comm.pallas_ring import resolve_chunk_bytes
+
+    flat = x.reshape(-1)
+    if flat.size == 0:
+        return x
+    if active_mask is None and world is None:
+        raise ValueError("chunked_psum_shard needs world when active_mask is None")
+    chunk_elems = max(1, resolve_chunk_bytes(chunk_bytes) // flat.dtype.itemsize)
+
+    def one(seg: jnp.ndarray) -> jnp.ndarray:
+        if active_mask is None:
+            return _fused_reduce(seg, axis_name, op, world)
+        return masked_psum_shard(seg, active_mask, axis_name, op)
+
+    if flat.size <= chunk_elems:
+        return one(flat).reshape(x.shape)
+    outs = [
+        one(flat[off : off + n])
+        for off, n in _chunk_bounds(flat.size, chunk_elems)
+    ]
+    return jnp.concatenate(outs).reshape(x.shape)
+
+
 def reduce_shard(
     x: jnp.ndarray,
     active_mask: jnp.ndarray,

@@ -1,7 +1,22 @@
-"""Data-parallel training plane: gradient bucketing, sync hook, trainer."""
+"""Data-parallel training plane: gradient bucketing, sync hook, trainer,
+and the overlapped-sync schedules (docs/OVERLAP.md)."""
 
 from adapcc_tpu.ddp.bucketing import BucketPlan, build_bucket_plan
 from adapcc_tpu.ddp.hook import GradSyncHook
+from adapcc_tpu.ddp.overlap import (
+    OVERLAP_ENV,
+    OVERLAP_MODES,
+    resolve_overlap_mode,
+)
 from adapcc_tpu.ddp.trainer import DDPTrainer, TrainState
 
-__all__ = ["BucketPlan", "build_bucket_plan", "GradSyncHook", "DDPTrainer", "TrainState"]
+__all__ = [
+    "BucketPlan",
+    "build_bucket_plan",
+    "GradSyncHook",
+    "DDPTrainer",
+    "TrainState",
+    "OVERLAP_ENV",
+    "OVERLAP_MODES",
+    "resolve_overlap_mode",
+]

@@ -35,10 +35,21 @@ class BucketPlan:
     leaf_bucket: Tuple[int, ...]
     bucket_sizes: Tuple[int, ...]
     chunk_bytes: Tuple[int, ...]
+    #: per-bucket payload bytes (the histogram the observability surface
+    #: records); empty tuple only on plans predating the field
+    bucket_bytes: Tuple[int, ...] = ()
+    #: how many single leaves exceeded the cap on their own (each lands in
+    #: a dedicated oversized bucket — torch DDP does the same; the count is
+    #: the signal that the cap is mis-sized for the model)
+    oversized_leaves: int = 0
 
     @property
     def num_buckets(self) -> int:
         return len(self.bucket_sizes)
+
+    @property
+    def total_bytes(self) -> int:
+        return int(sum(self.bucket_bytes))
 
 
 def _chunk_heuristic(nbytes: int) -> int:
@@ -56,16 +67,29 @@ def build_bucket_plan(grads_pytree: Any, bucket_cap_mb: float = 100.0) -> Bucket
     the reference's recorded bucket tables reflect (log/model_bucket_info.txt).
     """
     leaves, treedef = jax.tree_util.tree_flatten(grads_pytree)
+    if not leaves:
+        # an empty plan would "sync" nothing and read as success; the one
+        # caller shape this catches is a loss whose grads pytree lost its
+        # leaves (e.g. a frozen-params filter applied twice)
+        raise ValueError(
+            "build_bucket_plan: gradient pytree has no leaves — nothing to "
+            "bucket (did a filter strip every parameter?)"
+        )
     cap = int(bucket_cap_mb * 1024 * 1024)
 
     leaf_bucket = [0] * len(leaves)
     bucket_sizes: List[int] = []
     bucket_bytes: List[int] = []
+    oversized = 0
     cur_bucket = -1
     cur_bytes = cap + 1  # force a new bucket on first leaf
     for i in reversed(range(len(leaves))):
         leaf = leaves[i]
         nbytes = leaf.size * leaf.dtype.itemsize
+        if nbytes > cap:
+            # a single leaf over the cap gets its own bucket (it cannot be
+            # split — the plan is leaf-granular); counted for observability
+            oversized += 1
         if cur_bytes + nbytes > cap and cur_bytes > 0:
             cur_bucket += 1
             bucket_sizes.append(0)
@@ -82,6 +106,8 @@ def build_bucket_plan(grads_pytree: Any, bucket_cap_mb: float = 100.0) -> Bucket
         leaf_bucket=tuple(leaf_bucket),
         bucket_sizes=tuple(bucket_sizes),
         chunk_bytes=tuple(_chunk_heuristic(b) for b in bucket_bytes),
+        bucket_bytes=tuple(bucket_bytes),
+        oversized_leaves=oversized,
     )
 
 

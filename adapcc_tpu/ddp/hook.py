@@ -52,6 +52,9 @@ class GradSyncHook:
         compress: str = "off",
         error_feedback: bool = False,
         quant_block_size: int = 256,
+        overlap: str = "off",
+        trace: Optional[Any] = None,
+        metrics: Optional[Any] = None,
     ) -> None:
         """``mode``: ``"psum"`` = per-leaf masked psum (one XLA collective per
         leaf — no bucketing copies, optimal on a flat ICI mesh and still
@@ -78,7 +81,23 @@ class GradSyncHook:
         residual buffer folded into the next step's gradient (the
         :func:`adapcc_tpu.quant.error_feedback_step` loop) — drive it via
         :meth:`sync_error_feedback`; the trainer threads the buffer.
+
+        ``overlap`` selects the sync schedule (docs/OVERLAP.md; resolved at
+        construction, ``ADAPCC_OVERLAP`` overriding): ``"bucket"`` forces
+        the bucketed path on either data plane and dispatches every bucket
+        as independent chunked collectives honoring the plan's per-bucket
+        ``chunk_bytes``; ``"microbatch"`` is a trainer-level schedule and
+        leaves the hook's per-sync program unchanged.
+
+        ``trace``/``metrics`` are optional observability sinks (a
+        :class:`~adapcc_tpu.utils.observability.CollectiveTrace` /
+        :class:`~adapcc_tpu.utils.observability.MetricsRegistry`): the
+        first traced sync records the bucket plan — count, byte histogram,
+        oversized leaves, resolved chunk sizes, and the model-predicted
+        ``exposed_comm_s`` floor — into both.  When absent, an attached
+        communicator's engine trace / metrics registry are used.
         """
+        from adapcc_tpu.ddp.overlap import resolve_overlap_mode
         from adapcc_tpu.quant import get_codec
 
         if compress != "strategy":
@@ -97,6 +116,9 @@ class GradSyncHook:
         self.communicator = communicator
         self.mode = mode
         self.compress = compress
+        self.overlap = resolve_overlap_mode(overlap)
+        self._trace = trace
+        self._metrics = metrics
         self._plan: Optional[BucketPlan] = None
         self.recorded_buckets: List[tuple] = []  # (size, chunk_bytes) per bucket
 
@@ -213,11 +235,112 @@ class GradSyncHook:
         synced = self._sync_impl(wire, active_mask)
         return tm(lambda s, dt: s.astype(dt), synced, orig_dtypes), new_residual
 
+    def resolved_chunk_bytes(self) -> List[int]:
+        """The per-bucket chunk sizes the dispatch actually honors:
+        ``ADAPCC_RING_CHUNK_BYTES`` override > the plan's per-bucket
+        heuristic — the chunk-knob precedence every other chunk consumer
+        follows.  Requires a recorded plan (first traced sync)."""
+        from adapcc_tpu.comm.pallas_ring import resolve_chunk_bytes
+
+        if self._plan is None:
+            raise ValueError(
+                "no recorded bucket plan yet: resolved_chunk_bytes() reads "
+                "the table the first traced sync records"
+            )
+        return [resolve_chunk_bytes(c) for c in self._plan.chunk_bytes]
+
+    def _record_plan(self, plan: BucketPlan, data_plane: str) -> None:
+        """Bucket-plan observability (host side, once per trace): counts and
+        the byte histogram into the metrics registry, the full table — with
+        the resolved chunk sizes and the cost model's predicted
+        ``exposed_comm_s`` floor for the active overlap schedule — into the
+        dispatch trace."""
+        metrics = self._metrics
+        if metrics is None and self.communicator is not None:
+            metrics = getattr(self.communicator, "metrics", None)
+        trace = self._trace
+        if trace is None and self.communicator is not None:
+            trace = getattr(
+                getattr(self.communicator, "engine", None), "trace", None
+            )
+        if metrics is None and trace is None:
+            return
+        if metrics is not None:
+            metrics.gauge("bucket_plan.num_buckets", plan.num_buckets)
+            metrics.gauge("bucket_plan.total_bytes", plan.total_bytes)
+            if plan.oversized_leaves:
+                metrics.incr(
+                    "bucket_plan.oversized_leaves", plan.oversized_leaves
+                )
+            for b in plan.bucket_bytes:
+                # the byte histogram rides the timing reservoir: p50/p99
+                # of bucket sizes in the snapshot, O(1) memory
+                metrics.observe("bucket_plan.bucket_bytes", float(b))
+        if trace is not None:
+            from adapcc_tpu.sim.calibrate import load_or_default
+            from adapcc_tpu.sim.cost_model import (
+                bottleneck_ring_coeffs,
+                exposed_comm_floor_s,
+            )
+
+            world = self.strategy.world_size
+            coeffs = bottleneck_ring_coeffs(load_or_default(world=world), world)
+            wd = self.effective_compress()
+            trace.record(
+                "grad_sync",
+                f"{data_plane}[{self.overlap}]",
+                plan.total_bytes,
+                buckets=plan.num_buckets,
+                bucket_bytes=list(plan.bucket_bytes),
+                plan_chunk_bytes=list(plan.chunk_bytes),
+                chunk_bytes=self.resolved_chunk_bytes(),
+                oversized_leaves=plan.oversized_leaves,
+                overlap=self.overlap,
+                wire_dtype=wd,
+                exposed_comm_s=exposed_comm_floor_s(
+                    world, plan.total_bytes, coeffs,
+                    overlap=self.overlap,
+                    bucket_bytes=plan.bucket_bytes,
+                    wire_dtype=wd,
+                ),
+            )
+
+    def _bucket_plan(self, grads: Any, data_plane: str) -> BucketPlan:
+        if self._plan is None:
+            # first trace records the bucket table (the analog of the
+            # reference's step-0/1 record phase, commu.py:409-418)
+            self._plan = build_bucket_plan(grads, self.bucket_cap_mb)
+            self.recorded_buckets = [
+                (s, c) for s, c in zip(self._plan.bucket_sizes, self._plan.chunk_bytes)
+            ]
+            self._record_plan(self._plan, data_plane)
+        return self._plan
+
     def _sync_impl(self, grads: Any, active_mask: Optional[jnp.ndarray]) -> Any:
         import jax as _jax
         from jax import lax as _lax
 
-        if self._resolved_mode() == "psum":
+        data_plane = self._resolved_mode()
+        if self.overlap == "bucket":
+            # per-bucket rolling sync: the bucket plan drives independent
+            # chunked collectives on whichever data plane resolved —
+            # bitwise-identical values, finer dispatch granularity so
+            # XLA's async collectives interleave buckets with remaining
+            # compute (docs/OVERLAP.md §2)
+            from adapcc_tpu.ddp.overlap import rolling_bucket_sync
+
+            mask = active_mask
+            if data_plane != "psum" and mask is None:
+                mask = jnp.ones((self.strategy.world_size,), dtype=jnp.bool_)
+            plan = self._bucket_plan(grads, data_plane)
+            buckets = flatten_to_buckets(plan, grads)
+            synced = rolling_bucket_sync(
+                buckets, plan.chunk_bytes, mask,
+                mode=data_plane, strategy=self.strategy,
+                axis_name=self.axis_name, op=self.op,
+            )
+            return unflatten_from_buckets(plan, synced)
+        if data_plane == "psum":
             if active_mask is None:
                 world = self.strategy.world_size
 
@@ -232,21 +355,15 @@ class GradSyncHook:
             )
         if active_mask is None:
             active_mask = jnp.ones((self.strategy.world_size,), dtype=jnp.bool_)
-        if self._plan is None:
-            # first trace records the bucket table (the analog of the
-            # reference's step-0/1 record phase, commu.py:409-418)
-            self._plan = build_bucket_plan(grads, self.bucket_cap_mb)
-            self.recorded_buckets = [
-                (s, c) for s, c in zip(self._plan.bucket_sizes, self._plan.chunk_bytes)
-            ]
-        buckets = flatten_to_buckets(self._plan, grads)
+        plan = self._bucket_plan(grads, data_plane)
+        buckets = flatten_to_buckets(plan, grads)
         synced = [
             allreduce_shard(
                 b, active_mask, self.strategy, axis_name=self.axis_name, op=self.op
             )
             for b in buckets
         ]
-        return unflatten_from_buckets(self._plan, synced)
+        return unflatten_from_buckets(plan, synced)
 
     def sync_deferred(
         self, grads: Any, deferred: Any, active_mask: jnp.ndarray

@@ -1,0 +1,235 @@
+"""Overlapped gradient sync: pipeline per-bucket collectives behind compute.
+
+AdapCC's core win is chunked, pipelined collectives that keep the wire busy
+while other work proceeds (SURVEY §3.3: the DDP hook hands buckets to an
+async relay as backward produces them); the baseline port computes the full
+gradient, then syncs it — all communication time is exposed.  This module is
+the static overlap schedule that software-pipelines gradient synchronization
+*inside* the compiled step, in two shape-static, scan-friendly mechanisms:
+
+1. **Microbatch-pipelined sync** (``"microbatch"``): in the trainer's
+   accumulation ``lax.scan``, the carry holds the *previous* microbatch's
+   gradient delta; the loop body dispatches that delta's allreduce and then
+   runs the next microbatch's forward/backward — two independent subgraphs
+   in one scan iteration, which XLA's async collectives and latency-hiding
+   scheduler interleave.  Only the last delta's sync (the drain) has no
+   compute left to hide behind.  Wire volume grows to ``accum`` full-size
+   syncs (each delta is gradient-sized), so this mode trades bytes for
+   overlap — the measured tuner, not the α-β model, decides whether that
+   trade wins on a given fabric (:mod:`adapcc_tpu.tuner`).
+
+2. **Per-bucket rolling sync** (``"bucket"``): the existing
+   :class:`~adapcc_tpu.ddp.bucketing.BucketPlan` drives the new chunked
+   engine entry points (:func:`adapcc_tpu.comm.engine.
+   chunked_allreduce_shard` / :func:`~adapcc_tpu.comm.engine.
+   chunked_psum_shard`): every bucket dispatches as an independent
+   collective split at its per-bucket ``chunk_bytes`` (the reference's
+   4 MB-chunk heuristic, commu.py:401-403 — previously computed and
+   dropped), so XLA's async collectives interleave bucket chunks with the
+   remaining compute (the optimizer tail, the scatter-back casts, the next
+   scanned step).  Numerics are bitwise-identical to the unchunked sync:
+   every element rides the same per-element reduction order, just in a
+   smaller dispatch.
+
+``ADAPCC_OVERLAP`` overrides the constructor-selected mode for sweeps —
+the same env-beats-caller precedence as ``ADAPCC_RING_CHUNK_BYTES`` and
+``ADAPCC_WIRE_DTYPE``; a malformed value raises instead of silently
+falling back.  Pricing lives in :func:`adapcc_tpu.sim.cost_model.
+overlapped_step_time`; the tuner's ``ddp_step`` cells carry the overlap
+axis (docs/OVERLAP.md).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from adapcc_tpu.comm.mesh import RANKS_AXIS
+from adapcc_tpu.primitives import ReduceOp
+
+#: env override for the overlap mode (off | microbatch | bucket)
+OVERLAP_ENV = "ADAPCC_OVERLAP"
+
+#: the schedulable overlap modes, risk order ("off" first so candidate
+#: tie-breaks keep the non-overlapped plane)
+OVERLAP_MODES = ("off", "bucket", "microbatch")
+
+
+def resolve_overlap_mode(overlap: Optional[str] = None) -> str:
+    """The overlap schedule actually in force: the ``ADAPCC_OVERLAP`` sweep
+    override wins, then the caller's mode, then ``"off"``.  A malformed
+    value raises — a typo silently falling back to the default would
+    invalidate an overlap A/B (same policy as ADAPCC_RING_CHUNK_BYTES)."""
+    env = os.environ.get(OVERLAP_ENV)
+    if env is not None and env.strip():
+        value = env.strip().lower()
+        if value not in OVERLAP_MODES:
+            raise ValueError(
+                f"{OVERLAP_ENV}={env!r}: expected one of {OVERLAP_MODES}"
+            )
+        return value
+    if overlap is None:
+        return "off"
+    if overlap not in OVERLAP_MODES:
+        raise ValueError(
+            f"overlap={overlap!r}: expected one of {OVERLAP_MODES}"
+        )
+    return overlap
+
+
+# --------------------------------------------------------------------------- #
+# mechanism 2: per-bucket rolling sync (device half; call inside shard_map)
+# --------------------------------------------------------------------------- #
+
+
+def rolling_bucket_sync(
+    buckets: Sequence[jnp.ndarray],
+    chunk_bytes: Sequence[int],
+    active_mask: Optional[jnp.ndarray],
+    *,
+    mode: str,
+    strategy: Any,
+    axis_name: str = RANKS_AXIS,
+    op: ReduceOp = ReduceOp.SUM,
+) -> List[jnp.ndarray]:
+    """Dispatch each bucket as an independent chunked collective honoring
+    its per-bucket ``chunk_bytes`` (env-overridable inside the engine's
+    chunked entry points).  ``mode`` picks the data plane the hook resolved:
+    ``"psum"`` = masked XLA collectives, ``"schedule"`` = strategy-tree
+    allreduce.  Values are bitwise-identical to the unchunked dispatch —
+    only the collective granularity changes."""
+    from adapcc_tpu.comm.engine import (
+        chunked_allreduce_shard,
+        chunked_psum_shard,
+    )
+
+    if len(buckets) != len(chunk_bytes):
+        raise ValueError(
+            f"{len(buckets)} buckets but {len(chunk_bytes)} chunk sizes — "
+            "the bucket plan and its chunk table must describe one layout"
+        )
+    out: List[jnp.ndarray] = []
+    for bucket, cb in zip(buckets, chunk_bytes):
+        if mode == "psum":
+            out.append(
+                chunked_psum_shard(
+                    bucket, active_mask, axis_name=axis_name, op=op,
+                    chunk_bytes=cb, world=strategy.world_size,
+                )
+            )
+        else:
+            mask = (
+                active_mask
+                if active_mask is not None
+                else jnp.ones((strategy.world_size,), dtype=jnp.bool_)
+            )
+            out.append(
+                chunked_allreduce_shard(
+                    bucket, mask, strategy, axis_name=axis_name, op=op,
+                    chunk_bytes=cb,
+                )
+            )
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# mechanism 1: microbatch-pipelined sync (device half; call inside shard_map)
+# --------------------------------------------------------------------------- #
+
+
+def microbatch_pipelined_sync(
+    vg: Callable,
+    params: Any,
+    model_state: Any,
+    micro: Any,
+    sync_fn: Callable[[Any], Any],
+    accum: int,
+) -> Tuple[jnp.ndarray, Any, Any]:
+    """The pipelined accumulation scan (mechanism 1 of docs/OVERLAP.md).
+
+    ``vg(params, model_state, mb) -> ((loss, new_model_state), grads)`` is
+    one microbatch's forward/backward; ``micro`` is the
+    ``[accum, B/accum, ...]`` microbatch stack; ``sync_fn`` is the hook's
+    allreduce (mask already bound).  The scan carry holds the previous
+    microbatch's raw delta: each iteration dispatches ``sync_fn(prev)``
+    and *then* computes the current microbatch — independent subgraphs XLA
+    overlaps — accumulating synced deltas in fp32.  After the scan one
+    drain sync covers the final delta (the only exposed transfer).
+
+    Returns ``(mean_loss_f32, synced_mean_grads_in_param_dtype,
+    new_model_state)``.  Numerics: the synced mean equals the baseline's
+    sync-of-accumulated-mean by linearity of the collective; only the
+    fp32 accumulation *order* differs (sum of synced deltas vs sync of
+    summed deltas), so parity holds to accumulation-order tolerance, not
+    bitwise — the documented contract the parity test asserts.
+    """
+    if accum < 2:
+        raise ValueError(
+            f"microbatch pipelining needs accum >= 2, got {accum}: with a "
+            "single microbatch there is no later compute to hide the sync "
+            "behind (use overlap='bucket' or 'off')"
+        )
+    tm = jax.tree_util.tree_map
+    mb0 = tm(lambda x: x[0], micro)
+    rest = tm(lambda x: x[1:], micro)
+    (loss0, ms), g0 = vg(params, model_state, mb0)
+    zeros = tm(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+    def body(carry, mb):
+        acc_l, acc_s, prev, ms = carry
+        # the previous delta's collective and this microbatch's compute are
+        # data-independent: XLA's async collectives run them concurrently
+        synced_prev = sync_fn(prev)
+        (loss, ms), g = vg(params, ms, mb)
+        acc_s = tm(lambda a, s: a + s.astype(jnp.float32), acc_s, synced_prev)
+        return (acc_l + loss.astype(jnp.float32), acc_s, g, ms), None
+
+    # the carry seeds with ``ms`` — microbatch 0's *updated* model state —
+    # so stateful losses see every microbatch sequentially (torch
+    # grad-accum semantics, same contract as the sequential path)
+    (loss_sum, acc_s, last, new_ms), _ = lax.scan(
+        body, (loss0.astype(jnp.float32), zeros, g0, ms), rest
+    )
+    drained = sync_fn(last)  # the pipeline drain: the one exposed sync
+    synced = tm(
+        lambda a, d, p: ((a + d.astype(jnp.float32)) / accum).astype(p.dtype),
+        acc_s, drained, params,
+    )
+    return loss_sum / accum, synced, new_ms
+
+
+# --------------------------------------------------------------------------- #
+# flat-vector chunk table (ZeRO-1 chunked reduce-scatter / all-gather)
+# --------------------------------------------------------------------------- #
+
+
+def even_chunk_bounds(total: int, n_chunks: int) -> List[Tuple[int, int]]:
+    """``(offset, length)`` table splitting ``total`` elements into
+    ``n_chunks`` near-equal contiguous chunks (remainder spread over the
+    leading chunks) — the static split the ZeRO-1 chunked collectives and
+    their parity tests share."""
+    if total < 0:
+        raise ValueError(f"total must be >= 0, got {total}")
+    n = max(1, min(int(n_chunks), max(1, total)))
+    base, rem = divmod(total, n)
+    bounds: List[Tuple[int, int]] = []
+    off = 0
+    for i in range(n):
+        length = base + (1 if i < rem else 0)
+        bounds.append((off, length))
+        off += length
+    return bounds
+
+
+def overlap_chunk_count(nbytes: int, chunk_bytes: Optional[int]) -> int:
+    """How many independent collectives a ``nbytes`` payload splits into at
+    ``chunk_bytes`` granularity (env-overridable via the ring chunk
+    resolver — one precedence ladder for every chunk knob)."""
+    from adapcc_tpu.comm.pallas_ring import resolve_chunk_bytes
+
+    cb = resolve_chunk_bytes(chunk_bytes)
+    return max(1, -(-int(nbytes) // cb))

@@ -148,6 +148,20 @@ class DDPTrainer:
         tune: bool = False,
         tuner: Optional[Any] = None,
         tune_every: int = 16,
+        # overlapped gradient sync (adapcc_tpu/ddp/overlap, docs/OVERLAP.md;
+        # ADAPCC_OVERLAP overrides, resolved at construction):
+        #   "off"        — compute the full gradient, then sync (baseline);
+        #   "bucket"     — per-bucket rolling sync: every bucket dispatches
+        #                  as independent chunked collectives honoring the
+        #                  plan's per-bucket chunk_bytes, so XLA's async
+        #                  collectives interleave them with remaining
+        #                  compute.  Bitwise-identical gradients;
+        #   "microbatch" — pipeline each microbatch delta's allreduce
+        #                  behind the next microbatch's forward/backward in
+        #                  the accumulation scan (requires accum_steps >= 2,
+        #                  BSP, no error_feedback/measure_gns); parity to
+        #                  accumulation-order tolerance, accum x wire bytes.
+        overlap: str = "off",
     ) -> None:
         self.loss_fn = loss_fn
         self.stateful_loss = stateful_loss
@@ -175,6 +189,41 @@ class DDPTrainer:
                 "quantization residual on top would double-carry it"
             )
         self.error_feedback = error_feedback
+        from adapcc_tpu.ddp.overlap import resolve_overlap_mode
+
+        self.overlap = resolve_overlap_mode(overlap)
+        if self.overlap == "microbatch":
+            # guard rails for the pipelined scan — each incompatibility
+            # would silently change semantics, so all reject at
+            # construction (the bsp/error-feedback precedent above):
+            if accum_steps < 2:
+                raise ValueError(
+                    "overlap='microbatch' needs accum_steps >= 2: with one "
+                    "microbatch there is no later compute to hide the sync "
+                    "behind (use overlap='bucket')"
+                )
+            if not bsp:
+                raise ValueError(
+                    "overlap='microbatch' requires BSP mode: the async "
+                    "relay's deferred bank folds into ONE sync per step, "
+                    "which the per-microbatch pipeline would re-sync "
+                    "accum times"
+                )
+            if error_feedback:
+                raise ValueError(
+                    "overlap='microbatch' with error_feedback=True would "
+                    "apply the codec (and bank its residual) per "
+                    "microbatch delta — a different quantization loop than "
+                    "the one the residual compensates; use "
+                    "overlap='bucket' (residual threads unchanged) or "
+                    "drop error_feedback"
+                )
+            if measure_gns:
+                raise ValueError(
+                    "overlap='microbatch' never materializes the unsynced "
+                    "accumulated gradient the GNS estimator contrasts; "
+                    "use overlap='bucket' or drop measure_gns"
+                )
         self.hook = GradSyncHook(
             strategy,
             axis_name=axis_name,
@@ -184,6 +233,7 @@ class DDPTrainer:
             mode=sync_mode,
             compress=grad_compress,
             error_feedback=error_feedback,
+            overlap=self.overlap,
         )
         if error_feedback and self.hook.effective_compress() == "off":
             # the residual of a no-op codec is provably zero, but the bank
@@ -248,6 +298,19 @@ class DDPTrainer:
             tuner = tuner.with_mode("choose")
         self.tune = tune
         self.tuner = tuner if tune else None
+        # the overlap schedules THIS trainer can legally compile — the
+        # tuner's ddp_step grid is narrowed to these so the explorer never
+        # pins on a cell the trainer cannot run (the error-feedback/'off'
+        # codec precedent)
+        modes = ["off", "bucket"]
+        if (
+            accum_steps >= 2
+            and bsp
+            and not error_feedback
+            and not measure_gns
+        ):
+            modes.append("microbatch")
+        self._overlap_modes = tuple(modes)
         self._grad_bytes: Optional[float] = None
         # warmup-discard token: bumped on every recompile so the first step
         # of each compiled program (which pays tracing + XLA compile) never
@@ -263,6 +326,18 @@ class DDPTrainer:
 
     # -- step program ----------------------------------------------------------
 
+    def _zero1_overlap(self) -> str:
+        """The Zero1Optimizer schedule the trainer's overlap mode implies:
+        any overlapped trainer schedule also chunks the zero1 RS/AG pair
+        (the Pallas ring streams its own chunks, so the ring path keeps
+        one chunking plane).  One definition for construction AND tuner
+        adoption — the two must never disagree."""
+        return (
+            "bucket"
+            if self.overlap != "off" and not self.zero1_ring
+            else "off"
+        )
+
     def init_state(self, params: Any, model_state: Any = ()) -> TrainState:
         """Build the trainer's state: replicated optax state normally, the
         ZeRO-1 flat master + sharded optimizer state when ``zero1=True``."""
@@ -274,6 +349,7 @@ class DDPTrainer:
             self.tx, self.mesh, self.axis_name, ring=self.zero1_ring,
             ring_chunk_bytes=self.zero1_ring_chunk_bytes,
             tuner=self.tuner,
+            overlap=self._zero1_overlap(),
         )
         master, opt_state = opt.init(params)
         if self.zero1_ring_chunk_bytes is None:
@@ -381,10 +457,16 @@ class DDPTrainer:
             _flatten(synced, meta), meta, world, self.axis_name,
             offset=1 if self.zero1_ring else 0,
         )
+        overlap_chunks = (
+            self._zero1_opt.overlap_chunks(meta.padded // world)
+            if self._zero1_opt is not None
+            else 1
+        )
         master, opt_state, params = zero1_apply_shard(
             self.tx, master, opt_state, g_shard, meta, self.axis_name,
             ring=self.zero1_ring, ring_interpret=ring_interpret,
             ring_chunk_bytes=self.zero1_ring_chunk_bytes,
+            overlap_chunks=overlap_chunks,
         )
         return TrainState(
             params=params,
@@ -413,15 +495,7 @@ class DDPTrainer:
             (loss, new_ms), grads = vg(params, model_state, batch)
             return loss, grads, new_ms
 
-        def to_micro(x):
-            b = x.shape[0]
-            if b % accum:
-                raise ValueError(
-                    f"per-rank batch {b} not divisible by accum_steps {accum}"
-                )
-            return x.reshape((accum, b // accum) + x.shape[1:])
-
-        micro = jax.tree_util.tree_map(to_micro, batch)
+        micro = self._to_microbatches(batch)
         g0 = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params
         )
@@ -442,13 +516,47 @@ class DDPTrainer:
         )
         return loss_sum / accum, grads, new_ms
 
+    def _to_microbatches(self, batch: Any) -> Any:
+        """``[B, ...]`` leaves → ``[accum, B/accum, ...]`` microbatch stacks
+        (shared by the sequential and pipelined accumulation paths)."""
+        accum = self.accum_steps
+
+        def to_micro(x):
+            b = x.shape[0]
+            if b % accum:
+                raise ValueError(
+                    f"per-rank batch {b} not divisible by accum_steps {accum}"
+                )
+            return x.reshape((accum, b // accum) + x.shape[1:])
+
+        return jax.tree_util.tree_map(to_micro, batch)
+
+    def _loss_and_synced(
+        self, params: Any, model_state: Any, batch: Any, mask
+    ):
+        """Per-rank ``(loss, synced_grads, new_model_state)`` for the plain
+        (non-banked) sync paths: sequential accumulate-then-sync by
+        default, the microbatch-pipelined scan under
+        ``overlap='microbatch'`` (docs/OVERLAP.md §1)."""
+        if self.overlap != "microbatch":
+            loss, grads, new_ms = self._value_and_grad(
+                params, model_state, batch
+            )
+            return loss, self.hook.sync(grads, mask), new_ms
+        from adapcc_tpu.ddp.overlap import microbatch_pipelined_sync
+
+        vg = jax.value_and_grad(self._loss3, has_aux=True)
+        return microbatch_pipelined_sync(
+            vg, params, model_state, self._to_microbatches(batch),
+            lambda g: self.hook.sync(g, mask), self.accum_steps,
+        )
+
     def _static_full_step(self, state: TrainState, batch: Any):
         """The static full-world step (no mask, no relay banking): the body
         scan_steps scans and _build's static path reduces to."""
-        loss, grads, new_ms = self._value_and_grad(
-            state.params, state.model_state, batch
+        loss, synced, new_ms = self._loss_and_synced(
+            state.params, state.model_state, batch, None
         )
-        synced = self.hook.sync(grads, None)
         return self._apply_synced(state, synced, new_ms), loss
 
     def _build(self) -> Callable:
@@ -459,13 +567,25 @@ class DDPTrainer:
         deferred_relay = not self.bsp
         error_feedback = self.error_feedback
 
+        pipelined = self.overlap == "microbatch"
+
         def per_shard(state: TrainState, batch: Any, *extra: Any):
-            loss, grads, new_ms = self._value_and_grad(
-                state.params, state.model_state, batch
-            )
             mask = extra[0] if dynamic_mask else None
             outs = []
-            if deferred_relay:
+            if not pipelined:
+                loss, grads, new_ms = self._value_and_grad(
+                    state.params, state.model_state, batch
+                )
+            if pipelined:
+                # microbatch-pipelined sync (docs/OVERLAP.md §1): each
+                # delta's allreduce dispatches behind the next microbatch's
+                # compute inside the accumulation scan.  The banked paths
+                # (deferred relay, error feedback) and measure_gns are
+                # construction-rejected with this schedule.
+                loss, synced, new_ms = self._loss_and_synced(
+                    state.params, state.model_state, batch, mask
+                )
+            elif deferred_relay:
                 # deferred rides in/out with a sharded [world] leading dim;
                 # strip the per-shard [1] so it matches the grads tree
                 deferred = jax.tree_util.tree_map(lambda d: d[0], extra[-1])
@@ -662,15 +782,18 @@ class DDPTrainer:
 
     def _step_cell(self, grad_bytes: int):
         """The database cell the *current* configuration's step walltimes
-        pool under: the hook's effective wire codec.  The cell must stay
-        inside ``TuningPolicy.candidates("ddp_step")`` — the codec-only
-        grid — or the posterior never forms and exploration never ends;
-        the ZeRO-1 ring chunk is a separate knob, tuned once at
-        ``Zero1Optimizer.init`` under its own "zero1_ring" cells."""
-        from adapcc_tpu.tuner.policy import HOOK_PATH, NO_CHUNK
+        pool under: the hook's effective wire codec crossed with the
+        executed overlap schedule (encoded in the key's path slot via
+        ``hook_path``).  The cell must stay inside
+        ``TuningPolicy.candidates("ddp_step")`` — the (codec × overlap)
+        grid narrowed to this trainer's legal modes — or the posterior
+        never forms and exploration never ends; the ZeRO-1 ring chunk is a
+        separate knob, tuned once at ``Zero1Optimizer.init`` under its own
+        "zero1_ring" cells."""
+        from adapcc_tpu.tuner.policy import NO_CHUNK, hook_path
 
         return self.tuner.key_for(
-            "ddp_step", grad_bytes, HOOK_PATH, NO_CHUNK,
+            "ddp_step", grad_bytes, hook_path(self.overlap), NO_CHUNK,
             self.hook.effective_compress(),
         )
 
@@ -697,7 +820,9 @@ class DDPTrainer:
             return
         import os as _os
 
+        from adapcc_tpu.ddp.overlap import OVERLAP_ENV
         from adapcc_tpu.quant import WIRE_DTYPE_ENV
+        from adapcc_tpu.tuner.policy import hook_overlap_of
 
         if _os.environ.get(WIRE_DTYPE_ENV, "").strip():
             # ADAPCC_WIRE_DTYPE pins the executed codec (effective_compress
@@ -714,13 +839,38 @@ class DDPTrainer:
             if self.error_feedback
             else None
         )
-        plan = self.tuner.choose("ddp_step", grad_bytes, wire_dtypes=wire_dtypes)
+        # ADAPCC_OVERLAP pins the executed schedule the same way the wire
+        # env pins the codec: collapse the overlap axis to the pinned mode
+        # (the codec axis stays free) instead of "adopting" a schedule the
+        # env would override at the next construction anyway
+        overlap_modes = (
+            (self.overlap,)
+            if _os.environ.get(OVERLAP_ENV, "").strip()
+            else self._overlap_modes
+        )
+        plan = self.tuner.choose(
+            "ddp_step", grad_bytes,
+            wire_dtypes=wire_dtypes, overlap_modes=overlap_modes,
+        )
         wd = plan.wire_dtype
-        if wd == self.hook.effective_compress():
+        ov = hook_overlap_of(plan.key.path)
+        if wd == self.hook.effective_compress() and ov == self.overlap:
             return
         self.hook.compress = wd
+        if ov != self.overlap:
+            # adopting an overlap schedule re-steers EVERY half that
+            # executes it: the hook (bucket-rolling dispatch), the trainer
+            # (pipelined scan), and an already-constructed Zero1Optimizer
+            # (chunked RS/AG) — a stale optimizer would leave the adopted
+            # cell's measurements half-applied, corrupting the very A/B
+            # the adoption logic ranks on
+            self.overlap = ov
+            self.hook.overlap = ov
+            if self._zero1_opt is not None:
+                self._zero1_opt.overlap = self._zero1_overlap()
+                self._zero1_opt._compiled = None
         self.hook.reset_plan()
-        self._compiled = None  # recompile with the adopted codec
+        self._compiled = None  # recompile with the adopted codec/schedule
         self._scan_cache.clear()
 
     def _record_gns(self, batch: Any, norms: jnp.ndarray, active_mask) -> None:

@@ -288,6 +288,7 @@ def zero1_apply_shard(
     ring: bool = False,
     ring_interpret: bool = False,
     ring_chunk_bytes: Optional[int] = None,
+    overlap_chunks: int = 1,
 ):
     """The in-shard ZeRO-1 update cycle, shared by every composition site
     (Zero1Optimizer.apply, zero1_train_step, DDPTrainer(zero1=True)):
@@ -301,6 +302,15 @@ def zero1_apply_shard(
     rolled back into chunk order before unflattening.  ``ring_chunk_bytes``
     is the staging granularity handed down from the strategy plane (None =
     default; payloads above it stream through HBM staging).
+
+    ``overlap_chunks > 1`` (XLA path only — the Pallas ring streams its own
+    chunks) splits the param all-gather into that many independent
+    collectives over contiguous shard slices, so XLA's async collectives
+    overlap later slices' gathers with the unflatten/cast compute — and, in
+    a scanned multi-step program, with the next step's forward — of earlier
+    slices (docs/OVERLAP.md §3).  The gathered bytes and their layout are
+    identical: chunk ``j`` of every rank lands in the same flat positions,
+    so results are bitwise-equal to the single-collective gather.
     """
     updates, opt_state = tx.update(g_shard, opt_state, master)
     master = optax.apply_updates(master, updates)
@@ -314,6 +324,14 @@ def zero1_apply_shard(
         )
         # gathered[i] = rank i's payload = chunk (i+1) % world
         flat_p = jnp.roll(gathered, 1, axis=0).reshape(-1)
+    elif overlap_chunks > 1:
+        from adapcc_tpu.ddp.overlap import even_chunk_bounds
+
+        gathered = [
+            lax.all_gather(master[off : off + n], axis_name)  # [world, n]
+            for off, n in even_chunk_bounds(master.size, overlap_chunks)
+        ]
+        flat_p = jnp.concatenate(gathered, axis=1).reshape(-1)
     else:
         flat_p = lax.all_gather(master, axis_name).reshape(-1)
     return master, opt_state, _unflatten(flat_p, meta)
@@ -371,12 +389,43 @@ class Zero1Optimizer:
         ring_chunk_bytes: Optional[int] = None,
         wire_dtype: Optional[str] = None,
         tuner: Optional[Any] = None,
+        overlap: str = "off",
+        overlap_chunk_bytes: Optional[int] = None,
     ) -> None:
         self.tx = tx
         self.mesh = mesh
         self.axis_name = axis_name
         self.world = mesh.shape[axis_name]
         self.ring = ring
+        # overlapped collectives (docs/OVERLAP.md §3): "bucket" splits the
+        # gradient reduce-scatter and the param all-gather into independent
+        # per-chunk collectives at ``overlap_chunk_bytes`` granularity
+        # (default: the reference's 4 MB chunk, env-overridable through the
+        # ring chunk resolver) so XLA interleaves them with surrounding
+        # compute.  Identical bytes, identical layout — checkpoints are
+        # unaffected.  The value arrives caller-resolved: DDPTrainer and
+        # train_ddp apply the ADAPCC_OVERLAP precedence *before* passing it
+        # down, because the env may legally pin "microbatch" for the
+        # trainer's scan while this optimizer's collectives stay "off"
+        if overlap == "microbatch":
+            raise ValueError(
+                "Zero1Optimizer has no microbatch axis to pipeline over — "
+                "microbatch overlap lives in DDPTrainer's accumulation "
+                "scan (overlap='microbatch' there composes with zero1=True)"
+            )
+        if overlap not in ("off", "bucket"):
+            raise ValueError(
+                f"overlap={overlap!r}: expected 'off' or 'bucket'"
+            )
+        self.overlap = overlap
+        if self.overlap == "bucket" and ring:
+            raise ValueError(
+                "overlap='bucket' with ring=True would chunk the Pallas "
+                "ring's payload twice: the ring kernel already streams "
+                "chunk_bytes-sized tiles (ring_chunk_bytes steers it); "
+                "use one chunking plane or the other"
+            )
+        self.overlap_chunk_bytes = overlap_chunk_bytes
         # measurement-driven chunk choice (adapcc_tpu/tuner): when the ring
         # staging granularity is left open and ADAPCC_TUNER=choose, init()
         # asks the tuner's policy for it (sized to the actual flat master)
@@ -413,6 +462,22 @@ class Zero1Optimizer:
         from adapcc_tpu.comm.pallas_ring import _tile_elems
 
         return _tile_elems(jnp.float32)
+
+    def overlap_chunks(self, shard_len: Optional[int] = None) -> int:
+        """How many independent collectives the overlapped RS/AG pair
+        splits into: 1 when overlap is off, else the fp32 shard's byte
+        count over ``overlap_chunk_bytes`` (env-overridable through the
+        ring chunk resolver — one precedence ladder for every chunk knob).
+        ``shard_len`` defaults to the initialized flat master's."""
+        if self.overlap != "bucket":
+            return 1
+        if shard_len is None:
+            if self._meta is None:
+                raise RuntimeError("call init(params) first")
+            shard_len = self._meta.padded // self.world
+        from adapcc_tpu.ddp.overlap import overlap_chunk_count
+
+        return overlap_chunk_count(int(shard_len) * 4, self.overlap_chunk_bytes)
 
     def tuning_key(self):
         """The tuning-database cell this optimizer's ring collectives
@@ -479,6 +544,7 @@ class Zero1Optimizer:
 
         ring, ring_interpret = self.ring, self.ring_interpret
         ring_chunk_bytes = self.ring_chunk_bytes
+        overlap_chunks = self.overlap_chunks(shard_len)
 
         def per_shard(master, opt_state, grads_tree):
             # strip the [1] shard dim shard_map leaves on the leading axis
@@ -495,6 +561,7 @@ class Zero1Optimizer:
                 tx, master, opt_state, g_shard, meta, axis,
                 ring=ring, ring_interpret=ring_interpret,
                 ring_chunk_bytes=ring_chunk_bytes,
+                overlap_chunks=overlap_chunks,
             )
             return (
                 master[None],
@@ -612,6 +679,7 @@ def zero1_train_step(
         tx = opt.tx
         ring, ring_interpret = opt.ring, opt.ring_interpret
         ring_chunk_bytes = opt.ring_chunk_bytes
+        overlap_chunks = opt.overlap_chunks(shard_len)
 
         if opt.wire_dtype != "off":
             from adapcc_tpu.quant import get_codec
@@ -641,6 +709,24 @@ def zero1_train_step(
                     flat_g, world, axis_name, interpret=ring_interpret,
                     chunk_bytes=ring_chunk_bytes,
                 )
+            elif overlap_chunks > 1:
+                # per-bucket rolling reduce-scatter (docs/OVERLAP.md §3):
+                # each contiguous shard slice scatters as an independent
+                # collective XLA can interleave with the flatten/codec
+                # compute and with the other slices.  Block r of chunk
+                # [:, off:off+n].reshape(-1) is row r's slice, so the
+                # concatenated shards keep the identity layout — bitwise
+                # equal to the single psum_scatter
+                from adapcc_tpu.ddp.overlap import even_chunk_bounds
+
+                g2d = flat_g.reshape(world, shard_len)
+                g_shard = jnp.concatenate([
+                    lax.psum_scatter(
+                        g2d[:, off : off + n].reshape(-1), axis_name,
+                        scatter_dimension=0, tiled=True,
+                    )
+                    for off, n in even_chunk_bounds(shard_len, overlap_chunks)
+                ])
             else:
                 g_shard = lax.psum_scatter(
                     flat_g.reshape(world, shard_len), axis_name,
@@ -650,6 +736,7 @@ def zero1_train_step(
                 tx, master, opt_state, g_shard, meta, axis_name,
                 ring=ring, ring_interpret=ring_interpret,
                 ring_chunk_bytes=ring_chunk_bytes,
+                overlap_chunks=overlap_chunks,
             )
             return (
                 new_params,

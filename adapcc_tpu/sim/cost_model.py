@@ -415,6 +415,157 @@ def choose_wire_dtype(
     return winner, times
 
 
+# --------------------------------------------------------------------------- #
+# overlapped-step pricing (adapcc_tpu/ddp/overlap): max(compute, comm) plus
+# the exposed fill/drain fractions of the software pipeline
+# --------------------------------------------------------------------------- #
+
+#: overlap schedules the pricing understands; mirrors
+#: ``adapcc_tpu.ddp.overlap.OVERLAP_MODES`` (drift pinned by a test)
+OVERLAP_MODE_CANDIDATES = ("off", "bucket", "microbatch")
+
+
+def _bucket_comm_times(
+    world: int,
+    grad_bytes: float,
+    coeffs: LinkCoeffs,
+    bucket_bytes: Optional[Sequence[float]],
+    wire_dtype: str,
+) -> Tuple[float, ...]:
+    """Per-collective ring times for one gradient's sync: one entry per
+    bucket (or a single whole-gradient entry when no plan is given), each
+    priced as a bottleneck-link ring allreduce under the wire codec."""
+    payloads = (
+        tuple(float(b) for b in bucket_bytes)
+        if bucket_bytes
+        else (float(grad_bytes),)
+    )
+    if any(b < 0 for b in payloads):
+        raise ValueError(f"bucket bytes must be >= 0, got {list(payloads)}")
+    return tuple(
+        quantized_ring_allreduce_time(world, b, coeffs, wire_dtype)
+        for b in payloads
+    )
+
+
+def _serial_pipeline(
+    ready: Sequence[float], costs: Sequence[float]
+) -> float:
+    """Makespan of transfers released at ``ready[i]`` onto one serial wire
+    (single-port: a rank drives one collective at a time, the SCCL/TACCL
+    assumption the replay shares)."""
+    t = 0.0
+    for r, c in zip(ready, costs):
+        t = max(t, r) + c
+    return t
+
+
+def overlapped_step_time(
+    world: int,
+    grad_bytes: float,
+    coeffs: LinkCoeffs,
+    compute_s: float,
+    accum: int = 1,
+    overlap: str = "off",
+    bucket_bytes: Optional[Sequence[float]] = None,
+    wire_dtype: str = "off",
+) -> Dict[str, float]:
+    """Analytical step time under one overlap schedule (docs/OVERLAP.md):
+    ``max(compute, comm)`` steady state plus the exposed fill/drain
+    fractions, on the bottleneck ring link
+    (:func:`bottleneck_ring_coeffs` — one pacing rule with every other
+    ring-shaped pricing and the tuner's prior).
+
+    - ``"off"``: one sync of the accumulated gradient after all compute —
+      every comm second exposed (the baseline this PR removes).
+    - ``"bucket"``: the accumulated gradient's buckets release uniformly
+      across the *final* microbatch's backward (earlier microbatches only
+      produce partial sums) and drain as independent rolling collectives;
+      exposed time collapses toward the last bucket's drain as compute
+      grows.
+    - ``"microbatch"``: every microbatch's full-size delta syncs behind the
+      next microbatch's compute; total wire volume is ``accum×`` the
+      gradient, with only the final delta's drain necessarily exposed —
+      the bytes-for-overlap trade the measured tuner arbitrates.
+
+    Returns ``{step_time_s, compute_s, comm_s, exposed_comm_s, fill_s,
+    drain_s}``; ``comm_s`` is total wire-busy time, ``exposed_comm_s`` is
+    ``step_time_s - compute_s`` (never negative).  Deterministic — the
+    overlap sweep's byte-stability rides on it.
+    """
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    if accum < 1:
+        raise ValueError(f"accum must be >= 1, got {accum}")
+    if compute_s < 0:
+        raise ValueError(f"compute_s must be >= 0, got {compute_s}")
+    if overlap not in OVERLAP_MODE_CANDIDATES:
+        raise ValueError(
+            f"overlap={overlap!r}: expected one of {OVERLAP_MODE_CANDIDATES}"
+        )
+    sync = _bucket_comm_times(world, grad_bytes, coeffs, bucket_bytes, wire_dtype)
+    sync_total = sum(sync)
+    compute_s = float(compute_s)
+    if overlap == "off":
+        comm = sync_total
+        step = compute_s + comm
+        fill, drain = 0.0, comm
+    elif overlap == "bucket":
+        comm = sync_total
+        n = len(sync)
+        # buckets finalize only during the last microbatch's backward: the
+        # overlap window is that microbatch's compute slice
+        window = compute_s / accum
+        start = compute_s - window
+        ready = [start + window * (i + 1) / n for i in range(n)]
+        step = max(compute_s, _serial_pipeline(ready, sync))
+        fill, drain = window / n, sync[-1]
+    else:  # microbatch
+        comm = sync_total * accum
+        c = compute_s / accum
+        # microbatch i's buckets release at the end of its compute and
+        # overlap microbatch i+1 .. accum-1; the last delta only drains
+        ready = [c * (i + 1) for i in range(accum) for _ in sync]
+        costs = list(sync) * accum
+        step = max(compute_s, _serial_pipeline(ready, costs))
+        fill, drain = c, sync_total
+    return {
+        "step_time_s": step,
+        "compute_s": compute_s,
+        "comm_s": comm,
+        "exposed_comm_s": max(0.0, step - compute_s),
+        "fill_s": fill,
+        "drain_s": drain,
+    }
+
+
+def exposed_comm_floor_s(
+    world: int,
+    grad_bytes: float,
+    coeffs: LinkCoeffs,
+    overlap: str = "off",
+    bucket_bytes: Optional[Sequence[float]] = None,
+    wire_dtype: str = "off",
+) -> float:
+    """The irreducible exposed communication of one step under a schedule —
+    the ``compute → ∞`` limit of :func:`overlapped_step_time` (everything
+    the pipeline could hide is hidden; only the drain remains).  This is
+    the compute-independent number the dispatch trace records as
+    ``exposed_comm_s`` next to the bucket plan: ``"off"`` exposes the whole
+    sync, ``"bucket"`` only the last bucket's collective, ``"microbatch"``
+    the final delta's full sync (its deltas are gradient-sized)."""
+    if overlap not in OVERLAP_MODE_CANDIDATES:
+        raise ValueError(
+            f"overlap={overlap!r}: expected one of {OVERLAP_MODE_CANDIDATES}"
+        )
+    sync = _bucket_comm_times(world, grad_bytes, coeffs, bucket_bytes, wire_dtype)
+    if overlap == "off":
+        return sum(sync)
+    if overlap == "bucket":
+        return sync[-1]
+    return sum(sync)  # microbatch: the drain is one delta's full sync
+
+
 def ring_allreduce_time(
     world: int, nbytes: float, coeffs: LinkCoeffs, chunks: int = 1
 ) -> float:

@@ -151,13 +151,16 @@ class CollectiveTuner:
         nbytes: int,
         dtype: str = "float32",
         wire_dtypes: Optional[Sequence[str]] = None,
+        overlap_modes: Optional[Sequence[str]] = None,
     ) -> TunedPlan:
         """Commit a plan for one dispatch (policy rules; see
         :class:`adapcc_tpu.tuner.policy.TuningPolicy`).  ``wire_dtypes``
         narrows the codec axis for configurations that cannot legally run
-        every codec."""
+        every codec; ``overlap_modes`` narrows the ddp_step overlap axis
+        the same way."""
         return self.policy.choose(
-            primitive, max(1, int(nbytes)), dtype, wire_dtypes
+            primitive, max(1, int(nbytes)), dtype, wire_dtypes,
+            overlap_modes,
         )
 
     def observe_dispatch(

@@ -56,8 +56,45 @@ NO_CHUNK = 0
 #: the quantized ppermute ring (wire_dtype != "off") — one cell per codec
 QUANT_PATH = "quant-ring"
 
-#: gradient-hook dispatches (DDPTrainer --tune): codec is the only knob
+#: gradient-hook dispatches (DDPTrainer --tune): knobs are the wire codec
+#: and the overlap schedule (encoded in the key's path slot, see
+#: :func:`hook_path` — the persistent schema stays untouched)
 HOOK_PATH = "hook"
+
+#: overlap schedules a ddp_step cell can carry; mirrors
+#: ``adapcc_tpu.ddp.overlap.OVERLAP_MODES`` (drift pinned by a test — a
+#: module-level import would couple the tuner's import graph to the DDP
+#: package for three strings)
+HOOK_OVERLAP_MODES = ("off", "bucket", "microbatch")
+
+
+def hook_path(overlap: str = "off") -> str:
+    """The ``TuningKey.path`` spelling of a ddp_step cell's overlap
+    schedule: ``"hook"`` for the baseline (unchanged from the pre-overlap
+    schema, so existing databases keep their samples), ``"hook-<mode>"``
+    for an overlapped schedule."""
+    if overlap not in HOOK_OVERLAP_MODES:
+        raise ValueError(
+            f"overlap={overlap!r}: expected one of {HOOK_OVERLAP_MODES}"
+        )
+    return HOOK_PATH if overlap == "off" else f"{HOOK_PATH}-{overlap}"
+
+
+def hook_overlap_of(path: str) -> str:
+    """Inverse of :func:`hook_path`; loud on a non-hook path."""
+    if path == HOOK_PATH:
+        return "off"
+    prefix = HOOK_PATH + "-"
+    if path.startswith(prefix) and path[len(prefix):] in HOOK_OVERLAP_MODES:
+        return path[len(prefix):]
+    raise ValueError(
+        f"path={path!r} is not a ddp_step hook cell (expected "
+        f"{HOOK_PATH!r} or {prefix}<{'|'.join(HOOK_OVERLAP_MODES[1:])}>)"
+    )
+
+
+def _is_hook_path(path: str) -> bool:
+    return path == HOOK_PATH or path.startswith(HOOK_PATH + "-")
 
 
 @dataclass(frozen=True)
@@ -163,32 +200,43 @@ class TuningPolicy:
         nbytes: int,
         dtype: str = "float32",
         wire_dtypes: Optional[Sequence[str]] = None,
+        overlap_modes: Optional[Sequence[str]] = None,
     ) -> List[TuningKey]:
         """The plan cells competing for this dispatch.
 
         Ring primitives cross the chunk grid (``wire_dtype="off"``, path
         from the kernel's own planner so a cell can never claim a path the
         data plane would not run) with one cell per non-"off" codec (the
-        quantized ring has no staging knob).  ``ddp_step`` keeps only the
-        codec axis — the hook's allreduce is not chunk-steered.
+        quantized ring has no staging knob).  ``ddp_step`` carries the
+        codec axis crossed with the overlap-schedule axis
+        (:data:`HOOK_OVERLAP_MODES`, encoded via :func:`hook_path`) — the
+        hook's allreduce is not chunk-steered.
 
         ``wire_dtypes`` narrows the codec axis for this call (default: the
         policy's full registry) — a caller whose configuration cannot
         legally run a codec (error-feedback forbids "off") must exclude it
         here, or the explorer pins on a cell that can never accrue samples.
+        ``overlap_modes`` narrows the ddp_step overlap axis the same way
+        (a trainer without gradient accumulation cannot compile the
+        microbatch pipeline).
         """
         if wire_dtypes is None:
             wire_dtypes = self.wire_dtypes
         bucket = size_bucket(nbytes)
         cells: List[TuningKey] = []
         if primitive == "ddp_step":
-            for wd in wire_dtypes:
-                cells.append(
-                    TuningKey(
-                        primitive, bucket, self.world, self.topology,
-                        HOOK_PATH, NO_CHUNK, wd,
+            modes = (
+                HOOK_OVERLAP_MODES if overlap_modes is None
+                else tuple(overlap_modes)
+            )
+            for overlap in modes:
+                for wd in wire_dtypes:
+                    cells.append(
+                        TuningKey(
+                            primitive, bucket, self.world, self.topology,
+                            hook_path(overlap), NO_CHUNK, wd,
+                        )
                     )
-                )
             return cells
         from adapcc_tpu.comm.pallas_ring import plan_ring_schedule
 
@@ -270,13 +318,19 @@ class TuningPolicy:
         model = self._model()
         world = max(2, self.world)
         coeffs = bottleneck_ring_coeffs(model, world)
-        if key.wire_dtype != "off":
+        if _is_hook_path(key.path):
+            # hook cells: the comm term only (the step's compute is shared
+            # across every cell, so it cancels in the ranking).  Overlap
+            # variants price identically to their codec's baseline cell on
+            # purpose: "off" wins the tie by candidate order, so an overlap
+            # schedule is adopted ONLY when measured step medians beat the
+            # incumbent — never from the model alone (docs/OVERLAP.md §4)
             return quantized_ring_allreduce_time(
                 world, float(nbytes), coeffs, key.wire_dtype
             )
-        if key.path == HOOK_PATH:
+        if key.wire_dtype != "off":
             return quantized_ring_allreduce_time(
-                world, float(nbytes), coeffs, "off"
+                world, float(nbytes), coeffs, key.wire_dtype
             )
         from adapcc_tpu.comm.pallas_ring import plan_ring_schedule
 
@@ -335,11 +389,15 @@ class TuningPolicy:
         nbytes: int,
         dtype: str = "float32",
         wire_dtypes: Optional[Sequence[str]] = None,
+        overlap_modes: Optional[Sequence[str]] = None,
     ) -> TunedPlan:
         """Commit a plan cell for one dispatch (see module docstring).
 
-        ``wire_dtypes`` narrows the codec axis (see :meth:`candidates`)."""
-        cells = self.candidates(primitive, nbytes, dtype, wire_dtypes)
+        ``wire_dtypes`` narrows the codec axis, ``overlap_modes`` the
+        ddp_step overlap axis (see :meth:`candidates`)."""
+        cells = self.candidates(
+            primitive, nbytes, dtype, wire_dtypes, overlap_modes
+        )
         if not cells:
             raise ValueError(
                 f"no candidate cells for primitive={primitive!r} "

@@ -107,6 +107,20 @@ def build_parser() -> argparse.ArgumentParser:
         "whatever the tuner picks (docs/TUNER.md)",
     )
     p.add_argument(
+        "--overlap", choices=["off", "microbatch", "bucket"], default="off",
+        help="overlapped gradient sync (docs/OVERLAP.md): bucket = "
+        "per-bucket rolling collectives honoring the plan's chunk_bytes "
+        "(bitwise-identical gradients); microbatch = pipeline each "
+        "microbatch delta's allreduce behind the next microbatch's "
+        "compute (needs --accum >= 2, --dp-mode ddp).  ADAPCC_OVERLAP "
+        "overrides for sweeps (malformed value -> loud error)",
+    )
+    p.add_argument(
+        "--accum", type=int, default=1,
+        help="gradient accumulation microbatches per step (ddp mode; the "
+        "axis the microbatch overlap schedule pipelines over)",
+    )
+    p.add_argument(
         "--sync-mode", choices=["auto", "psum", "schedule"], default="auto",
         help="gradient-sync data plane: psum = masked XLA collective per "
         "leaf; schedule = bucketed strategy-tree allreduce (multi-tree "
@@ -221,6 +235,29 @@ def main(argv=None) -> None:
             "--tune requires --dp-mode ddp or zero1: fsdp syncs via GSPMD "
             "and exposes none of the tuner's knobs (chunk/codec)"
         )
+    # the overlap schedule actually in force (ADAPCC_OVERLAP wins over the
+    # flag; malformed env -> loud error before any engine side effects)
+    from adapcc_tpu.ddp import resolve_overlap_mode
+
+    overlap = resolve_overlap_mode(args.overlap)
+    if args.dp_mode == "fsdp" and overlap != "off":
+        raise ValueError(
+            "--overlap requires --dp-mode ddp or zero1: fsdp's collectives "
+            "are GSPMD-inserted and expose no overlap schedule"
+        )
+    if args.dp_mode == "zero1" and overlap == "microbatch":
+        raise ValueError(
+            "--overlap microbatch requires --dp-mode ddp: the pipeline "
+            "rides the DDP trainer's accumulation scan (zero1 supports "
+            "--overlap bucket — chunked reduce-scatter/all-gather)"
+        )
+    if args.accum < 1:
+        raise ValueError(f"--accum must be >= 1, got {args.accum}")
+    if args.accum > 1 and args.dp_mode != "ddp":
+        raise ValueError(
+            "--accum requires --dp-mode ddp (gradient accumulation rides "
+            "the DDP trainer's compiled scan)"
+        )
     # join the multi-host world if the launcher set the coordinator env
     from adapcc_tpu.launch import maybe_initialize_distributed
 
@@ -275,6 +312,8 @@ def main(argv=None) -> None:
             ring_chunk_bytes=args.ring_chunk_bytes or None,
             wire_dtype=wire_dtype,
             tuner=z_tuner,
+            # env-resolved above; the Pallas ring keeps one chunking plane
+            overlap="off" if args.zero1_ring else overlap,
         )
         master, z_state = z_opt.init(params)
         if z_opt.tuned_plan is not None:
@@ -323,6 +362,8 @@ def main(argv=None) -> None:
             grad_compress=wire_dtype,
             error_feedback=args.error_feedback,
             tune=args.tune,
+            accum_steps=args.accum,
+            overlap=overlap,
             # loop-owned state: see train_gpt2 donation note
             donate_state=True,
         )

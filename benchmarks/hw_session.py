@@ -163,12 +163,17 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
       the artifact holds both the measured cells and what the policy
       settled on (the hardware twin of ``make tune-bench``).  Allreduce
       only: it is the one primitive the tuner steers.
+    - ``overlap_ab`` — the overlapped gradient sync A/B on a real DDP
+      step (the hardware twin of ``make overlap-bench``): the same
+      train_ddp workload under overlap off / bucket / microbatch, walltime
+      per schedule in the artifact (docs/OVERLAP.md).  Needs real
+      multi-chip comm or the "overlap" measures only dispatch noise.
     """
     gate = f"world={world} (needs multi-chip ICI)"
     if world < 2:
         for name in (
             "busbw_ici_128m", "ring_smoke", "ring_chunk_sweep",
-            "busbw_wire_dtype", "tuner_convergence",
+            "busbw_wire_dtype", "tuner_convergence", "overlap_ab",
         ):
             _skip(name, gate, out_path)
         return
@@ -227,6 +232,20 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
         extra_env={"ADAPCC_TUNER": "choose", "ADAPCC_TUNER_DB": db_path},
         rec_extra={"tuner": "choose", "tuner_db": db_path},
     )
+    # overlapped-sync A/B: one real DDP workload per overlap schedule,
+    # identical flags otherwise (accum=2 so the microbatch pipeline has a
+    # later microbatch to hide behind).  The phase walltime per schedule is
+    # the measurement; gradients are parity-pinned by the tier-1 tests, so
+    # a schedule can only move time, not results
+    for overlap in ("off", "bucket", "microbatch"):
+        _run(
+            "overlap_ab",
+            [py, "-m", "adapcc_tpu.workloads.train_ddp", "--model", "mlp",
+             "--steps", "12", "--batch", "64", "--accum", "2",
+             "--overlap", overlap, "--world", str(world)],
+            900, out_path,
+            rec_extra={"overlap": overlap, "accum": 2},
+        )
 
 
 def run_simulated_fallback(py: str, out_path: str, world: int = 8) -> dict:

@@ -354,6 +354,92 @@ def wire_dtype_sweep(
     return rows
 
 
+def overlap_sweep(
+    world: int,
+    sizes: Sequence[int],
+    accums: Sequence[int] = (1, 2, 4),
+    bucket_caps_mb: Sequence[float] = (1.0, 4.0),
+    compute_ratios: Sequence[float] = (0.25, 4.0),
+    model: Optional[LinkCostModel] = None,
+) -> List[dict]:
+    """Predicted overlapped-step rows over (accum × bucket cap × overlap
+    schedule) — the hardware-free regression artifact for the overlapped
+    gradient sync (``make overlap-bench``, docs/OVERLAP.md §4).
+
+    Each row prices one DDP step with :func:`adapcc_tpu.sim.cost_model.
+    overlapped_step_time` on the bottleneck ring link (the pacing rule
+    every other ring-shaped pricing shares).  The gradient is ``size``
+    bytes split into equal buckets of at most ``bucket_cap_mb`` (the
+    leaf-free proxy for ``build_bucket_plan``'s greedy fill); the step's
+    compute is ``compute_ratio ×`` the baseline sync time, so the grid
+    covers both the comm-bound (``ratio < 1``) and compute-bound regimes.
+    For every comm-bound configuration the ``"bucket"`` schedule's
+    ``exposed_comm_us`` is strictly below the ``"off"`` baseline's — the
+    property the regression test pins.  Deterministic: same calibration →
+    byte-identical rows.
+    """
+    from adapcc_tpu.sim.cost_model import (
+        OVERLAP_MODE_CANDIDATES,
+        bottleneck_ring_coeffs,
+        overlapped_step_time,
+    )
+
+    if model is None:
+        model = load_or_default(world=world)
+    elif model.world != world:
+        raise ValueError(f"model world {model.world} != sweep world {world}")
+    coeffs = bottleneck_ring_coeffs(model, world)
+    rows: List[dict] = []
+    for nbytes in sizes:
+        for cap_mb in bucket_caps_mb:
+            cap = max(1, int(cap_mb * 1024 * 1024))
+            n_buckets = max(1, -(-int(nbytes) // cap))
+            bucket_bytes = [nbytes / n_buckets] * n_buckets
+            baseline = overlapped_step_time(
+                world, nbytes, coeffs, 0.0, overlap="off",
+                bucket_bytes=bucket_bytes,
+            )["comm_s"]
+            for accum in accums:
+                for ratio in compute_ratios:
+                    compute_s = ratio * baseline
+                    for mode in OVERLAP_MODE_CANDIDATES:
+                        if mode == "microbatch" and accum < 2:
+                            continue  # no pipeline with one microbatch
+                        r = overlapped_step_time(
+                            world, nbytes, coeffs, compute_s,
+                            accum=accum, overlap=mode,
+                            bucket_bytes=bucket_bytes,
+                        )
+                        rows.append({
+                            "mode": "simulated",
+                            "collective": "ddp_step",
+                            "impl": "overlap",
+                            "world": world,
+                            "size_bytes": int(nbytes),
+                            "accum": int(accum),
+                            "bucket_cap_mb": float(cap_mb),
+                            "n_buckets": n_buckets,
+                            "compute_ratio": float(ratio),
+                            "comm_bound": ratio < 1.0,
+                            "overlap": mode,
+                            "pred_step_us": round(r["step_time_s"] * 1e6, 3),
+                            "compute_us": round(r["compute_s"] * 1e6, 3),
+                            "comm_us": round(r["comm_s"] * 1e6, 3),
+                            "exposed_comm_us": round(
+                                r["exposed_comm_s"] * 1e6, 3
+                            ),
+                            "fill_us": round(r["fill_s"] * 1e6, 3),
+                            "drain_us": round(r["drain_s"] * 1e6, 3),
+                            "calibration": model.source,
+                        })
+    if not rows:
+        raise ValueError(
+            f"overlap sweep produced no rows: sizes={list(sizes)} "
+            f"accums={list(accums)} caps={list(bucket_caps_mb)}"
+        )
+    return rows
+
+
 def tune_replay_sweep(
     world: int,
     sizes: Sequence[int],
@@ -488,6 +574,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "the strategy grid: one row per cell with the chosen plan flagged "
         "per size (make tune-bench; docs/TUNER.md)",
     )
+    ap.add_argument(
+        "--overlap-sweep", action="store_true",
+        help="price the overlapped DDP gradient sync over (accum x "
+        "bucket cap x overlap schedule) with overlapped_step_time instead "
+        "of the strategy grid (make overlap-bench; docs/OVERLAP.md)",
+    )
+    ap.add_argument(
+        "--accums", default="1,2,4",
+        help="overlap-sweep gradient-accumulation grid",
+    )
+    ap.add_argument(
+        "--bucket-caps-mb", default="1,4",
+        help="overlap-sweep bucket cap grid (MB)",
+    )
     ap.add_argument("--json", action="store_true", help="one JSON row per line")
     args = ap.parse_args(argv)
 
@@ -496,6 +596,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--wire-dtype", bool(args.wire_dtype)),
             ("--ring-sweep", args.ring_sweep),
             ("--tune-replay", args.tune_replay),
+            ("--overlap-sweep", args.overlap_sweep),
         ) if on
     ]
     if len(exclusive) > 1:
@@ -504,6 +605,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ap.error(f"{' and '.join(exclusive)} are mutually exclusive; "
                  "run one sweep per invocation")
     model = load_or_default(args.calibration, world=args.world)
+    if args.overlap_sweep:
+        rows = overlap_sweep(
+            world=args.world,
+            sizes=[parse_size(s) for s in args.sizes.split(",")],
+            accums=[int(a) for a in args.accums.split(",") if a],
+            bucket_caps_mb=[
+                float(c) for c in args.bucket_caps_mb.split(",") if c
+            ],
+            model=model,
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            else:
+                print(
+                    f"[sim] overlap {row['size_bytes']:>12}B "
+                    f"accum={row['accum']} cap={row['bucket_cap_mb']:>5}MB "
+                    f"ratio={row['compute_ratio']:>5} "
+                    f"{row['overlap']:<10} "
+                    f"step={row['pred_step_us']:>10.1f}us  "
+                    f"exposed={row['exposed_comm_us']:>10.1f}us"
+                )
+        return 0
     if args.tune_replay:
         rows = tune_replay_sweep(
             world=args.world,

@@ -1,9 +1,9 @@
 """The docs' code blocks execute — documentation that cannot drift.
 
 Every ```python block in docs/PARALLELISM.md, docs/OPERATIONS.md,
-docs/SIMULATION.md, docs/RING.md, docs/QUANT.md and docs/TUNER.md runs
-verbatim on the virtual pod.  A snippet that stops compiling or produces
-wrong shapes fails here.
+docs/SIMULATION.md, docs/RING.md, docs/QUANT.md, docs/TUNER.md and
+docs/OVERLAP.md runs verbatim on the virtual pod.  A snippet that stops
+compiling or produces wrong shapes fails here.
 """
 
 import os
@@ -20,6 +20,7 @@ _SIMULATION = os.path.join(_DOCS_DIR, "SIMULATION.md")
 _RING = os.path.join(_DOCS_DIR, "RING.md")
 _QUANT = os.path.join(_DOCS_DIR, "QUANT.md")
 _TUNER = os.path.join(_DOCS_DIR, "TUNER.md")
+_OVERLAP = os.path.join(_DOCS_DIR, "OVERLAP.md")
 
 
 def _blocks(path):
@@ -143,3 +144,25 @@ def test_tuner_doc_covers_the_contract():
 def test_tuner_doc_snippet_runs(idx):
     code = _blocks(_TUNER)[idx]
     exec(compile(code, f"{_TUNER}:block{idx}", "exec"), {})
+
+
+def test_overlap_doc_has_snippets():
+    assert len(_blocks(_OVERLAP)) >= 4
+
+
+def test_overlap_doc_covers_the_contract():
+    """The overlapped-sync topics the tuning runbook leans on must exist."""
+    text = open(_OVERLAP).read()
+    for needle in (
+        "ADAPCC_OVERLAP", "microbatch", "bucket", "chunk_bytes",
+        "overlapped_step_time", "exposed_comm_s", "make overlap-bench",
+        "bitwise", "error_feedback", "hook-bucket", "Zero1Optimizer",
+        "MetricsRegistry",
+    ):
+        assert needle in text, f"OVERLAP.md lost its {needle!r} coverage"
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_OVERLAP))))
+def test_overlap_doc_snippet_runs(idx):
+    code = _blocks(_OVERLAP)[idx]
+    exec(compile(code, f"{_OVERLAP}:block{idx}", "exec"), {})
