@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench ring-sweep quant-bench tune-bench overlap-bench trace-export clean
+.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench trace-export clean
 
 all: native
 
@@ -40,6 +40,15 @@ ring-sweep:
 quant-bench:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--world 8 --sizes 1M,16M,128M --wire-dtype off,bf16,int8 --json
+
+# Fused-vs-unfused codec sweep for the quantized STREAMING ring on the
+# same simulator (docs/RING.md §5): deterministic "mode": "simulated"
+# rows over (size x wire_dtype x chunk_bytes) comparing the fused staged
+# kernel's overlapped pricing against the ppermute reroute's serial
+# pricing, with the crossover size flagged per row.
+fused-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--world 8 --sizes 1M,16M,128M --fused-sweep --chunks 256K,1M,4M --json
 
 # Autotuner convergence replay on a deterministic synthetic cost surface
 # (docs/TUNER.md): "mode": "simulated" rows over the (chunk x codec) grid

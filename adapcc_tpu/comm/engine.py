@@ -961,15 +961,22 @@ class CollectiveEngine:
         return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
     def _ring_plan(
-        self, stacked: jnp.ndarray, chunk_bytes: Optional[int], rs: bool, ag: bool
+        self,
+        stacked: jnp.ndarray,
+        chunk_bytes: Optional[int],
+        rs: bool,
+        ag: bool,
+        wire_dtype: str = "off",
+        block_size: Optional[int] = None,
     ):
         """The executed ring schedule for a stacked call: the synthesized
         ``Strategy.chunk_bytes`` is the default granularity, an explicit
         argument overrides it, and the ``ADAPCC_RING_CHUNK_BYTES`` sweep env
         (resolved inside the planner) overrides both.  The plan decides the
-        VMEM vs HBM-streaming path and is recorded into the dispatch trace —
-        the chunk size a ring collective ran at is an artifact, not a
-        guess."""
+        VMEM vs HBM-streaming path (and the fused wire geometry when a
+        codec is on) and is recorded into the dispatch trace — the chunk
+        size and wire dtype a ring collective ran at are an artifact, not
+        a guess."""
         from adapcc_tpu.comm.pallas_ring import plan_ring_schedule
 
         per_rank = int(np.prod(stacked.shape[1:]))
@@ -983,25 +990,43 @@ class CollectiveEngine:
             chunk_bytes if chunk_bytes is not None else self.strategy.chunk_bytes,
             rs=rs,
             ag=ag,
+            wire_dtype=wire_dtype,
+            block_size=block_size,
         )
 
     @staticmethod
     def _ring_extras(plan) -> Dict[str, Any]:
         """Trace payload for a Pallas-ring dispatch — ONE definition shared
         by allreduce/RS/AG so the three primitives' artifacts cannot
-        drift."""
-        return {
+        drift.  ``wire_dtype`` is the EXECUTED codec (from the plan), never
+        a hard-coded constant; ``wire_bytes`` is what the per-rank payload
+        actually costs on the fabric under it."""
+        extras = {
             "chunk_bytes": plan.chunk_bytes,
             "stage_bytes": plan.stage_bytes,
             "n_tiles": plan.n_tiles,
-            "wire_dtype": "off",  # pallas kernels ship the payload dtype
+            "wire_dtype": plan.wire_dtype,
         }
+        if plan.wire_dtype == "off":
+            extras["wire_bytes"] = plan.payload_bytes
+        else:
+            from adapcc_tpu.sim.cost_model import wire_bytes_per_element
+
+            extras["wire_bytes"] = int(
+                (plan.payload_bytes / 4.0)
+                * wire_bytes_per_element(plan.wire_dtype, plan.block_size or 1)
+            )
+            extras["block_size"] = plan.block_size
+            extras["scale_slot_bytes"] = plan.scale_slot_bytes
+            extras["fused"] = True
+        return extras
 
     def _record_ring(self, primitive: str, plan, stacked: jnp.ndarray) -> None:
         if self.trace is not None:
+            suffix = "" if plan.wire_dtype == "off" else f"+{plan.wire_dtype}"
             self.trace.record(
                 primitive,
-                f"pallas_ring[{plan.path}]",
+                f"pallas_ring[{plan.path}{suffix}]",
                 int(stacked.nbytes),
                 **self._ring_extras(plan),
             )
@@ -1092,6 +1117,7 @@ class CollectiveEngine:
         per_rank_bytes = int(np.prod(stacked.shape[1:])) * stacked.dtype.itemsize
         tuner = self.tuner
         tplan = None
+        tuner_chose_quant = False
         if tuner is not None and tuner.choosing:
             tplan = tuner.choose(
                 "allreduce", per_rank_bytes, stacked.dtype.name
@@ -1101,19 +1127,75 @@ class CollectiveEngine:
             # resolve_wire_dtype) still win over everything
             if wire_dtype is None:
                 wire_dtype = tplan.wire_dtype
+                # a codec cell names its PATH too: the unfused quant-ring
+                # cell must actually run unfused, or the fused-vs-unfused
+                # A/B can never measure its second arm
+                tuner_chose_quant = (
+                    tplan.wire_dtype != "off" and tplan.key.path == QUANT_PATH
+                )
             if chunk_bytes is None and tplan.chunk_bytes is not None:
                 chunk_bytes = tplan.chunk_bytes
         wd = self._resolved_wire_dtype(wire_dtype)
         timing = tuner is not None and tuner.recording
         t0 = time.perf_counter()
         if wd != "off":
+            from adapcc_tpu.comm.pallas_ring import (
+                fused_ring_dispatch_reason,
+                note_quant_reroute,
+                resolve_fused_wire,
+            )
             from adapcc_tpu.quant import DEFAULT_BLOCK_SIZE
 
-            out, cache_key, extras = self._wire_ring_allreduce(
-                stacked, wd, quant_block_size or DEFAULT_BLOCK_SIZE
+            block = quant_block_size or DEFAULT_BLOCK_SIZE
+            reroute = fused_ring_dispatch_reason(stacked.dtype, wd, block)
+            # ADAPCC_FUSED_WIRE=on outranks the tuner's path cell: "on"
+            # means NOTHING runs unfused here, tuner exploration included
+            chosen_reroute = (
+                reroute is None
+                and tuner_chose_quant
+                and resolve_fused_wire() != "on"
             )
-            impl = f"quant_ring[{wd}]"
-            executed_path, executed_chunk = QUANT_PATH, NO_CHUNK
+            if chosen_reroute:
+                reroute = "tuner chose the unfused quant-ring cell"
+            if reroute is None:
+                # the fused path: codec inside the staged Pallas kernels —
+                # compressed tiles on the wire, fp32 accumulation in VMEM
+                if interpret is None:
+                    interpret = jax.devices()[0].platform != "tpu"
+                world = self.world_size
+                plan = self._ring_plan(
+                    stacked, chunk_bytes, rs=True, ag=True,
+                    wire_dtype=wd, block_size=block,
+                )
+
+                def per_shard(x):  # x: [1, *payload]
+                    return ring_allreduce_shard(
+                        x[0], world, self.axis_name, interpret=interpret,
+                        chunk_bytes=plan.chunk_bytes,
+                        wire_dtype=wd, block_size=block,
+                    )[None]
+
+                cache_key = (
+                    "ring_allreduce", stacked.shape, stacked.dtype.name,
+                    bool(interpret), plan.path, plan.stage_bytes, wd, block,
+                )
+                out = self._shard_mapped(cache_key, per_shard, 1)(stacked)
+                impl = f"pallas_ring[{plan.path}+{wd}]"
+                executed_path, executed_chunk = plan.path, plan.chunk_bytes
+                extras = self._ring_extras(plan)
+            else:
+                # the staged kernel was abandoned for this dispatch — say so
+                # once, loudly, and record the executed impl honestly (a
+                # tuner-chosen unfused cell is a deliberate A/B arm, not an
+                # abandonment — no note for it)
+                if not chosen_reroute:
+                    note_quant_reroute(wd, reroute)
+                out, cache_key, extras = self._wire_ring_allreduce(
+                    stacked, wd, block
+                )
+                extras["reroute_reason"] = reroute
+                impl = f"quant_ring[{wd}]"
+                executed_path, executed_chunk = QUANT_PATH, NO_CHUNK
         else:
             if interpret is None:
                 interpret = jax.devices()[0].platform != "tpu"
@@ -1154,19 +1236,51 @@ class CollectiveEngine:
                 duration,
             )
         if tplan is not None:
-            applied = wd == tplan.wire_dtype and (
-                tplan.chunk_bytes is None or executed_chunk == tplan.chunk_bytes
+            applied = (
+                wd == tplan.wire_dtype
+                and executed_path == tplan.key.path
+                and (
+                    tplan.chunk_bytes is None
+                    or executed_chunk == tplan.chunk_bytes
+                )
             )
             extras["tuner"] = tplan.trace_extra(applied=applied)
         if self.trace is not None:
             self.trace.record("allreduce", impl, int(stacked.nbytes), **extras)
         return out
 
+    def _ring_wire_args(
+        self, stacked: jnp.ndarray, wire_dtype: Optional[str],
+        quant_block_size: Optional[int], primitive: str,
+    ) -> Tuple[str, Optional[int]]:
+        """Resolve the wire codec for a ring RS/AG dispatch and validate it
+        against the fused kernels — the ONLY data plane those primitives
+        have for a codec, so an unsupported combination rejects loudly
+        instead of silently running fp32 under a codec label."""
+        wd = self._resolved_wire_dtype(wire_dtype)
+        if wd == "off":
+            return wd, None
+        from adapcc_tpu.comm.pallas_ring import fused_ring_dispatch_reason
+        from adapcc_tpu.quant import DEFAULT_BLOCK_SIZE
+
+        block = quant_block_size or DEFAULT_BLOCK_SIZE
+        reason = fused_ring_dispatch_reason(stacked.dtype, wd, block)
+        if reason is not None:
+            raise ValueError(
+                f"{primitive} has no unfused wire data plane "
+                f"(quant/ring.py is allreduce-only): wire_dtype={wd!r} "
+                f"cannot run here — {reason}.  Pin wire_dtype='off' (or "
+                "ADAPCC_WIRE_DTYPE=off) to run the fp32 kernels."
+            )
+        return wd, block
+
     def ring_reduce_scatter(
         self,
         stacked: jnp.ndarray,
         interpret: Optional[bool] = None,
         chunk_bytes: Optional[int] = None,
+        wire_dtype: Optional[str] = None,
+        quant_block_size: Optional[int] = None,
     ) -> jnp.ndarray:
         """Pallas ICI ring reduce-scatter (the RS half of the hand-tuned ring,
         :func:`adapcc_tpu.comm.pallas_ring.ring_reduce_scatter_shard`).
@@ -1177,6 +1291,12 @@ class CollectiveEngine:
         ``(r+1) % world`` on rank ``r``; one static roll restores chunk order
         in the stacked single-controller view so this matches
         :meth:`reduce_scatter`'s row semantics on tile-aligned payloads.
+
+        ``wire_dtype`` (default: the strategy's synthesized codec, under
+        the usual env > arg > strategy precedence) runs the fused codec
+        kernels: hops ship encoded tiles, accumulation stays fp32.  There
+        is no unfused RS codec plane — where the fused path can't run, the
+        dispatch rejects loudly rather than silently running fp32.
         """
         from adapcc_tpu.comm.pallas_ring import ring_reduce_scatter_shard
 
@@ -1186,15 +1306,22 @@ class CollectiveEngine:
                 "ring); two-level worlds use the strategy primitives"
             )
         self._check_world_dim(stacked, "ring_reduce_scatter")
+        wd, block = self._ring_wire_args(
+            stacked, wire_dtype, quant_block_size, "ring_reduce_scatter"
+        )
         if interpret is None:
             interpret = jax.devices()[0].platform != "tpu"
         world = self.world_size
-        plan = self._ring_plan(stacked, chunk_bytes, rs=True, ag=False)
+        plan = self._ring_plan(
+            stacked, chunk_bytes, rs=True, ag=False,
+            wire_dtype=wd, block_size=block,
+        )
 
         def per_shard(x):  # x: [1, *payload]
             out = ring_reduce_scatter_shard(
                 x[0], world, self.axis_name, interpret=interpret,
                 chunk_bytes=plan.chunk_bytes,
+                wire_dtype=wd, block_size=block,
             )
             # relabel to chunk order INSIDE the compiled program: the kernel
             # leaves rank r holding chunk (r+1) % world; one [chunk]-sized
@@ -1207,7 +1334,7 @@ class CollectiveEngine:
 
         key = (
             "ring_rs", stacked.shape, stacked.dtype.name, bool(interpret),
-            plan.path, plan.stage_bytes,
+            plan.path, plan.stage_bytes, wd, block,
         )
         self._record_ring("reduce_scatter", plan, stacked)
         return self._shard_mapped(key, per_shard, 1)(stacked)
@@ -1217,12 +1344,19 @@ class CollectiveEngine:
         stacked: jnp.ndarray,
         interpret: Optional[bool] = None,
         chunk_bytes: Optional[int] = None,
+        wire_dtype: Optional[str] = None,
+        quant_block_size: Optional[int] = None,
     ) -> jnp.ndarray:
         """Pallas ICI ring all-gather (the AG half of the hand-tuned ring).
 
         Input ``[world, chunk]`` (row ``r`` = rank ``r``'s tile-aligned
         payload) → output ``[world, world, chunk]`` — row ``r`` is the full
         gathered stack as seen by rank ``r``, matching :meth:`all_gather`.
+
+        ``wire_dtype`` runs the fused codec kernels: each rank's chunk is
+        encoded ONCE and the encoded bits are forwarded verbatim, so every
+        rank holds identical post-codec values.  No unfused AG codec plane
+        exists — unsupported combinations reject loudly.
         """
         from adapcc_tpu.comm.pallas_ring import ring_all_gather_shard
 
@@ -1232,20 +1366,27 @@ class CollectiveEngine:
                 "ring); two-level worlds use the strategy primitives"
             )
         self._check_world_dim(stacked, "ring_all_gather")
+        wd, block = self._ring_wire_args(
+            stacked, wire_dtype, quant_block_size, "ring_all_gather"
+        )
         if interpret is None:
             interpret = jax.devices()[0].platform != "tpu"
         world = self.world_size
-        plan = self._ring_plan(stacked, chunk_bytes, rs=False, ag=True)
+        plan = self._ring_plan(
+            stacked, chunk_bytes, rs=False, ag=True,
+            wire_dtype=wd, block_size=block,
+        )
 
         def per_shard(x):  # x: [1, chunk]
             return ring_all_gather_shard(
                 x[0], world, self.axis_name, interpret=interpret,
                 chunk_bytes=plan.chunk_bytes,
+                wire_dtype=wd, block_size=block,
             )[None]
 
         key = (
             "ring_ag", stacked.shape, stacked.dtype.name, bool(interpret),
-            plan.path, plan.stage_bytes,
+            plan.path, plan.stage_bytes, wd, block,
         )
         self._record_ring("all_gather", plan, stacked)
         return self._shard_mapped(key, per_shard, 1)(stacked)

@@ -72,11 +72,140 @@ _LANES = 128
 #: over both the caller's value and the strategy's synthesized chunk_bytes
 RING_CHUNK_ENV = "ADAPCC_RING_CHUNK_BYTES"
 
+#: env gate for the fused wire-codec kernels (A/B vs the unfused quantized
+#: ppermute ring): ``auto`` (default) fuses whenever the plan supports it,
+#: ``off`` forces the quant-ring reroute, ``on`` demands the fused path and
+#: fails loudly where it cannot run.  Malformed → loud error (the
+#: ADAPCC_MERGE_ROUNDS policy: a typo must not silently invalidate an A/B).
+FUSED_WIRE_ENV = "ADAPCC_FUSED_WIRE"
+
+FUSED_WIRE_MODES = ("auto", "on", "off")
+
+#: wire dtypes the fused kernels speak, with their wire-array itemsize.
+#: "off" is not fused (the plain kernels ship the payload dtype); other
+#: registry codecs reroute to the unfused quantized ppermute ring.
+_FUSED_WIRE_ITEMSIZE = {"bf16": 2, "int8": 1}
+
 
 def _tile_elems(dtype) -> int:
     itemsize = jnp.dtype(dtype).itemsize
     sublanes = {4: 8, 2: 16, 1: 32}.get(itemsize, 8)
     return _LANES * sublanes
+
+
+def resolve_fused_wire() -> str:
+    """The fused-wire gate in force (``auto`` | ``on`` | ``off``)."""
+    env = os.environ.get(FUSED_WIRE_ENV)
+    if env is None or not env.strip():
+        return "auto"
+    mode = env.strip().lower()
+    if mode not in FUSED_WIRE_MODES:
+        raise ValueError(
+            f"{FUSED_WIRE_ENV}={env!r}: expected one of "
+            f"{'|'.join(FUSED_WIRE_MODES)}"
+        )
+    return mode
+
+
+def fused_wire_unsupported_reason(
+    dtype, wire_dtype: str, block_size: Optional[int] = None
+) -> Optional[str]:
+    """Why the fused codec kernels cannot run this configuration, or None
+    when they can.  The one support funnel the engine, the wrappers, and
+    the tuner's candidate grid all consult — so a candidate cell can never
+    claim a fused path the data plane would not run.
+
+    The codec math is defined on fp32 payloads (quant/codec.py), and the
+    in-kernel block view needs whole 128-lane rows per block nested inside
+    every staging tile: ``block_size`` must be a multiple of 128 whose row
+    count divides the fp32 sublane tile (8 rows) — {128, 256, 512, 1024}.
+    """
+    if wire_dtype == "off":
+        return "wire_dtype=off has no codec to fuse (the plain kernels ship fp32)"
+    if wire_dtype not in _FUSED_WIRE_ITEMSIZE:
+        return (
+            f"wire_dtype={wire_dtype!r} has no fused kernel "
+            f"(fused codecs: {'|'.join(sorted(_FUSED_WIRE_ITEMSIZE))})"
+        )
+    if jnp.dtype(dtype) != jnp.float32:
+        return (
+            f"fused wire codecs are defined on float32 payloads, got "
+            f"{jnp.dtype(dtype).name} (quant/codec.py block semantics)"
+        )
+    if wire_dtype == "int8":
+        if block_size is None:
+            block_size = _default_block_size()
+        rows = block_size // _LANES
+        if block_size % _LANES or rows < 1 or 8 % rows:
+            return (
+                f"int8 block_size={block_size} cannot tile VMEM staging: "
+                f"need a multiple of {_LANES} whose {_LANES}-lane row count "
+                "divides the fp32 sublane tile (8) — one of 128|256|512|1024"
+            )
+    return None
+
+
+def _default_block_size() -> int:
+    from adapcc_tpu.quant.codec import DEFAULT_BLOCK_SIZE
+
+    return DEFAULT_BLOCK_SIZE
+
+
+def fused_ring_dispatch_reason(
+    dtype, wire_dtype: str, block_size: Optional[int] = None
+) -> Optional[str]:
+    """Why a dispatch cannot take the fused wire path HERE (env gate,
+    kernel support, codec geometry) — None when it can.  Under
+    ``ADAPCC_FUSED_WIRE=on`` any reason becomes a loud error instead of a
+    reroute: the operator demanded the fused kernel, a silent fallback
+    would invalidate the A/B."""
+    mode = resolve_fused_wire()
+    if mode == "off":
+        reason: Optional[str] = f"{FUSED_WIRE_ENV}=off pins the unfused path"
+    else:
+        from adapcc_tpu.compat import ring_kernels_supported
+
+        if not ring_kernels_supported():
+            reason = (
+                "ring kernels need a real TPU or the Mosaic TPU interpret "
+                "mode (jax >= 0.5); this build has neither"
+            )
+        else:
+            reason = fused_wire_unsupported_reason(dtype, wire_dtype, block_size)
+    if reason is not None and mode == "on":
+        raise ValueError(
+            f"{FUSED_WIRE_ENV}=on but the fused wire path cannot run: {reason}"
+        )
+    return reason
+
+
+_REROUTE_NOTED: set = set()
+
+
+def note_quant_reroute(wire_dtype: str, reason: str) -> None:
+    """One-time (per process, per reason) stderr note that a codec dispatch
+    abandoned the staged Pallas kernel for the XLA ppermute quant ring —
+    operators reading throughput must know which data plane produced it."""
+    key = (wire_dtype, reason)
+    if key in _REROUTE_NOTED:
+        return
+    _REROUTE_NOTED.add(key)
+    import sys
+
+    print(
+        f"adapcc: wire_dtype={wire_dtype} ring collective rerouted off the "
+        f"staged Pallas kernel onto the unfused ppermute quant ring "
+        f"(impl=quant_ring): {reason}",
+        file=sys.stderr,
+    )
+
+
+def _scale_rows(n_blocks: int) -> int:
+    """Rows of the fp32 scale side-channel tile holding ``n_blocks`` per-
+    block scales: whole 128-lane rows, padded to the fp32 sublane tile so
+    the slot is itself a legal VMEM tile."""
+    rows = -(-n_blocks // _LANES)
+    return -(-rows // 8) * 8
 
 
 def _interpret_params(interpret):
@@ -114,7 +243,8 @@ def resolve_chunk_bytes(chunk_bytes: Optional[int] = None) -> int:
 @dataclass(frozen=True)
 class RingSchedule:
     """The executed ring schedule — the observable contract for traces,
-    benchmarks, and tests: which path ran, at what staging granularity."""
+    benchmarks, and tests: which path ran, at what staging granularity,
+    under which wire codec."""
 
     path: str              #: "vmem" | "hbm-stream"
     world: int
@@ -125,17 +255,43 @@ class RingSchedule:
     payload_bytes: int     #: caller bytes before padding
     padded_bytes: int      #: world × tile-padded chunk bytes
     dtype: str = "float32"
+    #: wire codec fused into the kernels ("off" = the plain fp32 kernels)
+    wire_dtype: str = "off"
+    #: int8 quantization block (elements per fp32 scale); 0 when no blocks
+    block_size: int = 0
+    #: bytes of one staged *wire* tile (what each RDMA actually ships);
+    #: equals ``stage_bytes`` on the unfused path
+    wire_stage_bytes: int = 0
+    #: bytes of one fp32 scale side-channel tile (int8 plans only)
+    scale_slot_bytes: int = 0
+
+    @property
+    def scale_bytes(self) -> int:
+        """Total scale side-channel VMEM the kernel allocates: one send
+        slot + two comm slots, plus (vmem path) the per-chunk scale store
+        the all-gather forwards bits from.  Zero off the int8 path — this
+        is exactly what ``vmem_bound_bytes`` grows by on int8 plans."""
+        if self.scale_slot_bytes == 0:
+            return 0
+        slots = 3 + (self.world if self.path == "vmem" else 0)
+        return slots * self.scale_slot_bytes
 
     @property
     def vmem_bound_bytes(self) -> int:
-        """Peak VMEM the data buffers need: the whole payload three times
-        over (pallas input + output + work scratch) plus 2 comm slots on
-        the vmem path, 4 staging tiles (1 send + 1 accumulate + 2 comm) on
-        the stream path."""
+        """Peak VMEM the data buffers need.  Unfused: the whole payload
+        three times over (pallas input + output + work scratch) plus 2 comm
+        slots on the vmem path, 4 staging tiles (1 send + 1 accumulate +
+        2 comm) on the stream path.  Fused plans stage the *wire* arrays
+        (1 send + 2 comm slots at wire density) next to the fp32 staging,
+        plus the scale side channel (:attr:`scale_bytes`)."""
         chunk = self.padded_bytes // self.world
+        if self.wire_dtype == "off":
+            if self.path == "vmem":
+                return 3 * self.padded_bytes + 2 * chunk
+            return 4 * self.stage_bytes
         if self.path == "vmem":
-            return 3 * self.padded_bytes + 2 * chunk
-        return 4 * self.stage_bytes
+            return 3 * self.padded_bytes + 3 * self.wire_stage_bytes + self.scale_bytes
+        return 2 * self.stage_bytes + 3 * self.wire_stage_bytes + self.scale_bytes
 
     def to_row(self) -> dict:
         return {
@@ -147,6 +303,9 @@ class RingSchedule:
             "world": self.world,
             "payload_bytes": self.payload_bytes,
             "padded_bytes": self.padded_bytes,
+            "wire_dtype": self.wire_dtype,
+            "wire_stage_bytes": self.wire_stage_bytes,
+            "scale_slot_bytes": self.scale_slot_bytes,
         }
 
 
@@ -167,6 +326,16 @@ def _stage_rows_for(chunk_rows: int, sublanes: int, budget_bytes: int, row_bytes
     return -(-k // n) * sublanes
 
 
+def _wire_geometry(stage_rows: int, wire_dtype: str, block_size: int):
+    """(wire_stage_bytes, scale_slot_bytes) for one ``[stage_rows, 128]``
+    fp32 staging tile under a fused codec."""
+    wire_stage = stage_rows * _LANES * _FUSED_WIRE_ITEMSIZE[wire_dtype]
+    if wire_dtype != "int8":
+        return wire_stage, 0
+    n_blocks = stage_rows * _LANES // block_size
+    return wire_stage, _scale_rows(n_blocks) * _LANES * 4
+
+
 def plan_ring_schedule(
     nelems: int,
     dtype,
@@ -174,6 +343,8 @@ def plan_ring_schedule(
     chunk_bytes: Optional[int] = None,
     rs: bool = True,
     ag: bool = True,
+    wire_dtype: str = "off",
+    block_size: Optional[int] = None,
 ) -> RingSchedule:
     """Pure planning: path selection + executed tile size for a ring
     collective over ``nelems`` elements of ``dtype`` (total payload across
@@ -182,10 +353,26 @@ def plan_ring_schedule(
     Selection rule: the **vmem** path runs when the whole padded payload
     fits inside one ``chunk_bytes`` staging budget ("payloads under one
     chunk" — its VMEM need is then bounded by ~3× the budget); anything
-    larger takes the **hbm-stream** path, whose VMEM need is 4 staging
-    tiles regardless of payload size.
+    larger takes the **hbm-stream** path, whose VMEM need is a fixed set of
+    staging tiles regardless of payload size.
+
+    ``wire_dtype`` ≠ "off" plans the fused codec kernels: the staging
+    budget then also covers the fp32 scale vectors an int8 tile carries
+    (the scale side channel), and the plan records the wire/scale slot
+    geometry (:attr:`RingSchedule.wire_stage_bytes` /
+    :attr:`RingSchedule.scale_slot_bytes`) so ``vmem_bound_bytes`` accounts
+    every buffer the fused kernel actually allocates.  The external chunk
+    layout is the payload dtype's on every path and codec — wire density
+    never changes element→chunk assignment, so ZeRO-1 shard layouts are
+    codec-independent.
     """
     dtype = jnp.dtype(dtype)
+    if wire_dtype != "off":
+        if block_size is None:
+            block_size = _default_block_size()
+        reason = fused_wire_unsupported_reason(dtype, wire_dtype, block_size)
+        if reason is not None:
+            raise ValueError(f"cannot plan a fused wire ring: {reason}")
     itemsize = dtype.itemsize
     tile = _tile_elems(dtype)
     sublanes = tile // _LANES
@@ -194,16 +381,33 @@ def plan_ring_schedule(
     padded_bytes = world * chunk * itemsize
     budget = resolve_chunk_bytes(chunk_bytes)
     steps = (world - 1 if rs else 0) + (world - 1 if ag else 0)
+    fused = wire_dtype != "off"
+    blk = int(block_size) if fused and wire_dtype == "int8" else 0
     if world == 1 or padded_bytes <= budget:
+        chunk_rows = chunk // _LANES
+        wire_stage, scale_slot = (
+            _wire_geometry(chunk_rows, wire_dtype, blk) if fused else (0, 0)
+        )
         return RingSchedule(
             path="vmem", world=world, steps=steps, chunk_bytes=budget,
             stage_bytes=chunk * itemsize, n_tiles=1,
             payload_bytes=int(nelems) * itemsize, padded_bytes=padded_bytes,
-            dtype=dtype.name,
+            dtype=dtype.name, wire_dtype=wire_dtype, block_size=blk,
+            wire_stage_bytes=wire_stage, scale_slot_bytes=scale_slot,
         )
     chunk_rows = chunk // _LANES
-    stage_rows = _stage_rows_for(chunk_rows, sublanes, budget, _LANES * itemsize)
+    # the staging budget covers what one tile actually keeps in VMEM: the
+    # payload row plus, on int8 plans, its amortized fp32 scale bytes (one
+    # scale per block_size elements; ceil so block 1024's fraction of a
+    # byte per row still counts) — the wire_dtype-aware tile budget
+    row_bytes = _LANES * itemsize
+    if blk:
+        row_bytes += -(-(_LANES * 4) // blk)
+    stage_rows = _stage_rows_for(chunk_rows, sublanes, budget, row_bytes)
     n_tiles = -(-chunk_rows // stage_rows)
+    wire_stage, scale_slot = (
+        _wire_geometry(stage_rows, wire_dtype, blk) if fused else (0, 0)
+    )
     return RingSchedule(
         path="hbm-stream", world=world, steps=steps, chunk_bytes=budget,
         stage_bytes=stage_rows * _LANES * itemsize,
@@ -212,7 +416,8 @@ def plan_ring_schedule(
         # the kernel's working footprint: each chunk zero-padded to whole
         # staging tiles (the wrappers slice the padding back out)
         padded_bytes=world * n_tiles * stage_rows * _LANES * itemsize,
-        dtype=dtype.name,
+        dtype=dtype.name, wire_dtype=wire_dtype, block_size=blk,
+        wire_stage_bytes=wire_stage, scale_slot_bytes=scale_slot,
     )
 
 
@@ -429,6 +634,434 @@ def _stream_ring_kernel(
 
 
 # --------------------------------------------------------------------------- #
+# fused wire-codec kernels: quantize/dequantize inside the VMEM staging
+# --------------------------------------------------------------------------- #
+#
+# The EQuARX move (PAPERS.md) on the staged pipeline: each staging tile is
+# encoded to the wire dtype *before* its RDMA and decoded+accumulated in
+# fp32 on receive, so codec compute hides behind the RDMA of the
+# neighboring tile and the fabric carries ~4x fewer bytes on the same
+# credit-based flow control.  Bit-contract with the unfused quantized
+# ppermute ring (quant/ring.py):
+#
+# - the block math is quant/codec.py's, verbatim: per-block absmax/127
+#   fp32 scales, deterministic round, clip to [-127, 127].  Blocks nest in
+#   staging tiles (fused_wire_unsupported_reason enforces the geometry),
+#   so tile-wise encoding produces the same bits as chunk-wise encoding;
+# - reduce-scatter dequant-accumulates-requants per hop in fp32 — only the
+#   wire is narrow, the running sum never is;
+# - all-gather encodes each reduced chunk ONCE (at its owner) and forwards
+#   the encoded bits verbatim.  The int8 *codes* are exactly recoverable
+#   by re-quantizing the decoded fp32 values against the original scale
+#   (|q| <= 127 makes round(q*s/s) == q in fp32); the *scale* happens to
+#   re-derive stably too (fl(fl(127*s)/127) == s for 127-quotient scales)
+#   but only as a numerical accident of the quotient form — for raw values
+#   the same expression drifts an ulp ~1% of the time.  So the scales ride
+#   a side-channel store ([world, s_rows, 128] fp32; VMEM scratch on the
+#   vmem path, an HBM side output on the stream path) and are forwarded
+#   bit-verbatim: rank-to-rank bit identity rests on construction, not on
+#   the accident holding for every backend.  Every rank, owner included,
+#   adopts the decoded wire value, so results are bit-identical rank to
+#   rank (and match the unfused ring up to the FP contraction of the
+#   per-hop accumulate — XLA may fuse the dequantize multiply into an FMA
+#   with the add differently across programs, a <= 2-ulp effect; the wire
+#   bits and add order are op-identical).
+
+
+def _wire_scales_of(s_tile: jnp.ndarray, n_blocks: int) -> jnp.ndarray:
+    """[s_rows, 128] scale tile → the [n_blocks] fp32 scale vector."""
+    return s_tile.reshape(-1)[:n_blocks]
+
+
+def _fused_block_scales(vals: jnp.ndarray, rows_per_block: int) -> jnp.ndarray:
+    """Per-block fp32 scales of one ``[R, 128]`` tile — the exact absmax/127
+    derivation of ``quant/codec.quantize_int8``."""
+    n_blocks = vals.shape[0] // rows_per_block
+    blocks = vals.reshape(n_blocks, rows_per_block, _LANES)
+    absmax = jnp.max(jnp.abs(blocks), axis=(1, 2))
+    return jnp.where(absmax > 0, absmax / 127.0, 1.0)
+
+
+def _fused_encode(vals: jnp.ndarray, wire_dtype: str, rows_per_block: int):
+    """Encode one ``[R, 128]`` fp32 tile: returns ``(wire, scales | None)``
+    with the exact ops of ``quant/codec.quantize_int8`` (deterministic
+    rounding) so fused and unfused wire bits can never drift."""
+    if wire_dtype == "bf16":
+        return vals.astype(jnp.bfloat16), None
+    scales = _fused_block_scales(vals, rows_per_block)
+    n_blocks = vals.shape[0] // rows_per_block
+    blocks = vals.reshape(n_blocks, rows_per_block, _LANES)
+    q = jnp.clip(jnp.round(blocks / scales[:, None, None]), -127.0, 127.0)
+    return q.astype(jnp.int8).reshape(vals.shape), scales
+
+
+def _fused_requantize(
+    vals: jnp.ndarray, scales: jnp.ndarray, rows_per_block: int
+) -> jnp.ndarray:
+    """Re-derive the int8 codes of already-decoded values against their
+    original (forwarded) scales — exact: ``round((q·s)/s) == q`` for
+    ``|q| <= 127`` in fp32, so the all-gather forwards bits verbatim
+    without carrying the code arrays through HBM."""
+    n_blocks = vals.shape[0] // rows_per_block
+    blocks = vals.reshape(n_blocks, rows_per_block, _LANES)
+    q = jnp.clip(jnp.round(blocks / scales[:, None, None]), -127.0, 127.0)
+    return q.astype(jnp.int8).reshape(vals.shape)
+
+
+def _fused_decode(
+    wire: jnp.ndarray,
+    scales: Optional[jnp.ndarray],
+    wire_dtype: str,
+    rows_per_block: int,
+) -> jnp.ndarray:
+    """Decode one wire tile back to fp32 (``quant/codec.dequantize_int8``
+    ops, tile-shaped)."""
+    if wire_dtype == "bf16":
+        return wire.astype(jnp.float32)
+    n_blocks = wire.shape[0] // rows_per_block
+    blocks = wire.reshape(n_blocks, rows_per_block, _LANES).astype(jnp.float32)
+    return (blocks * scales[:, None, None]).reshape(wire.shape)
+
+
+def _scales_to_tile(scales: jnp.ndarray, s_rows: int) -> jnp.ndarray:
+    """[n_blocks] scale vector → the [s_rows, 128] side-channel tile
+    (padding scales are 1.0, the all-zero-block convention)."""
+    pad = s_rows * _LANES - scales.shape[0]
+    return jnp.concatenate(
+        [scales, jnp.ones((pad,), jnp.float32)]
+    ).reshape(s_rows, _LANES)
+
+
+def _fused_ring_kernel(
+    x_ref,
+    out_ref,
+    work,
+    wire_send,
+    scale_send,
+    comm_w,
+    comm_s,
+    scale_store,
+    send_w_sem,
+    recv_w_sem,
+    send_s_sem,
+    recv_s_sem,
+    cap_sem,
+    *,
+    world: int,
+    axis_name: str,
+    do_reduce_scatter: bool,
+    do_all_gather: bool,
+    wire_dtype: str,
+    rows_per_block: int,
+    s_rows: int,
+):
+    """VMEM-resident fused ring walk: the ``_ring_kernel`` schedule with
+    the wire codec applied per chunk.  ``wire_send``/``comm_w`` carry the
+    encoded chunk (int8 codes or bf16), ``scale_send``/``comm_s`` the fp32
+    block scales (int8 only), ``scale_store`` the per-chunk scales the
+    all-gather forwards verbatim.  One capacity credit covers both slot
+    arrays — the flow control is the unfused kernel's, unchanged."""
+    my_id = lax.axis_index(axis_name)
+    right = (my_id + 1) % world
+    left = (my_id + world - 1) % world
+    int8 = wire_dtype == "int8"
+    n_blocks = work.shape[1] * _LANES // (rows_per_block * _LANES) if int8 else 0
+
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id=left)
+    pltpu.semaphore_signal(barrier, inc=1, device_id=right)
+    pltpu.semaphore_wait(barrier, 2)
+
+    work[...] = x_ref[...]
+
+    n_rs = world - 1 if do_reduce_scatter else 0
+    n_ag = world - 1 if do_all_gather else 0
+    total_steps = n_rs + n_ag
+
+    for step in range(total_steps):
+        slot = step % 2
+        in_rs = step < n_rs
+        if in_rs:
+            send_idx = (my_id + world - step) % world
+            recv_idx = (my_id + world - step - 1) % world
+        else:
+            ag = step - n_rs
+            own = 1 if do_reduce_scatter else 0
+            send_idx = (my_id + world + own - ag) % world
+            recv_idx = (my_id + world + own - ag - 1) % world
+
+        vals = work[send_idx]
+        if in_rs or step == n_rs:
+            # RS hops re-encode the moving partial; the first AG hop is
+            # the once-per-reduced-chunk encode that defines the bits
+            wire, scales = _fused_encode(vals, wire_dtype, rows_per_block)
+        else:
+            # later AG hops forward verbatim: stored scales, exact codes
+            scales = (
+                _wire_scales_of(scale_store[send_idx], n_blocks)
+                if int8 else None
+            )
+            wire = (
+                _fused_requantize(vals, scales, rows_per_block)
+                if int8 else vals.astype(jnp.bfloat16)
+            )
+        wire_send[...] = wire
+        if int8:
+            scale_send[...] = _scales_to_tile(scales, s_rows)
+        if not in_rs and step == n_rs:
+            # the owner adopts its own DECODED chunk: every rank must see
+            # the same post-codec value, owner included (quant/ring.py)
+            work[send_idx] = _fused_decode(
+                wire, scales, wire_dtype, rows_per_block
+            )
+
+        if step >= 2:
+            pltpu.semaphore_wait(cap_sem, 1)
+
+        rdma_w = pltpu.make_async_remote_copy(
+            src_ref=wire_send,
+            dst_ref=comm_w.at[slot],
+            send_sem=send_w_sem.at[slot],
+            recv_sem=recv_w_sem.at[slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma_w.start()
+        if int8:
+            rdma_s = pltpu.make_async_remote_copy(
+                src_ref=scale_send,
+                dst_ref=comm_s.at[slot],
+                send_sem=send_s_sem.at[slot],
+                recv_sem=recv_s_sem.at[slot],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma_s.start()
+            rdma_s.wait()
+        rdma_w.wait()  # outbound sent AND left neighbor's arrays landed
+
+        landed_scales = (
+            _wire_scales_of(comm_s[slot], n_blocks) if int8 else None
+        )
+        landed = _fused_decode(
+            comm_w[slot], landed_scales, wire_dtype, rows_per_block
+        )
+        if in_rs:
+            work[recv_idx] = work[recv_idx] + landed
+        else:
+            work[recv_idx] = landed
+            if int8:
+                # bank the forwarded-bit scales for the next AG hop
+                scale_store[recv_idx] = comm_s[slot]
+
+        pltpu.semaphore_signal(cap_sem, inc=1, device_id=left)
+
+    tail = min(2, total_steps)
+    for _ in range(tail):
+        pltpu.semaphore_wait(cap_sem, 1)
+    out_ref[...] = work[...]
+
+
+def _fused_stream_ring_kernel(
+    x_ref,
+    out_ref,
+    scales_hbm,
+    send_stage,
+    acc,
+    wire_send,
+    scale_send,
+    comm_w,
+    comm_s,
+    local_sem,
+    send_w_sem,
+    recv_w_sem,
+    send_s_sem,
+    recv_s_sem,
+    cap_sem,
+    *,
+    world: int,
+    axis_name: str,
+    do_reduce_scatter: bool,
+    do_all_gather: bool,
+    n_tiles: int,
+    stage_rows: int,
+    total_iters: int,
+    wire_dtype: str,
+    rows_per_block: int,
+    s_rows: int,
+):
+    """HBM-streaming fused ring walk: ``_stream_ring_kernel``'s grid and
+    credit protocol with the codec in the staging tiles.  Each iteration
+    stages one fp32 tile, encodes it in VMEM (fresh on RS hops and the
+    first AG hop; re-derived against forwarded scales afterwards), ships
+    the wire arrays (codes + scale side channel), and folds the landed
+    tile back into HBM in fp32.  ``scales_hbm`` is the per-chunk scale
+    store ([world, n_tiles·s_rows, 128] fp32, an ANY-space side output)
+    the all-gather forwards bits from."""
+    step = pl.program_id(0)
+    tile = pl.program_id(1)
+    it = step * n_tiles + tile
+    my_id = lax.axis_index(axis_name)
+    right = (my_id + 1) % world
+    left = (my_id + world - 1) % world
+    int8 = wire_dtype == "int8"
+    n_blocks = stage_rows * _LANES // (rows_per_block * _LANES) if int8 else 0
+
+    n_rs = world - 1 if do_reduce_scatter else 0
+
+    @pl.when(it == 0)
+    def _enter():
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right)
+        pltpu.semaphore_wait(barrier, 2)
+        seed = pltpu.make_async_copy(x_ref, out_ref, local_sem)
+        seed.start()
+        seed.wait()
+
+    in_rs = step < n_rs
+    own = 1 if do_reduce_scatter else 0
+    ag = step - n_rs
+    send_idx = jnp.where(
+        in_rs,
+        (my_id + 2 * world - step) % world,
+        (my_id + 2 * world + own - ag) % world,
+    )
+    recv_idx = jnp.where(
+        in_rs,
+        (my_id + 2 * world - step - 1) % world,
+        (my_id + 2 * world + own - ag - 1) % world,
+    )
+    slot = it % 2
+    rows = pl.ds(tile * stage_rows, stage_rows)
+    srows = pl.ds(tile * s_rows, s_rows)
+    # fresh encode on RS hops and the first AG hop (the once-per-reduced-
+    # chunk encode); later AG hops re-derive codes against forwarded scales
+    fresh = jnp.logical_or(in_rs, ag == 0)
+
+    stage_in = pltpu.make_async_copy(
+        out_ref.at[send_idx, rows], send_stage, local_sem
+    )
+    stage_in.start()
+    stage_in.wait()
+
+    if int8:
+
+        @pl.when(jnp.logical_not(fresh))
+        def _load_forwarded_scales():
+            fwd = pltpu.make_async_copy(
+                scales_hbm.at[send_idx, srows], scale_send, local_sem
+            )
+            fwd.start()
+            fwd.wait()
+
+    vals = send_stage[...]
+    if int8:
+
+        @pl.when(fresh)
+        def _derive_fresh_scales():
+            # only fresh hops pay the absmax pass; forwarded hops already
+            # DMA'd the original scale bits into scale_send above
+            scale_send[...] = _scales_to_tile(
+                _fused_block_scales(vals, rows_per_block), s_rows
+            )
+
+        scales = _wire_scales_of(scale_send[...], n_blocks)
+        # one requantize serves both cases: with fresh scales it IS the
+        # encode (same round/clip ops), with forwarded scales it is exact
+        wire_send[...] = _fused_requantize(vals, scales, rows_per_block)
+    else:
+        scales = None
+        wire_send[...] = vals.astype(jnp.bfloat16)
+
+    @pl.when(jnp.logical_and(jnp.logical_not(in_rs), ag == 0))
+    def _adopt_own():
+        # the owner adopts its own decoded tile: every rank must end with
+        # the same post-codec bits, owner included
+        acc[...] = _fused_decode(
+            wire_send[...],
+            _wire_scales_of(scale_send[...], n_blocks) if int8 else None,
+            wire_dtype, rows_per_block,
+        )
+        own_out = pltpu.make_async_copy(
+            acc, out_ref.at[send_idx, rows], local_sem
+        )
+        own_out.start()
+        own_out.wait()
+
+    @pl.when(it >= 2)
+    def _credit_wait():
+        pltpu.semaphore_wait(cap_sem, 1)
+
+    rdma_w = pltpu.make_async_remote_copy(
+        src_ref=wire_send,
+        dst_ref=comm_w.at[slot],
+        send_sem=send_w_sem.at[slot],
+        recv_sem=recv_w_sem.at[slot],
+        device_id=right,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    rdma_w.start()
+    if int8:
+        rdma_s = pltpu.make_async_remote_copy(
+            src_ref=scale_send,
+            dst_ref=comm_s.at[slot],
+            send_sem=send_s_sem.at[slot],
+            recv_sem=recv_s_sem.at[slot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma_s.start()
+        rdma_s.wait()
+    rdma_w.wait()  # outbound sent AND left neighbor's arrays landed
+
+    landed_scales = (
+        _wire_scales_of(comm_s[slot], n_blocks) if int8 else None
+    )
+
+    @pl.when(in_rs)
+    def _reduce():
+        acc_in = pltpu.make_async_copy(
+            out_ref.at[recv_idx, rows], acc, local_sem
+        )
+        acc_in.start()
+        acc_in.wait()
+        acc[...] = acc[...] + _fused_decode(
+            comm_w[slot], landed_scales, wire_dtype, rows_per_block
+        )
+        acc_out = pltpu.make_async_copy(
+            acc, out_ref.at[recv_idx, rows], local_sem
+        )
+        acc_out.start()
+        acc_out.wait()
+
+    @pl.when(jnp.logical_not(in_rs))
+    def _adopt():
+        acc[...] = _fused_decode(
+            comm_w[slot], landed_scales, wire_dtype, rows_per_block
+        )
+        adopt = pltpu.make_async_copy(
+            acc, out_ref.at[recv_idx, rows], local_sem
+        )
+        adopt.start()
+        adopt.wait()
+        if int8:
+            # bank the forwarded-bit scales for the next AG hop
+            bank = pltpu.make_async_copy(
+                comm_s.at[slot], scales_hbm.at[recv_idx, srows], local_sem
+            )
+            bank.start()
+            bank.wait()
+
+    pltpu.semaphore_signal(cap_sem, inc=1, device_id=left)
+
+    @pl.when(it == total_iters - 1)
+    def _drain():
+        for _ in range(min(2, total_iters)):
+            pltpu.semaphore_wait(cap_sem, 1)
+
+
+# --------------------------------------------------------------------------- #
 # shard-level wrappers (call inside shard_map)
 # --------------------------------------------------------------------------- #
 
@@ -455,6 +1088,186 @@ def _check_ring_supported() -> None:
         )
 
 
+def _check_fused_wire(dtype, wire_dtype: str, block_size: Optional[int]) -> None:
+    """Loud reject where fused codec semantics don't apply — running fp32
+    silently under a requested codec would invalidate every wire A/B."""
+    reason = fused_wire_unsupported_reason(dtype, wire_dtype, block_size)
+    if reason is not None:
+        raise ValueError(
+            f"wire_dtype={wire_dtype!r} cannot run on the fused Pallas ring: "
+            f"{reason}"
+        )
+
+
+def _run_fused_ring_chunks(
+    chunks: jnp.ndarray,
+    plan: RingSchedule,
+    *,
+    world,
+    axis_name,
+    rs,
+    ag,
+    interpret,
+    block_size: int,
+):
+    """Dispatch a fused-codec plan on a pre-chunked ``[world, S, 128]``
+    fp32 array (both paths).  The wrappers slice stream-path padding back
+    out, exactly like the unfused dispatch."""
+    wire_dtype = plan.wire_dtype
+    int8 = wire_dtype == "int8"
+    wire_jnp = jnp.int8 if int8 else jnp.bfloat16
+    rows_per_block = (block_size // _LANES) if int8 else 1
+    chunk_rows = chunks.shape[1]
+    if plan.path == "vmem":
+        s_rows = _scale_rows(chunk_rows // rows_per_block) if int8 else 0
+        body = functools.partial(
+            _fused_ring_kernel,
+            world=world,
+            axis_name=axis_name,
+            do_reduce_scatter=rs,
+            do_all_gather=ag,
+            wire_dtype=wire_dtype,
+            rows_per_block=rows_per_block,
+            s_rows=s_rows,
+        )
+        wire_shape = (chunk_rows, _LANES)
+        scale_shape = (s_rows, _LANES)
+        scratch = [
+            pltpu.VMEM(chunks.shape, chunks.dtype),              # work
+            pltpu.VMEM(wire_shape, wire_jnp),                    # wire send
+        ]
+        if int8:
+            scratch.append(pltpu.VMEM(scale_shape, jnp.float32))  # scale send
+        scratch.append(pltpu.VMEM((2,) + wire_shape, wire_jnp))   # comm codes
+        if int8:
+            scratch.extend([
+                pltpu.VMEM((2,) + scale_shape, jnp.float32),      # comm scales
+                pltpu.VMEM((world,) + scale_shape, jnp.float32),  # scale store
+            ])
+        scratch.extend([
+            pltpu.SemaphoreType.DMA((2,)),                        # send codes
+            pltpu.SemaphoreType.DMA((2,)),                        # recv codes
+        ])
+        if int8:
+            scratch.extend([
+                pltpu.SemaphoreType.DMA((2,)),                    # send scales
+                pltpu.SemaphoreType.DMA((2,)),                    # recv scales
+            ])
+        scratch.append(pltpu.SemaphoreType.REGULAR)               # capacity
+
+        if int8:
+            kernel = body
+        else:
+            # bf16 needs no scale side channel: bind the unused refs to
+            # None so the plan's VMEM accounting matches the allocations
+            def kernel(x_ref, out_ref, work, wire_send, comm_w,
+                       send_w, recv_w, cap_sem):
+                return body(
+                    x_ref, out_ref, work, wire_send, None, comm_w, None,
+                    None, send_w, recv_w, None, None, cap_sem,
+                )
+
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(chunks.shape, chunks.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+            scratch_shapes=scratch,
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True, collective_id=0
+            ),
+            interpret=_interpret_params(interpret),
+        )(chunks)
+
+    stage_rows = plan.stage_bytes // (_LANES * jnp.dtype(chunks.dtype).itemsize)
+    s_rows = _scale_rows(stage_rows // rows_per_block) if int8 else 0
+    total_iters = plan.steps * plan.n_tiles
+    padded_rows = plan.n_tiles * stage_rows
+    if padded_rows != chunk_rows:
+        chunks = jnp.pad(chunks, ((0, 0), (0, padded_rows - chunk_rows), (0, 0)))
+    body = functools.partial(
+        _fused_stream_ring_kernel,
+        world=world,
+        axis_name=axis_name,
+        do_reduce_scatter=rs,
+        do_all_gather=ag,
+        n_tiles=plan.n_tiles,
+        stage_rows=stage_rows,
+        total_iters=total_iters,
+        wire_dtype=wire_dtype,
+        rows_per_block=rows_per_block,
+        s_rows=s_rows,
+    )
+    tile_shape = (stage_rows, _LANES)
+    scale_shape = (s_rows, _LANES)
+    payload_shape = jax.ShapeDtypeStruct(chunks.shape, chunks.dtype)
+    scratch = [
+        pltpu.VMEM(tile_shape, chunks.dtype),              # fp32 send staging
+        pltpu.VMEM(tile_shape, chunks.dtype),              # fp32 accumulate
+        pltpu.VMEM(tile_shape, wire_jnp),                  # wire send
+    ]
+    if int8:
+        scratch.append(pltpu.VMEM(scale_shape, jnp.float32))  # scale send
+    scratch.append(pltpu.VMEM((2,) + tile_shape, wire_jnp))   # comm codes
+    if int8:
+        scratch.append(
+            pltpu.VMEM((2,) + scale_shape, jnp.float32)       # comm scales
+        )
+    scratch.extend([
+        pltpu.SemaphoreType.DMA(()),                          # local DMAs
+        pltpu.SemaphoreType.DMA((2,)),                        # send codes
+        pltpu.SemaphoreType.DMA((2,)),                        # recv codes
+    ])
+    if int8:
+        scratch.extend([
+            pltpu.SemaphoreType.DMA((2,)),                    # send scales
+            pltpu.SemaphoreType.DMA((2,)),                    # recv scales
+        ])
+    scratch.append(pltpu.SemaphoreType.REGULAR)               # capacity
+    if int8:
+        kernel = body
+        out_shape = (
+            payload_shape,
+            # per-chunk scale store: the AG's forwarded-bit side channel
+            jax.ShapeDtypeStruct(
+                (world, plan.n_tiles * s_rows, _LANES), jnp.float32
+            ),
+        )
+        out_specs = (
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        )
+    else:
+        # bf16 needs no scale side channel or store: bind the unused refs
+        # to None so the plan's VMEM accounting matches the allocations
+        def kernel(x_ref, out_ref, send_stage, acc, wire_send, comm_w,
+                   local_sem, send_w, recv_w, cap_sem):
+            return body(
+                x_ref, out_ref, None, send_stage, acc, wire_send, None,
+                comm_w, None, local_sem, send_w, recv_w, None, None,
+                cap_sem,
+            )
+
+        out_shape = payload_shape
+        out_specs = pl.BlockSpec(memory_space=pltpu.ANY)
+    result = pl.pallas_call(
+        kernel,
+        grid=(plan.steps, plan.n_tiles),
+        out_shape=out_shape,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=out_specs,
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True,
+            collective_id=0,
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=_interpret_params(interpret),
+    )(chunks)
+    out = result[0] if int8 else result
+    return out[:, :chunk_rows] if padded_rows != chunk_rows else out
+
+
 def _run_ring_chunks(
     chunks: jnp.ndarray,
     *,
@@ -464,13 +1277,28 @@ def _run_ring_chunks(
     ag,
     interpret,
     chunk_bytes: Optional[int] = None,
+    wire_dtype: str = "off",
+    block_size: Optional[int] = None,
 ):
     """Run the ring on a pre-chunked ``[world, S, 128]`` array, dispatching
-    to the VMEM-resident or HBM-streaming kernel per the planned schedule."""
+    to the VMEM-resident or HBM-streaming kernel per the planned schedule
+    (the fused codec variants when ``wire_dtype`` names one)."""
+    if wire_dtype != "off":
+        # codec-semantics reject comes FIRST: it holds on every build,
+        # and a kernel-support RuntimeError must not mask it
+        _check_fused_wire(chunks.dtype, wire_dtype, block_size)
+        if block_size is None:
+            block_size = _default_block_size()
     _check_ring_supported()
     plan = plan_ring_schedule(
-        chunks.size, chunks.dtype, world, chunk_bytes, rs=rs, ag=ag
+        chunks.size, chunks.dtype, world, chunk_bytes, rs=rs, ag=ag,
+        wire_dtype=wire_dtype, block_size=block_size,
     )
+    if wire_dtype != "off":
+        return _run_fused_ring_chunks(
+            chunks, plan, world=world, axis_name=axis_name, rs=rs, ag=ag,
+            interpret=interpret, block_size=int(block_size),
+        )
     if plan.path == "vmem":
         kernel = functools.partial(
             _ring_kernel,
@@ -544,12 +1372,14 @@ def _run_ring_chunks(
 
 
 def _run_ring(
-    x: jnp.ndarray, *, world, axis_name, rs, ag, interpret, chunk_bytes=None
+    x: jnp.ndarray, *, world, axis_name, rs, ag, interpret, chunk_bytes=None,
+    wire_dtype="off", block_size=None,
 ):
     chunks, chunk = _pad_chunks(x.reshape(-1), world)
     out = _run_ring_chunks(
         chunks, world=world, axis_name=axis_name, rs=rs, ag=ag,
         interpret=interpret, chunk_bytes=chunk_bytes,
+        wire_dtype=wire_dtype, block_size=block_size,
     )
     return out, chunk
 
@@ -560,6 +1390,8 @@ def ring_allreduce_shard(
     axis_name: str = RANKS_AXIS,
     interpret: bool = False,
     chunk_bytes: Optional[int] = None,
+    wire_dtype: str = "off",
+    block_size: Optional[int] = None,
 ) -> jnp.ndarray:
     """Sum-allreduce via ring reduce-scatter + ring all-gather.
 
@@ -568,12 +1400,21 @@ def ring_allreduce_shard(
     (nccl-perf/tree/all_reduce.cu).  ``chunk_bytes`` is the staging
     granularity (synthesized by the strategy plane; env-overridable): payloads
     above it stream through HBM, below it stay VMEM-resident.
+
+    ``wire_dtype`` names a fused wire codec (``bf16`` | ``int8``): staging
+    tiles are encoded before their RDMA and decoded+accumulated in fp32 on
+    receive, the all-gather forwards each reduced chunk's encoded bits
+    verbatim — results are bit-identical rank to rank and match the unfused
+    ``quant/ring.py`` path wherever the chunk layouts coincide.  Rejects
+    loudly where codec semantics don't apply (non-fp32 payloads, block
+    sizes that can't tile VMEM) — never silently runs fp32.
     """
     if world == 1:
         return x
     out, _ = _run_ring(
         x, world=world, axis_name=axis_name, rs=True, ag=True,
         interpret=interpret, chunk_bytes=chunk_bytes,
+        wire_dtype=wire_dtype, block_size=block_size,
     )
     return out.reshape(-1)[: x.size].reshape(x.shape)
 
@@ -584,15 +1425,23 @@ def ring_reduce_scatter_shard(
     axis_name: str = RANKS_AXIS,
     interpret: bool = False,
     chunk_bytes: Optional[int] = None,
+    wire_dtype: str = "off",
+    block_size: Optional[int] = None,
 ) -> jnp.ndarray:
     """Ring reduce-scatter: returns this rank's reduced chunk (padded shape
     ``[chunk]``); rank r owns chunk ``(r + 1) % world`` of the flattened,
-    tile-padded input."""
+    tile-padded input.
+
+    Under a fused ``wire_dtype`` every hop ships encoded tiles and
+    dequant-accumulates in fp32; the owned chunk comes back as the fp32
+    running sum (no final encode — a standalone RS has no forwarding phase
+    to pin bits for).  Loud reject where the codec can't apply."""
     if world == 1:
         return x.reshape(-1)
     out, chunk = _run_ring(
         x, world=world, axis_name=axis_name, rs=True, ag=False,
         interpret=interpret, chunk_bytes=chunk_bytes,
+        wire_dtype=wire_dtype, block_size=block_size,
     )
     my_id = lax.axis_index(axis_name)
     own = (my_id + 1) % world
@@ -605,14 +1454,24 @@ def ring_all_gather_shard(
     axis_name: str = RANKS_AXIS,
     interpret: bool = False,
     chunk_bytes: Optional[int] = None,
+    wire_dtype: str = "off",
+    block_size: Optional[int] = None,
 ) -> jnp.ndarray:
     """Ring all-gather of per-rank chunks: input is this rank's ``[chunk]``
-    payload (tile-aligned), output is ``[world, chunk]`` in rank order."""
+    payload (tile-aligned), output is ``[world, chunk]`` in rank order.
+
+    Under a fused ``wire_dtype`` each rank encodes its chunk ONCE and the
+    ring forwards the encoded bits verbatim (scales ride the side
+    channel), so every rank — owner included — holds the identical
+    post-codec values.  Loud reject where the codec can't apply."""
     if world == 1:
         return x.reshape(1, -1)
     tile = _tile_elems(x.dtype)
     if x.size % tile:
         raise ValueError(f"all-gather payload must be tile-aligned ({tile} elems), got {x.size}")
+    if wire_dtype != "off":
+        # validate before any traced axis op so the reject fires eagerly
+        _check_fused_wire(x.dtype, wire_dtype, block_size)
     my_id = lax.axis_index(axis_name)
     chunks = jnp.zeros((world, x.size), x.dtype)
     # place the local payload in the row this rank owns; the ring walk
@@ -622,5 +1481,6 @@ def ring_all_gather_shard(
     out = _run_ring_chunks(
         chunks, world=world, axis_name=axis_name, rs=False, ag=True,
         interpret=interpret, chunk_bytes=chunk_bytes,
+        wire_dtype=wire_dtype, block_size=block_size,
     )
     return out.reshape(world, -1)

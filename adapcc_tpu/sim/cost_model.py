@@ -390,6 +390,77 @@ def quantized_ring_allreduce_time(
     return (world - 1) * (rs_hop + ag_hop)
 
 
+def fused_quantized_ring_allreduce_time(
+    world: int,
+    nbytes: float,
+    coeffs: LinkCoeffs,
+    chunk_bytes: float,
+    wire_dtype: str = "int8",
+    block_size: int = DEFAULT_QUANT_BLOCK,
+    hbm_bytes_per_s: float = DEFAULT_HBM_BYTES_PER_S,
+    codec_bytes_per_s: float = DEFAULT_CODEC_BYTES_PER_S,
+) -> float:
+    """Analytical latency of the FUSED quantized streaming ring — the wire
+    codec inside ``pallas_ring``'s staged kernels (EQuARX's shape on the
+    credit-based pipeline) — pricing per-tile codec compute *overlapped*
+    with the RDMA of the neighboring tile.
+
+    Per rank the payload splits into ``world`` chunks, each moved as
+    ``ceil(chunk / chunk_bytes)`` staging tiles per ring step.  One tile's
+    pipeline stages:
+
+    - **fill** — HBM→VMEM stage-in (1 tile over HBM) + encode (1 codec
+      pass over the fp32 tile);
+    - **wire** — the RDMA of the *compressed* tile: ``α + β · tile/4 ·
+      wire_bytes_per_element`` (int8 includes the amortized fp32 scales);
+    - **drain** — decode+accumulate during reduce-scatter (2 HBM tile
+      moves + 2 codec passes), decode+adopt during all-gather (1 + 1).
+
+    One ring step is the 3-stage pipeline makespan over its tiles:
+    ``fill + (tiles − 1) · max(wire, fill, drain) + wire + drain`` — the
+    codec hides behind the neighboring tile's RDMA in steady state (or
+    vice versa), while each step still exposes one fill and one drain,
+    each grown by exactly one codec stage vs the unfused staged model;
+    steady-state wire bytes shrink by ``wire_bytes_per_element``.  At one
+    tile per chunk this degenerates to the serial fill+wire+drain sum.
+    Strictly below :func:`quantized_ring_allreduce_time`'s serial
+    codec+wire sum for bandwidth-bound sizes — the regression the fused
+    sweep pins.  ``wire_dtype="off"`` is rejected loudly: the unfused
+    staged model (:func:`staged_ring_allreduce_time`) already prices that
+    kernel.
+    """
+    if wire_dtype == "off":
+        raise ValueError(
+            "fused pricing needs a wire codec; the 'off' staged kernel is "
+            "priced by staged_ring_allreduce_time"
+        )
+    if world < 2:
+        return 0.0
+    if chunk_bytes <= 0:
+        raise ValueError(f"chunk_bytes must be positive, got {chunk_bytes}")
+    chunk = nbytes / world
+    tiles = max(1, int(-(-chunk // chunk_bytes)))
+    tile_bytes = chunk / tiles
+    wire_tile = (tile_bytes / 4.0) * wire_bytes_per_element(
+        wire_dtype, block_size
+    )
+    hbm = tile_bytes / hbm_bytes_per_s
+    codec = tile_bytes / codec_bytes_per_s
+    wire = coeffs.time(wire_tile)
+    rs_fill = hbm + codec              # stage-in + encode
+    rs_drain = 2.0 * hbm + 2.0 * codec  # acc read/write + decode-accumulate
+    ag_fill = hbm + codec              # stage-in + encode/requantize
+    ag_drain = hbm + codec             # decode + adopt write
+    rs_step = (
+        rs_fill + (tiles - 1) * max(wire, rs_fill, rs_drain) + wire + rs_drain
+    )
+    ag_step = (
+        ag_fill + (tiles - 1) * max(wire, ag_fill, ag_drain) + wire + ag_drain
+    )
+    seed = nbytes / hbm_bytes_per_s    # input → HBM work buffer
+    return seed + (world - 1) * (rs_step + ag_step)
+
+
 def choose_wire_dtype(
     world: int,
     nbytes: float,

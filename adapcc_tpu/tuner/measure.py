@@ -104,6 +104,12 @@ def _key_from_event(
 
     if impl.startswith("pallas_ring["):
         path = impl[len("pallas_ring["):-1]
+        # fused codec dispatches spell the codec into the impl
+        # ("pallas_ring[hbm-stream+int8]"); the extras carry it too
+        wire = "off"
+        if "+" in path:
+            path, wire = path.split("+", 1)
+        wire = str(extra.get("wire_dtype", wire))
         return TuningKey(
             primitive=event.primitive,
             size_bucket=size_bucket(per_rank),
@@ -116,7 +122,7 @@ def _key_from_event(
                 NO_CHUNK if path == "vmem"
                 else int(extra.get("chunk_bytes", 0))
             ),
-            wire_dtype="off",
+            wire_dtype=wire,
         )
     if impl.startswith("quant_ring["):
         return TuningKey(

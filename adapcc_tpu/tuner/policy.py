@@ -25,6 +25,7 @@ wire-codec registry — and picks a cell by three rules, in order:
 
 from __future__ import annotations
 
+import os
 import random
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -160,6 +161,7 @@ class TuningPolicy:
         hysteresis_min_samples: int = DEFAULT_HYSTERESIS_MIN_SAMPLES,
         cost_model=None,
         seed: int = 0,
+        fused_paths: Optional[bool] = None,
     ) -> None:
         if not 0.0 <= epsilon <= 1.0:
             raise ValueError(f"epsilon must be in [0, 1], got {epsilon}")
@@ -186,6 +188,13 @@ class TuningPolicy:
         self.hysteresis_margin = float(hysteresis_margin)
         self.hysteresis_min_samples = int(hysteresis_min_samples)
         self._cost_model = cost_model
+        #: whether fused wire cells (codec inside the Pallas kernels) join
+        #: the grid: None = probe the data plane (a cell must never claim a
+        #: path the engine would not run, or the explorer pins on it
+        #: forever); True/False force it — the tune-replay synthetic
+        #: surface forces True so the artifact shows fused cells on any
+        #: build
+        self.fused_paths = fused_paths
         # deterministic exploration: a seeded PRNG, not wall-clock entropy —
         # two identical runs explore the same cells in the same order
         self._rng = random.Random(seed)
@@ -193,6 +202,34 @@ class TuningPolicy:
         self._incumbent: Dict[Tuple[str, int], TuningKey] = {}
 
     # -- candidate grid --------------------------------------------------------
+
+    def _pinned_wire_dtype(self) -> Optional[str]:
+        """The ``ADAPCC_WIRE_DTYPE`` pin, or None when unset.  Under a pin
+        every dispatch executes the pinned codec regardless of what the
+        policy chooses, so cells of any other codec could never accrue
+        samples — the grid must collapse to the pinned axis value (the
+        ADAPCC_RING_CHUNK_BYTES collapse, codec flavor)."""
+        from adapcc_tpu.quant.codec import WIRE_DTYPE_ENV, resolve_wire_dtype
+
+        env = os.environ.get(WIRE_DTYPE_ENV)
+        if env is None or not env.strip():
+            return None
+        return resolve_wire_dtype(None)  # validated; loud on a typo
+
+    def _fused_paths_available(self, dtype, wire_dtype: str) -> bool:
+        """Whether fused (chunk × codec) cells may join the grid for this
+        payload: forced by :attr:`fused_paths` when set, otherwise probed
+        against the data plane's own support funnel."""
+        if self.fused_paths is not None:
+            return bool(self.fused_paths)
+        from adapcc_tpu.comm.pallas_ring import fused_ring_dispatch_reason
+
+        try:
+            return fused_ring_dispatch_reason(dtype, wire_dtype) is None
+        except ValueError:
+            # ADAPCC_FUSED_WIRE=on with an unsupportable combo: the
+            # dispatch itself will fail loudly; no cell for it
+            return False
 
     def candidates(
         self,
@@ -206,9 +243,11 @@ class TuningPolicy:
 
         Ring primitives cross the chunk grid (``wire_dtype="off"``, path
         from the kernel's own planner so a cell can never claim a path the
-        data plane would not run) with one cell per non-"off" codec (the
-        quantized ring has no staging knob).  ``ddp_step`` carries the
-        codec axis crossed with the overlap-schedule axis
+        data plane would not run) with, per non-"off" codec, one unfused
+        quant-ring cell (no staging knob) plus — where the fused kernels
+        can run — fused cells over the same chunk grid, so chunk_bytes ×
+        wire_dtype × path compete on measured medians.  ``ddp_step``
+        carries the codec axis crossed with the overlap-schedule axis
         (:data:`HOOK_OVERLAP_MODES`, encoded via :func:`hook_path`) — the
         hook's allreduce is not chunk-steered.
 
@@ -216,12 +255,17 @@ class TuningPolicy:
         policy's full registry) — a caller whose configuration cannot
         legally run a codec (error-feedback forbids "off") must exclude it
         here, or the explorer pins on a cell that can never accrue samples.
-        ``overlap_modes`` narrows the ddp_step overlap axis the same way
-        (a trainer without gradient accumulation cannot compile the
-        microbatch pipeline).
+        An ``ADAPCC_WIRE_DTYPE`` pin collapses the codec axis outright
+        (every dispatch executes the pin; other codecs' cells would
+        starve).  ``overlap_modes`` narrows the ddp_step overlap axis the
+        same way (a trainer without gradient accumulation cannot compile
+        the microbatch pipeline).
         """
         if wire_dtypes is None:
             wire_dtypes = self.wire_dtypes
+        pin = self._pinned_wire_dtype()
+        if pin is not None:
+            wire_dtypes = (pin,)
         bucket = size_bucket(nbytes)
         cells: List[TuningKey] = []
         if primitive == "ddp_step":
@@ -243,56 +287,92 @@ class TuningPolicy:
         nelems = max(1, int(nbytes)) // max(
             1, _itemsize(dtype)
         )
-        seen_planned = set()
-        for chunk in self.chunk_grid:
-            plan = plan_ring_schedule(nelems, dtype, self.world, chunk)
-            # several budgets can resolve to the identical executed plan
-            # (every vmem-path budget does — and under an
-            # ADAPCC_RING_CHUNK_BYTES pin, every budget does); duplicate
-            # cells would split one physical configuration's samples across
-            # keys.  Cells are keyed by the PLANNER-RESOLVED budget
-            # (``plan.chunk_bytes``, exactly what the engine keys live
-            # recordings with) — vmem by 0, the budget being inert there —
-            # so a record-mode run's samples always land where choose()
-            # looks, env pin or not
-            planned = (plan.path, plan.stage_bytes)
-            if planned in seen_planned:
-                continue
-            seen_planned.add(planned)
-            cells.append(
-                TuningKey(
-                    primitive, bucket, self.world, self.topology,
-                    plan.path,
-                    NO_CHUNK if plan.path == "vmem" else int(plan.chunk_bytes),
-                    "off",
+        if "off" in wire_dtypes:
+            seen_planned = set()
+            for chunk in self.chunk_grid:
+                plan = plan_ring_schedule(nelems, dtype, self.world, chunk)
+                # several budgets can resolve to the identical executed plan
+                # (every vmem-path budget does — and under an
+                # ADAPCC_RING_CHUNK_BYTES pin, every budget does); duplicate
+                # cells would split one physical configuration's samples
+                # across keys.  Cells are keyed by the PLANNER-RESOLVED
+                # budget (``plan.chunk_bytes``, exactly what the engine keys
+                # live recordings with) — vmem by 0, the budget being inert
+                # there — so a record-mode run's samples always land where
+                # choose() looks, env pin or not
+                planned = (plan.path, plan.stage_bytes)
+                if planned in seen_planned:
+                    continue
+                seen_planned.add(planned)
+                cells.append(
+                    TuningKey(
+                        primitive, bucket, self.world, self.topology,
+                        plan.path,
+                        NO_CHUNK if plan.path == "vmem" else int(plan.chunk_bytes),
+                        "off",
+                    )
                 )
-            )
         # measured cells OUTSIDE the grid still compete in exploitation: a
         # record-only run under a pinned or solver-assigned chunk (any
         # budget not in the grid) produced honest medians for a plan the
         # data plane actually ran — ignoring them would re-explore cells
-        # the pod already paid to measure
+        # the pod already paid to measure.  Fused off-grid cells compete
+        # too, but only where the data plane can still run them (a cell
+        # the dispatch would reroute around would starve forever)
         for known in self.db.keys():
             if (
                 known.primitive == primitive
                 and known.size_bucket == bucket
                 and known.world == self.world
                 and known.topology == self.topology
-                and known.wire_dtype == "off"
+                and known.wire_dtype in wire_dtypes
+                and known.path != QUANT_PATH
                 and known not in cells
+                and (
+                    known.wire_dtype == "off"
+                    or self._fused_paths_available(dtype, known.wire_dtype)
+                )
             ):
                 cells.append(known)
         if primitive == "allreduce":
-            # only allreduce has a quantized ring variant (PR-3)
+            # only allreduce has a quantized ring variant (PR-3); the fused
+            # streaming cells (PR-6) speak every ring primitive but compete
+            # on the tuner's one steered primitive.  ADAPCC_FUSED_WIRE=on
+            # prunes the unfused cells outright — under "on" the engine
+            # refuses to run them, so offering them would starve the
+            # explorer (the mirror of "off" pruning the fused cells)
+            from adapcc_tpu.comm.pallas_ring import resolve_fused_wire
+
+            fused_only = resolve_fused_wire() == "on"
             for wd in wire_dtypes:
                 if wd == "off":
                     continue
-                cells.append(
-                    TuningKey(
-                        primitive, bucket, self.world, self.topology,
-                        QUANT_PATH, NO_CHUNK, wd,
+                if self._fused_paths_available(dtype, wd):
+                    seen_planned = set()
+                    for chunk in self.chunk_grid:
+                        plan = plan_ring_schedule(
+                            nelems, dtype, self.world, chunk, wire_dtype=wd
+                        )
+                        planned = (plan.path, plan.stage_bytes)
+                        if planned in seen_planned:
+                            continue
+                        seen_planned.add(planned)
+                        cells.append(
+                            TuningKey(
+                                primitive, bucket, self.world, self.topology,
+                                plan.path,
+                                NO_CHUNK if plan.path == "vmem"
+                                else int(plan.chunk_bytes),
+                                wd,
+                            )
+                        )
+                if not fused_only:
+                    cells.append(
+                        TuningKey(
+                            primitive, bucket, self.world, self.topology,
+                            QUANT_PATH, NO_CHUNK, wd,
+                        )
                     )
-                )
         return cells
 
     # -- prior -----------------------------------------------------------------
@@ -305,12 +385,14 @@ class TuningPolicy:
         return self._cost_model
 
     def prior_time(self, key: TuningKey, nbytes: int) -> float:
-        """Model-predicted seconds for one cell — the PR-1/2/3 cost-model
+        """Model-predicted seconds for one cell — the PR-1/2/3/6 cost-model
         terms, so the tuner's prior and ``make ring-sweep`` /
-        ``make quant-bench`` can never disagree about a cell's ranking."""
+        ``make quant-bench`` / ``make fused-bench`` can never disagree
+        about a cell's ranking."""
         from adapcc_tpu.sim.cost_model import (
             DEFAULT_HBM_BYTES_PER_S,
             bottleneck_ring_coeffs,
+            fused_quantized_ring_allreduce_time,
             quantized_ring_allreduce_time,
             staged_ring_allreduce_time,
         )
@@ -328,26 +410,36 @@ class TuningPolicy:
             return quantized_ring_allreduce_time(
                 world, float(nbytes), coeffs, key.wire_dtype
             )
-        if key.wire_dtype != "off":
+        if key.wire_dtype != "off" and key.path == QUANT_PATH:
             return quantized_ring_allreduce_time(
                 world, float(nbytes), coeffs, key.wire_dtype
             )
         from adapcc_tpu.comm.pallas_ring import plan_ring_schedule
 
         nelems = max(1, int(nbytes)) // 4
+        wire = key.wire_dtype
         plan = plan_ring_schedule(
             nelems, "float32", world,
             key.chunk_bytes if key.chunk_bytes > 0 else None,
+            wire_dtype=wire,
         )
         if key.path == "vmem" and plan.path != "vmem":
             # a vmem cell is keyed chunk_bytes=0; realize it with a budget
             # covering the whole padded payload
-            plan = plan_ring_schedule(nelems, "float32", world, plan.padded_bytes)
+            plan = plan_ring_schedule(
+                nelems, "float32", world, plan.padded_bytes, wire_dtype=wire,
+            )
+        hbm = float("inf") if plan.path == "vmem" else DEFAULT_HBM_BYTES_PER_S
+        if wire != "off":
+            # fused cells: codec inside the staged kernels, priced by the
+            # overlapped per-tile term
+            return fused_quantized_ring_allreduce_time(
+                world, float(nbytes), coeffs, plan.stage_bytes, wire,
+                hbm_bytes_per_s=hbm,
+            )
         return staged_ring_allreduce_time(
             world, float(nbytes), coeffs, plan.stage_bytes,
-            hbm_bytes_per_s=(
-                float("inf") if plan.path == "vmem" else DEFAULT_HBM_BYTES_PER_S
-            ),
+            hbm_bytes_per_s=hbm,
         )
 
     # -- selection -------------------------------------------------------------
@@ -361,10 +453,11 @@ class TuningPolicy:
         return self.prior_time(key, nbytes), False
 
     def _exec_chunk(self, key: TuningKey, nbytes: int, dtype: str) -> Optional[int]:
-        """Execution budget for a vmem cell (keyed chunk_bytes=0): the
-        smallest grid budget the planner resolves to the vmem path, so
-        applying the plan actually runs the cell that was ranked."""
-        if key.wire_dtype != "off" or key.path != "vmem" or key.chunk_bytes > 0:
+        """Execution budget for a vmem cell (keyed chunk_bytes=0, fused or
+        not): the smallest grid budget the planner resolves to the vmem
+        path, so applying the plan actually runs the cell that was
+        ranked."""
+        if key.path != "vmem" or key.chunk_bytes > 0:
             return None
         from adapcc_tpu.comm.pallas_ring import plan_ring_schedule
 

@@ -156,7 +156,11 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
     - ``busbw_wire_dtype`` — the ring at 128 MB across wire codecs via
       ``ADAPCC_WIRE_DTYPE`` (int8 vs bf16 vs fp32: the hardware twin of
       ``make quant-bench``; off rides the Pallas kernels, the codecs ride
-      the quantized ppermute ring);
+      the fused staged kernels where supported);
+    - ``busbw_fused_wire`` — the int8 ring at 128 MB with the codec fused
+      into the staged Pallas kernel (``ADAPCC_FUSED_WIRE=auto``) vs the
+      unfused ppermute reroute (``=off``): the hardware twin of ``make
+      fused-bench``'s fused-vs-unfused pricing;
     - ``tuner_convergence`` — the autotuner closing its loop on real
       hardware: ``ADAPCC_TUNER=choose`` over a repeated 128 MB allreduce
       sweep, the tuning database appended under ``benchmarks/results`` so
@@ -173,7 +177,8 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
     if world < 2:
         for name in (
             "busbw_ici_128m", "ring_smoke", "ring_chunk_sweep",
-            "busbw_wire_dtype", "tuner_convergence", "overlap_ab",
+            "busbw_wire_dtype", "busbw_fused_wire", "tuner_convergence",
+            "overlap_ab",
         ):
             _skip(name, gate, out_path)
         return
@@ -212,6 +217,21 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
             900, out_path,
             extra_env={"ADAPCC_WIRE_DTYPE": wire},
             rec_extra={"wire_dtype": wire},
+        )
+    # fused-wire A/B on the same 128 MB int8 ring payload: ADAPCC_FUSED_WIRE
+    # auto runs the codec INSIDE the staged Pallas kernel (PR-6), off pins
+    # the unfused ppermute reroute — same payload, same codec, the two data
+    # planes `make fused-bench` prices head to head.  Allreduce ONLY (the
+    # A/B's unfused arm exists for no other primitive)
+    for fused in ("auto", "off"):
+        _run(
+            "busbw_fused_wire",
+            [py, "-m", "benchmarks.collectives", "--world", str(world),
+             "--sizes", "128M", "--impls", "pallas_ring",
+             "--collectives", "allreduce"],
+            900, out_path,
+            extra_env={"ADAPCC_WIRE_DTYPE": "int8", "ADAPCC_FUSED_WIRE": fused},
+            rec_extra={"wire_dtype": "int8", "fused_wire": fused},
         )
     # tuner convergence: ADAPCC_TUNER=choose on a repeated allreduce-only
     # sweep — every dispatch is timed into the tuning database (walltime,

@@ -354,6 +354,139 @@ def wire_dtype_sweep(
     return rows
 
 
+def fused_wire_sweep(
+    world: int,
+    sizes: Sequence[int],
+    chunk_sizes: Sequence[int],
+    wire_dtypes: Sequence[str] = ("bf16", "int8"),
+    model: Optional[LinkCostModel] = None,
+    block_size: Optional[int] = None,
+) -> List[dict]:
+    """Predicted fused-vs-unfused codec rows over (size × wire_dtype ×
+    chunk_bytes) — the hardware-free regression artifact for the fused
+    quantized streaming ring (``make fused-bench``, docs/RING.md §5).
+
+    Each row prices the SAME payload both ways on the bottleneck ring
+    link: ``pred_fused_us`` with :func:`adapcc_tpu.sim.cost_model.
+    fused_quantized_ring_allreduce_time` (codec inside the staged kernel,
+    per-tile codec overlapped with RDMA) at the planner-resolved tile for
+    that ``chunk_bytes``, and ``pred_unfused_us`` with
+    :func:`quantized_ring_allreduce_time` (the ppermute reroute's serial
+    codec passes).  ``fused_faster`` flags the winner per row and
+    ``crossover_bytes`` stamps, per (wire_dtype, chunk) curve, the
+    smallest swept size where the fused path wins (None when it never
+    does — small payloads pay the per-tile α and the exposed codec
+    fill/drain).  The executed path/tile come from
+    :func:`adapcc_tpu.comm.pallas_ring.plan_ring_schedule`, so a row can
+    never claim a geometry the data plane would not run.  Deterministic:
+    same calibration → byte-identical rows.
+    """
+    from adapcc_tpu.comm.pallas_ring import (
+        fused_wire_unsupported_reason,
+        plan_ring_schedule,
+    )
+    from adapcc_tpu.quant import DEFAULT_BLOCK_SIZE
+    from adapcc_tpu.sim.cost_model import (
+        bottleneck_ring_coeffs,
+        fused_quantized_ring_allreduce_time,
+        quantized_ring_allreduce_time,
+        wire_bytes_per_element,
+    )
+
+    if block_size is None:
+        block_size = DEFAULT_BLOCK_SIZE
+    for wd in wire_dtypes:
+        reason = fused_wire_unsupported_reason("float32", wd, block_size)
+        if reason is not None:
+            # loud on off/unknown/ungeometric codecs before any row exists
+            raise ValueError(f"fused sweep cannot price {wd!r}: {reason}")
+    if model is None:
+        model = load_or_default(world=world)
+    elif model.world != world:
+        raise ValueError(f"model world {model.world} != sweep world {world}")
+    from adapcc_tpu.sim.cost_model import DEFAULT_HBM_BYTES_PER_S
+
+    coeffs = bottleneck_ring_coeffs(model, world)
+    sizes = [int(s) for s in sizes]
+
+    def fused_pred(nbytes: int, wd: str, chunk: int):
+        """(plan, fused seconds) with the tuner prior's exact pricing rule
+        — vmem plans pay no HBM streaming (the payload is VMEM-resident),
+        so the artifact and prior_time can never disagree on a ranking."""
+        plan = plan_ring_schedule(
+            nbytes // 4, "float32", world, int(chunk),
+            wire_dtype=wd, block_size=block_size,
+        )
+        hbm = (
+            float("inf") if plan.path == "vmem" else DEFAULT_HBM_BYTES_PER_S
+        )
+        return plan, fused_quantized_ring_allreduce_time(
+            world, nbytes, coeffs, plan.stage_bytes, wd, block_size,
+            hbm_bytes_per_s=hbm,
+        )
+
+    # price every cell exactly once; rows and crossovers read the dicts
+    preds = {
+        (s, wd, int(chunk)): fused_pred(s, wd, chunk)
+        for s in sizes for wd in wire_dtypes for chunk in chunk_sizes
+    }
+    unfused = {
+        (s, wd): quantized_ring_allreduce_time(world, s, coeffs, wd, block_size)
+        for s in sizes for wd in wire_dtypes
+    }
+    rows: List[dict] = []
+    crossover: Dict[Tuple[str, int], Optional[int]] = {
+        (wd, int(chunk)): next(
+            (
+                s for s in sorted(sizes)
+                if preds[(s, wd, int(chunk))][1] < unfused[(s, wd)]
+            ),
+            None,
+        )
+        for wd in wire_dtypes for chunk in chunk_sizes
+    }
+    for nbytes in sizes:
+        for wd in wire_dtypes:
+            unfused_s = unfused[(nbytes, wd)]
+            for chunk in chunk_sizes:
+                plan, fused_s = preds[(nbytes, wd, int(chunk))]
+                algbw = nbytes / fused_s / 1e9 if fused_s > 0 else 0.0
+                rows.append({
+                    "mode": "simulated",
+                    "collective": "allreduce",
+                    "impl": "fused_ring",
+                    "strategy": "ring",
+                    "world": world,
+                    "size_bytes": int(nbytes),
+                    "wire_dtype": wd,
+                    "block_size": int(block_size),
+                    "chunk_bytes": int(chunk),
+                    "ring_path": plan.path,
+                    "stage_bytes": plan.stage_bytes,
+                    "wire_stage_bytes": plan.wire_stage_bytes,
+                    "scale_slot_bytes": plan.scale_slot_bytes,
+                    "vmem_bound_bytes": plan.vmem_bound_bytes,
+                    "wire_bytes_per_elem": round(
+                        wire_bytes_per_element(wd, block_size), 6
+                    ),
+                    "pred_fused_us": round(fused_s * 1e6, 3),
+                    "pred_unfused_us": round(unfused_s * 1e6, 3),
+                    "fused_faster": fused_s < unfused_s,
+                    "crossover_bytes": crossover[(wd, int(chunk))],
+                    "algbw_gbps": round(algbw, 6),
+                    "busbw_gbps": round(
+                        algbw * BUS_FACTORS["allreduce"](world), 6
+                    ),
+                    "calibration": model.source,
+                })
+    if not rows:
+        raise ValueError(
+            f"fused sweep produced no rows: sizes={list(sizes)} "
+            f"chunks={list(chunk_sizes)} wire_dtypes={list(wire_dtypes)}"
+        )
+    return rows
+
+
 def overlap_sweep(
     world: int,
     sizes: Sequence[int],
@@ -492,6 +625,10 @@ def tune_replay_sweep(
         policy = TuningPolicy(
             db, world, topology="tune-replay", chunk_grid=chunk_grid,
             epsilon=1.0, trial_budget=trial_budget, cost_model=model, seed=0,
+            # the replay is a synthetic surface, not a data plane: force the
+            # fused-path cells in so the artifact pins the full grid (chunk
+            # × codec × path) on any build, TPU or not
+            fused_paths=True,
         )
         cells = policy.candidates("allreduce", int(nbytes))
         surface = {
@@ -568,6 +705,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "by the sim-rank cost-model term (make quant-bench)",
     )
     ap.add_argument(
+        "--fused-sweep", action="store_true",
+        help="price the FUSED quantized streaming ring against the unfused "
+        "ppermute reroute over (size x wire_dtype x chunk_bytes), crossover "
+        "size flagged per row (make fused-bench; docs/RING.md)",
+    )
+    ap.add_argument(
+        "--fused-wire", default="bf16,int8",
+        help="fused-sweep codec grid (codecs the fused kernels speak)",
+    )
+    ap.add_argument(
         "--tune-replay", action="store_true",
         help="replay the autotuner's policy against a deterministic "
         "synthetic cost surface over the (chunk x codec) grid instead of "
@@ -595,6 +742,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         name for name, on in (
             ("--wire-dtype", bool(args.wire_dtype)),
             ("--ring-sweep", args.ring_sweep),
+            ("--fused-sweep", args.fused_sweep),
             ("--tune-replay", args.tune_replay),
             ("--overlap-sweep", args.overlap_sweep),
         ) if on
@@ -626,6 +774,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     f"{row['overlap']:<10} "
                     f"step={row['pred_step_us']:>10.1f}us  "
                     f"exposed={row['exposed_comm_us']:>10.1f}us"
+                )
+        return 0
+    if args.fused_sweep:
+        rows = fused_wire_sweep(
+            world=args.world,
+            sizes=[parse_size(s) for s in args.sizes.split(",")],
+            chunk_sizes=[parse_size(c) for c in args.chunks.split(",") if c],
+            wire_dtypes=[
+                w.strip() for w in args.fused_wire.split(",") if w.strip()
+            ],
+            model=model,
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            else:
+                star = "*" if row["fused_faster"] else " "
+                print(
+                    f"[sim] fused {row['size_bytes']:>12}B "
+                    f"wire={row['wire_dtype']:<5} "
+                    f"chunk={row['chunk_bytes']:>9}B{star} "
+                    f"fused={row['pred_fused_us']:>10.1f}us  "
+                    f"unfused={row['pred_unfused_us']:>10.1f}us  "
+                    f"crossover={row['crossover_bytes']}"
                 )
         return 0
     if args.tune_replay:

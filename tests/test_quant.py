@@ -232,12 +232,17 @@ def test_hook_int8_parity_with_fp32(mesh8, mode):
     assert (np.abs(plain - quant) <= bound + 1e-6).all()
 
 
-def test_engine_quant_ring_parity_and_trace(mesh8):
+def test_engine_quant_ring_parity_and_trace(mesh8, monkeypatch):
     """Ring-engine data plane: quantized ring vs the exact sum, within the
-    hop-accumulated block-wise bound, with the wire dtype in the trace."""
+    hop-accumulated block-wise bound, with the wire dtype in the trace.
+    ADAPCC_FUSED_WIRE=off pins the unfused reroute so the quant_ring impl
+    assertion holds on fused-capable builds too (the fused twin lives in
+    tests/test_fused_ring.py)."""
     from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.comm.pallas_ring import FUSED_WIRE_ENV
     from adapcc_tpu.utils.observability import CollectiveTrace
 
+    monkeypatch.setenv(FUSED_WIRE_ENV, "off")
     strat = Strategy.ring(8)
     strat.wire_dtype = "int8"
     trace = CollectiveTrace()
@@ -260,8 +265,10 @@ def test_engine_quant_ring_parity_and_trace(mesh8):
 
 def test_engine_env_override_reroutes_to_quant_ring(mesh8, monkeypatch):
     from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.comm.pallas_ring import FUSED_WIRE_ENV
     from adapcc_tpu.utils.observability import CollectiveTrace
 
+    monkeypatch.setenv(FUSED_WIRE_ENV, "off")  # build-independent reroute
     monkeypatch.setenv(WIRE_DTYPE_ENV, "bf16")
     trace = CollectiveTrace()
     eng = CollectiveEngine(mesh8, Strategy.ring(8), trace=trace)

@@ -689,7 +689,8 @@ def test_hw_session_multichip_phases_skip_cleanly_at_world1(tmp_path):
     rows = [_json.loads(l) for l in open(out)]
     assert {r["phase"] for r in rows} == {
         "busbw_ici_128m", "ring_smoke", "ring_chunk_sweep",
-        "busbw_wire_dtype", "tuner_convergence", "overlap_ab",
+        "busbw_wire_dtype", "busbw_fused_wire", "tuner_convergence",
+        "overlap_ab",
     }
     for r in rows:
         assert "world=1" in r["skipped"]

@@ -160,6 +160,9 @@ def test_size_bucket_pools_powers_of_two():
 def _policy(db, **kw):
     kw.setdefault("world", 8)
     kw.setdefault("topology", "test-fabric")
+    # these tests pin the pre-fused (chunk × quant-ring) grid semantics;
+    # the fused-path cells have their own coverage in tests/test_fused_ring.py
+    kw.setdefault("fused_paths", False)
     return TuningPolicy(db, **kw)
 
 
@@ -390,12 +393,16 @@ def test_engine_rejects_malformed_tuner_env(mesh8, monkeypatch):
 def _choose_engine(mesh8, tmp_path, monkeypatch, **tuner_kw):
     """Engine with a choosing tuner whose database says int8 is fastest —
     the quant ring runs on any backend, so the end-to-end path needs no
-    Pallas support."""
+    Pallas support.  ADAPCC_FUSED_WIRE=off pins the unfused reroute so
+    the quant_ring[...] impl assertions hold on fused-capable builds
+    (jax >= 0.5 interpret / real TPU) too."""
     from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.comm.pallas_ring import FUSED_WIRE_ENV
     from adapcc_tpu.strategy.ir import Strategy
     from adapcc_tpu.tuner import TUNER_MODE_ENV
 
     monkeypatch.setenv(TUNER_MODE_ENV, "choose")
+    monkeypatch.setenv(FUSED_WIRE_ENV, "off")
     db = TuningDatabase(str(tmp_path / "tuning.jsonl"))
     tuner = CollectiveTuner(
         world=8, topology="e2e", db=db, epsilon=0.0, min_samples=1,
@@ -448,6 +455,10 @@ def test_engine_arg_overrides_tuner_visible_in_trace(mesh8, tmp_path, monkeypatc
 
 
 def test_engine_env_overrides_tuner_visible_in_trace(mesh8, tmp_path, monkeypatch):
+    """An ADAPCC_WIRE_DTYPE pin collapses the tuner's codec axis to the
+    pinned cell (every dispatch executes the pin, so any other codec's
+    cell could never accrue samples — the chunk-pin collapse, codec
+    flavor), and the executed dispatch runs the pinned codec."""
     from adapcc_tpu.quant import WIRE_DTYPE_ENV
 
     engine, trace, db, tuner = _choose_engine(mesh8, tmp_path, monkeypatch)
@@ -457,8 +468,11 @@ def test_engine_env_overrides_tuner_visible_in_trace(mesh8, tmp_path, monkeypatc
     engine.ring_allreduce(x)
     ev = trace.events()[-1]
     assert ev.impl == "quant_ring[bf16]"  # ADAPCC_WIRE_DTYPE beat the tuner
-    assert ev.extra["tuner"]["chosen"]["wire_dtype"] == "int8"
-    assert ev.extra["tuner"]["applied"] is False
+    # the grid collapsed: the policy's chosen cell carries the pin, so the
+    # recorded walltime lands in the cell that actually ran
+    assert ev.extra["tuner"]["chosen"]["wire_dtype"] == "bf16"
+    cells = tuner.policy.candidates("allreduce", 2048 * 4)
+    assert {c.wire_dtype for c in cells} == {"bf16"}
 
 
 def test_engine_chunk_env_overrides_tuner_in_plan(mesh8, monkeypatch, tmp_path):
@@ -512,6 +526,9 @@ def test_engine_record_mode_fills_db_with_warmup_discard(mesh8, tmp_path, monkey
     from adapcc_tpu.tuner import TUNER_MODE_ENV
 
     monkeypatch.setenv(TUNER_MODE_ENV, "record")
+    from adapcc_tpu.comm.pallas_ring import FUSED_WIRE_ENV
+
+    monkeypatch.setenv(FUSED_WIRE_ENV, "off")  # pin the quant-ring cell
     db = TuningDatabase(str(tmp_path / "t.jsonl"))
     tuner = CollectiveTuner(world=8, topology="e2e", db=db)
     engine = CollectiveEngine(mesh8, Strategy.ring(8), tuner=tuner)
