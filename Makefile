@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench trace-export clean
+.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench elastic-bench trace-export clean
 
 all: native
 
@@ -67,6 +67,14 @@ overlap-bench:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--world 8 --sizes 16M,128M --overlap-sweep --accums 1,2,4 \
 		--bucket-caps-mb 1,4 --json
+
+# Elastic failover sweep on the same simulator (docs/ELASTIC.md):
+# deterministic "mode": "simulated" rows pricing each injected fault's
+# detection -> swap -> steady-state timeline (standby-cached vs cold swap
+# stall both priced), plus a canonical fault plan's per-step replay.
+elastic-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--world 8 --sizes 1M,16M --fault-sweep --hosts 2 --json
 
 # Perfetto/chrome://tracing export of a recorded dispatch trace: run a
 # short virtual-pod collective session under ADAPCC_TUNER=record and emit

@@ -39,6 +39,28 @@ from adapcc_tpu.strategy.ir import CommRound, Strategy, Tree
 from adapcc_tpu.comm.mesh import RANKS_AXIS
 
 
+class EpochMismatch(RuntimeError):
+    """A collective was issued against a world epoch that is no longer
+    current (the coordinator advanced the WorldView — a rank died, was
+    demoted, or recovered — and the engine swapped plans).
+
+    Retryable by construction: the caller refreshes its epoch token (the
+    exception carries ``current``) and re-issues; the
+    :class:`~adapcc_tpu.communicator.Communicator` layer does exactly that
+    with bounded retry + backoff.  This is the hang-free contract — a
+    stale issuer gets a loud, catchable signal instead of running a
+    schedule the world has moved past.
+    """
+
+    def __init__(self, issued: int, current: int) -> None:
+        super().__init__(
+            f"collective issued against dead epoch {issued} (current epoch "
+            f"is {current}); refresh the epoch token and retry"
+        )
+        self.issued = issued
+        self.current = current
+
+
 def _identity_for(op: ReduceOp, dtype) -> jnp.ndarray:
     if op is ReduceOp.MAX:
         return jnp.asarray(-jnp.inf if jnp.issubdtype(dtype, jnp.floating) else jnp.iinfo(dtype).min, dtype)
@@ -672,10 +694,47 @@ class CollectiveEngine:
         #: optional CollectiveTrace recording every dispatch (track.txt analog)
         self.trace = trace
         self._cache: Dict[Tuple, Callable] = {}
+        #: world epoch (adapcc_tpu.elastic): bumped by :meth:`advance_epoch`
+        #: on every membership change; collectives issued with a stale
+        #: ``epoch=`` token raise :class:`EpochMismatch` instead of running
+        self.epoch = 0
 
-    def _record(self, primitive: str, impl: str, stacked: jnp.ndarray) -> None:
+    # -- elastic plan failover -------------------------------------------------
+
+    def advance_epoch(self, strategy: Optional[Strategy] = None) -> int:
+        """World change: bump the epoch and optionally hot-swap the
+        executing strategy.
+
+        Compiled programs stay cached under their strategy fingerprint
+        (``_schedule_variant``), so swapping to a pre-warmed standby plan
+        (:class:`adapcc_tpu.elastic.standby.StandbyPlanCache`) is a
+        dispatch-time cache-key switch — no cold recompile stall on the
+        failover step.  Unlike :meth:`clear`, nothing is dropped: the old
+        epoch's programs remain warm for the recovery swap back.
+        """
+        if strategy is not None:
+            if strategy.world_size != self.world_size:
+                raise ValueError(
+                    f"standby strategy world {strategy.world_size} != engine "
+                    f"world {self.world_size}; elastic swaps keep the mesh "
+                    "and mask dead ranks (relay semantics), they do not "
+                    "shrink the device set"
+                )
+            self.strategy = strategy
+        self.epoch += 1
+        return self.epoch
+
+    def _check_epoch(self, epoch: Optional[int]) -> None:
+        if epoch is not None and epoch != self.epoch:
+            raise EpochMismatch(epoch, self.epoch)
+
+    def _record(
+        self, primitive: str, impl: str, stacked: jnp.ndarray, **extra: Any
+    ) -> None:
         if self.trace is not None:
-            self.trace.record(primitive, impl, int(stacked.nbytes))
+            self.trace.record(
+                primitive, impl, int(stacked.nbytes), epoch=self.epoch, **extra
+            )
 
     @property
     def world_size(self) -> int:
@@ -749,10 +808,12 @@ class CollectiveEngine:
         *,
         active_gpus: Optional[Sequence[int]] = None,
         op: ReduceOp = ReduceOp.SUM,
+        epoch: Optional[int] = None,
     ) -> jnp.ndarray:
         # keyword-only for the same reason as reduce_scatter: a positional
         # all_reduce(t, ReduceOp.AVG) must fail at the call site, not bind
         # the enum to active_gpus
+        self._check_epoch(epoch)
         self._check_world_dim(stacked, "all_reduce")
         mask = self._active_to_mask(active_gpus)
         if self.use_xla_fastpath and active_gpus is None:
@@ -777,7 +838,10 @@ class CollectiveEngine:
                 op=op,
             )
             key = ("allreduce", self._schedule_variant(), stacked.shape, stacked.dtype.name, op)
-        self._record("allreduce", "xla" if key[0] == "psum" else "schedule", stacked)
+        self._record(
+            "allreduce", "xla" if key[0] == "psum" else "schedule", stacked,
+            cache_hit=key in self._cache,
+        )
         return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
     def _psum_shard(self, x: jnp.ndarray, mask: jnp.ndarray, op: ReduceOp) -> jnp.ndarray:
@@ -789,7 +853,9 @@ class CollectiveEngine:
         *,
         active_gpus: Optional[Sequence[int]] = None,
         op: ReduceOp = ReduceOp.SUM,
+        epoch: Optional[int] = None,
     ) -> jnp.ndarray:
+        self._check_epoch(epoch)
         self._check_world_dim(stacked, "reduce")
         if self.use_xla_fastpath and active_gpus is None and not self.two_level:
             per_shard = functools.partial(
@@ -797,7 +863,7 @@ class CollectiveEngine:
                 strategy=self.strategy, axis_name=self.axis_name, op=op,
             )
             key = ("reduce_fast", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name, op)
-            self._record("reduce", "xla", stacked)
+            self._record("reduce", "xla", stacked, cache_hit=key in self._cache)
             return self._shard_mapped(key, per_shard, 1)(stacked)
         if self.two_level:
             from adapcc_tpu.comm.two_level import reduce_two_level_shard
@@ -815,47 +881,92 @@ class CollectiveEngine:
                 reduce_shard, strategy=self.strategy, axis_name=self.axis_name, op=op
             )
             key = ("reduce", self._schedule_variant(), stacked.shape, stacked.dtype.name, op)
-        self._record("reduce", "schedule", stacked)
+        self._record("reduce", "schedule", stacked, cache_hit=key in self._cache)
         return self._shard_mapped(key, per_shard, 2)(stacked, self._active_to_mask(active_gpus))
 
     def boardcast(
-        self, stacked: jnp.ndarray, active_gpus: Optional[Sequence[int]] = None
+        self,
+        stacked: jnp.ndarray,
+        active_gpus: Optional[Sequence[int]] = None,
+        *,
+        epoch: Optional[int] = None,
     ) -> jnp.ndarray:
         """Reference spelling kept for API parity (adapcc.py:55-57).
 
         ``active_gpus`` mirrors the reference C ABI (run.cu:150 takes the
-        active set for every collective); broadcast *values* are unaffected
-        by relay roles — inactive ranks still forward — so the set only
-        pins the schedule path."""
+        active set for every collective).  Broadcast *values* are
+        unaffected by relay roles — inactive ranks still forward and
+        receive — but the tree roots SOURCE the value, so the active set
+        is enforced against them: a stale set naming a dead root rejects
+        loudly here instead of silently broadcasting that root's garbage
+        (the elastic failover path swaps to a standby plan rooted on an
+        alive rank first).  The mask then rides the schedule program as a
+        real operand — the same plumbing as :meth:`reduce` — so a masked
+        dispatch can never replay the unmasked full-world fastpath."""
+        self._check_epoch(epoch)
         self._check_world_dim(stacked, "boardcast")
-        self._active_to_mask(active_gpus)  # validate ranks even though the
-        # broadcast result is mask-independent (fail fast on a typo'd set)
+        mask = self._active_to_mask(active_gpus)
+        if active_gpus is not None:
+            act = {int(r) for r in active_gpus}
+            dead_roots = sorted(
+                {t.root for t in self.strategy.trees} - act
+            )
+            if dead_roots:
+                # conservative by design: the engine cannot distinguish a
+                # DEAD root (broadcasting its stale row is the silent
+                # corruption this guard closes) from a merely demoted-slow
+                # one (alive; broadcast values are mask-independent, so
+                # including it in the set is always sound).  Callers with
+                # the distinction pass alive∪relays for broadcast; the
+                # elastic failover path swaps to a re-rooted standby plan.
+                raise ValueError(
+                    f"broadcast roots {dead_roots} are not in the active set "
+                    f"{sorted(act)}: a dead root cannot source the broadcast "
+                    "— swap to a degraded plan rooted on alive ranks "
+                    "(adapcc_tpu.elastic.standby), or, if the root is only "
+                    "demoted-slow, include it in active_gpus (broadcast "
+                    "values are unaffected by relay roles)"
+                )
         if self.use_xla_fastpath and active_gpus is None and not self.two_level:
             per_shard = functools.partial(
                 broadcast_fastpath_shard,
                 strategy=self.strategy, axis_name=self.axis_name,
             )
             key = ("broadcast_fast", self.strategy.fingerprint(), stacked.shape, stacked.dtype.name)
-            self._record("broadcast", "xla", stacked)
+            self._record("broadcast", "xla", stacked, cache_hit=key in self._cache)
             return self._shard_mapped(key, per_shard, 1)(stacked)
+        masked = active_gpus is not None
         if self.two_level:
             from adapcc_tpu.comm.two_level import broadcast_two_level_shard
 
-            per_shard = functools.partial(
+            inner = functools.partial(
                 broadcast_two_level_shard,
                 strategy=self.strategy,
                 num_slices=self.num_slices,
                 ici_size=self.ici_size,
             )
-            key = ("broadcast2l", self._schedule_variant(), stacked.shape, stacked.dtype.name)
+            key = ("broadcast2l", self._schedule_variant(), stacked.shape, stacked.dtype.name, masked)
         else:
-            per_shard = functools.partial(
+            inner = functools.partial(
                 broadcast_shard, strategy=self.strategy, axis_name=self.axis_name
             )
-            key = ("broadcast", self._schedule_variant(), stacked.shape, stacked.dtype.name)
+            key = ("broadcast", self._schedule_variant(), stacked.shape, stacked.dtype.name, masked)
+
+        if masked:
+            # the mask is a real operand of the compiled program (reduce's
+            # plumbing): broadcast values are mask-independent by the relay
+            # contract (forwarders still deliver), but the masked dispatch
+            # compiles its own keyed program, so a later degraded plan can
+            # consume the mask without a silent full-world replay
+            def per_shard(x, m):
+                return inner(x)
+        else:
+            per_shard = inner
         # trace vocabulary is normalized ("broadcast"); only the API keeps
         # the reference's "boardcast" spelling
-        self._record("broadcast", "schedule", stacked)
+        self._record("broadcast", "schedule", stacked, cache_hit=key in self._cache)
+        if masked:
+            return self._shard_mapped(key, per_shard, 2)(stacked, mask)
         return self._shard_mapped(key, per_shard, 1)(stacked)
 
     broadcast = boardcast
@@ -874,7 +985,11 @@ class CollectiveEngine:
         return lax.axis_index(self.axis_name)
 
     def all_gather(
-        self, stacked: jnp.ndarray, active_gpus: Optional[Sequence[int]] = None
+        self,
+        stacked: jnp.ndarray,
+        active_gpus: Optional[Sequence[int]] = None,
+        *,
+        epoch: Optional[int] = None,
     ) -> jnp.ndarray:
         """All-gather with subset semantics (reference stub: trans.h ALLGATHER).
 
@@ -885,6 +1000,7 @@ class CollectiveEngine:
         relay contract of :meth:`all_reduce`.  Two-level worlds gather
         hierarchically (DCN first, so each payload crosses DCN once).
         """
+        self._check_epoch(epoch)
         self._check_world_dim(stacked, "all_gather")
         mask = self._active_to_mask(active_gpus)
         masked = active_gpus is not None
@@ -901,7 +1017,7 @@ class CollectiveEngine:
                 )[None]
 
             key = ("allgather2l", stacked.shape, stacked.dtype.name, masked)
-            self._record("all_gather", "two_level", stacked)
+            self._record("all_gather", "two_level", stacked, cache_hit=key in self._cache)
             return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
         def per_shard(x, m):  # x: [1, *payload]
@@ -911,11 +1027,15 @@ class CollectiveEngine:
             return lax.all_gather(v, self.axis_name, axis=0)[None]
 
         key = ("allgather", stacked.shape, stacked.dtype.name, masked)
-        self._record("all_gather", "xla", stacked)
+        self._record("all_gather", "xla", stacked, cache_hit=key in self._cache)
         return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
     def all_to_all(
-        self, stacked: jnp.ndarray, active_gpus: Optional[Sequence[int]] = None
+        self,
+        stacked: jnp.ndarray,
+        active_gpus: Optional[Sequence[int]] = None,
+        *,
+        epoch: Optional[int] = None,
     ) -> jnp.ndarray:
         """All-to-all over ICI with subset semantics.
 
@@ -927,6 +1047,7 @@ class CollectiveEngine:
         (they contribute identity); every rank, active or not, still receives
         its incoming blocks — inactive ranks stay on the fabric as relays.
         """
+        self._check_epoch(epoch)
         self._check_world_dim(stacked, "all_to_all")
         if stacked.shape[1] != self.world_size:
             raise ValueError(
@@ -947,7 +1068,7 @@ class CollectiveEngine:
                 )[None]
 
             key = ("alltoall2l", stacked.shape, stacked.dtype.name, masked)
-            self._record("all_to_all", "two_level", stacked)
+            self._record("all_to_all", "two_level", stacked, cache_hit=key in self._cache)
             return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
         def per_shard(x, m):  # x: [1, world, *payload]
@@ -957,7 +1078,7 @@ class CollectiveEngine:
             return lax.all_to_all(v, self.axis_name, split_axis=0, concat_axis=0)[None]
 
         key = ("alltoall", stacked.shape, stacked.dtype.name, masked)
-        self._record("all_to_all", "xla", stacked)
+        self._record("all_to_all", "xla", stacked, cache_hit=key in self._cache)
         return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
     def _ring_plan(
@@ -1397,6 +1518,7 @@ class CollectiveEngine:
         *,
         active_gpus: Optional[Sequence[int]] = None,
         op: ReduceOp = ReduceOp.SUM,
+        epoch: Optional[int] = None,
     ) -> jnp.ndarray:
         """Reduce-scatter with subset semantics (reference stub: REDUCESCATTER).
 
@@ -1413,6 +1535,7 @@ class CollectiveEngine:
         scatter hierarchically (ICI first, so DCN carries only ``1/ici`` of
         the buffer).
         """
+        self._check_epoch(epoch)
         self._check_world_dim(stacked, "reduce_scatter")
         if op is ReduceOp.MAX:
             raise ValueError(
@@ -1453,7 +1576,7 @@ class CollectiveEngine:
                 return _norm(out, m)[None, :]
 
             key = ("reducescatter2l", stacked.shape, stacked.dtype.name, op, masked)
-            self._record("reduce_scatter", "two_level", stacked)
+            self._record("reduce_scatter", "two_level", stacked, cache_hit=key in self._cache)
             return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
         def per_shard(x, m):  # x: [1, n]
@@ -1462,5 +1585,5 @@ class CollectiveEngine:
             return _norm(out, m)[None, :]
 
         key = ("reducescatter", stacked.shape, stacked.dtype.name, op, masked)
-        self._record("reduce_scatter", "xla", stacked)
+        self._record("reduce_scatter", "xla", stacked, cache_hit=key in self._cache)
         return self._shard_mapped(key, per_shard, 2)(stacked, mask)

@@ -67,6 +67,15 @@ def _strategy_round_key() -> str:
 
 _COLLECTIVE_PRIMS = (ALLREDUCE, REDUCE, BOARDCAST, ALLGATHER, ALLTOALL, REDUCESCATTER)
 
+#: bounded retry for collectives that race a plan failover: a dispatch
+#: issued against a dead epoch (the coordinator advanced the WorldView and
+#: the engine hot-swapped plans) raises EpochMismatch; the Communicator
+#: adopts the engine's current epoch and re-issues after an exponential
+#: backoff.  Exhausting the budget re-raises — a world churning faster
+#: than the retry budget is an operator problem, not something to spin on.
+EPOCH_RETRY_MAX = 3
+EPOCH_RETRY_BACKOFF_S = 0.02
+
 
 class Communicator:
     """One communication world: mesh + artifacts + compiled engines."""
@@ -296,6 +305,36 @@ class Communicator:
             )
         return self._engines[prim]
 
+    def _dispatch_with_epoch_retry(self, dispatch, epoch: Optional[int]):
+        """Run ``dispatch(epoch)`` with bounded EpochMismatch retry.
+
+        ``epoch=None`` (the default on every collective) skips the check —
+        legacy callers never see a behavior change.  An elastic caller
+        passes the epoch token it planned against; if the world moved on
+        mid-flight, the mismatch is caught here, the engine's current
+        epoch adopted, and the call re-issued after an exponential backoff
+        — the collective continues with the swapped plan instead of
+        hanging (or silently running the dead schedule).
+        """
+        import time as _time
+
+        from adapcc_tpu.comm.engine import EpochMismatch
+
+        attempt = 0
+        while True:
+            try:
+                return dispatch(epoch)
+            except EpochMismatch as e:
+                if attempt >= EPOCH_RETRY_MAX:
+                    raise
+                if attempt > 0:
+                    # the first retry goes immediately: the exception
+                    # already carries the refreshed epoch, so it succeeds
+                    # unless a SECOND swap raced in — only then back off
+                    _time.sleep(EPOCH_RETRY_BACKOFF_S * (2 ** (attempt - 1)))
+                attempt += 1
+                epoch = e.current
+
     def all_reduce(
         self,
         tensor: jnp.ndarray,
@@ -303,12 +342,17 @@ class Communicator:
         chunk_bytes: Optional[int] = None,
         active_gpus: Optional[Sequence[int]] = None,
         op: ReduceOp = ReduceOp.SUM,
+        epoch: Optional[int] = None,
     ) -> jnp.ndarray:
         """Reference signature ``all_reduce(tensor, size, chunk_bytes,
         active_gpus)`` (commu.py:360-365); size/chunk_bytes are accepted for
         parity only — shapes are static under jit, and chunking belongs to
         the compiled program (synthesis-time ``self.chunk_bytes``), so a
-        per-call value is ignored rather than mutating communicator state."""
+        per-call value is ignored rather than mutating communicator state.
+
+        ``epoch`` is the elastic plan token (docs/ELASTIC.md): when given,
+        a dispatch racing a plan failover retries against the refreshed
+        epoch with bounded backoff instead of hanging."""
         if isinstance(size, ReduceOp) or isinstance(chunk_bytes, ReduceOp):
             raise TypeError(
                 "pass op= by keyword: the reference-parity positional slots "
@@ -316,7 +360,12 @@ class Communicator:
                 "positional ReduceOp would silently land in one of them and "
                 "the reduction would run as SUM"
             )
-        return self._engine(ALLREDUCE).all_reduce(tensor, active_gpus=active_gpus, op=op)
+        return self._dispatch_with_epoch_retry(
+            lambda ep: self._engine(ALLREDUCE).all_reduce(
+                tensor, active_gpus=active_gpus, op=op, epoch=ep
+            ),
+            epoch,
+        )
 
     def reduce(
         self,
@@ -325,6 +374,7 @@ class Communicator:
         chunk_bytes: Optional[int] = None,
         active_gpus: Optional[Sequence[int]] = None,
         op: ReduceOp = ReduceOp.SUM,
+        epoch: Optional[int] = None,
     ) -> jnp.ndarray:
         if isinstance(size, ReduceOp) or isinstance(chunk_bytes, ReduceOp):
             raise TypeError(
@@ -332,7 +382,12 @@ class Communicator:
                 "land in 'size'/'chunk_bytes' and the reduction would run "
                 "as SUM"
             )
-        return self._engine(REDUCE).reduce(tensor, active_gpus=active_gpus, op=op)
+        return self._dispatch_with_epoch_retry(
+            lambda ep: self._engine(REDUCE).reduce(
+                tensor, active_gpus=active_gpus, op=op, epoch=ep
+            ),
+            epoch,
+        )
 
     def boardcast(
         self,
@@ -340,8 +395,14 @@ class Communicator:
         size: Optional[int] = None,
         chunk_bytes: Optional[int] = None,
         active_gpus: Optional[Sequence[int]] = None,
+        epoch: Optional[int] = None,
     ) -> jnp.ndarray:
-        return self._engine(BOARDCAST).boardcast(tensor, active_gpus=active_gpus)
+        return self._dispatch_with_epoch_retry(
+            lambda ep: self._engine(BOARDCAST).boardcast(
+                tensor, active_gpus=active_gpus, epoch=ep
+            ),
+            epoch,
+        )
 
     def alltoall(
         self,
@@ -349,13 +410,27 @@ class Communicator:
         size: Optional[int] = None,
         chunk_bytes: Optional[int] = None,
         active_gpus: Optional[Sequence[int]] = None,
+        epoch: Optional[int] = None,
     ) -> jnp.ndarray:
-        return self._engine(ALLTOALL).all_to_all(tensor, active_gpus=active_gpus)
+        return self._dispatch_with_epoch_retry(
+            lambda ep: self._engine(ALLTOALL).all_to_all(
+                tensor, active_gpus=active_gpus, epoch=ep
+            ),
+            epoch,
+        )
 
     def all_gather(
-        self, tensor: jnp.ndarray, active_gpus: Optional[Sequence[int]] = None
+        self,
+        tensor: jnp.ndarray,
+        active_gpus: Optional[Sequence[int]] = None,
+        epoch: Optional[int] = None,
     ) -> jnp.ndarray:
-        return self._engine(ALLGATHER).all_gather(tensor, active_gpus=active_gpus)
+        return self._dispatch_with_epoch_retry(
+            lambda ep: self._engine(ALLGATHER).all_gather(
+                tensor, active_gpus=active_gpus, epoch=ep
+            ),
+            epoch,
+        )
 
     def reduce_scatter(
         self,
@@ -363,13 +438,17 @@ class Communicator:
         *,
         active_gpus: Optional[Sequence[int]] = None,
         op: ReduceOp = ReduceOp.SUM,
+        epoch: Optional[int] = None,
     ) -> jnp.ndarray:
         # keyword-only: ``active_gpus`` was inserted before the pre-existing
         # ``op`` parameter, so a legacy positional ``reduce_scatter(t,
         # ReduceOp.AVG)`` would silently bind the enum to active_gpus; now it
         # fails at the call site instead (ADVICE r5)
-        return self._engine(REDUCESCATTER).reduce_scatter(
-            tensor, active_gpus=active_gpus, op=op
+        return self._dispatch_with_epoch_retry(
+            lambda ep: self._engine(REDUCESCATTER).reduce_scatter(
+                tensor, active_gpus=active_gpus, op=op, epoch=ep
+            ),
+            epoch,
         )
 
     # -- coordinator plane -----------------------------------------------------

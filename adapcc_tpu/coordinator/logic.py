@@ -29,13 +29,21 @@ from __future__ import annotations
 import threading
 import time
 from collections import defaultdict
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Mapping, Optional, Tuple
 
 from adapcc_tpu.primitives import (
     FAULT_TOLERANT_TIME_S,
     RELAY_THRESHOLD_S,
     TIME_SLOT_DURATION_S,
 )
+
+
+class CoordinatorShutdown(RuntimeError):
+    """The coordinator is stopping: blocked waiters are drained with this
+    instead of being left parked on the condition variable forever.  The
+    gRPC layer turns it into an UNAVAILABLE abort, so a worker blocked on
+    ``send_ready_request`` unblocks with a clean error when the
+    coordinator dies (instead of hanging past the server's teardown)."""
 
 
 class CoordinatorLogic:
@@ -47,11 +55,36 @@ class CoordinatorLogic:
         fault_timeout: float = FAULT_TOLERANT_TIME_S,
         accumulated_size: float = 100 * 8 / 1024,
         accumulated_bandwidth: Optional[float] = None,
+        fault_plan: Optional[object] = None,
+        heartbeat_timeout: Optional[float] = None,
+        slow_factor: Optional[float] = None,
     ) -> None:
+        from adapcc_tpu.elastic.worldview import (
+            WorldView,
+            heartbeat_timeout_s,
+            slow_rank_factor,
+        )
+
         self.world_size = world_size
         self.relay_threshold = relay_threshold
         self.time_slot = time_slot
         self.fault_timeout = fault_timeout
+        #: heartbeat deadline for the controller barrier; defaults to the
+        #: fault timeout, overridable per-deploy via
+        #: ``ADAPCC_HEARTBEAT_TIMEOUT_S`` (docs/ELASTIC.md)
+        self.heartbeat_timeout = heartbeat_timeout_s(
+            heartbeat_timeout if heartbeat_timeout is not None else fault_timeout
+        )
+        #: slow-rank demotion threshold for :meth:`observe_step_medians`
+        #: (``ADAPCC_SLOW_RANK_FACTOR`` overrides)
+        self.slow_factor = slow_rank_factor(
+            slow_factor if slow_factor is not None else 2.0
+        )
+        #: deterministic fault injection (adapcc_tpu.elastic.faults): down
+        #: ranks' arrivals are dropped at this funnel and the barriers'
+        #: expected counts shrink, so every failover path is exercisable on
+        #: CPU with no hardware and no wall-clock timeout
+        self.fault_plan = fault_plan
         # cost-model constants mirroring the reference's defaults
         # (rpc_server.py:41-46): a nominal accumulated gradient size and an
         # aggregate bandwidth proportional to the world size
@@ -64,6 +97,14 @@ class CoordinatorLogic:
         self._ready: Dict[int, List[int]] = defaultdict(list)
         self._frozen: Dict[int, List[int]] = {}
         self._heartbeats: Dict[int, List[int]] = defaultdict(list)
+        self._shutdown = False
+        self._worldview = WorldView.full(world_size)
+        # plan-fold bookkeeping: the newest step whose fault state has been
+        # applied (late arrivals for older steps must not regress the view)
+        # and the relay set the PLAN installed (so plan updates never
+        # clobber relays the slow-rank rule demoted independently)
+        self._plan_step = -1
+        self._plan_relays: frozenset = frozenset()
 
     def calibrate(self, total_grad_bytes: float, link_bandwidth_gbps: float) -> None:
         """Replace the reference's hardcoded cost constants
@@ -96,10 +137,58 @@ class CoordinatorLogic:
         ratio = ((m - 1) / m) / ((n - 1) / n)
         return self._initial_rent_cost() * ratio + n * self.accumulated_size / self.accumulated_bandwidth
 
+    def _check_shutdown_locked(self) -> None:
+        if self._shutdown:
+            raise CoordinatorShutdown("coordinator stopped")
+
+    def _plan_down_locked(self, step: int) -> frozenset:
+        """Injected-dead ranks at ``step`` (empty without a fault plan).
+        Folding the plan into the world picture happens here — the single
+        funnel every arrival passes through — so detection is deterministic
+        and the WorldView epoch advances exactly when membership changes.
+
+        The fold is MONOTONE in step: a relay worker landing its arrival
+        for an older step (the rent-or-buy design explicitly allows that)
+        replays that step's barrier but must not regress the world picture
+        to the older fault state.  Plan-installed relays are tracked
+        separately so applying the plan never clobbers demotions the
+        slow-rank rule (:meth:`observe_step_medians`) installed on its own.
+        """
+        if self.fault_plan is None:
+            return frozenset()
+        state = self.fault_plan.state_at(step)
+        if step >= self._plan_step:
+            self._plan_step = step
+            plan_slow = frozenset(state.slow_map)
+            kept = (self._worldview.relays - self._plan_relays) | plan_slow
+            self._plan_relays = plan_slow
+            self._worldview = self._worldview.with_alive(
+                frozenset(range(self.world_size)) - state.down
+            ).with_relays(kept)
+        return state.down
+
     def hook_arrive(self, step: int, rank: int) -> List[int]:
         """Register ``rank`` as ready for ``step``; block until the active
-        list is frozen; return it.  Thread-safe, reentrant across steps."""
+        list is frozen; return it.  Thread-safe, reentrant across steps.
+
+        With a fault plan attached, a rank the plan marks down at this step
+        is dropped at the funnel: its arrival never joins the ready list
+        (the injected analog of the dead worker whose RPC never lands) and
+        it learns the frozen list like a late relay.  The freeze barrier
+        shrinks to the injected-alive count so the decision is reached
+        deterministically, with no wall-clock timeout in the loop.
+        """
         with self._cond:
+            self._check_shutdown_locked()
+            down = self._plan_down_locked(step)
+            expected = self.world_size - len(down)
+            if rank in down:
+                # injected-dead: the arrival is dropped; wait out the freeze
+                # like a relay so the caller still unblocks deterministically
+                while step not in self._frozen:
+                    self._check_shutdown_locked()
+                    self._cond.wait(timeout=self.time_slot)
+                return list(self._frozen[step])
             if step in self._frozen:
                 # relay worker: the train has left, learn who's on it
                 return list(self._frozen[step])
@@ -110,7 +199,8 @@ class CoordinatorLogic:
             if len(self._ready[step]) > 1:
                 # active waiting worker: sleep until the leader freezes
                 while step not in self._frozen:
-                    self._cond.wait()
+                    self._check_shutdown_locked()
+                    self._cond.wait(timeout=self.time_slot)
                 return list(self._frozen[step])
 
             # leader: rent-or-buy wait loop.  Unlike the reference
@@ -135,9 +225,13 @@ class CoordinatorLogic:
 
             t0 = time.monotonic()
             while True:
+                self._check_shutdown_locked()
                 accumulated_rent = time.monotonic() - t0
                 num_ready = len(self._ready[step])
-                if num_ready == self.world_size:
+                # the freeze barrier is the *injected-alive* count: a plan's
+                # dead ranks can never arrive, so waiting for the full world
+                # would always ride the rent clock to the relay threshold
+                if num_ready == expected:
                     break
                 if num_ready > 1:
                     if (
@@ -157,30 +251,97 @@ class CoordinatorLogic:
 
     def controller_arrive(self, step: int, rank: int) -> Tuple[List[int], int]:
         """Heartbeat for ``step``; block until all ranks heartbeat (then
-        return the frozen active list, status 1) or the fault timeout expires
-        (then return the alive list, status 0)."""
+        return the frozen active list, status 1) or the heartbeat timeout
+        expires (then return the alive list, status 0).
+
+        With a fault plan, injected-dead ranks never count toward the
+        barrier and their own heartbeats are dropped, so the alive subset
+        surfaces with status 0 *deterministically* — the CPU-testable twin
+        of the wall-clock timeout path.  Either status-0 exit also records
+        the detection in the :class:`WorldView` (alive set shrunk, epoch
+        bumped), which is what downstream plan failover keys on.
+        """
         with self._cond:
+            self._check_shutdown_locked()
+            down = self._plan_down_locked(step)
+            if rank in down:
+                # injected-dead rank: its heartbeat is dropped at the funnel;
+                # it learns the alive picture like everyone else
+                return sorted(set(range(self.world_size)) - down), 0
             self._heartbeats[step].append(rank)
             self._cond.notify_all()
 
-            deadline = time.monotonic() + self.fault_timeout
-            while len(self._heartbeats[step]) < self.world_size:
+            expected = self.world_size - len(down)
+            deadline = time.monotonic() + self.heartbeat_timeout
+            while len(self._heartbeats[step]) < expected:
+                self._check_shutdown_locked()
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    return list(self._heartbeats[step]), 0
+                    alive = list(self._heartbeats[step])
+                    self._worldview = self._worldview.with_alive(alive)
+                    return alive, 0
                 self._cond.wait(timeout=remaining)
+
+            if down:
+                # every injected-alive rank reported; surface the alive
+                # subset with status 0 without waiting out any clock
+                alive = sorted(self._heartbeats[step])
+                self._worldview = self._worldview.with_alive(alive)
+                return alive, 0
 
             # everyone is alive; hand out the hook phase's decision
             while step not in self._frozen:
+                self._check_shutdown_locked()
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    return list(self._heartbeats[step]), 0
+                    alive = list(self._heartbeats[step])
+                    self._worldview = self._worldview.with_alive(alive)
+                    return alive, 0
                 self._cond.wait(timeout=remaining)
             # bounded history (the reference preallocates 1M steps instead,
             # rpc_server.py:29-34); participants are never 1000 steps apart
             if step % 100 == 0:
                 self._forget_locked(step - 1000)
             return list(self._frozen[step]), 1
+
+    # -- world view / elastic surface ------------------------------------------
+
+    def worldview(self):
+        """The coordinator's current :class:`~adapcc_tpu.elastic.worldview.
+        WorldView` — alive set, relay set, epoch counter — the explicit
+        output plan failover consumes (a bare active list cannot say
+        *why* a rank is absent or whether anything changed)."""
+        with self._cond:
+            return self._worldview
+
+    def observe_step_medians(self, medians: Mapping[int, float]):
+        """Feed per-rank step medians (the DispatchTimer data already
+        flowing through the tuner) into the slow-rank rule: ranks slower
+        than ``slow_factor ×`` their peers' median are demoted to
+        forwarding relays; ranks that caught back up are promoted.
+        Returns the (possibly epoch-bumped) WorldView."""
+        from adapcc_tpu.elastic.worldview import slow_ranks_from_medians
+
+        slow = slow_ranks_from_medians(medians, factor=self.slow_factor)
+        with self._cond:
+            self._worldview = self._worldview.with_relays(slow)
+            return self._worldview
+
+    def mark_down(self, ranks) -> None:
+        with self._cond:
+            self._worldview = self._worldview.with_down(ranks)
+
+    def mark_recovered(self, ranks) -> None:
+        with self._cond:
+            self._worldview = self._worldview.with_recovered(ranks)
+
+    def shutdown(self) -> None:
+        """Drain every blocked waiter with :class:`CoordinatorShutdown`
+        (the explicit sentinel ``CoordinatorServer.stop`` fires before
+        tearing the gRPC server down)."""
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
 
     # -- introspection / GC ----------------------------------------------------
 

@@ -18,7 +18,7 @@ from typing import List, Optional, Tuple
 
 import grpc
 
-from adapcc_tpu.coordinator.logic import CoordinatorLogic
+from adapcc_tpu.coordinator.logic import CoordinatorLogic, CoordinatorShutdown
 from adapcc_tpu.coordinator.protocol import coordinator_pb2 as pb
 
 _SERVICE = "coordinator.Coordinator"
@@ -65,16 +65,31 @@ class CoordinatorServer:
         return self
 
     def stop(self, grace: Optional[float] = 0.5) -> None:
+        """Drain in-flight waiters, then stop the transport.
+
+        ``logic.shutdown()`` wakes every RPC handler blocked on the
+        condition variable with an explicit sentinel (turned into an
+        UNAVAILABLE abort below), so a worker parked in
+        ``send_ready_request`` unblocks with a clean error instead of
+        hanging until its channel times out long after the server is gone.
+        """
+        self.logic.shutdown()
         self._server.stop(grace)
 
     # -- rpc handlers ----------------------------------------------------------
 
     def _controller_fetch(self, request, context):
-        active, status = self.logic.controller_arrive(request.step, request.world_rank)
+        try:
+            active, status = self.logic.controller_arrive(request.step, request.world_rank)
+        except CoordinatorShutdown:
+            context.abort(grpc.StatusCode.UNAVAILABLE, "coordinator stopped")
         return pb.cont_response(active_list=active, status=status)
 
     def _hook_fetch(self, request, context):
-        active = self.logic.hook_arrive(request.step, request.world_rank)
+        try:
+            active = self.logic.hook_arrive(request.step, request.world_rank)
+        except CoordinatorShutdown:
+            context.abort(grpc.StatusCode.UNAVAILABLE, "coordinator stopped")
         return pb.hook_response(active_list=active)
 
 

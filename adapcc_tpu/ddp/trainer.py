@@ -265,6 +265,12 @@ class DDPTrainer:
         self._coord_calibrated = False
         self._compiled: Optional[Callable] = None
         self._scan_cache: dict = {}  # ("scan", n_steps) → compiled program
+        # elastic plan failover (adapcc_tpu.elastic, docs/ELASTIC.md):
+        # compiled step programs keyed by the strategy fingerprint they were
+        # traced under.  prewarm() AOT-compiles a standby strategy's step;
+        # adopt_strategy() then swaps to it as a dispatch-time cache-key
+        # switch — the training-loop twin of the engine's standby plan cache
+        self._program_cache: dict = {}  # fingerprint → compiled step
         self._host_step = 0
         # optional gradient-noise-scale measurement (units-test/get_gns.py):
         # the per-rank vs allreduced gradient norms fall out of the sync step
@@ -654,8 +660,13 @@ class DDPTrainer:
         """
         self._check_state(state)
         if self._compiled is None:
-            self._compiled = self._build()
-            self._build_gen += 1
+            key = self._program_key()
+            fn = self._program_cache.get(key)
+            if fn is None:
+                fn = self._build()
+                self._build_gen += 1  # an actual (re)trace, not a cache hit
+                self._program_cache[key] = fn
+            self._compiled = fn
         if not self._coord_calibrated:
             # rent-or-buy calibration: this trainer's actual gradient volume
             # + the bootstrap's profiled link bandwidth replace the
@@ -920,10 +931,99 @@ class DDPTrainer:
 
     # -- re-adaptation ---------------------------------------------------------
 
+    def _program_key(self, strategy: Optional[Strategy] = None) -> tuple:
+        """Compiled-step cache key: everything the traced program bakes in
+        that can change at runtime — the strategy shape, the wire codec
+        (tuner adoption rewrites ``hook.compress``), and the overlap
+        schedule.  Two configurations sharing a key replay one program;
+        anything else retraces."""
+        s = strategy if strategy is not None else self.hook.strategy
+        return (s.fingerprint(), self.hook.effective_compress(), self.overlap)
+
     def rebuild(self, strategy: Strategy) -> None:
         """Swap in a freshly synthesized strategy and recompile the step
-        (the reconstruct_topology analog for the training loop)."""
+        (the reconstruct_topology analog for the training loop).  A
+        strategy whose program was already compiled under the current
+        codec/overlap (a prewarmed standby, or a swap back after
+        recovery) is a cache hit — the swap costs one dict lookup."""
         self.hook.strategy = strategy
         self.hook.reset_plan()
         self._compiled = None
         self._scan_cache.clear()  # scanned programs trace the old schedule too
+
+    # -- elastic plan failover (docs/ELASTIC.md) -------------------------------
+
+    @property
+    def recompiles(self) -> int:
+        """How many step programs were actually traced+compiled — the
+        counter the elastic acceptance test pins: a failover onto a
+        prewarmed standby strategy must NOT increment it."""
+        return self._build_gen
+
+    def prewarm(
+        self,
+        strategy: Strategy,
+        state: "TrainState",
+        batch: Any,
+        active_mask: Optional[jnp.ndarray] = None,
+    ) -> bool:
+        """AOT-compile the step program for a standby ``strategy`` on the
+        real state/batch shapes, so a later :meth:`adopt_strategy` is a
+        dispatch-time switch with no recompile stall on the failover step.
+
+        One throwaway dispatch traces + compiles the program; its outputs
+        are discarded, and the prewarmed program is built WITHOUT donation
+        (the caller's live state must survive the warmup dispatch — the
+        cost is one extra state copy per step on that program, which a
+        degraded epoch tolerates).  Returns False when
+        the program was already warm.  Banked modes (async relay, error
+        feedback) thread per-step buffers the throwaway dispatch would
+        corrupt, so they are rejected here — prewarm before training
+        starts, or run those modes with the cold-swap path.
+        """
+        if not self.bsp or self.error_feedback:
+            raise ValueError(
+                "prewarm() supports the plain BSP step only: banked modes "
+                "(async relay / error feedback) carry per-step buffers a "
+                "throwaway warmup dispatch would corrupt"
+            )
+        self._check_state(state)
+        saved_strategy = self.hook.strategy
+        saved_donate = self.donate_state
+        # the key must resolve under the SWAPPED strategy: with
+        # compress="strategy" the effective codec is the standby
+        # strategy's synthesized wire_dtype, not the incumbent's
+        self.hook.strategy = strategy
+        self.donate_state = False
+        try:
+            key = self._program_key()
+            if key in self._program_cache:
+                return False
+            fn = self._build()
+            self._build_gen += 1
+            args = [state, batch]
+            if self._dynamic_mask:
+                if active_mask is None:
+                    active_mask = jnp.ones(
+                        (self.mesh.devices.size,), dtype=jnp.bool_
+                    )
+                args.append(active_mask)
+            jax.block_until_ready(fn(*args))
+        finally:
+            self.hook.strategy = saved_strategy
+            self.donate_state = saved_donate
+        self._program_cache[key] = fn
+        return True
+
+    def adopt_strategy(self, strategy: Strategy) -> bool:
+        """Hot-swap the training step onto ``strategy``.
+
+        Returns True when the swap hit a prewarmed program (dispatch-time
+        cache-key switch — the no-recompile failover the standby cache
+        exists for) and False when it fell back to a cold rebuild (an
+        unanticipated world shape; the next step pays the compile).
+        """
+        self.rebuild(strategy)
+        # resolved AFTER the swap so a compress="strategy" hook keys on
+        # the adopted strategy's codec (exactly what step() will look up)
+        return self._program_key() in self._program_cache

@@ -1,0 +1,65 @@
+"""Elastic fault tolerance: the detect → re-plan → hot-swap loop.
+
+The subsystem closes ROADMAP open item 1 — the paper's signature
+robustness behaviors on the *compiled* data plane:
+
+- :mod:`~adapcc_tpu.elastic.faults` — deterministic fault injection
+  (``FaultPlan``; ``ADAPCC_FAULT_PLAN`` env artifact) so every failover
+  path is exercisable on CPU and priced by the cost model;
+- :mod:`~adapcc_tpu.elastic.worldview` — the coordinator's explicit
+  ``WorldView`` (alive set, relay set, epoch counter) plus the slow-rank
+  demotion rule over DispatchTimer step medians;
+- :mod:`~adapcc_tpu.elastic.standby` — sim-ranked degraded plans
+  (one-rank-down, one-host-down) AOT-compiled at setup, so a world shrink
+  is a dispatch-time cache-key switch, not a cold recompile stall;
+- :mod:`~adapcc_tpu.elastic.rebalance` — ZeRO-1 shard re-balance on a
+  world change, validated through the checkpoint layout-tag funnel.
+
+See docs/ELASTIC.md for the lifecycle and the failover cost rows.
+"""
+
+from adapcc_tpu.elastic.faults import (
+    DEFAULT_SLOWDOWN,
+    FAULT_PLAN_ENV,
+    FaultEvent,
+    FaultPlan,
+    FaultState,
+    load_fault_plan,
+)
+from adapcc_tpu.elastic.rebalance import (
+    rebalance_zero1_pair,
+    reshard_zero1_snapshot,
+    shrink_zero1_trainer_state,
+)
+from adapcc_tpu.elastic.standby import (
+    StandbyPlan,
+    StandbyPlanCache,
+    degraded_scenarios,
+    reemit_for_active,
+)
+from adapcc_tpu.elastic.worldview import (
+    HEARTBEAT_TIMEOUT_ENV,
+    SLOW_RANK_FACTOR_ENV,
+    WorldView,
+    slow_ranks_from_medians,
+)
+
+__all__ = [
+    "DEFAULT_SLOWDOWN",
+    "FAULT_PLAN_ENV",
+    "FaultEvent",
+    "FaultPlan",
+    "FaultState",
+    "HEARTBEAT_TIMEOUT_ENV",
+    "SLOW_RANK_FACTOR_ENV",
+    "StandbyPlan",
+    "StandbyPlanCache",
+    "WorldView",
+    "degraded_scenarios",
+    "load_fault_plan",
+    "rebalance_zero1_pair",
+    "reemit_for_active",
+    "reshard_zero1_snapshot",
+    "shrink_zero1_trainer_state",
+    "slow_ranks_from_medians",
+]

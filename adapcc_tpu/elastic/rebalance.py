@@ -1,0 +1,230 @@
+"""Elastic ZeRO-1 re-balance: reshard the flat optimizer state on a world
+change, validated through the checkpoint layout-tag funnel.
+
+PR 1's checkpoint layout tags (``extra["zero1_layout"] = {ring, align,
+world}``) exist precisely so a ZeRO-1 master restored under the wrong
+geometry fails loudly.  Elastic shrink/grow is the one *legitimate* layout
+change: the flat ``[world, N/world]`` master and its mirrored optimizer
+shards are gathered back to the canonical flat vector (undoing any ring
+chunk ownership), re-padded and re-split for the new world, and re-tagged.
+The result then flows through the EXISTING ``apply_snapshot`` load funnel,
+whose layout guard verifies the re-tagged snapshot against the resuming
+optimizer's declared geometry — so a reshard is exactly as validated as a
+resume, and an un-resharded snapshot still refuses to load.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional, Tuple
+
+import jax
+import numpy as np
+
+from adapcc_tpu.checkpoint import TrainCheckpointState
+from adapcc_tpu.parallel.fsdp import Zero1Optimizer, _flatten_meta
+
+
+def _require_layout(extra: Optional[Mapping[str, Any]]) -> Mapping[str, Any]:
+    layout = (extra or {}).get(Zero1Optimizer.LAYOUT_KEY)
+    if layout is None:
+        raise ValueError(
+            "snapshot carries no zero1 layout tag (extra["
+            f"{Zero1Optimizer.LAYOUT_KEY!r}]); cannot re-balance a master "
+            "of unknown chunk geometry — save with "
+            "Zero1Optimizer.checkpoint_extra() first"
+        )
+    return layout
+
+
+def _to_canonical_flat(
+    rows: np.ndarray, layout: Mapping[str, Any], total: int
+) -> np.ndarray:
+    """``[old_world, L_old]`` shard rows → the canonical flat ``[total]``
+    vector (ring ownership unrolled, padding dropped)."""
+    world = int(layout["world"])
+    if rows.ndim != 2 or rows.shape[0] != world:
+        raise ValueError(
+            f"expected a [world={world}, shard] array, got shape {rows.shape}"
+        )
+    if layout.get("ring"):
+        # init() assigned row r ← chunk (r+1) % world (jnp.roll(..., -1));
+        # rolling +1 restores chunk order
+        rows = np.roll(rows, 1, axis=0)
+    flat = rows.reshape(-1)
+    if flat.size < total:
+        raise ValueError(
+            f"flat master holds {flat.size} elements but the param tree "
+            f"needs {total}; the snapshot belongs to a different model"
+        )
+    return flat[:total]
+
+
+def _to_layout_rows(
+    flat: np.ndarray, meta, world: int, ring: bool
+) -> np.ndarray:
+    """Canonical flat ``[total]`` vector → ``[new_world, L_new]`` rows in
+    the target layout (padded, ring-rolled when the target rides the ring)."""
+    padded = np.pad(flat, (0, meta.padded - flat.size))
+    rows = padded.reshape(world, meta.padded // world)
+    if ring:
+        rows = np.roll(rows, -1, axis=0)
+    return rows
+
+
+def rebalance_zero1_pair(
+    opt_pair: Tuple[Any, Any],
+    params: Any,
+    old_layout: Mapping[str, Any],
+    new_opt: Zero1Optimizer,
+) -> Tuple[np.ndarray, Any]:
+    """Reshard a ``(master [old_world, L], opt-state shards)`` pair onto
+    ``new_opt``'s geometry.
+
+    The optimizer shards mirror the master's layout leaf-by-leaf
+    (``vmap(tx.init)`` over the master rows): per-element moment buffers
+    ``[old_world, L]`` reshard exactly like the master; per-shard scalars
+    (adam's ``count``, shape ``[old_world]``) are world-replicated by
+    construction, so the first row's value fans out to the new world.
+    Padding regions hold zeros on both sides of the move (gradients never
+    land there), so truncate-and-repad is lossless.
+    """
+    master, opt_state = opt_pair
+    old_world = int(old_layout["world"])
+    old_align = int(old_layout.get("align", 1))
+    new_layout = new_opt.layout_metadata()
+    meta_old = _flatten_meta(params, old_world, old_align)
+    meta_new = _flatten_meta(params, new_opt.world, new_opt._align())
+    total = meta_old.total
+    if meta_new.total != total:
+        raise ValueError(
+            f"param tree sizes disagree: {total} vs {meta_new.total}"
+        )
+
+    def reshard_rows(leaf: np.ndarray) -> np.ndarray:
+        flat = _to_canonical_flat(np.asarray(leaf), old_layout, total)
+        return _to_layout_rows(
+            flat, meta_new, new_opt.world, bool(new_layout["ring"])
+        ).astype(np.asarray(leaf).dtype)
+
+    new_master = reshard_rows(np.asarray(master))
+
+    def one(leaf):
+        arr = np.asarray(leaf)
+        if arr.shape == (old_world, meta_old.padded // old_world):
+            return reshard_rows(arr)
+        if arr.shape == (old_world,):
+            # per-shard scalar (e.g. adam count): replicated by construction
+            return np.full((new_opt.world,), arr[0], arr.dtype)
+        if arr.shape == ():
+            return arr
+        raise ValueError(
+            f"cannot re-balance optimizer leaf of shape {arr.shape}; "
+            f"expected [{old_world}, shard], [{old_world}] or scalar"
+        )
+
+    new_opt_state = jax.tree_util.tree_map(one, opt_state)
+    # record the target meta so the resharded pair is immediately usable
+    # by new_opt.apply() without an init() that would reset the master
+    new_opt._meta = meta_new
+    new_opt._compiled = None
+    return new_master, new_opt_state
+
+
+def reshard_zero1_snapshot(
+    snapshot: TrainCheckpointState,
+    params: Any,
+    new_opt: Zero1Optimizer,
+) -> TrainCheckpointState:
+    """Re-balance a tagged ZeRO-1 snapshot onto ``new_opt``'s world and
+    validate the result at the EXISTING ``apply_snapshot`` load funnel.
+
+    The returned state was produced by applying the re-tagged snapshot to a
+    receiving state that *declares* the new layout — so the same guard that
+    blocks a mis-matched resume has positively verified this reshard, and
+    ``new_opt.restore(returned_state)`` places the pair on the new mesh.
+    """
+    old_layout = _require_layout(snapshot.extra)
+    new_pair = rebalance_zero1_pair(
+        snapshot.opt_state, params, old_layout, new_opt
+    )
+    resharded = TrainCheckpointState(
+        params=snapshot.params,
+        opt_state=new_pair,
+        epoch=snapshot.epoch,
+        step=snapshot.step,
+        best_metric=snapshot.best_metric,
+        extra=new_opt.checkpoint_extra(
+            {k: v for k, v in (snapshot.extra or {}).items()
+             if k != Zero1Optimizer.LAYOUT_KEY}
+        ),
+    )
+    # the load funnel: a receiver declaring the NEW layout applies the
+    # re-tagged snapshot; the layout guard runs on this exact path
+    receiver = TrainCheckpointState(
+        params=params,
+        opt_state=new_pair,  # template with the target structure
+        extra=new_opt.checkpoint_extra(),
+    )
+    receiver.apply_snapshot(resharded.capture_snapshot())
+    return receiver
+
+
+def shrink_zero1_trainer_state(
+    trainer,
+    state,
+    old_world: Optional[int] = None,
+):
+    """Re-balance a ZeRO-1 :class:`~adapcc_tpu.ddp.trainer.TrainState`
+    produced under a LARGER world onto ``trainer``'s (already smaller)
+    mesh — the mid-run shrink path.
+
+    ``trainer`` must be a ``zero1=True`` DDPTrainer whose ``init_state``
+    has been called once (so its optimizer geometry exists); ``state`` is
+    the old-world TrainState.  Returns a TrainState on the new world with
+    identical canonical master/opt content, validated through the
+    checkpoint funnel.
+    """
+    from adapcc_tpu.ddp.trainer import TrainState
+
+    opt = trainer._zero1_opt
+    if opt is None:
+        raise ValueError(
+            "call trainer.init_state(params) once before shrinking into it: "
+            "the target optimizer geometry comes from the constructed "
+            "Zero1Optimizer"
+        )
+    master, opt_state = state.opt_state
+    if old_world is None:
+        old_world = int(np.asarray(master).shape[0])
+    # the OLD layout: same ring/align discipline as the target (one trainer
+    # configuration, two worlds) — only the world differs
+    old_layout = dict(opt.layout_metadata())
+    old_layout["world"] = int(old_world)
+    snap = TrainCheckpointState(
+        params=state.params,
+        opt_state=(np.asarray(master), jax.device_get(opt_state)),
+        step=int(state.step),
+        extra={Zero1Optimizer.LAYOUT_KEY: old_layout},
+    )
+    restored = reshard_zero1_snapshot(snap, state.params, opt)
+    new_master, new_opt_state = opt.restore(restored)
+    # replicated leaves (params, step, model collections) were committed to
+    # the OLD mesh's devices; re-place them on the new mesh or the first
+    # step dies on a device mismatch between params and the resharded pair
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    replicated = NamedSharding(opt.mesh, P())
+
+    def replace(tree):
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(jax.device_get(leaf), replicated)
+            if isinstance(leaf, jax.Array) else leaf,
+            tree,
+        )
+
+    return TrainState(
+        params=replace(state.params),
+        opt_state=(new_master, new_opt_state),
+        step=replace(state.step),
+        model_state=replace(state.model_state),
+    )

@@ -1,0 +1,290 @@
+"""Standby plan cache: pre-compiled degraded plans for hot failover.
+
+GC3 treats communication schedules as compiled programs that must be
+*swapped*, not patched; TACCL shows degraded strategies are cheap to
+re-synthesize when the topology sketch changes (PAPERS.md).  This module
+does both ahead of time: for every plausible world shrink (each one-rank-
+down, each one-host-down), a strategy is re-emitted over the alive subset
+(dead ranks pushed to prunable leaf tails — relay masks are already in the
+IR), the candidates are sim-ranked on the calibrated α-β replay, and the
+top-k winners are AOT-compiled against the live engine — so when the
+coordinator's WorldView actually shrinks, the swap is a dispatch-time
+cache-key switch, not a cold recompile stall.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from adapcc_tpu.strategy.ir import Strategy, Tree
+
+
+def degraded_scenarios(
+    world: int,
+    ips: Optional[Mapping[int, str]] = None,
+    include_hosts: bool = True,
+) -> List[Tuple[str, FrozenSet[int]]]:
+    """The shrink shapes worth pre-compiling: every one-rank-down subset,
+    plus (multi-host worlds) every one-host-down subset — the preemptible-
+    pod failure units.  Labels are stable and deterministic."""
+    if world < 2:
+        return []
+    everyone = frozenset(range(world))
+    out: List[Tuple[str, FrozenSet[int]]] = [
+        (f"rank{r}-down", everyone - {r}) for r in range(world)
+    ]
+    if include_hosts and ips:
+        hosts: Dict[str, set] = {}
+        for r in range(world):
+            hosts.setdefault(ips.get(r, ""), set()).add(r)
+        if len(hosts) > 1:
+            for host, ranks in sorted(hosts.items()):
+                if len(ranks) < world:  # never the whole world
+                    out.append((f"host[{host}]-down", everyone - ranks))
+    # the one-rank scenarios subsume single-rank hosts; dedup by subset
+    seen: Dict[FrozenSet[int], str] = {}
+    deduped = []
+    for label, active in out:
+        if active not in seen:
+            seen[active] = label
+            deduped.append((label, active))
+    return deduped
+
+
+def reemit_for_active(
+    world: int,
+    active: Iterable[int],
+    ips: Optional[Mapping[int, str]] = None,
+    num_trans: int = 1,
+    shape: str = "ring",
+    like: Optional[Strategy] = None,
+) -> Strategy:
+    """Re-emit a strategy over the alive subset.
+
+    The IR requires trees to span the full world (relay masks are runtime
+    state), so "over the alive subset" means: alive ranks form the working
+    chain/heap, dead ranks hang off the tail as prunable leaf subtrees —
+    :func:`adapcc_tpu.comm.relay.prune_reduce_rounds` then drops every
+    dead edge, and the simulated replay prices exactly the alive-only
+    schedule.  Roots rotate over ALIVE ranks only: a dead root could never
+    source a broadcast (the engine rejects that loudly).
+
+    ``like`` carries the incumbent strategy's data-plane settings —
+    synthesized ``chunk_bytes`` and ``wire_dtype`` — into the degraded
+    plan: a failover must not silently downgrade the wire format or reset
+    the ring granularity during exactly the window the fabric is already
+    degraded.
+    """
+    act = sorted(set(int(r) for r in active))
+    if not act:
+        raise ValueError("cannot re-emit a strategy for an empty active set")
+    bad = [r for r in act if not 0 <= r < world]
+    if bad:
+        raise ValueError(f"active ranks {bad} outside world [0, {world})")
+    dead = [r for r in range(world) if r not in act]
+    ips = dict(ips or {})
+    trees: List[Tree] = []
+    n = len(act)
+    for t in range(max(1, num_trans)):
+        order = [act[(t + i) % n] for i in range(n)] + dead
+        children: Dict[int, List[int]] = {}
+        if shape == "ring":
+            for i in range(len(order) - 1):
+                children[order[i]] = [order[i + 1]]
+        elif shape == "binary":
+            for i in range(len(order)):
+                kids = [order[j] for j in (2 * i + 1, 2 * i + 2) if j < len(order)]
+                if kids:
+                    children[order[i]] = kids
+        else:
+            raise ValueError(f"unknown degraded shape {shape!r}")
+        trees.append(Tree(order[0], children, ips))
+    out = Strategy(
+        trees, world, synthesis=f"degraded-{shape}", shares=None
+    )
+    if like is not None:
+        out.chunk_bytes = like.chunk_bytes
+        out.wire_dtype = like.wire_dtype
+    return out
+
+
+@dataclass
+class StandbyPlan:
+    """One pre-ranked degraded plan: the strategy to swap to when the
+    world shrinks to ``active``."""
+
+    label: str
+    active: FrozenSet[int]
+    strategy: Strategy
+    predicted_s: float
+    #: whether the engine's compiled-program cache was pre-populated
+    warmed: bool = False
+
+    def to_row(self) -> dict:
+        return {
+            "label": self.label,
+            "active": sorted(self.active),
+            "strategy": self.strategy.synthesis,
+            "pred_time_us": round(self.predicted_s * 1e6, 3),
+            "warmed": self.warmed,
+        }
+
+
+class StandbyPlanCache:
+    """Epoch-keyed standby plans over one :class:`CollectiveEngine`.
+
+    Lifecycle::
+
+        cache = StandbyPlanCache(engine, nbytes=grad_bytes)
+        cache.build()                      # sim-rank every shrink scenario
+        cache.warm(shape, dtype)           # AOT-compile the top-k plans
+        ...
+        plan, epoch = cache.activate(worldview.alive)   # dispatch-time swap
+        ...
+        epoch = cache.restore_full()       # recovery: back to the base plan
+
+    ``activate`` looks the alive set up in the cache; a hit swaps the
+    engine's strategy under a fresh epoch with the compiled programs
+    already warm.  A miss (an unanticipated multi-failure shape) re-emits
+    on the spot — correct, but a cold compile at the first dispatch, which
+    the plan row records as ``warmed=False`` so the stall is attributable.
+    """
+
+    def __init__(
+        self,
+        engine,
+        nbytes: float = 16 * 1024 * 1024,
+        top_k: int = 4,
+        cost_model=None,
+        num_trans: Optional[int] = None,
+        shapes: Sequence[str] = ("ring", "binary"),
+        include_hosts: bool = True,
+    ) -> None:
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        self.engine = engine
+        self.nbytes = float(nbytes)
+        self.top_k = top_k
+        self.shapes = tuple(shapes)
+        self.include_hosts = include_hosts
+        self.num_trans = (
+            num_trans if num_trans is not None else engine.strategy.num_trans
+        )
+        if cost_model is None:
+            from adapcc_tpu.sim.calibrate import load_or_default
+
+            cost_model = load_or_default(world=engine.world_size)
+        self._ips = dict(engine.strategy.trees[0].ips or {})
+        if cost_model.ips is None and self._ips:
+            cost_model = cost_model.with_ips(self._ips)
+        self.cost_model = cost_model
+        #: base (full-world) strategy to restore on recovery
+        self.base_strategy = engine.strategy
+        self.plans: Dict[FrozenSet[int], StandbyPlan] = {}
+
+    # -- construction ----------------------------------------------------------
+
+    def _best_for(self, label: str, active: FrozenSet[int]) -> StandbyPlan:
+        """Sim-rank the re-emitted candidate shapes for one shrink scenario
+        on the degraded replay (dead edges pruned) and keep the fastest;
+        ties break by shape order so "ring" survives a prediction-identical
+        alternative (no plan churn for nothing)."""
+        from adapcc_tpu.sim.rank import relay_latency
+
+        world = self.engine.world_size
+        best: Optional[StandbyPlan] = None
+        for shape in self.shapes:
+            strategy = reemit_for_active(
+                world, active, self._ips, self.num_trans, shape,
+                like=self.base_strategy,
+            )
+            seconds = relay_latency(
+                strategy, self.cost_model, self.nbytes, sorted(active)
+            )
+            if best is None or seconds < best.predicted_s:
+                best = StandbyPlan(label, active, strategy, seconds)
+        assert best is not None  # self.shapes is never empty
+        return best
+
+    def build(self) -> List[StandbyPlan]:
+        """Re-emit + sim-rank every shrink scenario; returns the plans
+        fastest-first (the warm order)."""
+        self.plans = {}
+        for label, active in degraded_scenarios(
+            self.engine.world_size, self._ips, self.include_hosts
+        ):
+            self.plans[active] = self._best_for(label, active)
+        return self.ranked()
+
+    def ranked(self) -> List[StandbyPlan]:
+        return sorted(
+            self.plans.values(), key=lambda p: (p.predicted_s, p.label)
+        )
+
+    # -- AOT compile -----------------------------------------------------------
+
+    def warm(
+        self,
+        shape: Tuple[int, ...],
+        dtype=np.float32,
+        primitives: Sequence[str] = ("all_reduce",),
+        top_k: Optional[int] = None,
+    ) -> List[StandbyPlan]:
+        """AOT-compile the top-k plans' programs for a ``[world, *shape]``
+        payload: one throwaway zeros dispatch per (plan, primitive) under
+        the temporarily-swapped strategy populates the engine's compiled-
+        program cache, keyed by the standby fingerprint.  After this, a
+        real failover's first dispatch is a cache hit (`cache_hit: true`
+        in the dispatch trace) — the no-recompile property the elastic
+        acceptance test pins."""
+        import jax.numpy as jnp
+
+        if not self.plans:
+            self.build()
+        k = top_k if top_k is not None else self.top_k
+        warmed = []
+        engine = self.engine
+        zeros = jnp.zeros((engine.world_size,) + tuple(shape), dtype)
+        for plan in self.ranked()[:k]:
+            saved = engine.strategy
+            engine.strategy = plan.strategy
+            try:
+                for prim in primitives:
+                    getattr(engine, prim)(
+                        zeros, active_gpus=sorted(plan.active)
+                    )
+            finally:
+                engine.strategy = saved
+            plan.warmed = True
+            warmed.append(plan)
+        return warmed
+
+    # -- failover --------------------------------------------------------------
+
+    def plan_for(self, active: Iterable[int]) -> StandbyPlan:
+        key = frozenset(int(r) for r in active)
+        hit = self.plans.get(key)
+        if hit is not None:
+            return hit
+        # unanticipated shrink shape (multi-failure): re-emit on the spot —
+        # correct but cold; the plan row says so
+        plan = self._best_for(f"adhoc-{sorted(key)}", key)
+        self.plans[key] = plan
+        return plan
+
+    def activate(self, active: Iterable[int]) -> Tuple[StandbyPlan, int]:
+        """Swap the engine to the plan for ``active`` under a fresh epoch.
+        Returns ``(plan, epoch)``; collectives in flight against the old
+        epoch raise :class:`~adapcc_tpu.comm.engine.EpochMismatch` and
+        retry at the Communicator layer."""
+        plan = self.plan_for(active)
+        epoch = self.engine.advance_epoch(plan.strategy)
+        return plan, epoch
+
+    def restore_full(self) -> int:
+        """Recovery: swap back to the base full-world strategy (its
+        programs never left the cache) under a fresh epoch."""
+        return self.engine.advance_epoch(self.base_strategy)

@@ -637,6 +637,103 @@ def exposed_comm_floor_s(
     return sum(sync)  # microbatch: the drain is one delta's full sync
 
 
+# --------------------------------------------------------------------------- #
+# failover pricing (adapcc_tpu/elastic): detection latency + plan-swap stall
+# + degraded-ring steady state, the three terms a world shrink costs
+# --------------------------------------------------------------------------- #
+
+#: dispatch-time plan swap when the standby cache holds the compiled
+#: program: one cache-key switch + re-dispatch (a deliberately round
+#: number well above a dict lookup and below any compile; replaced by any
+#: measured calibration)
+DEFAULT_PLAN_SWAP_DISPATCH_S = 250e-6
+
+#: cold plan swap when no standby program exists: tracing + XLA compile of
+#: the degraded schedule (a round number of the right order for a pod-scale
+#: shard_map program; the standby cache exists to never pay it mid-run)
+DEFAULT_COLD_COMPILE_S = 2.0
+
+
+def detection_latency_s(
+    heartbeat_timeout_s: float, step_time_s: float = 0.0
+) -> float:
+    """Expected time from a rank dying to the coordinator knowing: half a
+    step (the death lands uniformly inside one) plus the heartbeat
+    timeout the controller barrier waits out before surfacing status 0."""
+    if heartbeat_timeout_s < 0 or step_time_s < 0:
+        raise ValueError("heartbeat timeout / step time must be >= 0")
+    return 0.5 * step_time_s + heartbeat_timeout_s
+
+
+def plan_swap_stall_s(
+    standby_cached: bool,
+    dispatch_s: float = DEFAULT_PLAN_SWAP_DISPATCH_S,
+    compile_s: float = DEFAULT_COLD_COMPILE_S,
+) -> float:
+    """The stall the failover step pays to start executing the degraded
+    plan: a dispatch-time cache-key switch when the standby cache was
+    warmed at setup, a cold trace+compile when it was not — the gap the
+    standby plan cache exists to close."""
+    return dispatch_s if standby_cached else dispatch_s + compile_s
+
+
+def failover_cost(
+    world: int,
+    nbytes: float,
+    coeffs: LinkCoeffs,
+    n_down: int = 1,
+    slowdown: Optional[float] = None,
+    heartbeat_timeout_s: float = 1.0,
+    step_time_s: float = 0.0,
+    standby_cached: bool = True,
+    wire_dtype: str = "off",
+) -> Dict[str, float]:
+    """Price one fault end to end: detection → swap → degraded steady
+    state (docs/ELASTIC.md).
+
+    - ``healthy_s`` — the full-world ring collective;
+    - ``undetected_s`` — the collective while the fault is live but NOT
+      yet handled: a slow rank (``slowdown``) stretches every hop it
+      touches; a dead rank would hang forever, priced as the heartbeat
+      timeout per step (the "instead of hanging" baseline);
+    - ``degraded_s`` — the collective on the re-planned alive subset
+      (``world - n_down`` ring; demoted relays forward but don't pace);
+    - ``detection_s`` / ``swap_s`` — one-time costs of the transition;
+    - ``degraded_ratio`` — degraded / healthy steady-state slowdown;
+    - ``failover_total_s`` — detection + swap: the one-time price of the
+      transition, amortized over every post-swap step.
+
+    Deterministic, analytic — the fault sweep's rows ride on it.
+    """
+    if world < 2:
+        raise ValueError(f"failover pricing needs world >= 2, got {world}")
+    if not 0 < n_down < world:
+        raise ValueError(f"n_down must be in (0, {world}), got {n_down}")
+    healthy = quantized_ring_allreduce_time(world, nbytes, coeffs, wire_dtype)
+    if slowdown is not None:
+        undetected = quantized_ring_allreduce_time(
+            world, nbytes, coeffs.scaled(slowdown), wire_dtype
+        )
+    else:
+        # a dead rank's ring never completes: until detection, every step
+        # burns the full heartbeat timeout instead of hanging forever
+        undetected = heartbeat_timeout_s
+    degraded = quantized_ring_allreduce_time(
+        world - n_down, nbytes, coeffs, wire_dtype
+    )
+    detection = detection_latency_s(heartbeat_timeout_s, step_time_s)
+    swap = plan_swap_stall_s(standby_cached)
+    return {
+        "healthy_s": healthy,
+        "undetected_s": undetected,
+        "degraded_s": degraded,
+        "degraded_ratio": degraded / healthy if healthy > 0 else 1.0,
+        "detection_s": detection,
+        "swap_s": swap,
+        "failover_total_s": detection + swap,
+    }
+
+
 def ring_allreduce_time(
     world: int, nbytes: float, coeffs: LinkCoeffs, chunks: int = 1
 ) -> float:

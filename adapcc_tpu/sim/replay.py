@@ -159,6 +159,117 @@ def simulate_xml(
     )
 
 
+@dataclass
+class FaultStepRow:
+    """One step of a fault-plan replay: the collective's predicted cost
+    under that step's fault state, plus the transition costs stamped on
+    the step where the world actually changed."""
+
+    step: int
+    epoch: int
+    alive: Tuple[int, ...]
+    relays: Tuple[int, ...]
+    seconds: float
+    #: world changed at this step (detection + swap were paid here)
+    swapped: bool = False
+    detection_s: float = 0.0
+    swap_s: float = 0.0
+    mode: str = "simulated"
+
+    def to_row(self) -> dict:
+        return {
+            "mode": self.mode,
+            "step": self.step,
+            "epoch": self.epoch,
+            "alive": list(self.alive),
+            "relays": list(self.relays),
+            "pred_time_us": round(self.seconds * 1e6, 3),
+            "swapped": self.swapped,
+            "detection_us": round(self.detection_s * 1e6, 3),
+            "swap_us": round(self.swap_s * 1e6, 3),
+        }
+
+
+def simulate_fault_plan(
+    strategy: Strategy,
+    cost_model: LinkCostModel,
+    nbytes: float,
+    plan,
+    steps: Optional[int] = None,
+    collective: str = "allreduce",
+    heartbeat_timeout_s: float = 1.0,
+    standby_cached: bool = True,
+) -> List[FaultStepRow]:
+    """Replay a :class:`~adapcc_tpu.elastic.faults.FaultPlan` through the
+    event simulator: every step's collective is priced under that step's
+    fault state — down ranks excluded and their edges relay-pruned, slow
+    ranks demoted to relays on a degraded (slowed-link) cost model — and
+    each world *transition* is stamped with the detection latency and the
+    plan-swap stall from the failover cost terms.
+
+    This is the CPU-exercisable twin of the live failover loop: the same
+    plan injected at the coordinator funnel produces the same epochs, and
+    these rows price what each epoch costs.  Deterministic — same plan,
+    same calibration → byte-identical rows.
+    """
+    from adapcc_tpu.sim.cost_model import (
+        detection_latency_s,
+        plan_swap_stall_s,
+    )
+
+    if plan.world != strategy.world_size:
+        raise ValueError(
+            f"fault plan world {plan.world} != strategy world "
+            f"{strategy.world_size}"
+        )
+    n_steps = steps if steps is not None else plan.last_step() + 2
+    rows: List[FaultStepRow] = []
+    prev_state = None
+    epoch = 0
+    healthy_s: Optional[float] = None
+    for step in range(n_steps):
+        state = plan.state_at(step)
+        slow = state.slow_map
+        model = cost_model
+        for rank, slowdown in sorted(slow.items()):
+            model = model.degraded([rank], slowdown)
+        contributing = sorted(
+            set(range(plan.world)) - state.down - set(slow)
+        ) or sorted(set(range(plan.world)) - state.down)
+        active = None if state.healthy else contributing
+        seconds = simulate_strategy(
+            strategy, model, nbytes, collective, active=active,
+            keep_transfers=False,
+        ).seconds
+        if healthy_s is None and state.healthy:
+            healthy_s = seconds
+        # a plan whose FIRST event lands at step 0 is still a transition
+        # (from the implicit healthy world before training): its detection
+        # + swap costs must be stamped, not silently dropped
+        swapped = (
+            state != prev_state
+            if prev_state is not None
+            else not state.healthy
+        )
+        rows.append(
+            FaultStepRow(
+                step=step,
+                epoch=(epoch := epoch + 1) if swapped else epoch,
+                alive=tuple(sorted(set(range(plan.world)) - state.down)),
+                relays=tuple(sorted(slow)),
+                seconds=seconds,
+                swapped=swapped,
+                detection_s=(
+                    detection_latency_s(heartbeat_timeout_s, healthy_s or 0.0)
+                    if swapped else 0.0
+                ),
+                swap_s=plan_swap_stall_s(standby_cached) if swapped else 0.0,
+            )
+        )
+        prev_state = state
+    return rows
+
+
 def simulate_flow_broadcast(
     flow, cost_model: LinkCostModel, nbytes: float
 ) -> SimTimeline:

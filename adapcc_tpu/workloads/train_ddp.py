@@ -215,6 +215,19 @@ def main(argv=None) -> None:
                 "--coordinator/--no-bsp/--profile_freq require --dp-mode ddp "
                 "(relay and re-adaptation ride the DDP gradient hook)"
             )
+        import os as _os
+
+        from adapcc_tpu.elastic import FAULT_PLAN_ENV
+
+        if _os.environ.get(FAULT_PLAN_ENV, "").strip():
+            # fault injection rides the DDP hook's relay masks; silently
+            # running a healthy world under a set plan would be the exact
+            # "set-but-broken is quiet" failure the env contract forbids
+            raise ValueError(
+                f"{FAULT_PLAN_ENV} requires --dp-mode ddp (fault injection "
+                "drives the DDP trainer's per-step relay masks; zero1/fsdp "
+                "have no relay plane to inject into)"
+            )
     if args.zero1_ring and args.dp_mode != "zero1":
         raise ValueError("--zero1-ring requires --dp-mode zero1")
     # one wire-codec knob across modes: --wire-dtype wins over the older
@@ -369,13 +382,30 @@ def main(argv=None) -> None:
         )
         state = TrainState.create(params, tx)
 
+        # deterministic fault injection (docs/ELASTIC.md): with
+        # ADAPCC_FAULT_PLAN set, each step's relay mask is derived from the
+        # plan's fault state — down/slow ranks stop contributing (and
+        # recover on schedule) through the SAME compiled dynamic-mask step,
+        # so the run exercises a real world shrink + recovery.  This is the
+        # data plane the elastic_failover battery entry measures.
+        from adapcc_tpu.elastic import load_fault_plan
+
+        fault_plan = load_fault_plan(world=world)
+        if fault_plan is not None:
+            print(f"fault injection: {fault_plan!r}")
+
         def run_step(step):
             nonlocal state
             # periodic re-adaptation (reference train_ddp.py:45-46)
             if args.profile_freq and step > 0 and step % args.profile_freq == 0:
                 AdapCC.reconstruct_topology(comm_args, ALLREDUCE)
                 trainer.rebuild(AdapCC.communicator.strategy)
-            state, loss = trainer.step(state, batch_fn(), step_idx=step)
+            mask = None
+            if fault_plan is not None:
+                mask = jnp.asarray(fault_plan.mask_at(step))
+            state, loss = trainer.step(
+                state, batch_fn(), step_idx=step, active_mask=mask
+            )
             return loss
 
     t_last = time.perf_counter()

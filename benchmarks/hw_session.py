@@ -178,7 +178,7 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
         for name in (
             "busbw_ici_128m", "ring_smoke", "ring_chunk_sweep",
             "busbw_wire_dtype", "busbw_fused_wire", "tuner_convergence",
-            "overlap_ab",
+            "overlap_ab", "elastic_failover",
         ):
             _skip(name, gate, out_path)
         return
@@ -266,6 +266,39 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
             900, out_path,
             rec_extra={"overlap": overlap, "accum": 2},
         )
+    # elastic failover drill on real chips (the hardware twin of
+    # `make elastic-bench`): a deterministic fault plan — the last rank
+    # dies mid-run, then recovers — injected via ADAPCC_FAULT_PLAN into the
+    # DDP workload; the workload derives per-step relay masks from the
+    # plan, so the run measures masked-step walltime through a real world
+    # shrink + recovery (the phase walltime vs overlap_ab's healthy run is
+    # the failover overhead).  The plan artifact rides next to the battery
+    # output so the injected schedule is part of the evidence.
+    plan_path = os.path.join(
+        os.path.dirname(out_path),
+        f"fault_plan_{os.path.basename(out_path)}.json",
+    )
+    with open(plan_path, "w") as f:
+        json.dump(
+            {
+                "world": world,
+                "label": "battery-failover",
+                "events": [
+                    {"step": 4, "kind": "down", "rank": world - 1},
+                    {"step": 8, "kind": "recover", "rank": world - 1},
+                ],
+            },
+            f,
+        )
+    _run(
+        "elastic_failover",
+        [py, "-m", "adapcc_tpu.workloads.train_ddp", "--model", "mlp",
+         "--steps", "12", "--batch", "64", "--world", str(world),
+         "--sync-mode", "schedule"],
+        900, out_path,
+        extra_env={"ADAPCC_FAULT_PLAN": plan_path},
+        rec_extra={"fault_plan": plan_path},
+    )
 
 
 def run_simulated_fallback(py: str, out_path: str, world: int = 8) -> dict:

@@ -573,6 +573,126 @@ def overlap_sweep(
     return rows
 
 
+def fault_sweep(
+    world: int,
+    sizes: Sequence[int],
+    hosts: int = 1,
+    model: Optional[LinkCostModel] = None,
+    heartbeat_timeout_s: float = 1.0,
+    slowdown: float = 4.0,
+) -> List[dict]:
+    """Deterministic simulated failover rows — the hardware-free regression
+    artifact for elastic fault tolerance (``make elastic-bench``,
+    docs/ELASTIC.md).
+
+    Two row families per payload size:
+
+    - **summary** rows (``phase: "failover"``) price each injected fault
+      shape end to end with :func:`adapcc_tpu.sim.cost_model.failover_cost`:
+      detection latency (heartbeat timeout + half a step), the plan-swap
+      stall both ways (``swap_cached_us`` — the standby cache hit — vs
+      ``swap_cold_us`` — the recompile the cache exists to avoid), and the
+      healthy / undetected / degraded steady states.  Scenarios:
+      ``rank-down``, ``rank-slow`` and, on multi-host layouts
+      (``hosts > 1``), ``host-down``.
+    - **timeline** rows (``phase: "timeline"``) replay one canonical
+      :class:`~adapcc_tpu.elastic.faults.FaultPlan` (rank dies → another
+      straggles → both recover) step by step through
+      :func:`adapcc_tpu.sim.replay.simulate_fault_plan`: per-step predicted
+      collective cost under that step's fault state, with detection + swap
+      stamped on the transition steps — the detection → swap → steady-state
+      shape of one failover, as data.
+
+    Deterministic: same calibration → byte-identical rows.
+    """
+    from adapcc_tpu.elastic.faults import FaultEvent, FaultPlan
+    from adapcc_tpu.sim.cost_model import bottleneck_ring_coeffs, failover_cost
+    from adapcc_tpu.sim.replay import simulate_fault_plan
+
+    if model is None:
+        model = load_or_default(world=world)
+    elif model.world != world:
+        raise ValueError(f"model world {model.world} != sweep world {world}")
+    ips = (
+        {r: ip for r, ip in enumerate(_ip_table(world, hosts))}
+        if hosts > 1 else None
+    )
+    if ips is not None and model.ips is None:
+        model = model.with_ips(ips)
+    coeffs = bottleneck_ring_coeffs(model, world)
+    per_host = -(-world // max(1, hosts))
+    scenarios = [("rank-down", 1, None), ("rank-slow", 1, slowdown)]
+    if hosts > 1 and per_host < world:
+        scenarios.append(("host-down", per_host, None))
+
+    # one canonical plan: a rank dies, another straggles, both recover —
+    # the storyline the elastic acceptance test drives live
+    plan = FaultPlan(
+        [
+            FaultEvent(step=2, kind="down", rank=world - 1),
+            FaultEvent(step=3, kind="slow", rank=1, slowdown=slowdown),
+            FaultEvent(step=6, kind="recover", rank=world - 1),
+            FaultEvent(step=7, kind="recover", rank=1),
+        ],
+        world=world,
+        label="canonical-failover",
+    )
+    strategy = Strategy.ring(world, ips=ips)
+
+    rows: List[dict] = []
+    for nbytes in sizes:
+        for label, n_down, slow in scenarios:
+            cost = failover_cost(
+                world, nbytes, coeffs, n_down=n_down, slowdown=slow,
+                heartbeat_timeout_s=heartbeat_timeout_s,
+                standby_cached=True,
+            )
+            cold = failover_cost(
+                world, nbytes, coeffs, n_down=n_down, slowdown=slow,
+                heartbeat_timeout_s=heartbeat_timeout_s,
+                standby_cached=False,
+            )
+            rows.append({
+                "mode": "simulated",
+                "collective": "allreduce",
+                "impl": "elastic",
+                "phase": "failover",
+                "scenario": label,
+                "world": world,
+                "size_bytes": int(nbytes),
+                "n_down": n_down,
+                "slowdown": slow,
+                "heartbeat_timeout_s": heartbeat_timeout_s,
+                "detection_us": round(cost["detection_s"] * 1e6, 3),
+                "swap_cached_us": round(cost["swap_s"] * 1e6, 3),
+                "swap_cold_us": round(cold["swap_s"] * 1e6, 3),
+                "healthy_us": round(cost["healthy_s"] * 1e6, 3),
+                "undetected_us": round(cost["undetected_s"] * 1e6, 3),
+                "degraded_us": round(cost["degraded_s"] * 1e6, 3),
+                "degraded_ratio": round(cost["degraded_ratio"], 6),
+                "failover_total_us": round(cost["failover_total_s"] * 1e6, 3),
+                "calibration": model.source,
+            })
+        for step_row in simulate_fault_plan(
+            strategy, model, nbytes, plan,
+            heartbeat_timeout_s=heartbeat_timeout_s,
+        ):
+            row = step_row.to_row()
+            row.update({
+                "collective": "allreduce",
+                "impl": "elastic",
+                "phase": "timeline",
+                "scenario": plan.label,
+                "world": world,
+                "size_bytes": int(nbytes),
+                "calibration": model.source,
+            })
+            rows.append(row)
+    if not rows:
+        raise ValueError(f"fault sweep produced no rows: sizes={list(sizes)}")
+    return rows
+
+
 def tune_replay_sweep(
     world: int,
     sizes: Sequence[int],
@@ -722,6 +842,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "per size (make tune-bench; docs/TUNER.md)",
     )
     ap.add_argument(
+        "--fault-sweep", action="store_true",
+        help="price elastic failover instead of the strategy grid: per-fault "
+        "detection/swap/degraded summary rows plus a canonical fault plan's "
+        "step-by-step timeline (make elastic-bench; docs/ELASTIC.md)",
+    )
+    ap.add_argument(
+        "--heartbeat-timeout-s", type=float, default=1.0,
+        help="fault-sweep heartbeat timeout priced into detection latency",
+    )
+    ap.add_argument(
         "--overlap-sweep", action="store_true",
         help="price the overlapped DDP gradient sync over (accum x "
         "bucket cap x overlap schedule) with overlapped_step_time instead "
@@ -745,6 +875,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--fused-sweep", args.fused_sweep),
             ("--tune-replay", args.tune_replay),
             ("--overlap-sweep", args.overlap_sweep),
+            ("--fault-sweep", args.fault_sweep),
         ) if on
     ]
     if len(exclusive) > 1:
@@ -753,6 +884,35 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ap.error(f"{' and '.join(exclusive)} are mutually exclusive; "
                  "run one sweep per invocation")
     model = load_or_default(args.calibration, world=args.world)
+    if args.fault_sweep:
+        rows = fault_sweep(
+            world=args.world,
+            sizes=[parse_size(s) for s in args.sizes.split(",")],
+            hosts=args.hosts,
+            model=model,
+            heartbeat_timeout_s=args.heartbeat_timeout_s,
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            elif row["phase"] == "failover":
+                print(
+                    f"[sim] fault {row['size_bytes']:>12}B "
+                    f"{row['scenario']:<10} "
+                    f"detect={row['detection_us']:>10.1f}us  "
+                    f"swap={row['swap_cached_us']:>7.1f}us "
+                    f"(cold {row['swap_cold_us']:>10.1f}us)  "
+                    f"degraded_ratio={row['degraded_ratio']:.3f}"
+                )
+            else:
+                star = "*" if row["swapped"] else " "
+                print(
+                    f"[sim] fault {row['size_bytes']:>12}B "
+                    f"step={row['step']:>2} epoch={row['epoch']}{star} "
+                    f"alive={len(row['alive'])} relays={len(row['relays'])} "
+                    f"pred={row['pred_time_us']:>10.1f}us"
+                )
+        return 0
     if args.overlap_sweep:
         rows = overlap_sweep(
             world=args.world,

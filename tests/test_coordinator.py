@@ -171,6 +171,78 @@ def test_grpc_fault_detection(server):
         assert sorted(active) == [0, 1]
 
 
+def test_stop_drains_blocked_hook_waiters():
+    """A worker blocked on send_ready_request while the coordinator dies
+    must unblock with a clean RPC error, not hang: stop() fires the logic's
+    shutdown sentinel (CoordinatorShutdown -> UNAVAILABLE abort) before the
+    transport goes down."""
+    import grpc
+
+    # huge timeouts: without the drain, the blocked waiter would sit for
+    # minutes — the test passing quickly IS the property
+    logic = CoordinatorLogic(
+        3, relay_threshold=60.0, time_slot=0.01, fault_timeout=60.0
+    )
+    srv = CoordinatorServer(3, port=0, logic=logic).start()
+    port = srv.port
+    outcome = {}
+
+    def blocked_worker():
+        hooker = Hooker("127.0.0.1", port)
+        try:
+            outcome["result"] = hooker.send_ready_request(0, 0)
+        except grpc.RpcError as e:
+            outcome["error"] = e.code()
+        finally:
+            hooker.close()
+
+    t = threading.Thread(target=blocked_worker)
+    t.start()
+    # let the RPC land and start its rent-or-buy wait (sole leader)
+    deadline = time.monotonic() + 5
+    while not logic._ready.get(0) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert logic._ready.get(0) == [0], "worker never reached the hook funnel"
+    t0 = time.monotonic()
+    srv.stop()
+    t.join(timeout=5)
+    assert not t.is_alive(), "blocked hook waiter did not drain on stop()"
+    assert time.monotonic() - t0 < 5
+    assert outcome.get("error") is not None, (
+        f"expected a clean RPC error, got {outcome!r}"
+    )
+
+
+def test_stop_drains_blocked_controller_waiters():
+    import grpc
+
+    logic = CoordinatorLogic(
+        2, relay_threshold=60.0, time_slot=0.01, fault_timeout=60.0
+    )
+    srv = CoordinatorServer(2, port=0, logic=logic).start()
+    outcome = {}
+
+    def blocked_worker():
+        controller = Controller("127.0.0.1", srv.port)
+        try:
+            outcome["result"] = controller.send_relay_request(0, 0)
+        except grpc.RpcError as e:
+            outcome["error"] = e.code()
+        finally:
+            controller.close()
+
+    t = threading.Thread(target=blocked_worker)
+    t.start()
+    deadline = time.monotonic() + 5
+    while not logic._heartbeats.get(0) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert logic._heartbeats.get(0) == [0]
+    srv.stop()
+    t.join(timeout=5)
+    assert not t.is_alive(), "blocked controller waiter did not drain"
+    assert outcome.get("error") is not None
+
+
 # --------------------------------------------------------------------------- #
 # communicator integration
 # --------------------------------------------------------------------------- #

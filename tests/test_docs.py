@@ -1,9 +1,9 @@
 """The docs' code blocks execute — documentation that cannot drift.
 
 Every ```python block in docs/PARALLELISM.md, docs/OPERATIONS.md,
-docs/SIMULATION.md, docs/RING.md, docs/QUANT.md, docs/TUNER.md and
-docs/OVERLAP.md runs verbatim on the virtual pod.  A snippet that stops
-compiling or produces wrong shapes fails here.
+docs/SIMULATION.md, docs/RING.md, docs/QUANT.md, docs/TUNER.md,
+docs/OVERLAP.md and docs/ELASTIC.md runs verbatim on the virtual pod.  A
+snippet that stops compiling or produces wrong shapes fails here.
 """
 
 import os
@@ -21,6 +21,7 @@ _RING = os.path.join(_DOCS_DIR, "RING.md")
 _QUANT = os.path.join(_DOCS_DIR, "QUANT.md")
 _TUNER = os.path.join(_DOCS_DIR, "TUNER.md")
 _OVERLAP = os.path.join(_DOCS_DIR, "OVERLAP.md")
+_ELASTIC = os.path.join(_DOCS_DIR, "ELASTIC.md")
 
 
 def _blocks(path):
@@ -166,3 +167,26 @@ def test_overlap_doc_covers_the_contract():
 def test_overlap_doc_snippet_runs(idx):
     code = _blocks(_OVERLAP)[idx]
     exec(compile(code, f"{_OVERLAP}:block{idx}", "exec"), {})
+
+
+def test_elastic_doc_has_snippets():
+    assert len(_blocks(_ELASTIC)) >= 4
+
+
+def test_elastic_doc_covers_the_contract():
+    """The failover topics the elastic runbook leans on must exist."""
+    text = open(_ELASTIC).read()
+    for needle in (
+        "ADAPCC_FAULT_PLAN", "ADAPCC_HEARTBEAT_TIMEOUT_S",
+        "ADAPCC_SLOW_RANK_FACTOR", "WorldView", "epoch", "EpochMismatch",
+        "StandbyPlanCache", "cache_hit", "FaultPlan", "make elastic-bench",
+        "elastic_failover", "reshard_zero1_snapshot", "apply_snapshot",
+        "failover_cost", "simulate_fault_plan",
+    ):
+        assert needle in text, f"ELASTIC.md lost its {needle!r} coverage"
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_ELASTIC))))
+def test_elastic_doc_snippet_runs(idx):
+    code = _blocks(_ELASTIC)[idx]
+    exec(compile(code, f"{_ELASTIC}:block{idx}", "exec"), {})

@@ -78,3 +78,124 @@ def test_prune_broadcast_keeps_forwarding_path():
     edges = [e for r in rounds for e in r.edges]
     assert (0, 1) in edges and (1, 3) in edges
     assert (0, 2) not in edges and (1, 4) not in edges
+
+
+# --------------------------------------------------------------------------- #
+# multi-failure pruning: conservation against a dense reference reduce
+# --------------------------------------------------------------------------- #
+#
+# The relay contract's correctness statement: however many ranks are down,
+# the pruned reduce rounds must deliver EXACTLY the sum of the active
+# ranks' contributions to the root (relays forward, contribute identity,
+# and dead subtrees vanish), and the pruned broadcast rounds must deliver
+# the root's value to every live rank.  The executor below replays rounds
+# the way the engine does — per round, each edge (s, d) folds acc[s] into
+# acc[d] — so conservation here is conservation on the data plane.
+
+
+def _run_reduce(tree, rounds, active, values):
+    acc = {r: (values[r] if r in active else 0) for r in tree.ranks}
+    for rnd in rounds:
+        recvd = {d: acc[s] for s, d in rnd.edges}
+        for d, v in recvd.items():
+            acc[d] += v
+    return acc
+
+
+def _run_broadcast(tree, rounds, root_value):
+    has = {r: root_value if r == tree.root else None for r in tree.ranks}
+    for rnd in rounds:
+        recvd = {d: has[s] for s, d in rnd.edges}
+        for d, v in recvd.items():
+            has[d] = v
+    return has
+
+
+def _assert_reduce_conserves(tree, active):
+    values = {r: 10 ** i for i, r in enumerate(sorted(tree.ranks))}
+    rounds = prune_reduce_rounds(tree, active)
+    acc = _run_reduce(tree, rounds, set(active), values)
+    # distinct powers of ten: a wrong contributor set cannot cancel out
+    assert acc[tree.root] == sum(values[r] for r in active), (
+        f"active={sorted(active)}: root got {acc[tree.root]}"
+    )
+    return rounds
+
+
+def chain8():
+    return Tree(0, {i: [i + 1] for i in range(7)})
+
+
+def test_prune_reduce_root_down_conserves():
+    # the ROOT is down: it still aggregates (pure collector role) but must
+    # not contribute its own value
+    for tree in (binary7(), chain4(), chain8()):
+        _assert_reduce_conserves(tree, set(tree.ranks) - {tree.root})
+
+
+def test_prune_reduce_leaf_chain_down_conserves():
+    # a whole leaf-side run of the chain is dead: its edges vanish
+    # entirely from the pruned rounds (no wasted hops), sum still exact
+    t = chain8()
+    active = {0, 1, 2, 3, 4}
+    rounds = _assert_reduce_conserves(t, active)
+    edges = [e for r in rounds for e in r.edges]
+    for dead_src in (5, 6, 7):
+        assert not any(s == dead_src for s, _ in edges)
+    # depth shrank to the live chain
+    assert len(rounds) == len(active) - 1
+
+
+def test_prune_reduce_multi_failure_scattered():
+    # root down + a mid-chain relay + a dead leaf pair, together
+    t = binary7()
+    for active in ({1, 4, 5}, {3, 6}, {2, 3}, {5}):
+        _assert_reduce_conserves(t, active)
+
+
+def test_prune_recover_mid_epoch_sequences():
+    # epoch 1: ranks {5, 6} down; epoch 2: 5 recovers; epoch 3: all back.
+    # Each epoch's pruning is a pure function of (tree, active): the
+    # recovered rank's edge reappears and conservation holds at every step
+    t = binary7()
+    epochs = [
+        set(range(7)) - {5, 6},
+        set(range(7)) - {6},
+        set(range(7)),
+    ]
+    for active in epochs:
+        _assert_reduce_conserves(t, active)
+    e1 = [e for r in prune_reduce_rounds(t, epochs[0]) for e in r.edges]
+    e2 = [e for r in prune_reduce_rounds(t, epochs[1]) for e in r.edges]
+    assert (5, 2) not in e1 and (5, 2) in e2  # rank 5's edge came back
+    e3 = [e for r in prune_reduce_rounds(t, epochs[2]) for e in r.edges]
+    assert sorted(e3) == sorted(
+        e for r in t.reduce_rounds() for e in r.edges
+    )  # full recovery == the unpruned schedule
+
+
+def test_prune_broadcast_multi_failure_delivers_to_live():
+    # broadcast under the same multi-failure actives: every rank on a live
+    # path (active, or forwarding toward an active rank) receives the
+    # root's value; fully-dead subtrees receive nothing
+    t = binary7()
+    for active in ({1, 4, 5}, {3, 6}, {0, 3}):
+        rounds = prune_broadcast_rounds(t, active)
+        has = _run_broadcast(t, rounds, root_value=42)
+        for r in active:
+            if r == t.root:
+                continue
+            assert has[r] == 42, f"active rank {r} missed the broadcast"
+
+
+def test_prune_rounds_stay_partial_permutations():
+    # whatever the failure pattern, every pruned round must remain a valid
+    # ppermute (CommRound's constructor enforces it — this pins that the
+    # pruning never needs to re-pack)
+    t = binary7()
+    for active in ({1, 4, 5}, {3, 6}, {5}, set(range(7)) - {0}):
+        for rnd in prune_reduce_rounds(t, active) + prune_broadcast_rounds(t, active):
+            srcs = [s for s, _ in rnd.edges]
+            dsts = [d for _, d in rnd.edges]
+            assert len(set(srcs)) == len(srcs)
+            assert len(set(dsts)) == len(dsts)
