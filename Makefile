@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench elastic-bench trace-export clean
+.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench elastic-bench trace-export clean
 
 all: native
 
@@ -67,6 +67,16 @@ overlap-bench:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--world 8 --sizes 16M,128M --overlap-sweep --accums 1,2,4 \
 		--bucket-caps-mb 1,4 --json
+
+# Latency-bound allreduce algorithm sweep on the same simulator
+# (docs/LATENCY.md): deterministic "mode": "simulated" rows over a size
+# grid spanning the ring <-> recursive-doubling crossover, pricing ring vs
+# recursive halving/doubling vs binomial tree per size, with the chosen
+# algorithm and the crossover size flagged per row — the sized decision
+# ADAPCC_COLL_ALGO=auto executes, as a regression artifact.
+latency-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--world 8 --sizes 1K,16K,64K,256K,1M,16M --latency-sweep --json
 
 # Elastic failover sweep on the same simulator (docs/ELASTIC.md):
 # deterministic "mode": "simulated" rows pricing each injected fault's

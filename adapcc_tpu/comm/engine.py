@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import time
+import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -203,6 +204,10 @@ def _color_rounds(per_tree_rounds: Sequence[Sequence[CommRound]], world: int):
 
 
 _MERGED_PLANS: Dict[Tuple, Optional[_MergedPlan]] = {}
+
+#: one deprecation warning per process for the reference's "boardcast"
+#: spelling (satellite of the latency PR; see CollectiveEngine.boardcast)
+_BOARDCAST_WARNED = False
 
 
 def _merged_env_disabled() -> bool:
@@ -698,6 +703,14 @@ class CollectiveEngine:
         #: on every membership change; collectives issued with a stale
         #: ``epoch=`` token raise :class:`EpochMismatch` instead of running
         self.epoch = 0
+        # fail fast on a typo'd ADAPCC_COLL_ALGO, same policy as the merge
+        # and tuner knobs above
+        from adapcc_tpu.comm.latency import resolve_coll_algo
+
+        resolve_coll_algo(None)
+        #: lazily computed sim crossover (ring vs recursive doubling) the
+        #: `auto` algorithm selector consults; None = not yet computed
+        self._algo_crossover: Optional[float] = None
 
     # -- elastic plan failover -------------------------------------------------
 
@@ -802,6 +815,127 @@ class CollectiveEngine:
             self._cache[key] = fn
         return fn
 
+    # -- latency plane (adapcc_tpu/comm/latency): size-adaptive algorithm ------
+
+    #: the tuner-grid narrowing a pinned algorithm implies: a dispatch that
+    #: can only execute one plane must not offer the others' cells (they
+    #: would starve the explorer — the wire-pin collapse, algorithm flavor)
+    _ALGO_NARROW = {"ring": ("ring",), "rd": ("rd",), "tree": ("tree",)}
+
+    def _allreduce_crossover_bytes(self) -> float:
+        """Sim crossover (ring vs recursive doubling) for this world — the
+        analytic half of the ``auto`` selector.  With a tuner attached,
+        the TUNER's policy owns the number (it may carry an injected
+        custom cost model, and its candidate-grid gate must agree with
+        the auto decision on every payload); standalone engines compute
+        it from the calibrated α-β model, cached per engine."""
+        if self.tuner is not None:
+            return self.tuner.policy.algo_crossover_bytes()
+        if self._algo_crossover is None:
+            from adapcc_tpu.sim.calibrate import load_or_default
+            from adapcc_tpu.sim.cost_model import (
+                allreduce_crossover_bytes,
+                bottleneck_ring_coeffs,
+            )
+
+            model = load_or_default(world=self.world_size)
+            self._algo_crossover = allreduce_crossover_bytes(
+                self.world_size,
+                bottleneck_ring_coeffs(model, self.world_size),
+            )
+        return self._algo_crossover
+
+    def _auto_algo(
+        self, per_rank_bytes: int, wire_dtype: Optional[str] = None
+    ) -> Optional[str]:
+        """The ``auto`` selector's analytic decision: recursive doubling
+        for sub-crossover payloads where the latency plane can run, None
+        (= stay on the ring plane) otherwise.  Trees never win allreduce
+        on the model (full payload every hop), so they are executed only
+        by pin or by a measured tuner cell.
+
+        ``auto`` is NOT an explicit rd pin: a pinned wire codec (env or
+        the caller's ``wire_dtype`` argument) keeps auto on the
+        codec-capable ring planes instead of tripping the loud
+        algo-vs-codec conflict guard — that guard exists for two
+        *explicit* pins in contradiction."""
+        from adapcc_tpu.comm.latency import latency_algo_unsupported_reason
+
+        if self.two_level or self.world_size < 2:
+            return None
+        if self._wire_pinned_non_off(wire_dtype):
+            return None
+        if latency_algo_unsupported_reason(
+            self.world_size, "rd", self.two_level
+        ) is not None:
+            return None
+        if per_rank_bytes < self._allreduce_crossover_bytes():
+            return "rd"
+        return None
+
+    def _wire_pinned_non_off(self, wire_dtype: Optional[str]) -> bool:
+        """Whether an EXPLICIT wire-codec pin (env or argument — never the
+        strategy's synthesized default) resolves to a real codec."""
+        import os
+
+        from adapcc_tpu.quant import resolve_wire_dtype
+        from adapcc_tpu.quant.codec import WIRE_DTYPE_ENV
+
+        env = os.environ.get(WIRE_DTYPE_ENV)
+        if wire_dtype is None and (env is None or not env.strip()):
+            return False
+        return resolve_wire_dtype(wire_dtype) != "off"
+
+    def _check_algo_wire_conflict(
+        self, algo: str, wire_dtype: Optional[str]
+    ) -> None:
+        """Two explicit pins in conflict reject loudly: the latency plane
+        has no wire-codec variants, so a pinned non-"off" codec cannot
+        ride a pinned rd/tree dispatch (silently running fp32 under a
+        codec label is the lie the fused-wire work eliminated).  Only
+        explicit pins conflict — the strategy's synthesized default, the
+        auto selector, and the tuner all stand down instead."""
+        if self._wire_pinned_non_off(wire_dtype):
+            raise ValueError(
+                f"collective algo {algo!r} has no wire-codec plane but a "
+                "wire_dtype is pinned (env or argument); pin one knob or "
+                "the other — codecs ride the ring planes only"
+            )
+
+    def _latency_allreduce(
+        self,
+        stacked: jnp.ndarray,
+        algo: str,
+        mask: Optional[jnp.ndarray] = None,
+        op: ReduceOp = ReduceOp.SUM,
+    ) -> Tuple[jnp.ndarray, Tuple, bool]:
+        """Dispatch one latency-plane allreduce (``rd`` | ``tree``);
+        returns ``(result, cache_key, cache_hit)``.  Rejects loudly where
+        the plane cannot run — reachable only via an explicit pin (the
+        auto selector and the tuner grid both consult the same support
+        funnel first)."""
+        from adapcc_tpu.comm import latency as lat
+
+        reason = lat.latency_algo_unsupported_reason(
+            self.world_size, algo, self.two_level
+        )
+        if reason is not None:
+            raise ValueError(f"allreduce algo={algo!r} cannot run here: {reason}")
+        world = self.world_size
+        axis = self.axis_name
+        fn = (
+            lat.rd_allreduce_shard if algo == "rd" else lat.tree_allreduce_shard
+        )
+        if mask is None:
+            mask = jnp.ones((world,), dtype=jnp.bool_)
+
+        def per_shard(x, m):  # x: [1, *payload]
+            return fn(x[0], m, world, axis, op=op)[None]
+
+        key = (f"{algo}_allreduce", stacked.shape, stacked.dtype.name, op)
+        cache_hit = key in self._cache
+        return self._shard_mapped(key, per_shard, 2)(stacked, mask), key, cache_hit
+
     def all_reduce(
         self,
         stacked: jnp.ndarray,
@@ -809,13 +943,91 @@ class CollectiveEngine:
         active_gpus: Optional[Sequence[int]] = None,
         op: ReduceOp = ReduceOp.SUM,
         epoch: Optional[int] = None,
+        algo: Optional[str] = None,
     ) -> jnp.ndarray:
+        """Allreduce with subset semantics and a size-adaptive algorithm
+        selector (docs/LATENCY.md): ``algo`` is one of
+        ``auto|ring|rd|tree`` under the precedence **env > explicit arg >
+        tuner > sim-crossover** — ``ADAPCC_COLL_ALGO`` wins, then the
+        argument, then (for ``auto``/unset with a choosing tuner) a
+        measured algorithm cell, then the calibrated crossover decides
+        ``auto``.  Unset everywhere keeps the legacy ring/XLA plane.  The
+        executed algorithm is recorded in the dispatch trace next to the
+        impl, like ``wire_dtype``."""
         # keyword-only for the same reason as reduce_scatter: a positional
         # all_reduce(t, ReduceOp.AVG) must fail at the call site, not bind
         # the enum to active_gpus
         self._check_epoch(epoch)
         self._check_world_dim(stacked, "all_reduce")
+        from adapcc_tpu.comm.latency import resolve_coll_algo
+        from adapcc_tpu.tuner.policy import ALGO_OF_PATH, NO_CHUNK
+
+        algo_req = resolve_coll_algo(algo)
+        per_rank_bytes = (
+            int(np.prod(stacked.shape[1:])) * stacked.dtype.itemsize
+        )
         mask = self._active_to_mask(active_gpus)
+        tuner = self.tuner
+        tplan = None
+        executed_algo: Optional[str] = None
+        if algo_req in ("rd", "tree"):
+            executed_algo = algo_req  # pinned: loud reject if unsupported
+        elif (
+            algo_req in (None, "auto")
+            and not self.two_level
+            and tuner is not None
+            and tuner.choosing
+            # an env-pinned codec collapses the policy's grid to that
+            # codec's cells, none of which this plane's {xla, rd, tree}
+            # arbitration can offer (all fp32) — stand down like
+            # _auto_algo does, instead of dying on an empty grid
+            and not self._wire_pinned_non_off(None)
+        ):
+            # the measured slot of the ladder: rank THE CELLS THIS PLANE
+            # CAN RUN — the XLA-plane baseline cell against the rd/tree
+            # cells — READ-ONLY (rank_only: no exploration, no incumbent
+            # write).  An exploring choose() over the full Pallas grid
+            # would pin the explorer on chunk/codec cells whose trial
+            # budget can never drain from this entry point, and without
+            # the xla cell a measured rd sample would beat every
+            # unmeasurable alternative forever.  Only an rd/tree winner
+            # reroutes; the xla winner keeps the fastpath below.
+            tplan = tuner.rank_only(
+                "allreduce", per_rank_bytes, stacked.dtype.name,
+                algos=("xla", "rd", "tree"),
+            )
+            executed_algo = ALGO_OF_PATH.get(tplan.key.path)
+        elif algo_req == "auto":
+            executed_algo = self._auto_algo(per_rank_bytes)
+        if executed_algo is not None:
+            self._check_algo_wire_conflict(executed_algo, None)
+            timing = tuner is not None and tuner.recording
+            t0 = time.perf_counter()
+            out, key, cache_hit = self._latency_allreduce(
+                stacked, executed_algo, mask, op
+            )
+            extras: Dict[str, Any] = {"algo": executed_algo}
+            if timing:
+                jax.block_until_ready(out)
+                duration = time.perf_counter() - t0
+                extras["duration_s"] = duration
+                tuner.observe_dispatch(
+                    tuner.key_for(
+                        "allreduce", per_rank_bytes, executed_algo,
+                        NO_CHUNK, "off",
+                    ),
+                    key,
+                    duration,
+                )
+            if tplan is not None:
+                extras["tuner"] = tplan.trace_extra(
+                    applied=tplan.key.path == executed_algo
+                )
+            self._record(
+                "allreduce", executed_algo, stacked,
+                cache_hit=cache_hit, **extras,
+            )
+            return out
         if self.use_xla_fastpath and active_gpus is None:
             per_shard = functools.partial(self._psum_shard, op=op)
             key = ("psum", stacked.shape, stacked.dtype.name, op)
@@ -838,11 +1050,41 @@ class CollectiveEngine:
                 op=op,
             )
             key = ("allreduce", self._schedule_variant(), stacked.shape, stacked.dtype.name, op)
+        from adapcc_tpu.tuner.policy import XLA_PATH
+
+        is_psum = key[0] == "psum"
+        cache_hit = key in self._cache
+        # the psum fastpath is the xla cell's measurable arm: record-mode
+        # timings close the loop the rank_only arbitration reads
+        timing = tuner is not None and tuner.recording and is_psum
+        t0 = time.perf_counter()
+        out = self._shard_mapped(key, per_shard, 2)(stacked, mask)
+        ring_extras: Dict[str, Any] = {"algo": "ring"}
+        if timing:
+            jax.block_until_ready(out)
+            duration = time.perf_counter() - t0
+            ring_extras["duration_s"] = duration
+            tuner.observe_dispatch(
+                tuner.key_for(
+                    "allreduce", per_rank_bytes, XLA_PATH, NO_CHUNK, "off"
+                ),
+                key,
+                duration,
+            )
+        if tplan is not None:
+            # applied only when the chosen cell's plane actually ran: the
+            # xla cell over the psum fastpath.  A masked/two-level
+            # schedule dispatch is NOT that plane, and a chunk/codec cell
+            # can never run here — the trace must say so (PR 6's
+            # executed-impl honesty).
+            ring_extras["tuner"] = tplan.trace_extra(
+                applied=tplan.key.path == XLA_PATH and is_psum
+            )
         self._record(
-            "allreduce", "xla" if key[0] == "psum" else "schedule", stacked,
-            cache_hit=key in self._cache,
+            "allreduce", "xla" if is_psum else "schedule", stacked,
+            cache_hit=cache_hit, **ring_extras,
         )
-        return self._shard_mapped(key, per_shard, 2)(stacked, mask)
+        return out
 
     def _psum_shard(self, x: jnp.ndarray, mask: jnp.ndarray, op: ReduceOp) -> jnp.ndarray:
         return _fused_reduce(x, self.axis_name, op, self.world_size)
@@ -884,14 +1126,16 @@ class CollectiveEngine:
         self._record("reduce", "schedule", stacked, cache_hit=key in self._cache)
         return self._shard_mapped(key, per_shard, 2)(stacked, self._active_to_mask(active_gpus))
 
-    def boardcast(
+    def broadcast(
         self,
         stacked: jnp.ndarray,
         active_gpus: Optional[Sequence[int]] = None,
         *,
         epoch: Optional[int] = None,
     ) -> jnp.ndarray:
-        """Reference spelling kept for API parity (adapcc.py:55-57).
+        """Broadcast from each tree's root (the reference's ``boardcast``
+        context; the typo'd spelling survives as a deprecated alias —
+        :meth:`boardcast`).
 
         ``active_gpus`` mirrors the reference C ABI (run.cu:150 takes the
         active set for every collective).  Broadcast *values* are
@@ -904,7 +1148,7 @@ class CollectiveEngine:
         real operand — the same plumbing as :meth:`reduce` — so a masked
         dispatch can never replay the unmasked full-world fastpath."""
         self._check_epoch(epoch)
-        self._check_world_dim(stacked, "boardcast")
+        self._check_world_dim(stacked, "broadcast")
         mask = self._active_to_mask(active_gpus)
         if active_gpus is not None:
             act = {int(r) for r in active_gpus}
@@ -962,14 +1206,33 @@ class CollectiveEngine:
                 return inner(x)
         else:
             per_shard = inner
-        # trace vocabulary is normalized ("broadcast"); only the API keeps
-        # the reference's "boardcast" spelling
         self._record("broadcast", "schedule", stacked, cache_hit=key in self._cache)
         if masked:
             return self._shard_mapped(key, per_shard, 2)(stacked, mask)
         return self._shard_mapped(key, per_shard, 1)(stacked)
 
-    broadcast = boardcast
+    def boardcast(
+        self,
+        stacked: jnp.ndarray,
+        active_gpus: Optional[Sequence[int]] = None,
+        *,
+        epoch: Optional[int] = None,
+    ) -> jnp.ndarray:
+        """Deprecated: the reference's typo'd spelling of
+        :meth:`broadcast` (adapcc.py:55-57, boardcast.cu), kept as an
+        alias so reference-shaped callers keep working.  Warns ONCE per
+        process — a long training loop must not drown in a warning per
+        step — then delegates unchanged."""
+        global _BOARDCAST_WARNED
+        if not _BOARDCAST_WARNED:
+            _BOARDCAST_WARNED = True
+            warnings.warn(
+                "CollectiveEngine.boardcast (the reference's spelling) is "
+                "deprecated; call CollectiveEngine.broadcast instead",
+                DeprecationWarning,
+                stacklevel=2,
+            )
+        return self.broadcast(stacked, active_gpus, epoch=epoch)
 
     # -- primitives the reference only declared (trans.h:27-36 enum stubs) ----
     # implemented here at full adaptive depth: active-subset masking with the
@@ -1053,6 +1316,8 @@ class CollectiveEngine:
             raise ValueError(
                 f"all_to_all needs a [world, world, ...] stacked array, got {stacked.shape}"
             )
+        from adapcc_tpu.tuner.policy import A2A_XLA_PATH, NO_CHUNK
+
         mask = self._active_to_mask(active_gpus)
         masked = active_gpus is not None
 
@@ -1068,18 +1333,93 @@ class CollectiveEngine:
                 )[None]
 
             key = ("alltoall2l", stacked.shape, stacked.dtype.name, masked)
-            self._record("all_to_all", "two_level", stacked, cache_hit=key in self._cache)
-            return self._shard_mapped(key, per_shard, 2)(stacked, mask)
+            impl = path = "two_level"
+        else:
+            def per_shard(x, m):  # x: [1, world, *payload]
+                v = x[0]
+                if masked:
+                    v = jnp.where(m[self._my_flat_rank()], v, jnp.zeros_like(v))
+                return lax.all_to_all(v, self.axis_name, split_axis=0, concat_axis=0)[None]
 
-        def per_shard(x, m):  # x: [1, world, *payload]
-            v = x[0]
-            if masked:
-                v = jnp.where(m[self._my_flat_rank()], v, jnp.zeros_like(v))
-            return lax.all_to_all(v, self.axis_name, split_axis=0, concat_axis=0)[None]
+            key = ("alltoall", stacked.shape, stacked.dtype.name, masked)
+            impl, path = "xla", A2A_XLA_PATH
+        # all_to_all is tuned like every other collective (the primitive
+        # the reference left a stub and PR 4 left untimed): with a tuner
+        # attached, record|choose time every dispatch into the database
+        # under the `all_to_all` primitive — the MoE dispatch/combine
+        # traffic (parallel/expert.py via workloads/train_moe.py) lands
+        # here at its real payload geometry
+        cache_hit = key in self._cache
+        tuner = self.tuner
+        timing = tuner is not None and tuner.recording
+        t0 = time.perf_counter()
+        out = self._shard_mapped(key, per_shard, 2)(stacked, mask)
+        extras: Dict[str, Any] = {}
+        if timing:
+            jax.block_until_ready(out)
+            duration = time.perf_counter() - t0
+            extras["duration_s"] = duration
+            # one rank's send volume: its full [world, *payload] row
+            per_rank_bytes = (
+                int(np.prod(stacked.shape[1:])) * stacked.dtype.itemsize
+            )
+            tuner.observe_dispatch(
+                tuner.key_for(
+                    "all_to_all", per_rank_bytes, path, NO_CHUNK, "off"
+                ),
+                key,
+                duration,
+            )
+        self._record("all_to_all", impl, stacked, cache_hit=cache_hit, **extras)
+        return out
 
-        key = ("alltoall", stacked.shape, stacked.dtype.name, masked)
-        self._record("all_to_all", "xla", stacked, cache_hit=key in self._cache)
-        return self._shard_mapped(key, per_shard, 2)(stacked, mask)
+    def expert_a2a(self, axis_name: Optional[str] = None) -> Callable:
+        """Shard-level MoE token-exchange function for
+        :func:`adapcc_tpu.parallel.expert.expert_parallel_moe` — the
+        engine-routed spelling of its ``a2a`` override, so expert traffic
+        rides the engine's configuration (two-level hierarchy included)
+        and is *traced* like every other collective.
+
+        Returns ``a2a(v)`` to be called inside the caller's own shard_map:
+        on a flat mesh it is the XLA ``lax.all_to_all`` over ``axis_name``
+        (default: the engine's axis), on a two-level ``(dcn, ici)`` mesh
+        the hierarchical two-hop exchange.  Each traced application
+        records one ``all_to_all`` event (impl suffixed ``[moe]``) into
+        the engine's dispatch trace — once per compiled program, the
+        traceable boundary when the exchange lives inside a jitted step.
+        The tuner database is fed by :meth:`all_to_all` probe dispatches
+        at the same payload geometry (workloads/train_moe.py), since an
+        in-jit exchange cannot be walltimed individually.
+        """
+        if self.two_level:
+            from adapcc_tpu.comm.two_level import all_to_all_two_level_shard
+
+            inner = functools.partial(
+                all_to_all_two_level_shard,
+                num_slices=self.num_slices,
+                ici_size=self.ici_size,
+            )
+            impl = "two_level[moe]"
+        else:
+            name = axis_name if axis_name is not None else self.axis_name
+            if name not in self.mesh.axis_names:
+                raise ValueError(
+                    f"expert_a2a axis {name!r} is not a mesh axis "
+                    f"{tuple(self.mesh.axis_names)}"
+                )
+            inner = functools.partial(
+                lax.all_to_all, axis_name=name,
+                split_axis=0, concat_axis=0, tiled=False,
+            )
+            impl = "xla[moe]"
+
+        def a2a(v):
+            if self.trace is not None:
+                nbytes = int(np.prod(v.shape)) * jnp.dtype(v.dtype).itemsize
+                self.trace.record("all_to_all", impl, nbytes, moe=True)
+            return inner(v)
+
+        return a2a
 
     def _ring_plan(
         self,
@@ -1204,6 +1544,7 @@ class CollectiveEngine:
         chunk_bytes: Optional[int] = None,
         wire_dtype: Optional[str] = None,
         quant_block_size: Optional[int] = None,
+        algo: Optional[str] = None,
     ) -> jnp.ndarray:
         """Pallas ICI ring allreduce (hand-tuned data plane; see
         :mod:`adapcc_tpu.comm.pallas_ring`).  ``interpret=None`` auto-selects
@@ -1233,20 +1574,45 @@ class CollectiveEngine:
         self._check_world_dim(stacked, "ring_allreduce")
         # the single source of the key vocabulary: candidates(), live
         # recording, and trace replay must all spell one cell identically
-        from adapcc_tpu.tuner.policy import NO_CHUNK, QUANT_PATH
+        from adapcc_tpu.comm.latency import resolve_coll_algo
+        from adapcc_tpu.tuner.policy import ALGO_OF_PATH, ALGO_PATHS, NO_CHUNK, QUANT_PATH
 
+        # algorithm selector (docs/LATENCY.md): env > arg > tuner cell >
+        # sim-crossover (under "auto"); unset everywhere keeps the ring —
+        # the legacy contract of this entry point
+        algo_req = resolve_coll_algo(algo)
+        wire_arg = wire_dtype  # the caller's pin, before tuner adoption
+        if algo_req in ("rd", "tree"):
+            # double-pin conflict BEFORE the tuner consult: under both
+            # pins the candidate grid is legitimately empty (neither the
+            # ring planes nor the algo cells may be offered), and choose()
+            # would die with a misleading "no candidate cells" — the
+            # purpose-built diagnostic must fire first
+            self._check_algo_wire_conflict(algo_req, wire_arg)
         per_rank_bytes = int(np.prod(stacked.shape[1:])) * stacked.dtype.itemsize
         tuner = self.tuner
         tplan = None
         tuner_chose_quant = False
+        tuner_chose_algo: Optional[str] = None
+        algos_narrow = self._ALGO_NARROW.get(algo_req)
+        if algos_narrow is None and self._wire_pinned_non_off(wire_arg):
+            # a caller-pinned codec rides the ring planes only: narrow the
+            # algorithm axis so the explorer never offers a cell the
+            # conflict guard would refuse on execution (the wire-pin
+            # collapse, engine side; the env pin is collapsed inside
+            # candidates() already — this covers the explicit argument)
+            algos_narrow = ("ring",)
         if tuner is not None and tuner.choosing:
             tplan = tuner.choose(
-                "allreduce", per_rank_bytes, stacked.dtype.name
+                "allreduce", per_rank_bytes, stacked.dtype.name,
+                algos=algos_narrow,
             )
+            if algo_req in (None, "auto") and tplan.key.path in ALGO_PATHS:
+                tuner_chose_algo = ALGO_OF_PATH[tplan.key.path]
             # the tuner only fills knobs the caller left open; the env
             # overrides (resolved inside resolve_chunk_bytes /
             # resolve_wire_dtype) still win over everything
-            if wire_dtype is None:
+            if wire_dtype is None and tplan.key.path not in ALGO_PATHS:
                 wire_dtype = tplan.wire_dtype
                 # a codec cell names its PATH too: the unfused quant-ring
                 # cell must actually run unfused, or the fused-vs-unfused
@@ -1256,10 +1622,28 @@ class CollectiveEngine:
                 )
             if chunk_bytes is None and tplan.chunk_bytes is not None:
                 chunk_bytes = tplan.chunk_bytes
-        wd = self._resolved_wire_dtype(wire_dtype)
+        executed_algo: Optional[str] = None
+        if algo_req in ("rd", "tree"):
+            executed_algo = algo_req  # pinned: loud reject if unsupported
+        elif tuner_chose_algo is not None:
+            executed_algo = tuner_chose_algo
+        elif algo_req == "auto" and tplan is None:
+            # the sim crossover is the LAST rung of the ladder: a choosing
+            # tuner's committed cell — ring-plane included — outranks it
+            # (tplan carries the decision above; overriding a committed
+            # ring cell here would discard its adopted chunk/codec knobs
+            # and starve the cells the tuner is trying to measure)
+            executed_algo = self._auto_algo(per_rank_bytes, wire_arg)
         timing = tuner is not None and tuner.recording
         t0 = time.perf_counter()
-        if wd != "off":
+        if executed_algo is not None:
+            self._check_algo_wire_conflict(executed_algo, wire_arg)
+            out, cache_key, _ = self._latency_allreduce(stacked, executed_algo)
+            impl = executed_algo
+            executed_path, executed_chunk = executed_algo, NO_CHUNK
+            extras = {"algo": executed_algo}
+            wd = "off"
+        elif (wd := self._resolved_wire_dtype(wire_dtype)) != "off":
             from adapcc_tpu.comm.pallas_ring import (
                 fused_ring_dispatch_reason,
                 note_quant_reroute,
@@ -1337,6 +1721,10 @@ class CollectiveEngine:
             impl = f"pallas_ring[{plan.path}]"
             executed_path, executed_chunk = plan.path, plan.chunk_bytes
             extras = self._ring_extras(plan)
+        # the executed ALGORITHM rides the trace like wire_dtype: every
+        # ring-family branch above is "ring", the latency branch stamped
+        # its own name
+        extras.setdefault("algo", "ring")
         if timing:
             # measurement semantics: the sample is the full dispatch-to-
             # completion walltime.  The block serializes the host loop by

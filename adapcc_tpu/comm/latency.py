@@ -1,0 +1,342 @@
+"""Latency-optimal small-message collectives: recursive doubling + binomial trees.
+
+Every data plane before this one optimizes the bandwidth-bound regime: the
+Pallas ring (PR 2/6) and the wire codecs (PR 3) all pay the ring's
+``2·(p−1)·α`` fixed-latency bill, which is the right trade when ``β·n``
+dominates.  Small payloads — MoE router tensors, inference logits, norm
+scalars, the sub-crossover tail of a bucketed gradient — invert that:
+``(p−1)·α`` IS the cost, and a logarithmic schedule pays ``log2(p)·α``
+instead (GC3 / "The Big Send-off", PAPERS.md).
+
+This module is the small-message data plane:
+
+- :func:`rd_allreduce_shard` — recursive-**halving** reduce-scatter followed
+  by recursive-**doubling** all-gather (the MPICH/Rabenseifner shape):
+  ``2·log2(p)`` ppermute rounds total, message sizes halving/doubling so the
+  wire volume stays ``2·(p−1)/p·n`` — bandwidth-optimal AND latency-optimal
+  on a fully-connected fabric.  On a physical ring/torus the round-``k``
+  exchange rides ``min(2^k, p−2^k)`` ICI hops, which is exactly why the ring
+  still wins large payloads; :func:`adapcc_tpu.sim.cost_model.
+  recursive_doubling_allreduce_time` prices that embedding and
+  ``allreduce_crossover_bytes`` finds the break-even.
+- :func:`binomial_broadcast_shard` / :func:`binomial_reduce_shard` — one
+  single-shot binomial tree phase (``ceil(log2 p)`` rounds, full payload per
+  hop): the latency-optimal rooted collectives.
+- :func:`tree_allreduce_shard` — reduce-to-root + broadcast, the
+  ``algo="tree"`` allreduce arm.
+
+Power-of-two contract: recursive doubling pairs ranks by XOR, so the data
+plane **rejects loudly** on non-power-of-two worlds (the cost model prices
+the textbook fold-in instead, so the selector still reasons about such
+worlds — it just never routes them here).  Binomial trees run on any world.
+
+Relay semantics match the engine's schedule plane: ``active_mask`` gates the
+*contribution* (inactive ranks inject the reduction identity) while every
+rank stays on the exchange path and receives results — the reference's
+``hasLocal`` role algebra (control.cu), spelled as masked XOR exchanges.
+
+Selection is a sized decision end to end: ``ADAPCC_COLL_ALGO`` >
+explicit ``algo=`` argument > a measured tuner cell > the sim crossover
+(under ``auto``), with the executed algorithm recorded in the dispatch
+trace next to ``wire_dtype`` (docs/LATENCY.md).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+from adapcc_tpu.comm.mesh import RANKS_AXIS
+from adapcc_tpu.primitives import ReduceOp
+
+#: algorithm selector vocabulary: ``auto`` = size-adaptive (tuner, then the
+#: sim crossover), the rest pin one data plane
+COLL_ALGOS = ("auto", "ring", "rd", "tree")
+
+#: env override for the collective algorithm (docs/LATENCY.md §3); the top
+#: of the precedence ladder env > arg > tuner > sim-crossover
+COLL_ALGO_ENV = "ADAPCC_COLL_ALGO"
+
+
+def resolve_coll_algo(algo: Optional[str] = None) -> Optional[str]:
+    """The collective algorithm in force: ``ADAPCC_COLL_ALGO`` env > the
+    explicit argument > ``None`` (caller decides its legacy default —
+    the engine keeps ``ring`` so an unset environment never changes a
+    working dispatch).  A malformed value raises — a typo'd
+    ``ADAPCC_COLL_ALGO=rdx`` silently running the ring would invalidate
+    the A/B it was meant to drive (the ADAPCC_MERGE_ROUNDS policy)."""
+    env = os.environ.get(COLL_ALGO_ENV)
+    value = env if env is not None and env.strip() else algo
+    if value is None:
+        return None
+    v = str(value).strip().lower()
+    if v not in COLL_ALGOS:
+        raise ValueError(
+            f"{COLL_ALGO_ENV}/algo={value!r}: expected one of "
+            f"{'|'.join(COLL_ALGOS)}"
+        )
+    return v
+
+
+def latency_algo_unsupported_reason(
+    world: int, algo: str, two_level: bool = False
+) -> Optional[str]:
+    """Why the latency plane cannot run ``algo`` on this world — None when
+    it can.  The ONE support funnel shared by the engine dispatch, the
+    auto-selector, and the tuner's candidate grid, so a cell can never
+    claim a program the data plane would refuse."""
+    if algo not in ("rd", "tree"):
+        raise ValueError(
+            f"algo={algo!r} is not a latency-plane algorithm ('rd'|'tree')"
+        )
+    if two_level:
+        return (
+            "two-level (dcn, ici) worlds route through the hierarchical "
+            "schedule; the latency plane needs a flat ranks mesh"
+        )
+    if algo == "rd" and world & (world - 1):
+        return (
+            f"recursive doubling pairs ranks by XOR and needs a power-of-two "
+            f"world, got {world}; the cost model prices the fold-in, the "
+            "data plane rejects it"
+        )
+    return None
+
+
+# --------------------------------------------------------------------------- #
+# shard-level programs (call inside shard_map)
+# --------------------------------------------------------------------------- #
+
+
+def _combine(a: jnp.ndarray, b: jnp.ndarray, op: ReduceOp) -> jnp.ndarray:
+    if op is ReduceOp.MAX:
+        return jnp.maximum(a, b)
+    return a + b
+
+
+def _xor_perm(world: int, d: int) -> List[Tuple[int, int]]:
+    """The round's full exchange permutation: every rank swaps with its
+    XOR-partner at distance ``d`` (a bijection, so ppermute delivers to
+    everyone — no zero-fill corner for MAX)."""
+    return [(i, i ^ d) for i in range(world)]
+
+
+def rd_allreduce_shard(
+    x: jnp.ndarray,
+    active_mask: Optional[jnp.ndarray],
+    world: int,
+    axis_name: str = RANKS_AXIS,
+    op: ReduceOp = ReduceOp.SUM,
+) -> jnp.ndarray:
+    """Recursive-halving reduce-scatter + recursive-doubling all-gather
+    allreduce over ``axis_name``; call inside shard_map.
+
+    ``2·log2(world)`` ppermute rounds.  Round ``k`` of the halving phase
+    pairs ranks across distance ``world/2^(k+1)`` and exchanges half the
+    working segment (each rank keeps the half its final segment lives in
+    and folds the received half into it); after ``log2(world)`` rounds rank
+    ``r`` holds the fully reduced segment ``r``.  The doubling phase mirrors
+    it back up: each round swaps the current block with the XOR-partner and
+    concatenates, doubling the gathered extent.  Wire volume is the ring's
+    ``2·(p−1)/p·n``; fixed cost is ``2·log2(p)·α`` instead of ``2·(p−1)·α``.
+
+    Power-of-two worlds only (loud reject — see module docstring).
+    ``active_mask`` follows the relay contract: inactive ranks contribute
+    the reduction identity but stay on the exchange path and receive the
+    result; ``ReduceOp.AVG`` normalizes by the active count.
+    """
+    reason = latency_algo_unsupported_reason(world, "rd")
+    if reason is not None:
+        raise ValueError(f"rd_allreduce_shard: {reason}")
+    from adapcc_tpu.comm.engine import (
+        _avg_normalize,
+        _identity_for,
+        _mask_contribution,
+    )
+
+    flat = x.reshape(-1)
+    if flat.size == 0 or world == 1:
+        if op is ReduceOp.AVG:
+            return x  # one contributor: the average is the value
+        return x
+    if active_mask is not None:
+        flat = _mask_contribution(flat, active_mask, axis_name, op)
+    n = flat.size
+    seg = -(-n // world)
+    pad = world * seg - n
+    if pad:
+        ident = _identity_for(op, flat.dtype)
+        flat = jnp.concatenate([flat, jnp.full((pad,), ident, flat.dtype)])
+    me = lax.axis_index(axis_name)
+    cur = flat
+
+    # recursive-halving reduce-scatter: distances p/2, p/4, ..., 1.  The
+    # rank's bit at the round's distance says which half its final segment
+    # lives in: keep that half, send the other, fold in what arrives (the
+    # partner has the opposite bit, so it sends exactly the kept half).
+    d = world // 2
+    while d >= 1:
+        half = cur.shape[0] // 2
+        bit = (me // d) % 2
+        send = lax.dynamic_slice(cur, ((1 - bit) * half,), (half,))
+        keep = lax.dynamic_slice(cur, (bit * half,), (half,))
+        recvd = lax.ppermute(send, axis_name, _xor_perm(world, d))
+        cur = _combine(keep, recvd, op)
+        d //= 2
+
+    # recursive-doubling all-gather: distances 1, 2, ..., p/2.  Each round
+    # swaps the gathered block with the XOR-partner; the rank whose bit is
+    # 0 owns the lower half of the merged block, so concatenation order is
+    # a one-bit select.
+    d = 1
+    while d < world:
+        recvd = lax.ppermute(cur, axis_name, _xor_perm(world, d))
+        low = (me // d) % 2 == 0
+        first = jnp.where(low, cur, recvd)
+        second = jnp.where(low, recvd, cur)
+        cur = jnp.concatenate([first, second])
+        d *= 2
+
+    result = cur[:n].reshape(x.shape)
+    if active_mask is not None:
+        return _avg_normalize(result, active_mask, op)
+    if op is ReduceOp.AVG:
+        return result / world
+    return result
+
+
+def _binomial_rounds(world: int) -> List[int]:
+    """Ascending round distances 1, 2, 4, ... < world (any world size)."""
+    out: List[int] = []
+    d = 1
+    while d < world:
+        out.append(d)
+        d *= 2
+    return out
+
+
+def _tree_round_tables(
+    world: int, d: int, root: int, up: bool
+):
+    """One binomial-tree round's ppermute edges + destination mask, in
+    virtual-rank space rotated so ``root`` is vrank 0.
+
+    ``up=True`` (reduce): vranks ``v + d`` with ``v % 2d == 0`` send their
+    partial DOWN to ``v``.  ``up=False`` (broadcast): vranks ``v`` that
+    already hold the value send UP to ``v + d``.
+    """
+    import numpy as np
+
+    perm: List[Tuple[int, int]] = []
+    dst_mask = np.zeros((world,), dtype=bool)
+    for v in range(0, world, 2 * d):
+        other = v + d
+        if other >= world:
+            continue
+        src_v, dst_v = (other, v) if up else (v, other)
+        src = (src_v + root) % world
+        dst = (dst_v + root) % world
+        perm.append((src, dst))
+        dst_mask[dst] = True
+    return perm, dst_mask
+
+
+def binomial_broadcast_shard(
+    x: jnp.ndarray,
+    root: int,
+    world: int,
+    axis_name: str = RANKS_AXIS,
+) -> jnp.ndarray:
+    """Single-shot binomial-tree broadcast from ``root``: ``ceil(log2 p)``
+    ppermute rounds, the set of value-holders doubling each round (vs the
+    chain tree's ``p−1`` rounds).  Any world size; call inside shard_map.
+    Every rank ends holding the root's value (relays included — broadcast
+    values are unaffected by relay roles, docs/ELASTIC.md)."""
+    if not 0 <= root < world:
+        raise ValueError(f"root {root} outside world [0, {world})")
+    if world == 1:
+        return x
+    out = x
+    me = lax.axis_index(axis_name)
+    # descending distances: the first hop crosses half the (virtual) world
+    for d in reversed(_binomial_rounds(world)):
+        perm, dst_mask = _tree_round_tables(world, d, root, up=False)
+        recvd = lax.ppermute(out, axis_name, perm)
+        is_dst = jnp.asarray(dst_mask)[me]
+        out = jnp.where(is_dst, recvd, out)
+    return out
+
+
+def binomial_reduce_shard(
+    x: jnp.ndarray,
+    active_mask: Optional[jnp.ndarray],
+    root: int,
+    world: int,
+    axis_name: str = RANKS_AXIS,
+    op: ReduceOp = ReduceOp.SUM,
+) -> jnp.ndarray:
+    """Single-shot binomial-tree reduce to ``root``: ``ceil(log2 p)``
+    ppermute rounds with halving sender sets.  ``root`` holds the full
+    reduction; other ranks hold partials for their subtree (the same
+    contract as the engine's schedule-path reduce).  ``active_mask``
+    follows the relay contract (identity contribution, stays on the path).
+    Any world size; call inside shard_map."""
+    if not 0 <= root < world:
+        raise ValueError(f"root {root} outside world [0, {world})")
+    from adapcc_tpu.comm.engine import _avg_normalize, _mask_contribution
+
+    acc = x
+    if active_mask is not None:
+        acc = _mask_contribution(acc, active_mask, axis_name, op)
+    if world == 1:
+        if active_mask is not None:
+            return _avg_normalize(acc, active_mask, op)
+        return acc  # one contributor: AVG over 1 is the value itself
+    me = lax.axis_index(axis_name)
+    for d in _binomial_rounds(world):
+        perm, dst_mask = _tree_round_tables(world, d, root, up=True)
+        recvd = lax.ppermute(acc, axis_name, perm)
+        is_dst = jnp.asarray(dst_mask)[me]
+        acc = jnp.where(is_dst, _combine(acc, recvd, op), acc)
+    if active_mask is not None:
+        return _avg_normalize(acc, active_mask, op)
+    if op is ReduceOp.AVG:
+        return acc / world
+    return acc
+
+
+def tree_allreduce_shard(
+    x: jnp.ndarray,
+    active_mask: Optional[jnp.ndarray],
+    world: int,
+    axis_name: str = RANKS_AXIS,
+    op: ReduceOp = ReduceOp.SUM,
+    root: int = 0,
+) -> jnp.ndarray:
+    """Binomial-tree allreduce: reduce to ``root`` + broadcast back —
+    ``2·ceil(log2 p)`` rounds, full payload per hop.  The ``algo="tree"``
+    arm of the selector: latency-optimal like recursive doubling but with
+    ``O(n)`` per-hop payloads, so it prices above ``rd`` for allreduce
+    (its own regime is the rooted broadcast/reduce primitives); it exists
+    on the allreduce axis so the tuner can *measure* that, not assume it.
+    Any world size; call inside shard_map."""
+    from adapcc_tpu.comm.engine import _avg_normalize
+
+    if world == 1:
+        return x
+    # the reduce phase must NOT normalize (the broadcast would re-ship an
+    # already-averaged value — fine — but the identity-contribution math
+    # for AVG needs the active count applied exactly once, at the end)
+    reduced = binomial_reduce_shard(
+        x, active_mask, root, world, axis_name,
+        op=ReduceOp.SUM if op is ReduceOp.AVG else op,
+    )
+    out = binomial_broadcast_shard(reduced, root, world, axis_name)
+    if op is ReduceOp.AVG:
+        if active_mask is not None:
+            return _avg_normalize(out, active_mask, ReduceOp.AVG)
+        return out / world
+    return out

@@ -389,7 +389,7 @@ class Communicator:
             epoch,
         )
 
-    def boardcast(
+    def broadcast(
         self,
         tensor: jnp.ndarray,
         size: Optional[int] = None,
@@ -398,11 +398,16 @@ class Communicator:
         epoch: Optional[int] = None,
     ) -> jnp.ndarray:
         return self._dispatch_with_epoch_retry(
-            lambda ep: self._engine(BOARDCAST).boardcast(
+            lambda ep: self._engine(BOARDCAST).broadcast(
                 tensor, active_gpus=active_gpus, epoch=ep
             ),
             epoch,
         )
+
+    #: reference C-ABI spelling (commu.py boardcast); the engine-level
+    #: alias carries the one deprecation warning, this facade stays silent
+    #: for AdapCC API parity (PARITY.md P1)
+    boardcast = broadcast
 
     def alltoall(
         self,

@@ -24,6 +24,17 @@ from jax import shard_map
 from adapcc_tpu.models.moe import MoEConfig
 
 
+def moe_capacity(cfg: MoEConfig, n_loc: int) -> int:
+    """Static per-(rank, expert) token capacity for a local shard of
+    ``n_loc`` tokens — the ONE definition of the exchange geometry, shared
+    by the EP shard program and the train_moe tuner probe so the probed
+    all-to-all payload can never drift from the executed one."""
+    return max(
+        1,
+        int(-(-cfg.capacity_factor * cfg.top_k * n_loc // cfg.num_experts)),
+    )
+
+
 def _moe_shard(
     router_kernel: jnp.ndarray,
     router_bias: jnp.ndarray,
@@ -113,6 +124,7 @@ def expert_parallel_moe(
     mesh: Mesh,
     axis_name: str = "experts",
     capacity: int | None = None,
+    engine: Any = None,
 ):
     """Apply an EP-sharded MoE MLP.
 
@@ -126,6 +138,16 @@ def expert_parallel_moe(
     hierarchical two-hop exchange (`all_to_all_two_level_shard`): intra-slice
     regrouping on ICI, then strictly lane-aligned DCN traffic — instead of a
     DCN-oblivious flat collective.
+
+    ``engine`` (a :class:`~adapcc_tpu.comm.engine.CollectiveEngine` built on
+    the SAME mesh) routes the dispatch/combine all-to-alls through the
+    engine's :meth:`~adapcc_tpu.comm.engine.CollectiveEngine.expert_a2a`
+    instead of a raw ``lax.all_to_all`` — bit-identical exchange (pinned by
+    a parity test), but the traffic is now *traced* in the engine's
+    dispatch trace and *tuned* under the ``all_to_all`` primitive like
+    every other collective (docs/LATENCY.md §5; the tuner database is fed
+    by engine-level probe dispatches at this payload geometry, see
+    workloads/train_moe.py).
     """
     from adapcc_tpu.comm.two_level import (
         all_to_all_two_level_shard,
@@ -151,12 +173,25 @@ def expert_parallel_moe(
         )
     else:
         world = mesh.shape[axis_name]
+    if engine is not None:
+        if engine.world_size != world:
+            raise ValueError(
+                f"engine world {engine.world_size} != expert-parallel world "
+                f"{world}; build the engine on the MoE mesh"
+            )
+        if bool(getattr(engine, "two_level", False)) != is_two_level(mesh):
+            raise ValueError(
+                "engine and mesh disagree about the (dcn, ici) hierarchy; "
+                "build the engine on the MoE mesh"
+            )
+        a2a = engine.expert_a2a(
+            axis_name=None if is_two_level(mesh) else axis_name
+        )
     p = params["params"]
     if cfg.num_experts % world:
         raise ValueError(f"{cfg.num_experts} experts not divisible by world {world}")
     if capacity is None:
-        n_loc = x.shape[0] // world
-        capacity = max(1, int(-(-cfg.capacity_factor * cfg.top_k * n_loc // cfg.num_experts)))
+        capacity = moe_capacity(cfg, x.shape[0] // world)
 
     fn = shard_map(
         partial(_moe_shard, cfg=cfg, axis_name=axis_name, capacity=capacity, a2a=a2a),

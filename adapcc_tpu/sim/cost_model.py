@@ -734,6 +734,198 @@ def failover_cost(
     }
 
 
+# --------------------------------------------------------------------------- #
+# latency-optimal algorithm pricing (adapcc_tpu/comm/latency): recursive
+# doubling + binomial trees vs the ring, on the physical ring embedding
+# --------------------------------------------------------------------------- #
+
+#: algorithm candidates the size-adaptive selector prices, safest first
+#: ("ring" leads so a predicted tie keeps the bandwidth-optimal plane);
+#: mirrors ``adapcc_tpu.comm.latency.COLL_ALGOS`` minus "auto" (drift
+#: pinned by a test)
+COLL_ALGO_CANDIDATES = ("ring", "rd", "tree")
+
+
+def _ring_hops(distance: int, world: int) -> int:
+    """Physical ICI hops a logical exchange at XOR/tree distance ``d``
+    rides on the ring/torus embedding (wraparound both ways).  This is the
+    term that makes the ring win large payloads: recursive doubling's
+    round-``k`` messages serialize over ``min(2^k, p−2^k)`` links, so its
+    bandwidth cost grows with ``p`` while its fixed cost stays ``log2 p``."""
+    d = int(distance) % int(world)
+    return min(d, world - d)
+
+
+def recursive_doubling_allreduce_time(
+    world: int, nbytes: float, coeffs: LinkCoeffs
+) -> float:
+    """Analytical latency of the recursive-halving reduce-scatter +
+    recursive-doubling all-gather allreduce
+    (:func:`adapcc_tpu.comm.latency.rd_allreduce_shard`) on the ring
+    embedding.
+
+    Each of the ``2·log2(p)`` rounds pays one α plus the wire time of its
+    message *serialized over the physical hop distance*: the halving phase
+    sends ``n/2^(k+1)`` across ``min(p/2^(k+1)·2^k…)`` — concretely,
+    distance ``p/2^(k+1)`` — links, the doubling phase mirrors it.  Summed:
+
+        t(n) = 2·log2(p)·α + 2·β·n·Σ_k hops(d_k)/2^(k+1)
+
+    — fixed cost ``2·log2(p)·α`` (vs the ring's ``2·(p−1)·α``), bandwidth
+    slope ≈ ``(2p/3)·β`` (vs the ring's ``2·(p−1)/p·β``), which is exactly
+    the small-wins / large-loses shape
+    :func:`allreduce_crossover_bytes` solves.
+
+    Non-power-of-two worlds price the textbook fold-in: the remainder
+    ranks pre-reduce into (and re-receive from) a power-of-two core over
+    one neighbor hop each way — two extra full-payload transfers — then
+    the core runs the power-of-two schedule.  (The data plane itself
+    rejects such worlds; this term exists so the selector can still rank
+    them.)  ``world < 2`` is free.
+    """
+    world = int(world)
+    if world < 2:
+        return 0.0
+    total = 0.0
+    p = 1 << (world.bit_length() - 1)  # largest power of two <= world
+    if p != world:
+        # fold-in: remainder ranks send their payload to a core neighbor
+        # before the schedule and receive the result after it
+        total += 2.0 * coeffs.time(nbytes)
+    # recursive-halving reduce-scatter: distances p/2, p/4, ..., 1 with
+    # messages n/2, n/4, ..., n/p; the all-gather mirrors the same
+    # (distance, size) pairs back up, hence the factor 2
+    rs = 0.0
+    d = p // 2
+    msg = float(nbytes) / 2.0
+    while d >= 1:
+        rs += coeffs.alpha + coeffs.beta * _ring_hops(d, p) * msg
+        d //= 2
+        msg /= 2.0
+    return total + 2.0 * rs
+
+
+def binomial_tree_time(
+    world: int, nbytes: float, coeffs: LinkCoeffs
+) -> float:
+    """Analytical latency of ONE single-shot binomial-tree phase — a
+    broadcast from (or reduce to) a root
+    (:func:`adapcc_tpu.comm.latency.binomial_broadcast_shard` /
+    ``binomial_reduce_shard``): ``ceil(log2 p)`` rounds, each moving the
+    full payload across its round's hop distance on the ring embedding:
+
+        t(n) = ceil(log2 p)·α + β·n·Σ_k hops(2^k)
+
+    A tree *allreduce* is two phases (reduce + broadcast): price it as
+    ``2 × binomial_tree_time`` — which is what
+    :func:`choose_allreduce_algo` does for the ``"tree"`` arm.  Any world
+    size; ``world < 2`` is free.
+    """
+    world = int(world)
+    if world < 2:
+        return 0.0
+    total = 0.0
+    d = 1
+    while d < world:
+        total += coeffs.alpha + coeffs.beta * _ring_hops(d, world) * float(nbytes)
+        d *= 2
+    return total
+
+
+def all_to_all_time(
+    world: int, nbytes: float, coeffs: LinkCoeffs
+) -> float:
+    """Analytical latency of a flat all-to-all on the ring embedding — the
+    tuner prior for the new ``all_to_all`` primitive (the MoE dispatch/
+    combine shuffle).  ``nbytes`` is one rank's total send volume (its
+    ``[world, block]`` row).
+
+    Priced as the linear-shift schedule: ``world − 1`` rounds, round ``k``
+    shipping one ``n/world`` block to the rank at logical distance ``k``
+    (``min(k, p−k)`` physical hops):
+
+        t(n) = (p−1)·α + β·(n/p)·Σ_k hops(k)  ≈  (p−1)·α + β·n·p/4
+
+    — the ``p/4`` slope is the torus bisection showing up in the price,
+    which is why expert traffic is worth tuning at all.  ``world < 2`` is
+    free.
+    """
+    world = int(world)
+    if world < 2:
+        return 0.0
+    block = float(nbytes) / world
+    total = 0.0
+    for k in range(1, world):
+        total += coeffs.alpha + coeffs.beta * _ring_hops(k, world) * block
+    return total
+
+
+def allreduce_crossover_bytes(world: int, coeffs: LinkCoeffs) -> float:
+    """The payload size where the ring allreduce catches up with recursive
+    doubling: below it ``rd`` is strictly cheaper (the ``log2 p`` fixed
+    cost wins), above it strictly more expensive (the hop-serialized
+    bandwidth slope loses).  Both models are affine in ``n``, so the
+    break-even is exact:
+
+        n* = (ring_α_term − rd_α_term) / (rd_slope − ring_slope)
+
+    Returns ``0.0`` when rd is never cheaper (degenerate coefficients or
+    ``world < 2``) and ``inf`` when it always is (β = 0: a latency-only
+    fabric).  This is the sized decision ``ADAPCC_COLL_ALGO=auto``
+    executes and the ``make latency-bench`` rows stamp per row.
+    """
+    world = int(world)
+    if world < 2:
+        return 0.0
+
+    def ring(n: float) -> float:
+        return quantized_ring_allreduce_time(world, n, coeffs, "off")
+
+    def rd(n: float) -> float:
+        return recursive_doubling_allreduce_time(world, n, coeffs)
+
+    probe = float(1 << 20)
+    ring_a, rd_a = ring(0.0), rd(0.0)
+    ring_slope = (ring(probe) - ring_a) / probe
+    rd_slope = (rd(probe) - rd_a) / probe
+    if rd_a >= ring_a:
+        return 0.0  # no latency advantage: rd never wins
+    if rd_slope <= ring_slope:
+        return float("inf")  # no bandwidth penalty: rd always wins
+    return (ring_a - rd_a) / (rd_slope - ring_slope)
+
+
+def choose_allreduce_algo(
+    world: int,
+    nbytes: float,
+    coeffs: LinkCoeffs,
+    candidates: Sequence[str] = COLL_ALGO_CANDIDATES,
+) -> Tuple[str, Dict[str, float]]:
+    """Pick the cheapest allreduce algorithm for one payload size — the
+    cost-model half of the size-adaptive selector (the measured tuner is
+    the other half).  Returns ``(winner, {algo: seconds})``; ties break by
+    candidate order, so "ring" survives a prediction-identical
+    alternative (no churn of the bandwidth plane)."""
+    if not candidates:
+        raise ValueError("need at least one collective-algorithm candidate")
+    pricing = {
+        "ring": lambda: quantized_ring_allreduce_time(
+            world, nbytes, coeffs, "off"
+        ),
+        "rd": lambda: recursive_doubling_allreduce_time(world, nbytes, coeffs),
+        "tree": lambda: 2.0 * binomial_tree_time(world, nbytes, coeffs),
+    }
+    unknown = [c for c in candidates if c not in pricing]
+    if unknown:
+        raise ValueError(
+            f"unknown algorithm(s) {unknown}; expected a subset of "
+            f"{COLL_ALGO_CANDIDATES}"
+        )
+    times = {c: pricing[c]() for c in candidates}
+    winner = min(candidates, key=lambda c: times[c])
+    return winner, times
+
+
 def ring_allreduce_time(
     world: int, nbytes: float, coeffs: LinkCoeffs, chunks: int = 1
 ) -> float:

@@ -152,15 +152,33 @@ class CollectiveTuner:
         dtype: str = "float32",
         wire_dtypes: Optional[Sequence[str]] = None,
         overlap_modes: Optional[Sequence[str]] = None,
+        algos: Optional[Sequence[str]] = None,
     ) -> TunedPlan:
         """Commit a plan for one dispatch (policy rules; see
         :class:`adapcc_tpu.tuner.policy.TuningPolicy`).  ``wire_dtypes``
         narrows the codec axis for configurations that cannot legally run
         every codec; ``overlap_modes`` narrows the ddp_step overlap axis
-        the same way."""
+        the same way; ``algos`` narrows the allreduce algorithm axis (an
+        ``ADAPCC_COLL_ALGO`` pin at the engine collapses it)."""
         return self.policy.choose(
             primitive, max(1, int(nbytes)), dtype, wire_dtypes,
-            overlap_modes,
+            overlap_modes, algos,
+        )
+
+    def rank_only(
+        self,
+        primitive: str,
+        nbytes: int,
+        dtype: str = "float32",
+        wire_dtypes: Optional[Sequence[str]] = None,
+        overlap_modes: Optional[Sequence[str]] = None,
+        algos: Optional[Sequence[str]] = None,
+    ) -> TunedPlan:
+        """Side-effect-free exploitation view (no exploration, no
+        incumbent mutation) — see :meth:`TuningPolicy.rank_only`."""
+        return self.policy.rank_only(
+            primitive, max(1, int(nbytes)), dtype, wire_dtypes,
+            overlap_modes, algos,
         )
 
     def observe_dispatch(

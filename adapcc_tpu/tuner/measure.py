@@ -134,6 +134,43 @@ def _key_from_event(
             chunk_bytes=NO_CHUNK,
             wire_dtype=str(extra.get("wire_dtype", impl[len("quant_ring["):-1])),
         )
+    from adapcc_tpu.tuner.policy import A2A_XLA_PATH, ALGO_PATHS, XLA_PATH
+
+    if impl in ALGO_PATHS:
+        # latency-plane dispatches (docs/LATENCY.md): the impl IS the
+        # algorithm path — no chunk knob, fp32 wire
+        return TuningKey(
+            primitive=event.primitive,
+            size_bucket=size_bucket(per_rank),
+            world=world,
+            topology=topology,
+            path=impl,
+            chunk_bytes=NO_CHUNK,
+            wire_dtype="off",
+        )
+    if event.primitive == "allreduce" and impl == XLA_PATH:
+        # the psum fastpath — the xla baseline cell all_reduce's
+        # algorithm arbitration reads (only timed dispatches land here;
+        # untimed xla events fall through to the caller's skip count)
+        return TuningKey(
+            primitive="allreduce",
+            size_bucket=size_bucket(per_rank),
+            world=world,
+            topology=topology,
+            path=XLA_PATH,
+            chunk_bytes=NO_CHUNK,
+            wire_dtype="off",
+        )
+    if event.primitive == "all_to_all" and impl in (A2A_XLA_PATH, "two_level"):
+        return TuningKey(
+            primitive="all_to_all",
+            size_bucket=size_bucket(per_rank),
+            world=world,
+            topology=topology,
+            path=impl,
+            chunk_bytes=NO_CHUNK,
+            wire_dtype="off",
+        )
     return None
 
 
@@ -148,8 +185,10 @@ def replay_trace(
 
     Returns ``(ingested, skipped)``.  Skipped events are the ones with no
     ``duration_s`` (recorded under ``ADAPCC_TUNER=off``) or with an impl
-    that has no plan cell (xla / strategy dispatches) — counted, never
-    silently vanished, so a replay that ingests nothing is diagnosable.
+    that has no plan cell (strategy/schedule dispatches; timed allreduce
+    ``xla`` and latency-plane ``rd``/``tree`` events DO have cells) —
+    counted, never silently vanished, so a replay that ingests nothing is
+    diagnosable.
     """
     events = trace.events() if hasattr(trace, "events") else list(trace)
     ingested = skipped = 0

@@ -57,6 +57,31 @@ NO_CHUNK = 0
 #: the quantized ppermute ring (wire_dtype != "off") — one cell per codec
 QUANT_PATH = "quant-ring"
 
+#: latency-plane algorithm cells (adapcc_tpu/comm/latency): the recursive
+#: halving/doubling allreduce and the binomial-tree allreduce, keyed in the
+#: path slot like the ring paths — the persistent schema stays untouched
+RD_PATH = "rd"
+TREE_PATH = "tree"
+ALGO_PATHS = (RD_PATH, TREE_PATH)
+
+#: selector spelling of a path slot: rd/tree cells name their algorithm,
+#: every other path (vmem/hbm-stream/quant-ring) is the ring plane
+ALGO_OF_PATH = {RD_PATH: "rd", TREE_PATH: "tree"}
+
+#: the engine's flat XLA all-to-all — the one cell of the (new) tuned
+#: ``all_to_all`` primitive on a flat mesh ("two_level" on a (dcn, ici)
+#: mesh, recorded by the engine and folded in via the known-keys rule)
+A2A_XLA_PATH = "xla"
+
+#: the fused XLA collective plane (``engine.all_reduce``'s psum fastpath)
+#: as an allreduce cell: the baseline the algorithm cells compete against
+#: from THAT entry point — it can neither execute nor time the Pallas
+#: chunk/codec grid, so without its own measurable cell a measured rd
+#: sample would beat every unmeasurable alternative forever.  Joins the
+#: grid only on request (``algos`` containing "xla"); ring_allreduce
+#: never offers it (that plane cannot run a psum).
+XLA_PATH = "xla"
+
 #: gradient-hook dispatches (DDPTrainer --tune): knobs are the wire codec
 #: and the overlap schedule (encoded in the key's path slot, see
 #: :func:`hook_path` — the persistent schema stays untouched)
@@ -200,6 +225,9 @@ class TuningPolicy:
         self._rng = random.Random(seed)
         #: hysteresis state: (primitive, size_bucket) → incumbent key
         self._incumbent: Dict[Tuple[str, int], TuningKey] = {}
+        #: lazily computed sim crossover (ring vs recursive doubling) that
+        #: gates the algorithm axis: None = not yet computed
+        self._algo_crossover: Optional[float] = None
 
     # -- candidate grid --------------------------------------------------------
 
@@ -231,6 +259,37 @@ class TuningPolicy:
             # dispatch itself will fail loudly; no cell for it
             return False
 
+    def algo_crossover_bytes(self) -> float:
+        """The sim crossover (ring vs recursive doubling) on THIS policy's
+        cost model, cached — the one number both the candidate-grid gate
+        and the engine's ``auto`` selector consult, so an injected custom
+        calibration can never make the tuner offer rd cells at sizes the
+        engine's own crossover would refuse (or vice versa)."""
+        if self._algo_crossover is None:
+            from adapcc_tpu.sim.cost_model import (
+                allreduce_crossover_bytes,
+                bottleneck_ring_coeffs,
+            )
+
+            coeffs = bottleneck_ring_coeffs(self._model(), max(2, self.world))
+            self._algo_crossover = allreduce_crossover_bytes(
+                self.world, coeffs
+            )
+        return self._algo_crossover
+
+    def _sub_crossover(self, nbytes: int) -> bool:
+        """Whether this payload's size bucket sits at or below the sim
+        crossover (ring vs recursive doubling) — the gate that admits the
+        algorithm axis into the grid.  Bucket-granular on purpose: every
+        payload in one bucket must see the same candidate set, or samples
+        and choices within a bucket would rank different grids."""
+        x = self.algo_crossover_bytes()
+        if x <= 0.0:
+            return False
+        if x == float("inf"):
+            return True
+        return size_bucket(nbytes) <= size_bucket(max(1, int(x)))
+
     def candidates(
         self,
         primitive: str,
@@ -238,6 +297,7 @@ class TuningPolicy:
         dtype: str = "float32",
         wire_dtypes: Optional[Sequence[str]] = None,
         overlap_modes: Optional[Sequence[str]] = None,
+        algos: Optional[Sequence[str]] = None,
     ) -> List[TuningKey]:
         """The plan cells competing for this dispatch.
 
@@ -246,10 +306,18 @@ class TuningPolicy:
         data plane would not run) with, per non-"off" codec, one unfused
         quant-ring cell (no staging knob) plus — where the fused kernels
         can run — fused cells over the same chunk grid, so chunk_bytes ×
-        wire_dtype × path compete on measured medians.  ``ddp_step``
-        carries the codec axis crossed with the overlap-schedule axis
-        (:data:`HOOK_OVERLAP_MODES`, encoded via :func:`hook_path`) — the
-        hook's allreduce is not chunk-steered.
+        wire_dtype × path compete on measured medians.  ``allreduce``
+        additionally carries the **algorithm axis** for sub-crossover size
+        buckets (docs/LATENCY.md): one recursive-doubling and one
+        binomial-tree cell (:data:`RD_PATH`/:data:`TREE_PATH` in the path
+        slot, no chunk knob, fp32 wire), gated on the latency plane's own
+        support funnel.  ``ddp_step`` carries the codec axis crossed with
+        the overlap-schedule axis (:data:`HOOK_OVERLAP_MODES`, encoded via
+        :func:`hook_path`) — the hook's allreduce is not chunk-steered.
+        ``all_to_all`` (the MoE dispatch/combine shuffle) has one flat XLA
+        cell plus whatever the database already measured for the bucket —
+        the engine's dispatches are timed and traced like every other
+        collective even while the axis has a single knobless cell.
 
         ``wire_dtypes`` narrows the codec axis for this call (default: the
         policy's full registry) — a caller whose configuration cannot
@@ -259,15 +327,56 @@ class TuningPolicy:
         (every dispatch executes the pin; other codecs' cells would
         starve).  ``overlap_modes`` narrows the ddp_step overlap axis the
         same way (a trainer without gradient accumulation cannot compile
-        the microbatch pipeline).
+        the microbatch pipeline).  ``algos`` narrows the algorithm axis:
+        an ``ADAPCC_COLL_ALGO`` pin (or an explicit ``algo=`` argument at
+        the engine) collapses it — a pinned ``rd`` dispatch can never
+        execute a ring cell, so offering one would starve the explorer;
+        under a single-algorithm pin the crossover gate stands down (the
+        pinned cell must exist at every size the engine dispatches).
         """
         if wire_dtypes is None:
             wire_dtypes = self.wire_dtypes
         pin = self._pinned_wire_dtype()
         if pin is not None:
             wire_dtypes = (pin,)
+        allowed_algos = (
+            ("ring",) + ALGO_PATHS if algos is None else tuple(algos)
+        )
         bucket = size_bucket(nbytes)
         cells: List[TuningKey] = []
+        if (
+            primitive == "allreduce"
+            and "xla" in allowed_algos
+            and "off" in wire_dtypes
+        ):
+            # the XLA-plane baseline cell, FIRST so a predicted tie keeps
+            # the fused collective (see XLA_PATH)
+            cells.append(
+                TuningKey(
+                    primitive, bucket, self.world, self.topology,
+                    XLA_PATH, NO_CHUNK, "off",
+                )
+            )
+        if primitive == "all_to_all":
+            cells.append(
+                TuningKey(
+                    primitive, bucket, self.world, self.topology,
+                    A2A_XLA_PATH, NO_CHUNK, "off",
+                )
+            )
+            # measured cells beyond the static grid compete (e.g. the
+            # two-level hierarchical exchange the engine records on a
+            # (dcn, ici) mesh)
+            for known in self.db.keys():
+                if (
+                    known.primitive == primitive
+                    and known.size_bucket == bucket
+                    and known.world == self.world
+                    and known.topology == self.topology
+                    and known not in cells
+                ):
+                    cells.append(known)
+            return cells
         if primitive == "ddp_step":
             modes = (
                 HOOK_OVERLAP_MODES if overlap_modes is None
@@ -287,7 +396,7 @@ class TuningPolicy:
         nelems = max(1, int(nbytes)) // max(
             1, _itemsize(dtype)
         )
-        if "off" in wire_dtypes:
+        if "off" in wire_dtypes and "ring" in allowed_algos:
             seen_planned = set()
             for chunk in self.chunk_grid:
                 plan = plan_ring_schedule(nelems, dtype, self.world, chunk)
@@ -312,13 +421,38 @@ class TuningPolicy:
                         "off",
                     )
                 )
+        if primitive == "allreduce":
+            # the algorithm axis (docs/LATENCY.md): recursive doubling and
+            # the binomial tree join the grid for sub-crossover buckets —
+            # where the log2(p) α term can actually win — gated on the
+            # latency plane's own support funnel (rd needs a power-of-two
+            # world).  Under a single-algorithm pin (algos collapsed by
+            # the engine) the crossover gate stands down: the pinned cell
+            # must exist wherever the engine dispatches it.
+            from adapcc_tpu.comm.latency import latency_algo_unsupported_reason
+
+            for path in ALGO_PATHS:
+                if path not in allowed_algos or "off" not in wire_dtypes:
+                    continue
+                if latency_algo_unsupported_reason(self.world, path) is not None:
+                    continue
+                if "ring" in allowed_algos and not self._sub_crossover(nbytes):
+                    continue
+                cells.append(
+                    TuningKey(
+                        primitive, bucket, self.world, self.topology,
+                        path, NO_CHUNK, "off",
+                    )
+                )
         # measured cells OUTSIDE the grid still compete in exploitation: a
         # record-only run under a pinned or solver-assigned chunk (any
         # budget not in the grid) produced honest medians for a plan the
         # data plane actually ran — ignoring them would re-explore cells
         # the pod already paid to measure.  Fused off-grid cells compete
         # too, but only where the data plane can still run them (a cell
-        # the dispatch would reroute around would starve forever)
+        # the dispatch would reroute around would starve forever); a cell
+        # of a narrowed-out algorithm never re-enters (the pin the caller
+        # declared means the engine would override it every time)
         for known in self.db.keys():
             if (
                 known.primitive == primitive
@@ -329,12 +463,17 @@ class TuningPolicy:
                 and known.path != QUANT_PATH
                 and known not in cells
                 and (
+                    known.path
+                    if known.path in ALGO_PATHS
+                    else ("xla" if known.path == XLA_PATH else "ring")
+                ) in allowed_algos
+                and (
                     known.wire_dtype == "off"
                     or self._fused_paths_available(dtype, known.wire_dtype)
                 )
             ):
                 cells.append(known)
-        if primitive == "allreduce":
+        if primitive == "allreduce" and "ring" in allowed_algos:
             # only allreduce has a quantized ring variant (PR-3); the fused
             # streaming cells (PR-6) speak every ring primitive but compete
             # on the tuner's one steered primitive.  ADAPCC_FUSED_WIRE=on
@@ -391,15 +530,33 @@ class TuningPolicy:
         about a cell's ranking."""
         from adapcc_tpu.sim.cost_model import (
             DEFAULT_HBM_BYTES_PER_S,
+            all_to_all_time,
+            binomial_tree_time,
             bottleneck_ring_coeffs,
             fused_quantized_ring_allreduce_time,
             quantized_ring_allreduce_time,
+            recursive_doubling_allreduce_time,
             staged_ring_allreduce_time,
         )
 
         model = self._model()
         world = max(2, self.world)
         coeffs = bottleneck_ring_coeffs(model, world)
+        if key.primitive == "all_to_all":
+            return all_to_all_time(world, float(nbytes), coeffs)
+        if key.path == RD_PATH:
+            return recursive_doubling_allreduce_time(
+                world, float(nbytes), coeffs
+            )
+        if key.path == TREE_PATH:
+            # a tree allreduce is two single-shot phases: reduce + broadcast
+            return 2.0 * binomial_tree_time(world, float(nbytes), coeffs)
+        if key.primitive == "allreduce" and key.path == XLA_PATH:
+            # the fused XLA collective is the bandwidth-optimal ring on a
+            # healthy torus: price it with the classic ring term
+            return quantized_ring_allreduce_time(
+                world, float(nbytes), coeffs, "off"
+            )
         if _is_hook_path(key.path):
             # hook cells: the comm term only (the step's compute is shared
             # across every cell, so it cancels in the ranking).  Overlap
@@ -476,6 +633,57 @@ class TuningPolicy:
             exec_chunk_bytes=self._exec_chunk(key, nbytes, dtype),
         )
 
+    def _best(
+        self, cells: Sequence[TuningKey], nbytes: int
+    ) -> Tuple[TuningKey, float, str]:
+        """Exploitation ranking shared by :meth:`choose` and
+        :meth:`rank_only`: measured cells by database median; with nothing
+        measured, the sim prior over the whole grid."""
+        measured = {
+            c: self.db.stats(c)
+            for c in cells
+            if self.db.count(c) >= self.min_samples
+        }
+        if measured:
+            best = min(
+                measured,
+                key=lambda c: (measured[c].median_s, cells.index(c)),
+            )
+            return best, measured[best].median_s, "measured"
+        priors = {c: self.prior_time(c, nbytes) for c in cells}
+        best = min(cells, key=lambda c: (priors[c], cells.index(c)))
+        return best, priors[best], "prior"
+
+    def rank_only(
+        self,
+        primitive: str,
+        nbytes: int,
+        dtype: str = "float32",
+        wire_dtypes: Optional[Sequence[str]] = None,
+        overlap_modes: Optional[Sequence[str]] = None,
+        algos: Optional[Sequence[str]] = None,
+    ) -> TunedPlan:
+        """Side-effect-free exploitation view of :meth:`choose`: rank the
+        grid by measured median (prior fallback) WITHOUT exploration,
+        incumbent mutation, or RNG advance.
+
+        For callers that can only *honor* a decision, never realize
+        arbitrary cells — ``engine.all_reduce``'s algorithm arbitration:
+        the xla/schedule plane cannot execute or time a chunk/codec cell,
+        so an exploring choose() there would return count-0 cells whose
+        trial budget can never drain (explorer starvation), and its
+        incumbent writes would flap the REAL dispatcher's hysteresis."""
+        cells = self.candidates(
+            primitive, nbytes, dtype, wire_dtypes, overlap_modes, algos
+        )
+        if not cells:
+            raise ValueError(
+                f"no candidate cells for primitive={primitive!r} "
+                f"(chunk grid {self.chunk_grid})"
+            )
+        best, best_s, best_src = self._best(cells, nbytes)
+        return self._plan(best, best_src, best_s, nbytes, dtype)
+
     def choose(
         self,
         primitive: str,
@@ -483,13 +691,15 @@ class TuningPolicy:
         dtype: str = "float32",
         wire_dtypes: Optional[Sequence[str]] = None,
         overlap_modes: Optional[Sequence[str]] = None,
+        algos: Optional[Sequence[str]] = None,
     ) -> TunedPlan:
         """Commit a plan cell for one dispatch (see module docstring).
 
         ``wire_dtypes`` narrows the codec axis, ``overlap_modes`` the
-        ddp_step overlap axis (see :meth:`candidates`)."""
+        ddp_step overlap axis, ``algos`` the allreduce algorithm axis (see
+        :meth:`candidates`)."""
         cells = self.candidates(
-            primitive, nbytes, dtype, wire_dtypes, overlap_modes
+            primitive, nbytes, dtype, wire_dtypes, overlap_modes, algos
         )
         if not cells:
             raise ValueError(
@@ -505,21 +715,7 @@ class TuningPolicy:
                 cell, "explore", self._score(cell, nbytes)[0], nbytes, dtype
             )
         # 2. posterior over prior
-        measured = {
-            c: self.db.stats(c)
-            for c in cells
-            if self.db.count(c) >= self.min_samples
-        }
-        if measured:
-            best = min(
-                measured,
-                key=lambda c: (measured[c].median_s, cells.index(c)),
-            )
-            best_s, best_src = measured[best].median_s, "measured"
-        else:
-            priors = {c: self.prior_time(c, nbytes) for c in cells}
-            best = min(cells, key=lambda c: (priors[c], cells.index(c)))
-            best_s, best_src = priors[best], "prior"
+        best, best_s, best_src = self._best(cells, nbytes)
         # 3. hysteresis against the incumbent
         group = (primitive, size_bucket(nbytes))
         incumbent = self._incumbent.get(group)

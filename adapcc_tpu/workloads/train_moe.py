@@ -39,6 +39,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--aux-weight", type=float, default=0.01)
     p.add_argument("--world", type=int, default=None)
+    p.add_argument(
+        "--tune-every", type=int, default=10,
+        help="steps between all-to-all tuner probes when a tuner is active "
+        "(ADAPCC_TUNER=record|choose): the engine times real all_to_all "
+        "dispatches at the MoE exchange geometry into the tuning database "
+        "(the in-jit dispatch/combine shuffles cannot be walltimed "
+        "individually)",
+    )
     return p
 
 
@@ -83,6 +91,35 @@ def run(args) -> Tuple[float, float]:
     x_np, y_np = _cluster_data(args.batch, cfg.d_model, args.classes)
     x, y = jnp.asarray(x_np), jnp.asarray(y_np)
 
+    # expert traffic rides the engine when a tuner is active: the MoE
+    # dispatch/combine all-to-alls route through engine.expert_a2a (traced
+    # per compiled program) and periodic engine.all_to_all probes at the
+    # SAME payload geometry feed the tuning database under the
+    # `all_to_all` primitive (docs/LATENCY.md §5)
+    from adapcc_tpu.tuner import tuner_mode
+
+    engine = None
+    a2a_probe = None
+    if tuner_mode() != "off":
+        from adapcc_tpu.comm.engine import CollectiveEngine
+        from adapcc_tpu.strategy.ir import Strategy
+        from adapcc_tpu.utils import CollectiveTrace
+
+        from adapcc_tpu.parallel.expert import moe_capacity
+
+        engine = CollectiveEngine(
+            mesh, Strategy.ring(world), axis_name="experts",
+            trace=CollectiveTrace(),
+        )
+        e_loc = cfg.num_experts // world
+        cap = moe_capacity(cfg, args.batch // world)
+        probe = jnp.zeros(
+            (world, world, e_loc * cap * cfg.d_model), jnp.float32
+        )
+
+        def a2a_probe():
+            engine.all_to_all(probe)
+
     import flax.linen as nn
 
     class Readout(nn.Module):
@@ -97,20 +134,25 @@ def run(args) -> Tuple[float, float]:
     head_params = readout.init(jax.random.PRNGKey(1), x)
 
     if args.mode == "inference":
-        fwd = jax.jit(lambda p, x: expert_parallel_moe(p, x, cfg, mesh)[0])
+        fwd = jax.jit(
+            lambda p, x: expert_parallel_moe(p, x, cfg, mesh, engine=engine)[0]
+        )
         jax.block_until_ready(fwd(moe_params, x))  # compile
         times = []
-        for _ in range(args.steps):
+        for i in range(args.steps):
+            if a2a_probe is not None and i % max(1, args.tune_every) == 0:
+                a2a_probe()
             t0 = time.perf_counter()
             jax.block_until_ready(fwd(moe_params, x))
             times.append(time.perf_counter() - t0)
         ms = float(np.mean(times) * 1e3)
+        _report_tuner(engine)
         # reference prints per-iteration computation time (train_moe.py)
         print(f"computation time: {ms:.3f} ms/step ({args.batch} tokens, world={world})")
         return ms, ms
 
     def loss_fn(params, x, y):
-        h, aux = expert_parallel_moe(params["moe"], x, cfg, mesh)
+        h, aux = expert_parallel_moe(params["moe"], x, cfg, mesh, engine=engine)
         logits = readout.apply(params["head"], h.astype(jnp.float32))
         ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
         return ce + args.aux_weight * aux, (ce, aux)
@@ -130,6 +172,8 @@ def run(args) -> Tuple[float, float]:
 
     first = last = None
     for i in range(args.steps):
+        if a2a_probe is not None and i % max(1, args.tune_every) == 0:
+            a2a_probe()
         params, opt_state, loss, ce, aux = step(params, opt_state, x, y)
         if i == 0 or i == args.steps - 1 or (i + 1) % 10 == 0:
             loss_v = float(loss)
@@ -137,7 +181,23 @@ def run(args) -> Tuple[float, float]:
             if first is None:
                 first = loss_v
             last = loss_v
+    _report_tuner(engine)
     return first, last
+
+
+def _report_tuner(engine) -> None:
+    """One summary line per tuned all_to_all cell — the run's evidence that
+    expert traffic landed in the tuning database."""
+    if engine is None or engine.tuner is None:
+        return
+    rows = [
+        r for r in engine.tuner.db.snapshot() if r["primitive"] == "all_to_all"
+    ]
+    for r in rows:
+        print(
+            f"[tuner] all_to_all bucket={r['size_bucket']}B path={r['path']} "
+            f"n={r['count']} median={r['median_s'] * 1e6:.1f}us"
+        )
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

@@ -174,12 +174,12 @@ def _make_ops(engine, elems: int, dtype=jnp.float32) -> Dict[str, tuple]:
         # active_gpus pins the schedule path; bare calls ride the XLA
         # fastpath (flat meshes only — see docstring)
         ops[("reduce", "xla")] = (lambda: engine.reduce(flat), per_rank)
-        ops[("broadcast", "xla")] = (lambda: engine.boardcast(flat), per_rank)
+        ops[("broadcast", "xla")] = (lambda: engine.broadcast(flat), per_rank)
     ops[("reduce", "strategy")] = (
         lambda: engine.reduce(flat, active_gpus=list(range(world))), per_rank,
     )
     ops[("broadcast", "strategy")] = (
-        lambda: engine.boardcast(flat, active_gpus=list(range(world))), per_rank,
+        lambda: engine.broadcast(flat, active_gpus=list(range(world))), per_rank,
     )
     if elems % world == 0:
         blocked = jax.device_put(

@@ -172,13 +172,19 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
       train_ddp workload under overlap off / bucket / microbatch, walltime
       per schedule in the artifact (docs/OVERLAP.md).  Needs real
       multi-chip comm or the "overlap" measures only dispatch noise.
+    - ``small_msg_crossover`` — the latency-bound regime A/B (the hardware
+      twin of ``make latency-bench``): the same small-to-medium allreduce
+      size grid under ``ADAPCC_COLL_ALGO=ring`` vs ``=rd``, locating the
+      measured ring ↔ recursive-doubling crossover the cost model predicts
+      (docs/LATENCY.md).  Needs a power-of-two multi-chip world; explicit
+      skip row otherwise.
     """
     gate = f"world={world} (needs multi-chip ICI)"
     if world < 2:
         for name in (
             "busbw_ici_128m", "ring_smoke", "ring_chunk_sweep",
             "busbw_wire_dtype", "busbw_fused_wire", "tuner_convergence",
-            "overlap_ab", "elastic_failover",
+            "overlap_ab", "small_msg_crossover", "elastic_failover",
         ):
             _skip(name, gate, out_path)
         return
@@ -266,6 +272,32 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
             900, out_path,
             rec_extra={"overlap": overlap, "accum": 2},
         )
+    # small-message crossover A/B (the hardware twin of `make
+    # latency-bench`): the SAME allreduce size grid spanning the
+    # sim-predicted ring <-> recursive-doubling crossover (~100 KB on
+    # default v5e coefficients), once per pinned algorithm via
+    # ADAPCC_COLL_ALGO — the measured curves locate the real crossover the
+    # cost model only predicts.  xla impl (engine.all_reduce honors the
+    # env); rd needs a power-of-two world, so non-pow2 pods record an
+    # explicit skip row instead of a loud failure mid-battery
+    if world & (world - 1):
+        _skip(
+            "small_msg_crossover",
+            f"world={world} is not a power of two (recursive doubling "
+            "pairs ranks by XOR)",
+            out_path,
+        )
+    else:
+        for algo in ("ring", "rd"):
+            _run(
+                "small_msg_crossover",
+                [py, "-m", "benchmarks.collectives", "--world", str(world),
+                 "--sizes", "4K,64K,256K,4M", "--impls", "xla",
+                 "--collectives", "allreduce"],
+                900, out_path,
+                extra_env={"ADAPCC_COLL_ALGO": algo},
+                rec_extra={"coll_algo": algo},
+            )
     # elastic failover drill on real chips (the hardware twin of
     # `make elastic-bench`): a deterministic fault plan — the last rank
     # dies mid-run, then recovers — injected via ADAPCC_FAULT_PLAN into the

@@ -487,6 +487,86 @@ def fused_wire_sweep(
     return rows
 
 
+def latency_sweep(
+    world: int,
+    sizes: Sequence[int],
+    algos: Sequence[str] = ("ring", "rd", "tree"),
+    model: Optional[LinkCostModel] = None,
+) -> List[dict]:
+    """Predicted allreduce-algorithm rows over a size grid spanning the
+    ring↔recursive-doubling crossover — the hardware-free regression
+    artifact for the latency-bound regime (``make latency-bench``,
+    docs/LATENCY.md).
+
+    Each row prices one (size, algorithm) cell on the bottleneck ring link
+    (the pacing rule every ring-shaped pricing shares): ``ring`` with the
+    classic ``2·(p−1)·(α + β·n/p)`` term, ``rd`` with
+    :func:`adapcc_tpu.sim.cost_model.recursive_doubling_allreduce_time`
+    (hop-serialized recursive halving/doubling), ``tree`` as two
+    single-shot binomial phases.  ``chosen`` marks the algorithm
+    :func:`choose_allreduce_algo` would commit for that size — the sized
+    decision ``ADAPCC_COLL_ALGO=auto`` executes — and every row stamps
+    ``crossover_bytes`` (ring vs rd break-even; ``None`` when rd never
+    loses, i.e. β = 0).  Deterministic: same calibration → byte-identical
+    rows.
+    """
+    from adapcc_tpu.sim.cost_model import (
+        COLL_ALGO_CANDIDATES,
+        allreduce_crossover_bytes,
+        bottleneck_ring_coeffs,
+        choose_allreduce_algo,
+    )
+
+    algos = [a.strip() for a in algos if str(a).strip()]
+    bad = [a for a in algos if a not in COLL_ALGO_CANDIDATES]
+    if bad:
+        raise ValueError(
+            f"unknown algorithm(s) {bad}; expected a subset of "
+            f"{COLL_ALGO_CANDIDATES}"
+        )
+    if model is None:
+        model = load_or_default(world=world)
+    elif model.world != world:
+        raise ValueError(f"model world {model.world} != sweep world {world}")
+    coeffs = bottleneck_ring_coeffs(model, world)
+    crossover = allreduce_crossover_bytes(world, coeffs)
+    crossover_field = (
+        None if crossover == float("inf") else int(round(crossover))
+    )
+    rows: List[dict] = []
+    for nbytes in sizes:
+        chosen, times = choose_allreduce_algo(
+            world, int(nbytes), coeffs, candidates=tuple(algos)
+        )
+        for algo in algos:
+            seconds = times[algo]
+            algbw = nbytes / seconds / 1e9 if seconds > 0 else 0.0
+            rows.append({
+                "mode": "simulated",
+                "collective": "allreduce",
+                "impl": "latency",
+                "strategy": "ring",
+                "world": world,
+                "size_bytes": int(nbytes),
+                "algo": algo,
+                "chosen": algo == chosen,
+                "sub_crossover": float(nbytes) < crossover,
+                "crossover_bytes": crossover_field,
+                "pred_time_us": round(seconds * 1e6, 3),
+                "algbw_gbps": round(algbw, 6),
+                "busbw_gbps": round(
+                    algbw * BUS_FACTORS["allreduce"](world), 6
+                ),
+                "calibration": model.source,
+            })
+    if not rows:
+        raise ValueError(
+            f"latency sweep produced no rows: sizes={list(sizes)} "
+            f"algos={list(algos)}"
+        )
+    return rows
+
+
 def overlap_sweep(
     world: int,
     sizes: Sequence[int],
@@ -852,6 +932,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="fault-sweep heartbeat timeout priced into detection latency",
     )
     ap.add_argument(
+        "--latency-sweep", action="store_true",
+        help="price the latency-bound allreduce algorithms (ring vs "
+        "recursive doubling vs binomial tree) over --sizes instead of the "
+        "strategy grid, with the per-size chosen algorithm and the ring-rd "
+        "crossover flagged per row (make latency-bench; docs/LATENCY.md)",
+    )
+    ap.add_argument(
+        "--algos", default="ring,rd,tree",
+        help="latency-sweep algorithm grid",
+    )
+    ap.add_argument(
         "--overlap-sweep", action="store_true",
         help="price the overlapped DDP gradient sync over (accum x "
         "bucket cap x overlap schedule) with overlapped_step_time instead "
@@ -875,6 +966,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--fused-sweep", args.fused_sweep),
             ("--tune-replay", args.tune_replay),
             ("--overlap-sweep", args.overlap_sweep),
+            ("--latency-sweep", args.latency_sweep),
             ("--fault-sweep", args.fault_sweep),
         ) if on
     ]
@@ -911,6 +1003,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     f"step={row['step']:>2} epoch={row['epoch']}{star} "
                     f"alive={len(row['alive'])} relays={len(row['relays'])} "
                     f"pred={row['pred_time_us']:>10.1f}us"
+                )
+        return 0
+    if args.latency_sweep:
+        rows = latency_sweep(
+            world=args.world,
+            sizes=[parse_size(s) for s in args.sizes.split(",")],
+            algos=[a.strip() for a in args.algos.split(",") if a.strip()],
+            model=model,
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            else:
+                star = "*" if row["chosen"] else " "
+                print(
+                    f"[sim] latency {row['size_bytes']:>12}B "
+                    f"algo={row['algo']:<5}{star} "
+                    f"pred={row['pred_time_us']:>10.1f}us  "
+                    f"busbw={row['busbw_gbps']:>8.3f}GB/s  "
+                    f"crossover={row['crossover_bytes']}"
                 )
         return 0
     if args.overlap_sweep:

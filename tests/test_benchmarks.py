@@ -1,5 +1,7 @@
 """Benchmark harness tests on the virtual CPU pod (tiny sizes)."""
 
+import json
+
 import pytest
 
 from benchmarks.collectives import (
@@ -467,3 +469,61 @@ def test_committed_longcontext_r05_artifact_memory_story():
         by[("single", 4096)]["score_bytes_per_device"]
         == 16 * by[("single", 1024)]["score_bytes_per_device"]
     )
+
+
+# ------------------------------------------------- latency sweep (PR 8)
+
+
+def test_latency_sweep_rows_byte_identical_and_decision_flagged():
+    """The latency-bench artifact is deterministic to the byte, spans the
+    crossover, and stamps the per-size decision + the crossover itself."""
+    from benchmarks.sim_collectives import latency_sweep
+
+    sizes = [1 << 10, 16 << 10, 256 << 10, 16 << 20]
+    rows = latency_sweep(8, sizes)
+    again = latency_sweep(8, sizes)
+    assert [json.dumps(r, sort_keys=True) for r in rows] == [
+        json.dumps(r, sort_keys=True) for r in again
+    ]
+    assert all(r["mode"] == "simulated" for r in rows)
+    assert len(rows) == len(sizes) * 3  # ring, rd, tree per size
+    by = {(r["size_bytes"], r["algo"]): r for r in rows}
+    # the sized decision: rd below the crossover, ring above
+    assert by[(1 << 10, "rd")]["chosen"] and by[(16 << 10, "rd")]["chosen"]
+    assert by[(16 << 20, "ring")]["chosen"]
+    assert all(isinstance(r["crossover_bytes"], int) for r in rows)
+    x = rows[0]["crossover_bytes"]
+    for r in rows:
+        assert r["sub_crossover"] == (r["size_bytes"] < x)
+        # exactly one chosen algorithm per size
+    for s in sizes:
+        assert sum(by[(s, a)]["chosen"] for a in ("ring", "rd", "tree")) == 1
+    with pytest.raises(ValueError, match="unknown algorithm"):
+        latency_sweep(8, sizes, algos=("rind",))
+
+
+def test_latency_sweep_cli_mutually_exclusive(capsys):
+    from benchmarks.sim_collectives import main
+
+    for other in (
+        ["--ring-sweep"],
+        ["--tune-replay"],
+        ["--fused-sweep"],
+        ["--overlap-sweep"],
+        ["--fault-sweep"],
+        ["--wire-dtype", "off,int8"],
+    ):
+        with pytest.raises(SystemExit):
+            main(["--latency-sweep"] + other)
+    capsys.readouterr()
+
+
+def test_latency_sweep_cli_emits_json(capsys):
+    from benchmarks.sim_collectives import main
+
+    assert main([
+        "--latency-sweep", "--world", "8", "--sizes", "4K,1M", "--json",
+    ]) == 0
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rows and all(r["impl"] == "latency" for r in rows)
+    assert {r["algo"] for r in rows} == {"ring", "rd", "tree"}

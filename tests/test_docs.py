@@ -2,8 +2,9 @@
 
 Every ```python block in docs/PARALLELISM.md, docs/OPERATIONS.md,
 docs/SIMULATION.md, docs/RING.md, docs/QUANT.md, docs/TUNER.md,
-docs/OVERLAP.md and docs/ELASTIC.md runs verbatim on the virtual pod.  A
-snippet that stops compiling or produces wrong shapes fails here.
+docs/OVERLAP.md, docs/LATENCY.md and docs/ELASTIC.md runs verbatim on
+the virtual pod.  A snippet that stops compiling or produces wrong
+shapes fails here.
 """
 
 import os
@@ -21,6 +22,7 @@ _RING = os.path.join(_DOCS_DIR, "RING.md")
 _QUANT = os.path.join(_DOCS_DIR, "QUANT.md")
 _TUNER = os.path.join(_DOCS_DIR, "TUNER.md")
 _OVERLAP = os.path.join(_DOCS_DIR, "OVERLAP.md")
+_LATENCY = os.path.join(_DOCS_DIR, "LATENCY.md")
 _ELASTIC = os.path.join(_DOCS_DIR, "ELASTIC.md")
 
 
@@ -167,6 +169,28 @@ def test_overlap_doc_covers_the_contract():
 def test_overlap_doc_snippet_runs(idx):
     code = _blocks(_OVERLAP)[idx]
     exec(compile(code, f"{_OVERLAP}:block{idx}", "exec"), {})
+
+
+def test_latency_doc_has_snippets():
+    assert len(_blocks(_LATENCY)) >= 5
+
+
+def test_latency_doc_covers_the_contract():
+    """The small-message-regime topics the selection runbook leans on."""
+    text = open(_LATENCY).read()
+    for needle in (
+        "ADAPCC_COLL_ALGO", "rd_allreduce_shard", "recursive",
+        "binomial", "allreduce_crossover_bytes", "crossover_bytes",
+        "make latency-bench", "small_msg_crossover", "all_to_all",
+        "expert_a2a", "power-of-two", "env > explicit arg > tuner",
+    ):
+        assert needle in text, f"LATENCY.md lost its {needle!r} coverage"
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_LATENCY))))
+def test_latency_doc_snippet_runs(idx):
+    code = _blocks(_LATENCY)[idx]
+    exec(compile(code, f"{_LATENCY}:block{idx}", "exec"), {})
 
 
 def test_elastic_doc_has_snippets():

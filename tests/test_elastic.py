@@ -272,9 +272,9 @@ def test_broadcast_rejects_dead_root(mesh4):
     eng = CollectiveEngine(mesh4, Strategy.ring(4))
     x = jnp.arange(4 * 8, dtype=jnp.float32).reshape(4, 8)
     with pytest.raises(ValueError, match="dead root cannot source"):
-        eng.boardcast(x, active_gpus=[1, 2, 3])  # root 0 excluded
+        eng.broadcast(x, active_gpus=[1, 2, 3])  # root 0 excluded
     # an alive-root masked broadcast still delivers the root row everywhere
-    out = np.asarray(eng.boardcast(x, active_gpus=[0, 1, 3]))
+    out = np.asarray(eng.broadcast(x, active_gpus=[0, 1, 3]))
     np.testing.assert_allclose(out, np.tile(np.asarray(x)[0], (4, 1)))
 
 

@@ -115,7 +115,7 @@ def test_broadcast_from_root(mesh8):
     s = Strategy.binary(8)
     eng = CollectiveEngine(mesh8, s)
     x = jnp.stack([jnp.full((16,), float(r + 1)) for r in range(8)])
-    out = eng.boardcast(x)
+    out = eng.broadcast(x)
     # everyone ends with the root's (rank 0's) data
     np.testing.assert_allclose(np.asarray(out), np.ones((8, 16)))
 
@@ -125,7 +125,7 @@ def test_broadcast_multi_tree_mixes_roots(mesh8):
     s = Strategy.ring(8, num_trans=2)
     eng = CollectiveEngine(mesh8, s)
     x = jnp.stack([jnp.full((16,), float(r + 1)) for r in range(8)])
-    out = np.asarray(eng.boardcast(x))
+    out = np.asarray(eng.broadcast(x))
     np.testing.assert_allclose(out[:, :8], np.ones((8, 8)))
     np.testing.assert_allclose(out[:, 8:], np.full((8, 8), 2.0))
 
@@ -197,12 +197,12 @@ def test_broadcast_fastpath_matches_schedule(mesh8):
     fast = CollectiveEngine(mesh8, strat, use_xla_fastpath=True)
     slow = CollectiveEngine(mesh8, strat, use_xla_fastpath=False)
     x = stacked_inputs(8)
-    out_fast = np.asarray(fast.boardcast(x))
-    np.testing.assert_allclose(out_fast, np.asarray(slow.boardcast(x)))
+    out_fast = np.asarray(fast.broadcast(x))
+    np.testing.assert_allclose(out_fast, np.asarray(slow.broadcast(x)))
     assert any(k[0] == "broadcast_fast" for k in fast._cache)
     # active_gpus pins the schedule path on a fastpath engine (run.cu:150
     # ABI parity) and produces the same values
-    pinned = np.asarray(fast.boardcast(x, active_gpus=list(range(8))))
+    pinned = np.asarray(fast.broadcast(x, active_gpus=list(range(8))))
     np.testing.assert_allclose(pinned, out_fast)
     assert any(k[0] == "broadcast" for k in fast._cache)
 
@@ -210,7 +210,7 @@ def test_broadcast_fastpath_matches_schedule(mesh8):
 def test_broadcast_fastpath_preserves_bool_dtype(mesh8):
     eng = CollectiveEngine(mesh8, Strategy.binary(8), use_xla_fastpath=True)
     x = jnp.stack([jnp.full((8,), bool(r == 0)) for r in range(8)])
-    out = eng.boardcast(x)
+    out = eng.broadcast(x)
     assert out.dtype == jnp.bool_  # psum promotes bool; the fastpath must not
     np.testing.assert_allclose(np.asarray(out), True)
 
@@ -218,7 +218,7 @@ def test_broadcast_fastpath_preserves_bool_dtype(mesh8):
 def test_broadcast_rejects_out_of_range_active_set(mesh8):
     eng = CollectiveEngine(mesh8, Strategy.binary(8))
     with pytest.raises(ValueError):
-        eng.boardcast(stacked_inputs(8), active_gpus=[99])
+        eng.broadcast(stacked_inputs(8), active_gpus=[99])
 
 
 # -- subset (active-mask) semantics on the gather/scatter primitives --------

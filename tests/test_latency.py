@@ -1,0 +1,780 @@
+"""Latency-optimal small-message collectives (adapcc_tpu/comm/latency).
+
+The recursive-halving/doubling allreduce and the binomial trees are
+validated against numpy oracles on the virtual 8-device pod; the
+size-adaptive selector (ADAPCC_COLL_ALGO, env > arg > tuner >
+sim-crossover) is pinned end to end through the engine's dispatch trace;
+the cost-model crossover is the acceptance regression: recursive doubling
+strictly cheaper than the ring below ``allreduce_crossover_bytes`` and
+strictly more expensive well above it.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from adapcc_tpu.comm.engine import CollectiveEngine
+from adapcc_tpu.comm.latency import (
+    COLL_ALGO_ENV,
+    COLL_ALGOS,
+    binomial_broadcast_shard,
+    binomial_reduce_shard,
+    latency_algo_unsupported_reason,
+    rd_allreduce_shard,
+    resolve_coll_algo,
+    tree_allreduce_shard,
+)
+from adapcc_tpu.primitives import ReduceOp
+from adapcc_tpu.sim.cost_model import (
+    COLL_ALGO_CANDIDATES,
+    LinkCoeffs,
+    all_to_all_time,
+    allreduce_crossover_bytes,
+    binomial_tree_time,
+    choose_allreduce_algo,
+    quantized_ring_allreduce_time,
+    recursive_doubling_allreduce_time,
+)
+from adapcc_tpu.strategy.ir import Strategy
+from adapcc_tpu.utils import CollectiveTrace
+
+COEFFS = LinkCoeffs(1e-6, 1.0 / 45e9)  # the ~v5e synthetic defaults
+
+
+def _run_shard(mesh, world, fn, x, mask=None, n_extra=0):
+    """Drive a latency-plane shard fn through shard_map on ``world`` ranks."""
+    if mask is None:
+        specs = (P("ranks"),)
+        wrapped = lambda v: fn(v[0])[None]
+        args = (jnp.asarray(x),)
+    else:
+        specs = (P("ranks"), P())
+        wrapped = lambda v, m: fn(v[0], m)[None]
+        args = (jnp.asarray(x), jnp.asarray(mask))
+    f = jax.jit(
+        jax.shard_map(
+            wrapped, mesh=mesh, in_specs=specs, out_specs=P("ranks"),
+            check_vma=False,
+        )
+    )
+    return np.asarray(f(*args))
+
+
+# ------------------------------------------------------------- resolver
+
+
+def test_resolve_coll_algo_precedence_and_validation():
+    assert resolve_coll_algo() is None          # unset everywhere: legacy
+    assert resolve_coll_algo("rd") == "rd"
+    os.environ[COLL_ALGO_ENV] = "tree"
+    try:
+        assert resolve_coll_algo("rd") == "tree"  # env wins over the arg
+    finally:
+        del os.environ[COLL_ALGO_ENV]
+    with pytest.raises(ValueError, match="rdx"):
+        resolve_coll_algo("rdx")
+    os.environ[COLL_ALGO_ENV] = "rings"
+    try:
+        with pytest.raises(ValueError, match="ADAPCC_COLL_ALGO"):
+            resolve_coll_algo()
+    finally:
+        del os.environ[COLL_ALGO_ENV]
+
+
+def test_support_funnel():
+    assert latency_algo_unsupported_reason(8, "rd") is None
+    assert latency_algo_unsupported_reason(8, "tree") is None
+    assert "power-of-two" in latency_algo_unsupported_reason(6, "rd")
+    assert latency_algo_unsupported_reason(6, "tree") is None  # any world
+    assert "two-level" in latency_algo_unsupported_reason(8, "rd", two_level=True)
+    with pytest.raises(ValueError):
+        latency_algo_unsupported_reason(8, "ring")  # not a latency algo
+
+
+def test_algo_vocabulary_pinned_against_cost_model():
+    """The selector and the pricing must speak one algorithm vocabulary."""
+    assert COLL_ALGO_CANDIDATES == tuple(a for a in COLL_ALGOS if a != "auto")
+
+
+# ------------------------------------------------------- shard programs
+
+
+@pytest.mark.parametrize("n", [1, 7, 64, 257])  # odd sizes exercise padding
+def test_rd_allreduce_matches_sum(mesh8, n):
+    x = np.random.default_rng(n).normal(size=(8, n)).astype(np.float32)
+    got = _run_shard(
+        mesh8, 8,
+        lambda v, m: rd_allreduce_shard(v, m, 8, "ranks"),
+        x, np.ones(8, bool),
+    )
+    np.testing.assert_allclose(
+        got, np.broadcast_to(x.sum(0), (8, n)), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_rd_allreduce_max_and_avg(mesh8):
+    x = np.random.default_rng(1).normal(size=(8, 33)).astype(np.float32)
+    got = _run_shard(
+        mesh8, 8,
+        lambda v, m: rd_allreduce_shard(v, m, 8, "ranks", op=ReduceOp.MAX),
+        x, np.ones(8, bool),
+    )
+    np.testing.assert_array_equal(got[0], x.max(0))
+    got = _run_shard(
+        mesh8, 8,
+        lambda v, m: rd_allreduce_shard(v, m, 8, "ranks", op=ReduceOp.AVG),
+        x, np.ones(8, bool),
+    )
+    np.testing.assert_allclose(
+        got[0], x.mean(0), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_rd_allreduce_relay_mask(mesh8):
+    """Inactive ranks contribute identity, stay on the path, and receive;
+    AVG normalizes by the active count — the engine's relay contract."""
+    x = np.random.default_rng(2).normal(size=(8, 19)).astype(np.float32)
+    mask = np.array([1, 0, 1, 1, 0, 1, 1, 1], bool)
+    got = _run_shard(
+        mesh8, 8, lambda v, m: rd_allreduce_shard(v, m, 8, "ranks"), x, mask
+    )
+    want = x[mask].sum(0)
+    for r in range(8):  # every rank, active or not, holds the result
+        np.testing.assert_allclose(got[r], want, rtol=1e-5, atol=1e-5)
+    got = _run_shard(
+        mesh8, 8,
+        lambda v, m: rd_allreduce_shard(v, m, 8, "ranks", op=ReduceOp.AVG),
+        x, mask,
+    )
+    np.testing.assert_allclose(
+        got[3], x[mask].sum(0) / mask.sum(), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_rd_rejects_non_power_of_two_world():
+    with pytest.raises(ValueError, match="power-of-two"):
+        rd_allreduce_shard(jnp.ones((4,)), None, 6, "ranks")
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_binomial_broadcast_from_any_root(mesh8, root):
+    x = np.random.default_rng(root).normal(size=(8, 21)).astype(np.float32)
+    got = _run_shard(
+        mesh8, 8, lambda v: binomial_broadcast_shard(v, root, 8, "ranks"), x
+    )
+    np.testing.assert_array_equal(got, np.broadcast_to(x[root], (8, 21)))
+
+
+def test_binomial_tree_any_world_size():
+    """Trees run on non-power-of-two worlds (only rd needs pow2)."""
+    mesh = Mesh(np.array(jax.devices()[:6]), ("ranks",))
+    x = np.random.default_rng(6).normal(size=(6, 13)).astype(np.float32)
+    got = _run_shard(
+        mesh, 6, lambda v: binomial_broadcast_shard(v, 2, 6, "ranks"), x
+    )
+    np.testing.assert_array_equal(got, np.broadcast_to(x[2], (6, 13)))
+    got = _run_shard(
+        mesh, 6,
+        lambda v, m: binomial_reduce_shard(v, m, 4, 6, "ranks"),
+        x, np.ones(6, bool),
+    )
+    np.testing.assert_allclose(got[4], x.sum(0), rtol=1e-5, atol=1e-5)
+    got = _run_shard(
+        mesh, 6,
+        lambda v, m: tree_allreduce_shard(v, m, 6, "ranks"),
+        x, np.ones(6, bool),
+    )
+    np.testing.assert_allclose(
+        got, np.broadcast_to(x.sum(0), (6, 13)), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_tree_allreduce_masked_avg(mesh8):
+    x = np.random.default_rng(3).normal(size=(8, 11)).astype(np.float32)
+    mask = np.array([1, 1, 0, 1, 1, 1, 0, 1], bool)
+    got = _run_shard(
+        mesh8, 8,
+        lambda v, m: tree_allreduce_shard(v, m, 8, "ranks", op=ReduceOp.AVG),
+        x, mask,
+    )
+    np.testing.assert_allclose(
+        got[6], x[mask].sum(0) / mask.sum(), rtol=1e-5, atol=1e-5
+    )
+
+
+# ------------------------------------------------------------ cost model
+
+
+def test_crossover_acceptance_regression():
+    """THE acceptance pin: sim-priced recursive doubling strictly cheaper
+    than the ring below ``allreduce_crossover_bytes``, strictly more
+    expensive well above it."""
+    x = allreduce_crossover_bytes(8, COEFFS)
+    assert 16 << 10 < x < 1 << 20  # ~100 KB on the synthetic defaults
+    for n in (1 << 10, 16 << 10, int(x * 0.9)):
+        assert recursive_doubling_allreduce_time(8, n, COEFFS) < \
+            quantized_ring_allreduce_time(8, n, COEFFS, "off")
+    for n in (int(x * 1.1), 1 << 20, 16 << 20, 128 << 20):
+        assert recursive_doubling_allreduce_time(8, n, COEFFS) > \
+            quantized_ring_allreduce_time(8, n, COEFFS, "off")
+    # the break-even is exact: both affine models meet AT the crossover
+    assert recursive_doubling_allreduce_time(8, x, COEFFS) == pytest.approx(
+        quantized_ring_allreduce_time(8, x, COEFFS, "off"), rel=1e-9
+    )
+
+
+def test_crossover_degenerate_coefficients():
+    assert allreduce_crossover_bytes(1, COEFFS) == 0.0
+    # β = 0: a latency-only fabric — rd never loses
+    assert allreduce_crossover_bytes(8, LinkCoeffs(1e-6, 0.0)) == float("inf")
+    # α = 0: no fixed cost to amortize — rd never wins
+    assert allreduce_crossover_bytes(8, LinkCoeffs(0.0, 1e-10)) == 0.0
+
+
+def test_choose_allreduce_algo_per_size():
+    small, _ = choose_allreduce_algo(8, 4096, COEFFS)
+    large, times = choose_allreduce_algo(8, 128 << 20, COEFFS)
+    assert small == "rd" and large == "ring"
+    # the tree allreduce (two full-payload phases) never beats rd here
+    assert times["tree"] > times["rd"] or times["ring"] < times["tree"]
+    with pytest.raises(ValueError, match="unknown algorithm"):
+        choose_allreduce_algo(8, 4096, COEFFS, candidates=("rind",))
+
+
+def test_rd_non_power_of_two_fold_in_priced():
+    """The cost model prices non-pow2 worlds (fold-in) even though the
+    data plane rejects them — the selector must still rank such worlds."""
+    t6 = recursive_doubling_allreduce_time(6, 65536, COEFFS)
+    t4 = recursive_doubling_allreduce_time(4, 65536, COEFFS)
+    assert t6 > t4 > 0.0
+    assert binomial_tree_time(6, 65536, COEFFS) > 0.0
+    assert all_to_all_time(8, 1 << 20, COEFFS) > 0.0
+    assert recursive_doubling_allreduce_time(1, 1 << 20, COEFFS) == 0.0
+
+
+# ------------------------------------------------------ engine dispatch
+
+
+@pytest.fixture
+def engine8(mesh8):
+    trace = CollectiveTrace()
+    return CollectiveEngine(mesh8, Strategy.ring(8), trace=trace), trace
+
+
+def test_engine_unset_env_keeps_legacy_plane(engine8):
+    eng, trace = engine8
+    x = jnp.ones((8, 64), jnp.float32)
+    eng.all_reduce(x)
+    ev = trace.events()[-1]
+    assert ev.impl == "xla" and ev.extra["algo"] == "ring"
+
+
+def test_engine_pinned_rd_and_tree_parity_and_trace(engine8):
+    eng, trace = engine8
+    xn = np.random.default_rng(0).normal(size=(8, 100)).astype(np.float32)
+    x = jnp.asarray(xn)
+    want = np.broadcast_to(xn.sum(0), (8, 100))
+    for algo in ("rd", "tree"):
+        got = np.asarray(eng.all_reduce(x, algo=algo))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        ev = trace.events()[-1]
+        assert ev.primitive == "allreduce"
+        assert ev.impl == algo
+        assert ev.extra["algo"] == algo
+        assert "cache_hit" in ev.extra
+
+
+def test_engine_auto_selects_per_size(engine8):
+    """ADAPCC_COLL_ALGO=auto: rd below the sim crossover, ring above —
+    the pinned acceptance regression, visible in the dispatch trace."""
+    eng, trace = engine8
+    small = jnp.ones((8, 256), jnp.float32)     # 1 KB/rank
+    big = jnp.ones((8, 60_000), jnp.float32)    # 240 KB/rank
+    os.environ[COLL_ALGO_ENV] = "auto"
+    try:
+        eng.all_reduce(small)
+        assert trace.events()[-1].impl == "rd"
+        assert trace.events()[-1].extra["algo"] == "rd"
+        eng.all_reduce(big)
+        assert trace.events()[-1].impl == "xla"
+        assert trace.events()[-1].extra["algo"] == "ring"
+    finally:
+        del os.environ[COLL_ALGO_ENV]
+
+
+def test_engine_env_beats_argument(engine8):
+    eng, trace = engine8
+    x = jnp.ones((8, 64), jnp.float32)
+    os.environ[COLL_ALGO_ENV] = "tree"
+    try:
+        eng.all_reduce(x, algo="ring")  # env wins
+        assert trace.events()[-1].impl == "tree"
+    finally:
+        del os.environ[COLL_ALGO_ENV]
+
+
+def test_engine_masked_rd_respects_relay_contract(engine8):
+    eng, _ = engine8
+    xn = np.random.default_rng(4).normal(size=(8, 40)).astype(np.float32)
+    got = np.asarray(
+        eng.all_reduce(jnp.asarray(xn), algo="rd", active_gpus=[0, 2, 3, 5, 6, 7])
+    )
+    want = np.broadcast_to(xn[[0, 2, 3, 5, 6, 7]].sum(0), (8, 40))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_engine_rejects_rd_on_non_power_of_two_world():
+    mesh = Mesh(np.array(jax.devices()[:6]), ("ranks",))
+    eng = CollectiveEngine(mesh, Strategy.ring(6))
+    with pytest.raises(ValueError, match="power-of-two"):
+        eng.all_reduce(jnp.ones((6, 8)), algo="rd")
+    # auto quietly stays on the ring plane there
+    os.environ[COLL_ALGO_ENV] = "auto"
+    try:
+        eng.all_reduce(jnp.ones((6, 8)))
+    finally:
+        del os.environ[COLL_ALGO_ENV]
+
+
+def test_engine_algo_wire_pin_conflict_is_loud(engine8):
+    eng, _ = engine8
+    x = jnp.ones((8, 64), jnp.float32)
+    os.environ["ADAPCC_WIRE_DTYPE"] = "int8"
+    try:
+        with pytest.raises(ValueError, match="no wire-codec plane"):
+            eng.ring_allreduce(x, algo="rd")
+    finally:
+        del os.environ["ADAPCC_WIRE_DTYPE"]
+    # the strategy's synthesized codec is a default, not a pin: algo wins
+    strat = Strategy.ring(8)
+    strat.wire_dtype = "int8"
+    trace = CollectiveTrace()
+    eng2 = CollectiveEngine(
+        eng.mesh, strat, trace=trace, use_xla_fastpath=True
+    )
+    eng2.all_reduce(x, algo="rd")  # no error: runs rd in fp32
+    assert trace.events()[-1].impl == "rd"
+
+
+def test_engine_malformed_env_fails_at_construction(mesh8):
+    os.environ[COLL_ALGO_ENV] = "rdx"
+    try:
+        with pytest.raises(ValueError, match="ADAPCC_COLL_ALGO"):
+            CollectiveEngine(mesh8, Strategy.ring(8))
+    finally:
+        del os.environ[COLL_ALGO_ENV]
+
+
+# ------------------------------------------------------- tuner coupling
+
+
+def _choose_tuner(db=None, **kw):
+    from adapcc_tpu.tuner import CollectiveTuner, TuningDatabase
+
+    return CollectiveTuner(
+        world=8, topology="test-latency",
+        db=db if db is not None else TuningDatabase(persist=False),
+        mode="choose", epsilon=0.0, **kw,
+    )
+
+
+def test_candidates_algo_axis_sub_crossover_only():
+    from adapcc_tpu.tuner.policy import ALGO_PATHS
+
+    policy = _choose_tuner().policy
+    small = {c.path for c in policy.candidates("allreduce", 4 << 10)}
+    large = {c.path for c in policy.candidates("allreduce", 128 << 20)}
+    assert set(ALGO_PATHS) <= small
+    assert not (set(ALGO_PATHS) & large)
+    # pin collapse: a pinned algorithm is the ONLY cell, crossover or not
+    pinned = policy.candidates("allreduce", 128 << 20, algos=("rd",))
+    assert [c.path for c in pinned] == ["rd"]
+    ring_only = {
+        c.path for c in policy.candidates("allreduce", 4 << 10, algos=("ring",))
+    }
+    assert not (set(ALGO_PATHS) & ring_only)
+
+
+def test_candidates_algo_axis_respects_pow2_funnel():
+    from adapcc_tpu.tuner import CollectiveTuner, TuningDatabase
+    from adapcc_tpu.tuner.policy import ALGO_PATHS
+
+    tuner = CollectiveTuner(
+        world=6, topology="t6", db=TuningDatabase(persist=False),
+        mode="choose",
+    )
+    paths = {c.path for c in tuner.policy.candidates("allreduce", 4 << 10)}
+    assert "rd" not in paths  # the data plane would reject it
+    assert "tree" in paths    # trees run on any world
+
+
+def test_prior_routes_algo_cells_to_their_terms():
+    from adapcc_tpu.tuner.db import TuningKey, size_bucket
+    from adapcc_tpu.tuner.policy import NO_CHUNK, RD_PATH, TREE_PATH
+
+    policy = _choose_tuner(cost_model=None).policy
+    nbytes = 4 << 10
+    bucket = size_bucket(nbytes)
+
+    def key(path):
+        return TuningKey(
+            "allreduce", bucket, 8, "test-latency", path, NO_CHUNK, "off"
+        )
+
+    rd = policy.prior_time(key(RD_PATH), nbytes)
+    tree = policy.prior_time(key(TREE_PATH), nbytes)
+    ring_cells = [
+        c for c in policy.candidates("allreduce", nbytes)
+        if c.path not in (RD_PATH, TREE_PATH)
+    ]
+    assert rd < min(policy.prior_time(c, nbytes) for c in ring_cells)
+    assert tree > 0.0 and tree != rd
+
+
+def test_tuner_measured_rd_cell_reroutes_ring_allreduce(mesh8):
+    """The tuner slot of the ladder: a measured-best rd cell makes even
+    ring_allreduce execute the latency plane, recorded in the trace and
+    timed back into the SAME cell (the loop closes)."""
+    from adapcc_tpu.tuner.db import TuningKey, size_bucket
+    from adapcc_tpu.tuner.policy import NO_CHUNK, RD_PATH
+
+    tuner = _choose_tuner()
+    nbytes = 4096 * 4  # 4096 fp32 elems per rank
+    rd_key = TuningKey(
+        "allreduce", size_bucket(nbytes), 8, "test-latency",
+        RD_PATH, NO_CHUNK, "off",
+    )
+    for i in range(4):  # measured best by a mile
+        tuner.db.record(rd_key, 1e-6, ts=float(i))
+    trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh8, Strategy.ring(8), trace=trace, tuner=tuner)
+    x = jnp.ones((8, 4096), jnp.float32)
+    out = eng.ring_allreduce(x)
+    np.testing.assert_allclose(np.asarray(out), 8.0)
+    ev = trace.events()[-1]
+    assert ev.impl == "rd" and ev.extra["algo"] == "rd"
+    assert ev.extra["tuner"]["chosen"]["path"] == RD_PATH
+    assert ev.extra["tuner"]["applied"]
+    # first dispatch = compile warmup (discarded); the second records
+    eng.ring_allreduce(x)
+    assert tuner.db.count(rd_key) == 5
+
+
+def test_env_pin_overrides_tuner_choice(mesh8):
+    """env > tuner: a measured rd cell loses to ADAPCC_COLL_ALGO=tree."""
+    from adapcc_tpu.tuner.db import TuningKey, size_bucket
+    from adapcc_tpu.tuner.policy import NO_CHUNK, RD_PATH
+
+    tuner = _choose_tuner()
+    nbytes = 1024 * 4
+    rd_key = TuningKey(
+        "allreduce", size_bucket(nbytes), 8, "test-latency",
+        RD_PATH, NO_CHUNK, "off",
+    )
+    for i in range(4):
+        tuner.db.record(rd_key, 1e-6, ts=float(i))
+    trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh8, Strategy.ring(8), trace=trace, tuner=tuner)
+    os.environ[COLL_ALGO_ENV] = "tree"
+    try:
+        eng.all_reduce(jnp.ones((8, 1024), jnp.float32))
+    finally:
+        del os.environ[COLL_ALGO_ENV]
+    assert trace.events()[-1].impl == "tree"
+
+
+def test_record_mode_fills_algo_and_a2a_cells(mesh8):
+    """record-mode dispatches land in the db under the rd path and the
+    new all_to_all primitive, and both keys sit in the candidate set (the
+    recorded-key-in-candidates invariant)."""
+    from adapcc_tpu.tuner import CollectiveTuner, TuningDatabase
+    from adapcc_tpu.tuner.policy import RD_PATH
+
+    tuner = CollectiveTuner(
+        world=8, topology="rec", db=TuningDatabase(persist=False),
+        mode="record",
+    )
+    eng = CollectiveEngine(mesh8, Strategy.ring(8), tuner=tuner)
+    x = jnp.ones((8, 256), jnp.float32)
+    a = jnp.ones((8, 8, 32), jnp.float32)
+    for _ in range(3):
+        eng.all_reduce(x, algo="rd")
+        eng.all_to_all(a)
+    keys = tuner.db.keys()
+    rd_keys = [k for k in keys if k.path == RD_PATH]
+    a2a_keys = [k for k in keys if k.primitive == "all_to_all"]
+    assert rd_keys and a2a_keys
+    assert tuner.db.count(rd_keys[0]) == 2   # first discarded as warmup
+    assert tuner.db.count(a2a_keys[0]) == 2
+    assert rd_keys[0] in tuner.policy.candidates("allreduce", 256 * 4)
+    assert a2a_keys[0] in tuner.policy.candidates("all_to_all", 8 * 32 * 4)
+
+
+def test_replay_trace_parses_algo_and_a2a_impls():
+    from adapcc_tpu.tuner import TuningDatabase, replay_trace
+    from adapcc_tpu.tuner.policy import RD_PATH
+
+    trace = CollectiveTrace()
+    trace.record("allreduce", "rd", 8 * 1024, duration_s=1e-4, algo="rd")
+    trace.record("all_to_all", "xla", 8 * 2048, duration_s=2e-4)
+    trace.record("allreduce", "xla", 8 * 1024)  # untimed: skipped
+    db = TuningDatabase(persist=False)
+    ingested, skipped = replay_trace(trace, db, world=8, topology="rp")
+    assert (ingested, skipped) == (2, 1)
+    paths = {(k.primitive, k.path) for k in db.keys()}
+    assert ("allreduce", RD_PATH) in paths
+    assert ("all_to_all", "xla") in paths
+
+
+# --------------------------------------------------- boardcast deprecation
+
+
+def test_boardcast_deprecated_alias_warns_once(mesh8):
+    import warnings
+
+    from adapcc_tpu.comm import engine as engine_mod
+
+    eng = CollectiveEngine(mesh8, Strategy.ring(8))
+    x = jnp.ones((8, 16), jnp.float32)
+    want = np.asarray(eng.broadcast(x))
+    engine_mod._BOARDCAST_WARNED = False
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        got = np.asarray(eng.boardcast(x))
+        eng.boardcast(x)  # second call: silent
+    dep = [w for w in caught if issubclass(w.category, DeprecationWarning)]
+    assert len(dep) == 1 and "broadcast" in str(dep[0].message)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_auto_stands_down_under_a_codec_pin(engine8):
+    """auto is NOT an explicit rd pin: with a wire codec pinned the
+    selector stays on the codec-capable ring plane instead of tripping
+    the algo-vs-codec conflict guard (review finding: previously a
+    hard crash on every sub-crossover dispatch)."""
+    eng, trace = engine8
+    small = jnp.ones((8, 256), jnp.float32)
+    os.environ[COLL_ALGO_ENV] = "auto"
+    os.environ["ADAPCC_WIRE_DTYPE"] = "int8"
+    try:
+        eng.all_reduce(small)  # must NOT raise
+        assert trace.events()[-1].extra["algo"] == "ring"
+    finally:
+        del os.environ[COLL_ALGO_ENV]
+        del os.environ["ADAPCC_WIRE_DTYPE"]
+
+
+def test_choosing_tuner_never_offers_algo_cells_under_wire_arg_pin(mesh8):
+    """A caller-pinned codec narrows the tuner's algorithm axis to the
+    ring planes — the explorer must never pick a cell the conflict guard
+    would refuse on execution (review finding: 29/30 dispatches crashed)."""
+    from adapcc_tpu.tuner import CollectiveTuner, TuningDatabase
+
+    tuner = CollectiveTuner(
+        world=8, topology="pin", db=TuningDatabase(persist=False),
+        mode="choose", epsilon=1.0,  # always explore: the worst case
+    )
+    eng = CollectiveEngine(mesh8, Strategy.ring(8), tuner=tuner)
+    x = jnp.ones((8, 256), jnp.float32)  # sub-crossover
+    for _ in range(12):
+        # the quant-ring reroute runs on any backend; no dispatch may
+        # land on an rd/tree cell and crash against the int8 pin
+        eng.ring_allreduce(x, wire_dtype="int8")
+
+
+def test_all_reduce_never_claims_an_unexecutable_cell(mesh8):
+    """all_reduce's arbitration grid is restricted to the planes it can
+    execute AND measure — the xla baseline cell plus rd/tree.  A measured
+    quant/chunk cell from ring_allreduce's grid never leaks in (PR 6's
+    executed-impl honesty), and a measured-SLOW rd loses to the
+    measured-fast xla baseline instead of locking forever (an rd sample
+    must not beat every unmeasurable alternative by default)."""
+    from adapcc_tpu.tuner import CollectiveTuner, TuningDatabase
+    from adapcc_tpu.tuner.db import TuningKey, size_bucket
+    from adapcc_tpu.tuner.policy import (
+        NO_CHUNK, QUANT_PATH, RD_PATH, XLA_PATH,
+    )
+
+    tuner = CollectiveTuner(
+        world=8, topology="honest", db=TuningDatabase(persist=False),
+        mode="choose", epsilon=0.0,
+    )
+    nbytes = 256 * 4
+    bucket = size_bucket(nbytes)
+
+    def key(path, wire="off"):
+        return TuningKey("allreduce", bucket, 8, "honest", path, NO_CHUNK, wire)
+
+    for i in range(4):  # a quant cell psum cannot realize: must not leak in
+        tuner.db.record(key(QUANT_PATH, "int8"), 1e-9, ts=float(i))
+    assert key(QUANT_PATH, "int8") not in tuner.policy.candidates(
+        "allreduce", nbytes, algos=("xla", "rd", "tree")
+    )
+    # measured: rd SLOW, xla fast — the baseline must win
+    for i in range(4):
+        tuner.db.record(key(RD_PATH), 1e-3, ts=float(i))
+        tuner.db.record(key(XLA_PATH), 1e-6, ts=float(i))
+    trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh8, Strategy.ring(8), trace=trace, tuner=tuner)
+    eng.all_reduce(jnp.ones((8, 256), jnp.float32))
+    ev = trace.events()[-1]
+    assert ev.impl == "xla" and ev.extra["algo"] == "ring"
+    assert ev.extra["tuner"]["chosen"]["path"] == XLA_PATH
+    assert ev.extra["tuner"]["applied"] is True  # the xla cell DID run
+
+
+def test_all_reduce_record_mode_times_the_xla_baseline(mesh8):
+    """The psum fastpath is the xla cell's measurable arm: record-mode
+    all_reduce dispatches land in the db under (allreduce, xla), so the
+    arbitration's baseline accrues real samples."""
+    from adapcc_tpu.tuner import CollectiveTuner, TuningDatabase
+    from adapcc_tpu.tuner.policy import XLA_PATH
+
+    tuner = CollectiveTuner(
+        world=8, topology="base", db=TuningDatabase(persist=False),
+        mode="record",
+    )
+    eng = CollectiveEngine(mesh8, Strategy.ring(8), tuner=tuner)
+    x = jnp.ones((8, 256), jnp.float32)
+    for _ in range(3):
+        eng.all_reduce(x)
+    keys = [
+        k for k in tuner.db.keys()
+        if k.primitive == "allreduce" and k.path == XLA_PATH
+    ]
+    assert keys and tuner.db.count(keys[0]) == 2  # first = compile warmup
+
+
+def test_ring_allreduce_auto_respects_a_committed_ring_cell(mesh8):
+    """env auto + choosing tuner: the tuner's committed ring-plane cell
+    outranks the sim crossover (the documented env > arg > tuner >
+    sim-crossover ladder) — auto must not discard the tuner's adopted
+    knobs and force rd (review finding)."""
+    from adapcc_tpu.tuner import CollectiveTuner, TuningDatabase
+    from adapcc_tpu.tuner.db import TuningKey, size_bucket
+    from adapcc_tpu.tuner.policy import NO_CHUNK, QUANT_PATH
+
+    tuner = CollectiveTuner(
+        world=8, topology="prec", db=TuningDatabase(persist=False),
+        mode="choose", epsilon=0.0,
+    )
+    nbytes = 256 * 4  # sub-crossover: plain auto would pick rd
+    quant_key = TuningKey(
+        "allreduce", size_bucket(nbytes), 8, "prec",
+        QUANT_PATH, NO_CHUNK, "int8",
+    )
+    for i in range(4):  # measured best by far: the tuner commits it
+        tuner.db.record(quant_key, 1e-9, ts=float(i))
+    trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh8, Strategy.ring(8), trace=trace, tuner=tuner)
+    os.environ[COLL_ALGO_ENV] = "auto"
+    try:
+        eng.ring_allreduce(jnp.ones((8, 256), jnp.float32))
+    finally:
+        del os.environ[COLL_ALGO_ENV]
+    ev = trace.events()[-1]
+    assert ev.impl == "quant_ring[int8]"     # the committed cell ran
+    assert ev.extra["algo"] == "ring"
+    assert ev.extra["tuner"]["applied"] is True
+
+
+def test_all_reduce_tuner_consult_is_side_effect_free(mesh8):
+    """all_reduce arbitrates the algorithm READ-ONLY: no exploration of
+    cells it cannot execute (their trial budget could never drain from
+    this entry point — explorer starvation), no incumbent mutation that
+    would flap ring_allreduce's hysteresis (review finding)."""
+    from adapcc_tpu.tuner import CollectiveTuner, TuningDatabase
+
+    tuner = CollectiveTuner(
+        world=8, topology="ro", db=TuningDatabase(persist=False),
+        mode="choose", epsilon=1.0,  # an exploring choose() WOULD explore
+    )
+    eng = CollectiveEngine(mesh8, Strategy.ring(8), tuner=tuner)
+    x = jnp.ones((8, 256), jnp.float32)  # sub-crossover
+    rng_state = tuner.policy._rng.getstate()
+    for _ in range(6):
+        eng.all_reduce(x)
+    assert tuner.policy._rng.getstate() == rng_state  # no RNG advance
+    assert tuner.policy.incumbent("allreduce", 256 * 4) is None
+
+
+def test_double_pin_conflict_beats_empty_grid(mesh8):
+    """ADAPCC_COLL_ALGO=rd + ADAPCC_WIRE_DTYPE=int8 under a choosing tuner
+    must die on the purpose-built conflict diagnostic, not on choose()'s
+    misleading 'no candidate cells' (review finding)."""
+    from adapcc_tpu.tuner import CollectiveTuner, TuningDatabase
+
+    tuner = CollectiveTuner(
+        world=8, topology="dp", db=TuningDatabase(persist=False),
+        mode="choose",
+    )
+    eng = CollectiveEngine(mesh8, Strategy.ring(8), tuner=tuner)
+    os.environ[COLL_ALGO_ENV] = "rd"
+    os.environ["ADAPCC_WIRE_DTYPE"] = "int8"
+    try:
+        with pytest.raises(ValueError, match="no wire-codec plane"):
+            eng.ring_allreduce(jnp.ones((8, 256), jnp.float32))
+    finally:
+        del os.environ[COLL_ALGO_ENV]
+        del os.environ["ADAPCC_WIRE_DTYPE"]
+
+
+def test_engine_auto_uses_the_tuner_policys_crossover(mesh8):
+    """One crossover definition: with a tuner attached, the engine's auto
+    selector consults the SAME (possibly custom-calibrated) policy model
+    that gates the candidate grid (review finding)."""
+    from adapcc_tpu.sim.cost_model import LinkCostModel
+    from adapcc_tpu.tuner import CollectiveTuner, TuningDatabase
+
+    # a latency-only custom calibration: rd never loses, crossover = inf
+    model = LinkCostModel.uniform(8, alpha=1e-6, beta=0.0)
+    tuner = CollectiveTuner(
+        world=8, topology="cx", db=TuningDatabase(persist=False),
+        mode="record", cost_model=model,
+    )
+    trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh8, Strategy.ring(8), tuner=tuner, trace=trace)
+    assert eng._allreduce_crossover_bytes() == float("inf")
+    big = jnp.ones((8, 1 << 20), jnp.float32)  # 4 MB/rank: normally ring
+    os.environ[COLL_ALGO_ENV] = "auto"
+    try:
+        eng.all_reduce(big)
+    finally:
+        del os.environ[COLL_ALGO_ENV]
+    assert trace.events()[-1].extra["algo"] == "rd"
+    # without a tuner the engine falls back to its own calibration
+    eng2 = CollectiveEngine(mesh8, Strategy.ring(8))
+    assert eng2._allreduce_crossover_bytes() != float("inf")
+
+
+def test_all_reduce_arbitration_stands_down_under_env_wire_pin(mesh8):
+    """ADAPCC_WIRE_DTYPE + ADAPCC_TUNER=choose (a working pre-PR combo):
+    the env pin collapses the policy grid to the codec's cells, none of
+    which the {xla, rd, tree} arbitration can offer — all_reduce must
+    stand down to the legacy plane, not die on an empty candidate grid
+    (review finding)."""
+    from adapcc_tpu.tuner import CollectiveTuner, TuningDatabase
+
+    tuner = CollectiveTuner(
+        world=8, topology="wp", db=TuningDatabase(persist=False),
+        mode="choose",
+    )
+    trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh8, Strategy.ring(8), trace=trace, tuner=tuner)
+    x = jnp.ones((8, 256), jnp.float32)
+    os.environ["ADAPCC_WIRE_DTYPE"] = "bf16"
+    try:
+        out = np.asarray(eng.all_reduce(x))  # must NOT raise
+        np.testing.assert_allclose(out, 8.0)
+        assert trace.events()[-1].impl == "xla"
+        os.environ[COLL_ALGO_ENV] = "auto"
+        eng.all_reduce(x)  # auto under the pin: stands down too
+        assert trace.events()[-1].extra["algo"] == "ring"
+    finally:
+        del os.environ["ADAPCC_WIRE_DTYPE"]
+        os.environ.pop(COLL_ALGO_ENV, None)

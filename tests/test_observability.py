@@ -171,8 +171,8 @@ def test_engine_records_dispatches(mesh4):
     x = jnp.ones((4, 8))
     eng.all_reduce(x)
     eng.all_reduce(x, active_gpus=[0, 1, 2])
-    eng.boardcast(x)  # full world on a fastpath engine → fused xla collective
-    eng.boardcast(x, active_gpus=[0, 1, 2, 3])  # pinned schedule path
+    eng.broadcast(x)  # full world on a fastpath engine → fused xla collective
+    eng.broadcast(x, active_gpus=[0, 1, 2, 3])  # pinned schedule path
     eng.all_gather(x)
     prims = [(e.primitive, e.impl) for e in tr.events()]
     assert prims == [

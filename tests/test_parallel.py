@@ -304,3 +304,105 @@ def test_train_moe_rejects_indivisible_batch():
     args = build_parser().parse_args(["--world", "4", "--batch", "130"])
     with pytest.raises(ValueError, match="divide by world"):
         run(args)
+
+
+def test_moe_a2a_parity_flat_engine_and_two_level():
+    """Satellite of the latency PR: the MoE token exchange is BIT-IDENTICAL
+    across all three data planes — the flat `lax.all_to_all` (engine=None),
+    the engine-routed path (`engine.expert_a2a`, which adds tracing), and
+    the two-level hierarchical DCN x ICI exchange — so routing expert
+    traffic through the engine (to be timed/traced/tuned) can never change
+    a model's numerics."""
+    from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.comm.two_level import build_two_level_mesh
+    from adapcc_tpu.strategy.ir import Strategy
+    from adapcc_tpu.utils import CollectiveTrace
+
+    cfg = MoEConfig(
+        num_experts=8, d_model=16, d_hidden=32, top_k=2,
+        capacity_factor=2.0, dtype=jnp.float32,
+    )
+    model = MoEMLP(cfg)
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.normal(size=(64, cfg.d_model)), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x[None])
+
+    flat = Mesh(np.array(jax.devices()[:8]), ("experts",))
+    y_flat, aux_flat = expert_parallel_moe(params, x, cfg, flat)
+
+    trace = CollectiveTrace()
+    engine = CollectiveEngine(
+        flat, Strategy.ring(8), axis_name="experts", trace=trace
+    )
+    y_eng, aux_eng = expert_parallel_moe(params, x, cfg, flat, engine=engine)
+    np.testing.assert_array_equal(np.asarray(y_eng), np.asarray(y_flat))
+    np.testing.assert_array_equal(np.asarray(aux_eng), np.asarray(aux_flat))
+    # the engine-routed exchanges were traced: 2 a2as per forward
+    moe_events = [
+        e for e in trace.events()
+        if e.primitive == "all_to_all" and e.impl == "xla[moe]"
+    ]
+    assert len(moe_events) == 2 and all(e.extra.get("moe") for e in moe_events)
+
+    mesh2x4 = build_two_level_mesh(2, 4)
+    y_2l, aux_2l = expert_parallel_moe(params, x, cfg, mesh2x4)
+    np.testing.assert_array_equal(np.asarray(y_2l), np.asarray(y_flat))
+    trace2 = CollectiveTrace()
+    engine2 = CollectiveEngine(mesh2x4, Strategy.ring(8), trace=trace2)
+    y_2le, _ = expert_parallel_moe(params, x, cfg, mesh2x4, engine=engine2)
+    np.testing.assert_array_equal(np.asarray(y_2le), np.asarray(y_flat))
+    assert [
+        e.impl for e in trace2.events() if e.primitive == "all_to_all"
+    ] == ["two_level[moe]"] * 2
+
+
+def test_moe_engine_world_mismatch_rejected():
+    from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.strategy.ir import Strategy
+
+    cfg = MoEConfig(
+        num_experts=8, d_model=8, d_hidden=16, top_k=1,
+        capacity_factor=2.0, dtype=jnp.float32,
+    )
+    model = MoEMLP(cfg)
+    x = jnp.ones((32, cfg.d_model), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x[None])
+    mesh8 = Mesh(np.array(jax.devices()[:8]), ("experts",))
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("experts",))
+    engine4 = CollectiveEngine(mesh4, Strategy.ring(4), axis_name="experts")
+    with pytest.raises(ValueError, match="engine world"):
+        expert_parallel_moe(params, x, cfg, mesh8, engine=engine4)
+
+
+def test_train_moe_feeds_tuner_db_under_all_to_all(tmp_path, monkeypatch):
+    """Acceptance pin: a train_moe run with the tuner recording leaves
+    all_to_all samples in the tuning database at the MoE exchange
+    geometry."""
+    from adapcc_tpu.tuner import TuningDatabase
+    from adapcc_tpu.workloads.train_moe import build_parser, run
+
+    db_path = str(tmp_path / "tuning.jsonl")
+    monkeypatch.setenv("ADAPCC_TUNER", "record")
+    monkeypatch.setenv("ADAPCC_TUNER_DB", db_path)
+    args = build_parser().parse_args([
+        "--world", "4", "--steps", "9", "--experts", "4", "--dmodel", "16",
+        "--dhidden", "32", "--batch", "64", "--tune-every", "3",
+    ])
+    first, last = run(args)
+    assert np.isfinite(first) and np.isfinite(last)
+    db = TuningDatabase(db_path)
+    a2a = [k for k in db.keys() if k.primitive == "all_to_all"]
+    assert a2a, "MoE a2a dispatches must land in the tuner db"
+    # probe geometry = the dispatch exchange: world*e_loc*capacity*d_model
+    from adapcc_tpu.parallel.expert import moe_capacity
+
+    probe_cfg = MoEConfig(
+        num_experts=4, d_model=16, d_hidden=32, top_k=2,
+        capacity_factor=2.0, dtype=jnp.float32,
+    )
+    n_loc, e_loc = 64 // 4, 4 // 4
+    per_rank = 4 * e_loc * moe_capacity(probe_cfg, n_loc) * 16 * 4
+    from adapcc_tpu.tuner.db import size_bucket
+
+    assert a2a[0].size_bucket == size_bucket(per_rank)
+    assert db.count(a2a[0]) >= 1  # 3 probes - 1 warmup discard

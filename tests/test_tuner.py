@@ -1054,3 +1054,51 @@ def test_with_mode_shares_policy_without_rebuilding():
     assert view.timer is base.timer        # warmup state shared
     assert view.db is db
     assert view.explicit_mode == "choose" and base.explicit_mode is None
+
+
+def test_old_records_load_unchanged_next_to_new_primitives(tmp_path):
+    """Satellite of the latency PR: adding the `all_to_all` primitive and
+    the algo-in-path-slot keys (`rd`/`tree`) is a VOCABULARY extension,
+    not a schema change — a pre-existing tuning.jsonl written before the
+    extension must load byte-for-byte unchanged next to the new keys, and
+    a mixed-version save/load round-trips losslessly."""
+    from adapcc_tpu.tuner.policy import NO_CHUNK, RD_PATH, TREE_PATH
+
+    path = str(tmp_path / "tuning.jsonl")
+    # an "old" database: pre-PR vocabulary only, written raw (exactly the
+    # lines an older build appended)
+    old_keys = [
+        _key(),                                    # hbm-stream chunk cell
+        _key(path="vmem", chunk_bytes=0),
+        _key(path="quant-ring", chunk_bytes=0, wire_dtype="int8"),
+        _key(primitive="ddp_step", path="hook", chunk_bytes=0),
+    ]
+    with open(path, "w") as f:
+        for i, k in enumerate(old_keys):
+            f.write(json.dumps(
+                {"v": SCHEMA_VERSION, "key": k.to_dict(),
+                 "t_s": 1e-6 * (i + 1), "ts": float(i)},
+                sort_keys=True,
+            ) + "\n")
+    db = TuningDatabase(path)
+    assert db.skipped_records == 0
+    for i, k in enumerate(old_keys):  # loaded unchanged, stats intact
+        assert db.samples(k) == [1e-6 * (i + 1)]
+    # new-vocabulary records append into the SAME file, same schema version
+    new_keys = [
+        _key(path=RD_PATH, chunk_bytes=NO_CHUNK),
+        _key(path=TREE_PATH, chunk_bytes=NO_CHUNK),
+        _key(primitive="all_to_all", path="xla", chunk_bytes=NO_CHUNK),
+    ]
+    for k in new_keys:
+        db.record(k, 2e-6, ts=10.0)
+    reloaded = TuningDatabase(path)
+    assert reloaded.skipped_records == 0
+    assert set(reloaded.keys()) == set(old_keys) | set(new_keys)
+    for i, k in enumerate(old_keys):  # old records still byte-identical
+        assert reloaded.samples(k) == [1e-6 * (i + 1)]
+    # compaction round-trip keeps the mixed vocabulary lossless
+    reloaded.save()
+    again = TuningDatabase(path)
+    assert set(again.keys()) == set(old_keys) | set(new_keys)
+    assert again.samples(new_keys[0]) == [2e-6]

@@ -125,7 +125,7 @@ def test_two_level_reduce_root_slice_holds_total(mesh2x4):
 def test_two_level_broadcast_root_value_everywhere(mesh2x4):
     eng = CollectiveEngine(mesh2x4, hier_strategy(), use_xla_fastpath=False)
     x = jnp.stack([jnp.full((4,), float(10 * (r + 1))) for r in range(8)])
-    out = np.asarray(eng.boardcast(x))
+    out = np.asarray(eng.broadcast(x))
     assert np.allclose(out, 10.0)  # root rank 0's value lands on all 8 ranks
 
 

@@ -119,7 +119,7 @@ def test_two_level_merged_reduce_and_broadcast_oracles(mesh2x4):
         off += size
 
     # broadcast: each segment adopts its tree's root-rank value everywhere
-    got_b = np.asarray(eng.boardcast(jnp.asarray(x)))
+    got_b = np.asarray(eng.broadcast(jnp.asarray(x)))
     off = 0
     for tree, size in zip(strat.trees, sizes):
         np.testing.assert_allclose(
