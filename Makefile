@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench elastic-bench trace-export clean
+.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench elastic-bench adapt-bench trace-export clean
 
 all: native
 
@@ -85,6 +85,16 @@ latency-bench:
 elastic-bench:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--world 8 --sizes 1M,16M --fault-sweep --hosts 2 --json
+
+# Closed-adaptation-loop replay on the same simulator (docs/ADAPT.md):
+# deterministic "mode": "simulated" rows driving the REAL drift detector
+# through an injected DCN degradation — per-step detection timeline
+# (drift onset, detection lag) plus a summary pricing stale-vs-adapted
+# steady state and the hot-swap stall vs the full-rebuild stall (probe
+# traffic + re-synthesis + cold compile) the closed loop avoids.
+adapt-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--world 8 --sizes 1M,16M --adapt-sweep --hosts 2 --json
 
 # Perfetto/chrome://tracing export of a recorded dispatch trace: run a
 # short virtual-pod collective session under ADAPCC_TUNER=record and emit

@@ -1,0 +1,66 @@
+"""Closed-loop online adaptation (docs/ADAPT.md).
+
+The paper's core promise is *adaptive* collective communication: re-profile
+every ``profile_freq`` steps, re-synthesize when link conditions drift
+(PAPER.md:61).  Before this package the only re-adaptation path was
+``AdapCC.reconstruct_topology`` — a full teardown + active re-profile +
+re-synthesis + engine rebuild, paying probe traffic and recompiles the
+whole time.  This package closes the loop from data that already flows,
+with **zero probe traffic on the hot path**:
+
+- :mod:`adapcc_tpu.adapt.detector` — passive drift detection over rolling
+  per-plan-cell windows of the measurements the tuner already records
+  (``ADAPCC_DRIFT_FACTOR`` / ``ADAPCC_DRIFT_WINDOW``);
+- :mod:`adapcc_tpu.adapt.recalibrate` — observed collective timings
+  inverted back into per-link-class α-β corrections through the existing
+  ``fit_alpha_beta`` + ``calibrate.py`` funnel, decay-merged into
+  ``topology/calibration.json`` (never last-writer-wins);
+- :mod:`adapcc_tpu.adapt.controller` — sim re-rank over candidate
+  strategies under the corrected costs, top-k AOT-compiled through the
+  PR-7 :class:`StandbyPlanCache`, adoption a hysteresis-gated
+  ``advance_epoch`` cache-key switch (``ADAPCC_ADAPT=off|detect|swap``).
+"""
+
+from adapcc_tpu.adapt.controller import (
+    ADAPT_MODE_ENV,
+    ADAPT_MODES,
+    AdaptationController,
+    AdaptationReport,
+    adapt_mode,
+)
+from adapcc_tpu.adapt.detector import (
+    DEFAULT_DRIFT_FACTOR,
+    DEFAULT_DRIFT_WINDOW,
+    DRIFT_FACTOR_ENV,
+    DRIFT_WINDOW_ENV,
+    DriftDetector,
+    DriftReport,
+    DriftSignal,
+    resolve_drift_factor,
+    resolve_drift_window,
+)
+from adapcc_tpu.adapt.recalibrate import (
+    calibration_of,
+    corrected_model,
+    drift_correction,
+)
+
+__all__ = [
+    "ADAPT_MODE_ENV",
+    "ADAPT_MODES",
+    "AdaptationController",
+    "AdaptationReport",
+    "DEFAULT_DRIFT_FACTOR",
+    "DEFAULT_DRIFT_WINDOW",
+    "DRIFT_FACTOR_ENV",
+    "DRIFT_WINDOW_ENV",
+    "DriftDetector",
+    "DriftReport",
+    "DriftSignal",
+    "adapt_mode",
+    "calibration_of",
+    "corrected_model",
+    "drift_correction",
+    "resolve_drift_factor",
+    "resolve_drift_window",
+]

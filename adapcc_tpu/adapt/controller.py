@@ -1,0 +1,394 @@
+"""The closed loop: drift detection → re-calibration → re-rank → hot swap.
+
+``AdaptationController`` ties the passive halves together over one live
+:class:`CollectiveEngine` (and optionally a :class:`DDPTrainer`):
+
+1. **Detect** — a :class:`DriftDetector` consumes the measurements already
+   flowing (no probe traffic on the hot path, ever).
+2. **Re-calibrate** — fired windows invert into per-link-class α-β
+   corrections, decay-merged into ``topology/calibration.json``
+   (:mod:`adapcc_tpu.adapt.recalibrate`).
+3. **Re-rank** — :meth:`Synthesizer.resynthesize` re-runs the sim-rank
+   pass under the corrected costs, incumbent listed first.
+4. **Swap** — under the hysteresis gate (challenger's predicted steady
+   state must beat the incumbent's by ``hysteresis_margin``, drift backed
+   by at least a full window of samples), the top-k candidates are
+   AOT-compiled through the PR-7 :class:`StandbyPlanCache` and adoption is
+   one ``advance_epoch`` — a dispatch-time cache-key switch (``cache_hit``
+   pinned), with ``DDPTrainer.adopt_strategy`` swapping the training step
+   the same way.
+
+``ADAPCC_ADAPT=off|detect|swap`` gates the plane (env > explicit mode >
+off; malformed → loud): ``detect`` runs steps 1–3 and *reports* the swap
+it would make, ``swap`` executes it.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from adapcc_tpu.adapt.detector import DriftDetector, DriftReport
+from adapcc_tpu.adapt.recalibrate import calibration_of, drift_correction
+
+#: global adaptation-plane mode env: off (default) | detect | swap
+ADAPT_MODE_ENV = "ADAPCC_ADAPT"
+
+ADAPT_MODES = ("off", "detect", "swap")
+
+
+def adapt_mode(explicit: Optional[str] = None) -> str:
+    """The adaptation mode in force: ``ADAPCC_ADAPT`` env > the caller's
+    explicit mode > "off".  A malformed value raises — a typo'd
+    ``ADAPCC_ADAPT=swapp`` silently running un-adapted would invalidate
+    the drill it was meant to drive (the ADAPCC_TUNER policy)."""
+    env = os.environ.get(ADAPT_MODE_ENV)
+    value = env if env is not None and env.strip() else explicit
+    if value is None:
+        return "off"
+    mode = str(value).strip().lower()
+    if mode not in ADAPT_MODES:
+        raise ValueError(
+            f"{ADAPT_MODE_ENV}={value!r}: expected one of "
+            f"{'|'.join(ADAPT_MODES)}"
+        )
+    return mode
+
+
+@dataclass
+class AdaptationReport:
+    """What one :meth:`AdaptationController.maybe_adapt` pass did — every
+    stage's outcome, artifact-shaped."""
+
+    mode: str
+    #: "off" | "no-drift" | "uninvertible" | "incumbent-wins" |
+    #: "hysteresis" | "would-swap" (detect mode) | "swapped"
+    outcome: str
+    drift: Optional[DriftReport] = None
+    recalibrated: bool = False
+    calibration_source: Optional[str] = None
+    ranked: List[dict] = field(default_factory=list)
+    incumbent_fingerprint: Optional[str] = None
+    incumbent_pred_s: Optional[float] = None
+    winner_label: Optional[str] = None
+    winner_fingerprint: Optional[str] = None
+    winner_pred_s: Optional[float] = None
+    swapped: bool = False
+    epoch: Optional[int] = None
+    #: AOT warm walltime (off the swap's critical path)
+    aot_warm_s: Optional[float] = None
+    #: the swap stall itself: advance_epoch + trainer adoption walltime
+    stall_s: Optional[float] = None
+    trainer_adopt_hit: Optional[bool] = None
+
+    @property
+    def fired(self) -> bool:
+        return self.drift is not None and self.drift.drifted
+
+    def to_row(self) -> dict:
+        return {
+            "mode": self.mode,
+            "outcome": self.outcome,
+            "fired": self.fired,
+            "recalibrated": self.recalibrated,
+            "calibration": self.calibration_source,
+            "incumbent": self.incumbent_fingerprint,
+            "incumbent_pred_us": (
+                round(self.incumbent_pred_s * 1e6, 3)
+                if self.incumbent_pred_s is not None else None
+            ),
+            "winner": self.winner_fingerprint,
+            "winner_label": self.winner_label,
+            "winner_pred_us": (
+                round(self.winner_pred_s * 1e6, 3)
+                if self.winner_pred_s is not None else None
+            ),
+            "swapped": self.swapped,
+            "epoch": self.epoch,
+            "aot_warm_s": self.aot_warm_s,
+            "stall_s": self.stall_s,
+            "trainer_adopt_hit": self.trainer_adopt_hit,
+        }
+
+
+class AdaptationController:
+    """One engine's closed adaptation loop (module doc).
+
+    Pure host work until a swap: detection, re-calibration, and re-ranking
+    never dispatch a collective; only the ``swap``-mode AOT warm compiles
+    (off the critical path — the adoption itself is a cache-key switch).
+    """
+
+    def __init__(
+        self,
+        engine,
+        synthesizer,
+        detector: Optional[DriftDetector] = None,
+        trainer: Optional[Any] = None,
+        trainer_prewarm: Optional[Callable[[Any], Any]] = None,
+        mode: Optional[str] = None,
+        calibration_path: Optional[str] = None,
+        cost_model=None,
+        db=None,
+        fingerprint: Optional[str] = None,
+        nbytes: int = 16 << 20,
+        parallel_degree: int = 1,
+        top_k: int = 2,
+        hysteresis_margin: float = 0.1,
+        min_samples: Optional[int] = None,
+        warm_shape: Tuple[int, ...] = (1024,),
+        warm_dtype=np.float32,
+        decay: float = 0.5,
+    ) -> None:
+        adapt_mode(mode)  # validate BOTH the env and the explicit mode now
+        if top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
+        if hysteresis_margin < 0:
+            raise ValueError(
+                f"hysteresis_margin must be >= 0, got {hysteresis_margin}"
+            )
+        self.engine = engine
+        self.synthesizer = synthesizer
+        self.trainer = trainer
+        self.trainer_prewarm = trainer_prewarm
+        self.explicit_mode = mode
+        self.calibration_path = calibration_path
+        self.db = db
+        self.nbytes = int(nbytes)
+        self.parallel_degree = max(1, int(parallel_degree))
+        self.top_k = int(top_k)
+        self.hysteresis_margin = float(hysteresis_margin)
+        self.warm_shape = tuple(warm_shape)
+        self.warm_dtype = warm_dtype
+        self.decay = float(decay)
+        world = engine.world_size
+        ips = dict(engine.strategy.trees[0].ips or {})
+        if fingerprint is None:
+            from adapcc_tpu.tuner.db import topology_fingerprint
+
+            fingerprint = topology_fingerprint(world, ips or None)
+        self.fingerprint = fingerprint
+        if cost_model is None:
+            from adapcc_tpu.sim.calibrate import (
+                DEFAULT_CALIBRATION_PATH,
+                load_or_default,
+            )
+
+            cost_model = load_or_default(
+                calibration_path or DEFAULT_CALIBRATION_PATH,
+                world=world,
+                fingerprint=fingerprint,
+            )
+        if cost_model.ips is None and ips:
+            cost_model = cost_model.with_ips(ips)
+        self._model = cost_model
+        self.detector = (
+            detector
+            if detector is not None
+            else DriftDetector(world, fingerprint, cost_model=cost_model)
+        )
+        self.min_samples = (
+            int(min_samples) if min_samples is not None else self.detector.window
+        )
+        # PR-7's standby machinery carries the AOT warm + epoch swap
+        from adapcc_tpu.elastic.standby import StandbyPlanCache
+
+        self.cache = StandbyPlanCache(
+            engine, nbytes=float(self.nbytes), cost_model=cost_model
+        )
+        self.swaps = 0
+        self.reports: List[AdaptationReport] = []
+
+    # -- mode ------------------------------------------------------------------
+
+    @property
+    def mode(self) -> str:
+        return adapt_mode(self.explicit_mode)
+
+    # -- feeds (delegation) ----------------------------------------------------
+
+    def observe(
+        self,
+        key,
+        seconds: float,
+        ts: Optional[float] = None,
+        nbytes: Optional[int] = None,
+    ) -> None:
+        self.detector.observe(key, seconds, ts=ts, nbytes=nbytes)
+
+    def observe_step(self, seconds: float, nbytes: int) -> None:
+        self.detector.observe_step(seconds, nbytes)
+
+    def ingest_trace(self, trace) -> Tuple[int, int]:
+        return self.detector.ingest_trace(trace)
+
+    def refresh(self) -> None:
+        """Re-sync the detector from the attached tuning database (the
+        ``tuning.jsonl`` / DispatchTimer history feed), when one exists."""
+        if self.db is not None:
+            self.detector.ingest_db(self.db)
+
+    def check(self) -> DriftReport:
+        self.refresh()
+        return self.detector.check()
+
+    # -- the loop --------------------------------------------------------------
+
+    def _base_calibration(self):
+        """The merge base: the persisted artifact when it exists AND was
+        fitted on this fabric, else the live model wrapped as a calibration
+        (first re-calibration seeds — or re-seeds — the artifact).  An
+        artifact stamped with another fabric's fingerprint is never merged
+        into (``merge_calibration`` would refuse anyway): corrections from
+        this pod must not launder another pod's fit under our stamp."""
+        from adapcc_tpu.sim.calibrate import Calibration
+
+        if self.calibration_path and os.path.exists(self.calibration_path):
+            try:
+                base = Calibration.load(self.calibration_path)
+            except (OSError, ValueError, KeyError, TypeError):
+                base = None  # unusable artifact: fall through
+            if base is not None and (
+                base.world == self.engine.world_size
+                and (
+                    base.fingerprint is None
+                    or base.fingerprint == self.fingerprint
+                )
+            ):
+                return base
+        return calibration_of(
+            self._model,
+            fingerprint=self.fingerprint,
+            samples=0,
+        )
+
+    def _done(self, report: AdaptationReport) -> AdaptationReport:
+        self.reports.append(report)
+        return report
+
+    def maybe_adapt(self) -> AdaptationReport:
+        """Run one pass of the loop (module doc).  Deterministic given the
+        fed samples; returns a stage-by-stage report either way."""
+        mode = self.mode
+        if mode == "off":
+            return self._done(AdaptationReport(mode=mode, outcome="off"))
+        drift = self.check()
+        incumbent = self.engine.strategy
+        report = AdaptationReport(
+            mode=mode,
+            outcome="no-drift",
+            drift=drift,
+            incumbent_fingerprint=incumbent.fingerprint(),
+        )
+        if not drift.drifted:
+            return self._done(report)
+        # -- re-calibrate ------------------------------------------------------
+        from adapcc_tpu.sim.calibrate import merge_calibration
+
+        correction = drift_correction(
+            drift, self._model, fingerprint=self.fingerprint
+        )
+        if correction is None:
+            # drift without link algebra (baseline-referenced cells only —
+            # e.g. a ddp_step compute slowdown): nothing to re-calibrate,
+            # and re-ranking under the UNCHANGED model would let a compute
+            # regression hot-swap the comm strategy on evidence that says
+            # nothing about links.  Report it; the operator (or a priced
+            # feed) decides.
+            report.outcome = "uninvertible"
+            return self._done(report)
+        merged = merge_calibration(
+            self._base_calibration(), correction, decay=self.decay
+        )
+        if self.calibration_path:
+            merged.save(self.calibration_path)
+        model = merged.cost_model()
+        ips = dict(incumbent.trees[0].ips or {})
+        if model.ips is None and ips:
+            model = model.with_ips(ips)
+        self._model = model
+        # the corrected model becomes the detector's reference: windows
+        # consistent with it stop firing (the loop converges)
+        self.detector.set_cost_model(model)
+        self.cache.cost_model = model
+        report.recalibrated = True
+        report.calibration_source = merged.source
+        # -- re-rank -----------------------------------------------------------
+        ranked = self.synthesizer.resynthesize(
+            self._model,
+            self.nbytes,
+            parallel_degree=self.parallel_degree,
+            incumbent=incumbent,
+        )
+        report.ranked = [
+            {"label": r.label, "pred_us": round(r.seconds * 1e6, 3)}
+            for r in ranked
+        ]
+        winner = ranked[0]
+        inc_s = next(
+            (r.seconds for r in ranked if r.label == "incumbent"), None
+        )
+        report.incumbent_pred_s = inc_s
+        report.winner_label = winner.label
+        report.winner_pred_s = winner.seconds
+        if (
+            winner.strategy is None
+            or winner.strategy.fingerprint() == incumbent.fingerprint()
+        ):
+            report.outcome = "incumbent-wins"
+            report.winner_fingerprint = incumbent.fingerprint()
+            return self._done(report)
+        report.winner_fingerprint = winner.strategy.fingerprint()
+        # -- hysteresis gate ---------------------------------------------------
+        # the challenger's predicted steady state must beat the incumbent's
+        # by the margin, and the drift evidence must be a full window deep —
+        # one lucky (or unlucky) dispatch must not flap the executing plan
+        evidence = max((s.count for s in drift.fired), default=0)
+        if (
+            inc_s is None
+            or winner.seconds >= inc_s * (1.0 - self.hysteresis_margin)
+            or evidence < self.min_samples
+        ):
+            report.outcome = "hysteresis"
+            return self._done(report)
+        if mode == "detect":
+            report.outcome = "would-swap"
+            return self._done(report)
+        # -- swap --------------------------------------------------------------
+        t0 = time.perf_counter()
+        challengers = [
+            r for r in ranked
+            if r.strategy is not None and r.strategy is not incumbent
+        ]
+        for cand in challengers[: self.top_k]:
+            self.cache.warm_strategy(
+                cand.strategy,
+                self.warm_shape,
+                self.warm_dtype,
+                label=cand.label,
+                predicted_s=cand.seconds,
+            )
+        if self.trainer_prewarm is not None:
+            self.trainer_prewarm(winner.strategy)
+        report.aot_warm_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        report.epoch = self.cache.adopt(winner.strategy)
+        if self.trainer is not None:
+            report.trainer_adopt_hit = self.trainer.adopt_strategy(
+                winner.strategy
+            )
+        report.stall_s = time.perf_counter() - t1
+        report.swapped = True
+        report.outcome = "swapped"
+        self.swaps += 1
+        # fresh evidence for the adopted strategy: stale windows measured
+        # the OLD plan and would immediately re-fire against the new one.
+        # The watermark matters as much as the clear — the attached tuning
+        # database still HOLDS the old plan's samples, and the next
+        # refresh() would otherwise re-ingest exactly what was cleared.
+        self.detector.reset(watermark=time.time())
+        return self._done(report)

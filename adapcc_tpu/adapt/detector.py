@@ -1,0 +1,434 @@
+"""Passive drift detection from measurements that already flow.
+
+The paper re-profiles every ``profile_freq`` steps with *active* probe
+rounds (PAPER.md:61); this module gets the same signal for free.  Three
+feeds already carry per-dispatch walltimes:
+
+- the engine's :class:`~adapcc_tpu.tuner.measure.DispatchTimer` samples
+  (live, warmup-discarded),
+- dispatch-trace events whose extras carry ``duration_s``
+  (``ADAPCC_TUNER=record|choose`` runs),
+- the persisted ``tuning.jsonl`` history (:class:`TuningDatabase`).
+
+The detector keeps one bounded rolling window per plan cell — the tuner's
+``(primitive, size bucket, world, topology, path, chunk, codec)`` key — and
+compares each full window's **median** against the
+``topology/calibration.json``-priced prediction for that cell (the SAME
+pricing the tuner's prior uses, via :class:`TuningPolicy.prior_time`, so
+the detector and every sweep judge a cell identically).  Each sample is
+normalized at feed time by the calibration price at its TRUE payload when
+the feed knows it (live observes carry ``nbytes=``), or at the bucket
+otherwise (database history only keeps the bucket — a payload just above
+a power of two then reads up to the bucket width *conservative*, never
+trigger-happy).  A window whose median ratio exceeds
+``ADAPCC_DRIFT_FACTOR`` fires; anything less — healthy noise, a single
+straggler-polluted dispatch — must not (the false-positive guard is a
+pinned test).
+
+Cells the calibration cannot price (``ddp_step`` walltimes carry the
+step's *compute*, which no link model prices) fall back to a frozen
+self-baseline: the first full window's median becomes the reference, and
+later windows fire on the same factor against it — drift is still a
+sustained departure from what this fabric measured when healthy.
+
+Zero probe traffic, zero RNG, zero wall-clock reads in the decision: the
+whole trajectory is a deterministic function of the fed samples.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Tuple
+
+from adapcc_tpu.tuner.db import TuningDatabase, TuningKey
+
+#: measured-median ÷ prediction ratio at which a full window fires
+DRIFT_FACTOR_ENV = "ADAPCC_DRIFT_FACTOR"
+DEFAULT_DRIFT_FACTOR = 2.0
+
+#: samples per rolling window (per plan cell) — detection needs a full one
+DRIFT_WINDOW_ENV = "ADAPCC_DRIFT_WINDOW"
+DEFAULT_DRIFT_WINDOW = 8
+
+#: primitives whose cells the calibration prices (the tuner-prior terms);
+#: everything else (ddp_step, zero1_ring, …) detects against a frozen
+#: self-baseline instead
+PRICED_PRIMITIVES = (
+    "allreduce", "reduce_scatter", "all_gather", "all_to_all",
+)
+
+
+def resolve_drift_factor(explicit: Optional[float] = None) -> float:
+    """The drift threshold in force: ``ADAPCC_DRIFT_FACTOR`` env > the
+    explicit argument > the default.  Must be > 1 (a factor ≤ 1 would fire
+    on every healthy window); malformed → loud error, never a silent
+    default (the ADAPCC_RING_CHUNK_BYTES policy)."""
+    env = os.environ.get(DRIFT_FACTOR_ENV)
+    value = env if env is not None and env.strip() else explicit
+    if value is None:
+        return DEFAULT_DRIFT_FACTOR
+    try:
+        factor = float(value)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{DRIFT_FACTOR_ENV}/factor={value!r}: expected a number > 1"
+        ) from None
+    if factor <= 1.0:
+        raise ValueError(
+            f"{DRIFT_FACTOR_ENV}/factor={factor} must be > 1: at <= 1 every "
+            "healthy window would read as drift"
+        )
+    return factor
+
+
+def resolve_drift_window(explicit: Optional[int] = None) -> int:
+    """The window length in force: ``ADAPCC_DRIFT_WINDOW`` env > the
+    explicit argument > the default.  Must be >= 2 (a one-sample median is
+    exactly the single noisy dispatch the window exists to absorb);
+    malformed → loud error."""
+    env = os.environ.get(DRIFT_WINDOW_ENV)
+    value = env if env is not None and env.strip() else explicit
+    if value is None:
+        return DEFAULT_DRIFT_WINDOW
+    try:
+        window = int(str(value).strip())
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{DRIFT_WINDOW_ENV}/window={value!r}: expected an integer >= 2"
+        ) from None
+    if window < 2:
+        raise ValueError(
+            f"{DRIFT_WINDOW_ENV}/window={window} must be >= 2: a one-sample "
+            "median is the single noisy dispatch the window exists to absorb"
+        )
+    return window
+
+
+def _median(xs: List[float]) -> float:
+    ys = sorted(xs)
+    n = len(ys)
+    mid = n // 2
+    if n % 2:
+        return ys[mid]
+    return 0.5 * (ys[mid - 1] + ys[mid])
+
+
+@dataclass(frozen=True)
+class DriftSignal:
+    """One cell's verdict at check time."""
+
+    key: TuningKey
+    median_s: float
+    reference_s: float
+    #: "calibration" = priced prediction; "baseline" = frozen first window
+    reference: str
+    ratio: float
+    count: int
+    fired: bool
+
+    def to_row(self) -> dict:
+        return {
+            **self.key.to_dict(),
+            "median_us": round(self.median_s * 1e6, 3),
+            "reference_us": round(self.reference_s * 1e6, 3),
+            "reference": self.reference,
+            "ratio": round(self.ratio, 6),
+            "count": self.count,
+            "fired": self.fired,
+        }
+
+
+@dataclass
+class DriftReport:
+    """Everything one :meth:`DriftDetector.check` saw: every full-window
+    cell's ratio, fired or not — a detection artifact, not just a bit."""
+
+    factor: float
+    window: int
+    signals: List[DriftSignal] = field(default_factory=list)
+
+    @property
+    def fired(self) -> List[DriftSignal]:
+        return [s for s in self.signals if s.fired]
+
+    @property
+    def drifted(self) -> bool:
+        return any(s.fired for s in self.signals)
+
+    def to_rows(self) -> List[dict]:
+        return [s.to_row() for s in self.signals]
+
+
+class DriftDetector:
+    """Rolling-window drift detector over tuner plan cells (module doc).
+
+    ``cost_model`` anchors the predictions (default: the persisted
+    calibration artifact via ``load_or_default``); after a re-calibration
+    the controller swaps the corrected model in with
+    :meth:`set_cost_model`, so a model that has caught up with reality
+    stops firing — the closed loop converges instead of oscillating.
+    """
+
+    def __init__(
+        self,
+        world: int,
+        topology: str = "adapt",
+        cost_model=None,
+        factor: Optional[float] = None,
+        window: Optional[int] = None,
+    ) -> None:
+        self.world = int(world)
+        self.topology = topology
+        self.factor = resolve_drift_factor(factor)
+        self.window = resolve_drift_window(window)
+        self._cost_model = cost_model
+        self._policy = None  # lazily built pricing view (TuningPolicy)
+        #: priced cells hold seconds ÷ reference RATIOS, unpriced cells raw
+        #: seconds (the baseline path); one kind per key, decided by
+        #: whether the calibration prices it
+        self._windows: Dict[TuningKey, Deque[float]] = {}
+        self._baseline: Dict[TuningKey, float] = {}
+        #: per-key bucket-price cache (None = unpriced); dropped on
+        #: set_cost_model so a re-calibration re-anchors every reference
+        self._ref: Dict[TuningKey, Optional[float]] = {}
+        #: timestamp floor for timestamped feeds (db/trace history): set by
+        #: :meth:`reset` after a strategy swap so evidence recorded under
+        #: the retired plan can never re-enter and re-fire against its
+        #: successor — without it, the next ingest would simply replace the
+        #: just-cleared windows with the same stale samples
+        self._watermark = float("-inf")
+        #: feed accounting (diagnosable ingestion, the replay_trace rule)
+        self.ingested = 0
+        self.skipped = 0
+
+    # -- pricing ---------------------------------------------------------------
+
+    def _pricing(self):
+        """One pricing definition with the tuner: a throwaway in-memory
+        :class:`TuningPolicy` whose ``prior_time`` routes every cell to the
+        same cost-model term the prior and the benches use."""
+        if self._policy is None:
+            from adapcc_tpu.tuner.policy import TuningPolicy
+
+            self._policy = TuningPolicy(
+                TuningDatabase(persist=False),
+                self.world,
+                self.topology,
+                cost_model=self._cost_model,
+            )
+        return self._policy
+
+    def set_cost_model(self, cost_model) -> None:
+        """Re-anchor predictions (post-re-calibration): the corrected model
+        becomes the reference.  Priced windows are DROPPED — their stored
+        ratios were normalized under the retired reference, and reading
+        them against the new one would reconstruct seconds that were never
+        measured (and re-fire forever on evidence the correction already
+        absorbed).  Fresh samples normalize under the corrected price, so
+        a model that has caught up with the fabric stops firing — the
+        closed loop converges.  Baseline windows keep their (model-free)
+        frozen reference."""
+        priced = [k for k in self._windows if self.predicted_s(k) is not None]
+        for k in priced:
+            del self._windows[k]
+        self._cost_model = cost_model
+        self._policy = None
+        self._ref.clear()
+
+    def _price_at(self, key: TuningKey, nbytes: int) -> Optional[float]:
+        if key.primitive not in PRICED_PRIMITIVES:
+            return None
+        try:
+            pred = self._pricing().prior_time(key, int(nbytes))
+        except (KeyError, ValueError):
+            return None
+        return pred if pred > 0 else None
+
+    def predicted_s(self, key: TuningKey) -> Optional[float]:
+        """Calibration-priced seconds for one cell at its bucket size, or
+        None where no link model prices it (self-baseline cells).  Cached
+        per key; dropped on :meth:`set_cost_model`."""
+        if key in self._ref:
+            return self._ref[key]
+        pred = self._price_at(key, key.size_bucket)
+        self._ref[key] = pred
+        return pred
+
+    # -- feeds -----------------------------------------------------------------
+
+    def _freeze_baseline(self, key: TuningKey) -> None:
+        win = self._windows.get(key)
+        if (
+            win is not None
+            and len(win) >= self.window
+            and key not in self._baseline
+            and self.predicted_s(key) is None
+        ):
+            self._baseline[key] = _median(list(win))
+
+    def observe(
+        self,
+        key: TuningKey,
+        seconds: float,
+        ts: Optional[float] = None,
+        nbytes: Optional[int] = None,
+    ) -> None:
+        """Feed one measured dispatch (live DispatchTimer-style samples).
+
+        ``nbytes`` is the dispatch's TRUE per-rank payload when the feed
+        knows it: priced cells normalize each sample by the calibration
+        price at that size (the bucket spans a 2× payload range, so
+        bucket-priced references would read a just-above-a-power-of-two
+        payload up to 2× too healthy).  ``ts`` (when known) is checked
+        against the post-swap watermark — a timestamped sample from before
+        the last swap is counted as skipped, never windowed;
+        untimestamped samples are live by definition and always enter."""
+        s = float(seconds)
+        if s < 0:
+            raise ValueError(f"negative duration {s}")
+        if ts is not None and float(ts) < self._watermark:
+            self.skipped += 1
+            return
+        ref = self.predicted_s(key)
+        if ref is not None:
+            per = ref
+            if nbytes is not None and int(nbytes) != key.size_bucket:
+                per = self._price_at(key, int(nbytes)) or ref
+            value = s / per
+        else:
+            value = s
+        win = self._windows.get(key)
+        if win is None:
+            win = self._windows[key] = deque(maxlen=self.window)
+        win.append(value)
+        self.ingested += 1
+        self._freeze_baseline(key)
+
+    def observe_step(
+        self, seconds: float, nbytes: int, label: str = "ddp_step"
+    ) -> TuningKey:
+        """Feed one training-step walltime (the DispatchTimer step-median
+        feed): keyed as an unpriced ``ddp_step``-family cell, so detection
+        runs against the frozen healthy baseline."""
+        from adapcc_tpu.tuner.db import size_bucket
+
+        key = TuningKey(
+            primitive=label,
+            size_bucket=size_bucket(max(1, int(nbytes))),
+            world=self.world,
+            topology=self.topology,
+            path="step",
+            chunk_bytes=0,
+            wire_dtype="off",
+        )
+        self.observe(key, seconds)
+        return key
+
+    def ingest_db(self, db: TuningDatabase) -> Tuple[int, int]:
+        """Re-sync windows from a tuning database (the ``tuning.jsonl``
+        history feed): each matching key's window is REPLACED by its newest
+        ``window`` samples, so repeated ingestion of the same database is
+        idempotent.  Samples older than the post-swap watermark are
+        excluded — the database keeps the retired plan's history, and
+        replaying it into a freshly reset detector would re-fire on
+        evidence the adopted strategy never produced.  Keys from other
+        worlds are counted, never silently dropped.  Returns
+        ``(ingested_keys, skipped_keys)``."""
+        ingested = skipped = 0
+        for key in db.keys():
+            if key.world != self.world:
+                skipped += 1
+                self.skipped += len(db.timed_samples(key))
+                continue
+            timed = db.timed_samples(key)
+            samples = [s for ts, s in timed if ts >= self._watermark]
+            self.skipped += len(timed) - len(samples)  # pre-watermark
+            samples = samples[-self.window:]
+            if not samples:
+                skipped += 1
+                continue
+            ref = self.predicted_s(key)
+            win = self._windows[key] = deque(maxlen=self.window)
+            for s in samples:
+                # the db only keeps the bucket, not the true payload:
+                # bucket-priced normalization (conservative — see observe)
+                win.append(float(s) / ref if ref is not None else float(s))
+            self.ingested += len(samples)
+            self._freeze_baseline(key)
+            ingested += 1
+        return ingested, skipped
+
+    def ingest_trace(self, trace) -> Tuple[int, int]:
+        """Feed a recorded :class:`CollectiveTrace` (or TraceEvent
+        iterable): events carrying ``duration_s`` land in their cells via
+        the SAME key vocabulary as the tuner replay
+        (:func:`adapcc_tpu.tuner.measure.replay_trace` — one spelling, so a
+        trace and a live run can never disagree about which cell a dispatch
+        belongs to).  Returns ``(ingested_events, skipped_events)``."""
+        from adapcc_tpu.tuner.measure import replay_trace
+
+        tmp = TuningDatabase(persist=False)
+        ingested, skipped = replay_trace(trace, tmp, self.world, self.topology)
+        # self.skipped is sample-granular and ingest_db already counts what
+        # IT drops (watermark, empty keys); add only the events the replay
+        # itself could not key — counting them twice would inflate the
+        # diagnostic past the number of events fed
+        self.skipped += skipped
+        self.ingest_db(tmp)
+        return ingested, skipped
+
+    # -- decision --------------------------------------------------------------
+
+    def check(self) -> DriftReport:
+        """Evaluate every full window (side-effect-free beyond baseline
+        freezing, which feeds already did): deterministic, analytic."""
+        report = DriftReport(factor=self.factor, window=self.window)
+        for key in sorted(self._windows):
+            win = self._windows[key]
+            if len(win) < self.window:
+                continue
+            med = _median(list(win))
+            pred = self.predicted_s(key)
+            if pred is not None:
+                # priced cells window normalized RATIOS; report seconds at
+                # the bucket reference so downstream algebra (the α-β
+                # inversion) stays bucket-consistent
+                reference, ref_s = "calibration", pred
+                ratio, median_s = med, med * pred
+            else:
+                base = self._baseline.get(key)
+                if base is None or base <= 0:
+                    continue
+                reference, ref_s = "baseline", base
+                ratio, median_s = med / base, med
+            report.signals.append(
+                DriftSignal(
+                    key=key,
+                    median_s=median_s,
+                    reference_s=ref_s,
+                    reference=reference,
+                    ratio=ratio,
+                    count=len(win),
+                    fired=ratio >= self.factor,
+                )
+            )
+        return report
+
+    def reset(self, watermark: Optional[float] = None) -> None:
+        """Drop every window and baseline (post-swap: the new strategy's
+        dispatches must build fresh evidence before the next adaptation).
+        ``watermark`` additionally floors the timestamped feeds: history
+        recorded before it (the retired plan's samples still sitting in
+        the tuning database) can never re-enter the windows."""
+        self._windows.clear()
+        self._baseline.clear()
+        if watermark is not None:
+            self._watermark = float(watermark)
+
+    def __repr__(self) -> str:
+        return (
+            f"DriftDetector(world={self.world}, factor={self.factor}, "
+            f"window={self.window}, cells={len(self._windows)})"
+        )

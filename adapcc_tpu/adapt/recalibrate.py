@@ -1,0 +1,158 @@
+"""α-β re-calibration from passively observed collective timings.
+
+The drift detector says *that* measured medians departed from the priced
+prediction; this module turns the same medians into *corrected* link
+coefficients, through the existing calibration funnel:
+
+1. **Invert** — each fired ring-structured cell contributes per-hop
+   ``(bytes, seconds)`` points via the same round/byte algebra the battery
+   calibration uses (``calibrate._RING_STRUCTURE``: an allreduce is
+   ``2(w−1)`` serial hops of ``n/w`` bytes, …).  With two or more distinct
+   payload sizes the points go through
+   :func:`adapcc_tpu.sim.cost_model.fit_alpha_beta` — a real least-squares
+   (α, β) fit; a single size cannot separate α from β, so the correction
+   falls back to scaling the current coefficients by the observed ratio
+   (both terms stretch — the degraded-link shape
+   :meth:`LinkCoeffs.scaled` already models).
+2. **Localize** — a lockstep collective is paced by its bottleneck ring
+   hop, so the correction lands on that hop's link *class*
+   (:func:`bottleneck_ring_link`): passive timings cannot name one wire,
+   but they do name the class that paced them.
+3. **Merge** — the correction becomes a :class:`Calibration` stamped with
+   topology fingerprint + sample count + provenance, folded into the
+   existing artifact with decay by
+   :func:`adapcc_tpu.sim.calibrate.merge_calibration` — never
+   last-writer-wins.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from adapcc_tpu.adapt.detector import DriftReport, DriftSignal
+from adapcc_tpu.sim.calibrate import _RING_STRUCTURE, Calibration
+from adapcc_tpu.sim.cost_model import (
+    LinkCoeffs,
+    LinkCostModel,
+    bottleneck_ring_coeffs,
+    bottleneck_ring_link,
+    fit_alpha_beta,
+)
+
+
+def _hop_points(
+    signals: List[DriftSignal], world: int
+) -> Tuple[List[Tuple[float, float]], int]:
+    """Fired ring-structured signals → per-hop (bytes, seconds) points +
+    the total sample count behind them."""
+    points: List[Tuple[float, float]] = []
+    total = 0
+    for sig in signals:
+        structure = _RING_STRUCTURE.get(sig.key.primitive)
+        if structure is None or sig.reference != "calibration":
+            continue
+        rounds_fn, byte_fn = structure
+        rounds = float(rounds_fn(world))
+        if rounds <= 0:
+            continue
+        per_hop_bytes = byte_fn(world) * float(sig.key.size_bucket) / rounds
+        points.append((per_hop_bytes, sig.median_s / rounds))
+        total += sig.count
+    return points, total
+
+
+def drift_correction(
+    report: DriftReport,
+    model: LinkCostModel,
+    fingerprint: Optional[str] = None,
+    source: str = "drift-recal",
+) -> Optional[Calibration]:
+    """One drift report → a correction :class:`Calibration` for the
+    bottleneck link class (module doc), or None when no fired signal is
+    invertible (baseline-referenced cells carry no link algebra).
+
+    The returned artifact holds ONLY the corrected class — merging keeps
+    every other class/link untouched, which is the point: a DCN
+    degradation must not rewrite the ICI fit.  Per-link fits OF the
+    corrected class ride along, each stretched by the same correction
+    (``LinkCostModel.coeffs`` prefers per-link entries over class means,
+    so a class-only correction under a per-link-fitted artifact — the
+    normal profiler/battery output — would be silently masked and the
+    loop could never converge); their relative structure survives.
+    """
+    world = model.world
+    points, samples = _hop_points(report.fired, world)
+    if not points:
+        return None
+    link = bottleneck_ring_link(model, world)
+    cls = model.link_class_of(*link)
+    current = bottleneck_ring_coeffs(model, world)
+    distinct_sizes = {round(b, 3) for b, _ in points}
+    if len(distinct_sizes) >= 2:
+        corrected = fit_alpha_beta(points)
+    else:
+        # one payload size cannot separate α from β: stretch the current
+        # coefficients by the observed per-hop ratio instead (exactly the
+        # degraded-link shape the relay pricing models)
+        nbytes, seconds = points[0]
+        predicted = current.time(nbytes)
+        ratio = seconds / predicted if predicted > 0 else 1.0
+        corrected = current.scaled(max(1e-9, ratio))
+
+    def _ratio(new: float, old: float) -> float:
+        return new / old if old > 0 else 1.0
+
+    ra = _ratio(corrected.alpha, current.alpha)
+    rb = _ratio(corrected.beta, current.beta)
+    links = {
+        l: LinkCoeffs(c.alpha * ra, c.beta * rb)
+        for l, c in model.links.items()
+        if model.link_class_of(*l) == cls
+    }
+    return Calibration(
+        world=world,
+        classes={cls: corrected},
+        links=links,
+        ips=model.ips,
+        source=source,
+        fingerprint=fingerprint,
+        samples=max(1, samples),
+    )
+
+
+def corrected_model(
+    report: DriftReport,
+    base: Calibration,
+    decay: float = 0.5,
+    fingerprint: Optional[str] = None,
+    source: str = "drift-recal",
+) -> Tuple[Optional[Calibration], LinkCostModel]:
+    """Convenience funnel: invert ``report`` against ``base``'s model and
+    decay-merge the correction in.  Returns ``(merged_or_None, model)`` —
+    the model is the merged one when a correction existed, else ``base``'s
+    unchanged model (callers re-rank on whatever comes back)."""
+    from adapcc_tpu.sim.calibrate import merge_calibration
+
+    base_model = base.cost_model()
+    correction = drift_correction(
+        report, base_model, fingerprint=fingerprint, source=source
+    )
+    if correction is None:
+        return None, base_model
+    merged = merge_calibration(base, correction, decay=decay)
+    return merged, merged.cost_model()
+
+
+def calibration_of(model: LinkCostModel, **stamps) -> Calibration:
+    """Wrap a live cost model as a :class:`Calibration` (the merge base
+    when no artifact exists yet): same classes/links/ips, stamped with
+    whatever hygiene fields the caller knows (``fingerprint=``,
+    ``samples=``, ``source=``)."""
+    return Calibration(
+        world=model.world,
+        classes=dict(model.classes),
+        links=dict(model.links),
+        ips=dict(model.ips) if model.ips else None,
+        source=stamps.pop("source", model.source),
+        **stamps,
+    )

@@ -1247,12 +1247,42 @@ class CollectiveEngine:
             return lax.axis_index(dcn_axis) * self.ici_size + lax.axis_index(ici_axis)
         return lax.axis_index(self.axis_name)
 
+    def _latency_variant(
+        self, primitive: str, algo: Optional[str]
+    ) -> Optional[str]:
+        """Resolve the latency-plane algorithm for an RS/AG dispatch
+        (docs/LATENCY.md §5): ``ADAPCC_COLL_ALGO`` env > the explicit
+        argument, validated against the SAME support funnel the allreduce
+        selector and the tuner grid consult — a pinned variant the plane
+        cannot run rejects loudly, never a silent fallback to the default
+        plane under the pinned label.  ``auto``/``ring``/unset keep the
+        legacy XLA/two-level plane (the allreduce crossover is an
+        allreduce-shaped decision; these primitives adopt a variant only
+        by pin or by the re-ranking loop)."""
+        from adapcc_tpu.comm.latency import (
+            latency_algo_unsupported_reason,
+            resolve_coll_algo,
+        )
+
+        algo_req = resolve_coll_algo(algo)
+        if algo_req not in ("rd", "tree"):
+            return None
+        reason = latency_algo_unsupported_reason(
+            self.world_size, algo_req, self.two_level, primitive=primitive
+        )
+        if reason is not None:
+            raise ValueError(
+                f"{primitive} algo={algo_req!r} cannot run here: {reason}"
+            )
+        return algo_req
+
     def all_gather(
         self,
         stacked: jnp.ndarray,
         active_gpus: Optional[Sequence[int]] = None,
         *,
         epoch: Optional[int] = None,
+        algo: Optional[str] = None,
     ) -> jnp.ndarray:
         """All-gather with subset semantics (reference stub: trans.h ALLGATHER).
 
@@ -1262,11 +1292,36 @@ class CollectiveEngine:
         (the gather identity) but still receive the gathered stack — the
         relay contract of :meth:`all_reduce`.  Two-level worlds gather
         hierarchically (DCN first, so each payload crosses DCN once).
+
+        ``algo="rd"`` (or an ``ADAPCC_COLL_ALGO`` pin) runs the
+        recursive-doubling all-gather instead — ``log2(p)`` rounds for
+        latency-bound payloads (docs/LATENCY.md §5) — behind the shared
+        support funnel (power-of-two flat worlds only, loud reject
+        otherwise); the executed algorithm rides the trace like
+        ``wire_dtype``.
         """
         self._check_epoch(epoch)
         self._check_world_dim(stacked, "all_gather")
         mask = self._active_to_mask(active_gpus)
         masked = active_gpus is not None
+        if self._latency_variant("all_gather", algo) == "rd":
+            from adapcc_tpu.comm import latency as lat
+
+            world = self.world_size
+            axis = self.axis_name
+
+            def per_shard(x, m):  # x: [1, *payload]
+                v = x[0]
+                if masked:
+                    v = jnp.where(m[self._my_flat_rank()], v, jnp.zeros_like(v))
+                return lat.rd_all_gather_shard(v, world, axis)[None]
+
+            key = ("allgather_rd", stacked.shape, stacked.dtype.name, masked)
+            self._record(
+                "all_gather", "rd", stacked,
+                cache_hit=key in self._cache, algo="rd",
+            )
+            return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
         if self.two_level:
             from adapcc_tpu.comm.two_level import all_gather_two_level_shard
@@ -1280,7 +1335,10 @@ class CollectiveEngine:
                 )[None]
 
             key = ("allgather2l", stacked.shape, stacked.dtype.name, masked)
-            self._record("all_gather", "two_level", stacked, cache_hit=key in self._cache)
+            self._record(
+                "all_gather", "two_level", stacked,
+                cache_hit=key in self._cache, algo="ring",
+            )
             return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
         def per_shard(x, m):  # x: [1, *payload]
@@ -1290,7 +1348,10 @@ class CollectiveEngine:
             return lax.all_gather(v, self.axis_name, axis=0)[None]
 
         key = ("allgather", stacked.shape, stacked.dtype.name, masked)
-        self._record("all_gather", "xla", stacked, cache_hit=key in self._cache)
+        self._record(
+            "all_gather", "xla", stacked,
+            cache_hit=key in self._cache, algo="ring",
+        )
         return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
     def all_to_all(
@@ -1907,6 +1968,7 @@ class CollectiveEngine:
         active_gpus: Optional[Sequence[int]] = None,
         op: ReduceOp = ReduceOp.SUM,
         epoch: Optional[int] = None,
+        algo: Optional[str] = None,
     ) -> jnp.ndarray:
         """Reduce-scatter with subset semantics (reference stub: REDUCESCATTER).
 
@@ -1922,6 +1984,13 @@ class CollectiveEngine:
         ``ReduceOp.AVG`` averages over the *active* count.  Two-level worlds
         scatter hierarchically (ICI first, so DCN carries only ``1/ici`` of
         the buffer).
+
+        ``algo="rd"`` (or an ``ADAPCC_COLL_ALGO`` pin) runs the
+        recursive-halving reduce-scatter instead — ``log2(p)`` rounds for
+        latency-bound payloads (docs/LATENCY.md §5) — behind the shared
+        support funnel (power-of-two flat worlds only, loud reject
+        otherwise); the executed algorithm rides the trace like
+        ``wire_dtype``.
         """
         self._check_epoch(epoch)
         self._check_world_dim(stacked, "reduce_scatter")
@@ -1938,6 +2007,28 @@ class CollectiveEngine:
             )
         mask = self._active_to_mask(active_gpus)
         masked = active_gpus is not None
+
+        if self._latency_variant("reduce_scatter", algo) == "rd":
+            from adapcc_tpu.comm import latency as lat
+
+            world = self.world_size
+            axis = self.axis_name
+
+            def per_shard(x, m):  # x: [1, n]
+                out = lat.rd_reduce_scatter_shard(
+                    x.reshape(-1), m if masked else None, world, axis, op=op
+                )
+                return out[None, :]
+
+            key = (
+                "reducescatter_rd", stacked.shape, stacked.dtype.name, op,
+                masked,
+            )
+            self._record(
+                "reduce_scatter", "rd", stacked,
+                cache_hit=key in self._cache, algo="rd",
+            )
+            return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
         def _contrib(v, m):
             if masked:
@@ -1964,7 +2055,10 @@ class CollectiveEngine:
                 return _norm(out, m)[None, :]
 
             key = ("reducescatter2l", stacked.shape, stacked.dtype.name, op, masked)
-            self._record("reduce_scatter", "two_level", stacked, cache_hit=key in self._cache)
+            self._record(
+                "reduce_scatter", "two_level", stacked,
+                cache_hit=key in self._cache, algo="ring",
+            )
             return self._shard_mapped(key, per_shard, 2)(stacked, mask)
 
         def per_shard(x, m):  # x: [1, n]
@@ -1973,5 +2067,8 @@ class CollectiveEngine:
             return _norm(out, m)[None, :]
 
         key = ("reducescatter", stacked.shape, stacked.dtype.name, op, masked)
-        self._record("reduce_scatter", "xla", stacked, cache_hit=key in self._cache)
+        self._record(
+            "reduce_scatter", "xla", stacked,
+            cache_hit=key in self._cache, algo="ring",
+        )
         return self._shard_mapped(key, per_shard, 2)(stacked, mask)

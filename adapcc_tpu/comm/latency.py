@@ -81,16 +81,41 @@ def resolve_coll_algo(algo: Optional[str] = None) -> Optional[str]:
     return v
 
 
+#: primitives with a latency-plane variant and the algorithms each speaks:
+#: rd = recursive halving/doubling (allreduce composes both halves;
+#: reduce_scatter is the halving half, all_gather the doubling half), the
+#: binomial tree composes rooted phases and exists for allreduce only
+_LATENCY_PRIMITIVE_ALGOS = {
+    "allreduce": ("rd", "tree"),
+    "reduce_scatter": ("rd",),
+    "all_gather": ("rd",),
+}
+
+
 def latency_algo_unsupported_reason(
-    world: int, algo: str, two_level: bool = False
+    world: int, algo: str, two_level: bool = False,
+    primitive: str = "allreduce",
 ) -> Optional[str]:
-    """Why the latency plane cannot run ``algo`` on this world — None when
-    it can.  The ONE support funnel shared by the engine dispatch, the
+    """Why the latency plane cannot run ``algo`` for ``primitive`` on this
+    world — None when it can.  The ONE support funnel shared by the engine
+    dispatches (allreduce AND the RS/AG variants, docs/LATENCY.md §5), the
     auto-selector, and the tuner's candidate grid, so a cell can never
     claim a program the data plane would refuse."""
     if algo not in ("rd", "tree"):
         raise ValueError(
             f"algo={algo!r} is not a latency-plane algorithm ('rd'|'tree')"
+        )
+    allowed = _LATENCY_PRIMITIVE_ALGOS.get(primitive)
+    if allowed is None:
+        return (
+            f"primitive {primitive!r} has no latency-plane variant "
+            f"(only {sorted(_LATENCY_PRIMITIVE_ALGOS)})"
+        )
+    if algo not in allowed:
+        return (
+            f"{primitive} has no {algo!r} variant: binomial trees are "
+            "rooted phases only allreduce composes — reduce_scatter/"
+            "all_gather speak the recursive halving/doubling ('rd') half"
         )
     if two_level:
         return (
@@ -122,6 +147,40 @@ def _xor_perm(world: int, d: int) -> List[Tuple[int, int]]:
     XOR-partner at distance ``d`` (a bijection, so ppermute delivers to
     everyone — no zero-fill corner for MAX)."""
     return [(i, i ^ d) for i in range(world)]
+
+
+def _halving_rounds(cur, me, world: int, axis_name: str, op: ReduceOp):
+    """The recursive-HALVING reduce-scatter rounds (distances p/2 … 1):
+    each round keeps the half the rank's final segment lives in, sends the
+    other, folds in what arrives.  After ``log2(p)`` rounds rank ``r``
+    holds the fully reduced segment ``r``.  Shared by the allreduce and
+    the standalone reduce-scatter — one definition of the halving walk."""
+    d = world // 2
+    while d >= 1:
+        half = cur.shape[0] // 2
+        bit = (me // d) % 2
+        send = lax.dynamic_slice(cur, ((1 - bit) * half,), (half,))
+        keep = lax.dynamic_slice(cur, (bit * half,), (half,))
+        recvd = lax.ppermute(send, axis_name, _xor_perm(world, d))
+        cur = _combine(keep, recvd, op)
+        d //= 2
+    return cur
+
+
+def _doubling_rounds(cur, me, world: int, axis_name: str):
+    """The recursive-DOUBLING all-gather rounds (distances 1 … p/2): each
+    round swaps the gathered block with the XOR-partner and concatenates
+    (the bit-0 rank owns the lower half), doubling the gathered extent.
+    Shared by the allreduce and the standalone all-gather."""
+    d = 1
+    while d < world:
+        recvd = lax.ppermute(cur, axis_name, _xor_perm(world, d))
+        low = (me // d) % 2 == 0
+        first = jnp.where(low, cur, recvd)
+        second = jnp.where(low, recvd, cur)
+        cur = jnp.concatenate([first, second])
+        d *= 2
+    return cur
 
 
 def rd_allreduce_shard(
@@ -171,34 +230,11 @@ def rd_allreduce_shard(
         ident = _identity_for(op, flat.dtype)
         flat = jnp.concatenate([flat, jnp.full((pad,), ident, flat.dtype)])
     me = lax.axis_index(axis_name)
-    cur = flat
-
-    # recursive-halving reduce-scatter: distances p/2, p/4, ..., 1.  The
-    # rank's bit at the round's distance says which half its final segment
-    # lives in: keep that half, send the other, fold in what arrives (the
-    # partner has the opposite bit, so it sends exactly the kept half).
-    d = world // 2
-    while d >= 1:
-        half = cur.shape[0] // 2
-        bit = (me // d) % 2
-        send = lax.dynamic_slice(cur, ((1 - bit) * half,), (half,))
-        keep = lax.dynamic_slice(cur, (bit * half,), (half,))
-        recvd = lax.ppermute(send, axis_name, _xor_perm(world, d))
-        cur = _combine(keep, recvd, op)
-        d //= 2
-
-    # recursive-doubling all-gather: distances 1, 2, ..., p/2.  Each round
-    # swaps the gathered block with the XOR-partner; the rank whose bit is
-    # 0 owns the lower half of the merged block, so concatenation order is
-    # a one-bit select.
-    d = 1
-    while d < world:
-        recvd = lax.ppermute(cur, axis_name, _xor_perm(world, d))
-        low = (me // d) % 2 == 0
-        first = jnp.where(low, cur, recvd)
-        second = jnp.where(low, recvd, cur)
-        cur = jnp.concatenate([first, second])
-        d *= 2
+    # recursive-halving reduce-scatter, then the recursive-doubling
+    # all-gather mirroring the same (distance, size) pairs back up — the
+    # standalone RS/AG entry points share both walks
+    cur = _halving_rounds(flat, me, world, axis_name, op)
+    cur = _doubling_rounds(cur, me, world, axis_name)
 
     result = cur[:n].reshape(x.shape)
     if active_mask is not None:
@@ -206,6 +242,82 @@ def rd_allreduce_shard(
     if op is ReduceOp.AVG:
         return result / world
     return result
+
+
+def rd_reduce_scatter_shard(
+    x: jnp.ndarray,
+    active_mask: Optional[jnp.ndarray],
+    world: int,
+    axis_name: str = RANKS_AXIS,
+    op: ReduceOp = ReduceOp.SUM,
+) -> jnp.ndarray:
+    """Recursive-HALVING reduce-scatter over ``axis_name``; call inside
+    shard_map (the RS half of :func:`rd_allreduce_shard`, standing alone
+    so re-ranking can select it — docs/LATENCY.md §5).
+
+    Input: this rank's full ``n``-element contribution (``n`` must divide
+    the world — the engine's reduce_scatter row contract).  Output: the
+    ``n/world``-element segment ``r`` fully reduced on rank ``r`` —
+    ``log2(p)`` ppermute rounds at the ring reduce-scatter's ``(p−1)/p·n``
+    wire volume (vs the ring's ``p−1`` rounds).  Power-of-two worlds only
+    (loud reject via the shared support funnel).  ``active_mask`` follows
+    the relay contract: inactive ranks contribute the reduction identity
+    but stay on the exchange path and receive their segment;
+    ``ReduceOp.AVG`` normalizes by the active count.
+    """
+    reason = latency_algo_unsupported_reason(
+        world, "rd", primitive="reduce_scatter"
+    )
+    if reason is not None:
+        raise ValueError(f"rd_reduce_scatter_shard: {reason}")
+    from adapcc_tpu.comm.engine import _avg_normalize, _mask_contribution
+
+    flat = x.reshape(-1)
+    if flat.size % world:
+        raise ValueError(
+            f"rd reduce-scatter payload ({flat.size} elems) must divide "
+            f"the world ({world})"
+        )
+    if world == 1:
+        return flat
+    if active_mask is not None:
+        flat = _mask_contribution(flat, active_mask, axis_name, op)
+    me = lax.axis_index(axis_name)
+    out = _halving_rounds(flat, me, world, axis_name, op)
+    if active_mask is not None:
+        return _avg_normalize(out, active_mask, op)
+    if op is ReduceOp.AVG:
+        return out / world
+    return out
+
+
+def rd_all_gather_shard(
+    x: jnp.ndarray,
+    world: int,
+    axis_name: str = RANKS_AXIS,
+) -> jnp.ndarray:
+    """Recursive-DOUBLING all-gather over ``axis_name``; call inside
+    shard_map (the AG half of :func:`rd_allreduce_shard`, standing alone
+    so re-ranking can select it — docs/LATENCY.md §5).
+
+    Input: this rank's payload (any shape).  Output: ``[world, *payload]``
+    — everyone's payloads in rank order — in ``log2(p)`` ppermute rounds
+    at the ring all-gather's ``(p−1)/p·n`` wire volume (vs the ring's
+    ``p−1`` rounds).  Power-of-two worlds only (loud reject via the shared
+    support funnel).  Relay semantics live with the caller: the engine
+    zeroes inactive contributions before the exchange, exactly like its
+    XLA all-gather plane.
+    """
+    reason = latency_algo_unsupported_reason(
+        world, "rd", primitive="all_gather"
+    )
+    if reason is not None:
+        raise ValueError(f"rd_all_gather_shard: {reason}")
+    if world == 1:
+        return x[None]
+    me = lax.axis_index(axis_name)
+    cur = _doubling_rounds(x.reshape(-1), me, world, axis_name)
+    return cur.reshape((world,) + x.shape)
 
 
 def _binomial_rounds(world: int) -> List[int]:

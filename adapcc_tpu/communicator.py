@@ -645,6 +645,34 @@ class Communicator:
             self._coordinator_server = None
         self._step_queue = None
 
+    # -- online adaptation (docs/ADAPT.md) -------------------------------------
+
+    def adaptation_controller(
+        self, prim: int = ALLREDUCE, trainer=None, mode: Optional[str] = None,
+        **kwargs,
+    ):
+        """Closed-loop online adaptation over this world's engine: an
+        :class:`~adapcc_tpu.adapt.AdaptationController` wired to the
+        communicator's own seams — the ``prim`` engine, the synthesizer
+        (so re-ranked candidates come from the same policy pool the
+        bootstrap used), the tuner's database (the passive measurement
+        feed) and topology fingerprint, and the calibration artifact
+        beside the other topology products.  ``ADAPCC_ADAPT`` gates the
+        plane; ``mode`` is the env-unset default (the tuner's contract)."""
+        from adapcc_tpu.adapt import AdaptationController
+
+        engine = self._engine(prim)
+        kwargs.setdefault("db", self.tuner.db)
+        kwargs.setdefault("fingerprint", self.tuner.topology)
+        kwargs.setdefault(
+            "calibration_path",
+            os.path.join(self.args.topology_dir, "calibration.json"),
+        )
+        kwargs.setdefault("parallel_degree", max(1, self.args.parallel_degree))
+        return AdaptationController(
+            engine, self.synthesizer, trainer=trainer, mode=mode, **kwargs
+        )
+
     # -- introspection ---------------------------------------------------------
 
     @property

@@ -184,6 +184,11 @@ class StandbyPlanCache:
         #: base (full-world) strategy to restore on recovery
         self.base_strategy = engine.strategy
         self.plans: Dict[FrozenSet[int], StandbyPlan] = {}
+        #: full-world CANDIDATE strategies warmed for the online
+        #: re-adaptation loop (docs/ADAPT.md), keyed by strategy
+        #: fingerprint — the shrink plans above key by alive subset, but a
+        #: re-ranked challenger keeps the whole world and only changes shape
+        self.adaptive: Dict[str, StandbyPlan] = {}
 
     # -- construction ----------------------------------------------------------
 
@@ -261,6 +266,62 @@ class StandbyPlanCache:
             plan.warmed = True
             warmed.append(plan)
         return warmed
+
+    def warm_strategy(
+        self,
+        strategy: Strategy,
+        shape: Tuple[int, ...],
+        dtype=np.float32,
+        primitives: Sequence[str] = ("all_reduce",),
+        label: Optional[str] = None,
+        predicted_s: float = 0.0,
+    ) -> StandbyPlan:
+        """AOT-compile a full-world CANDIDATE strategy — the online
+        re-adaptation half of this cache (docs/ADAPT.md §4).
+
+        Same temporary-swap warm as :meth:`warm`, but for an arbitrary
+        re-ranked strategy instead of a shrink scenario: one throwaway
+        zeros dispatch per primitive populates the engine's compiled-
+        program cache under the candidate's fingerprint, so a later
+        :meth:`adopt` is a dispatch-time cache-key switch (``cache_hit:
+        true`` on the first post-swap dispatch — the same no-recompile
+        property the elastic failover pins).  ``predicted_s`` records the
+        sim-ranked steady state that nominated the candidate.
+        """
+        import jax.numpy as jnp
+
+        engine = self.engine
+        if strategy.world_size != engine.world_size:
+            raise ValueError(
+                f"candidate strategy world {strategy.world_size} != engine "
+                f"world {engine.world_size}"
+            )
+        active = frozenset(range(engine.world_size))
+        plan = StandbyPlan(
+            label or f"adapt-{strategy.fingerprint()[:8]}",
+            active,
+            strategy,
+            float(predicted_s),
+        )
+        zeros = jnp.zeros((engine.world_size,) + tuple(shape), dtype)
+        saved = engine.strategy
+        engine.strategy = strategy
+        try:
+            for prim in primitives:
+                getattr(engine, prim)(zeros, active_gpus=sorted(active))
+        finally:
+            engine.strategy = saved
+        plan.warmed = True
+        self.adaptive[strategy.fingerprint()] = plan
+        return plan
+
+    def adopt(self, strategy: Strategy) -> int:
+        """Hot-swap the engine onto a candidate strategy under a fresh
+        epoch (the adoption half of :meth:`warm_strategy`): one
+        ``advance_epoch`` call — compiled programs stay cached under their
+        fingerprints, so a warmed candidate's first dispatch replays warm.
+        Returns the new epoch."""
+        return self.engine.advance_epoch(strategy)
 
     # -- failover --------------------------------------------------------------
 

@@ -59,7 +59,17 @@ _RING_STRUCTURE = {
 @dataclass
 class Calibration:
     """Serializable α-β calibration: class coefficients + optional per-link
-    table, stamped with provenance."""
+    table, stamped with provenance.
+
+    Hygiene stamps (docs/ADAPT.md §3): ``fingerprint`` is the topology
+    fingerprint the coefficients were fitted on (a calibration from one
+    fabric must not silently price another — :func:`load_or_default` warns
+    loudly on a mismatch), ``samples`` counts the measurements behind the
+    fit (the decay weight :func:`merge_calibration` blends by), and
+    ``provenance`` chains the merge history so an artifact always says how
+    it came to hold its numbers.  All three default empty, so pre-stamp
+    artifacts load unchanged.
+    """
 
     world: int
     classes: Dict[str, LinkCoeffs]
@@ -67,6 +77,13 @@ class Calibration:
     ips: Optional[Dict[int, str]] = None
     source: str = "unspecified"
     version: int = CALIBRATION_VERSION
+    #: topology fingerprint (adapcc_tpu.tuner.db.topology_fingerprint) the
+    #: fit was taken on; None = unstamped (legacy artifact)
+    fingerprint: Optional[str] = None
+    #: measurements behind the fit — the weight re-calibration merges by
+    samples: int = 0
+    #: bounded merge-history chain, newest last
+    provenance: Optional[List[str]] = None
 
     # -- model -----------------------------------------------------------------
 
@@ -95,6 +112,9 @@ class Calibration:
                 for (s, d), c in sorted(self.links.items())
             ],
             "ips": {str(r): ip for r, ip in (self.ips or {}).items()} or None,
+            "fingerprint": self.fingerprint,
+            "samples": int(self.samples),
+            "provenance": list(self.provenance) if self.provenance else None,
         }
 
     @classmethod
@@ -117,6 +137,7 @@ class Calibration:
         }
         ips_raw = obj.get("ips")
         ips = {int(r): ip for r, ip in ips_raw.items()} if ips_raw else None
+        prov = obj.get("provenance")
         return cls(
             world=int(obj["world"]),
             classes=classes,
@@ -124,6 +145,11 @@ class Calibration:
             ips=ips,
             source=str(obj.get("source", "unspecified")),
             version=version,
+            fingerprint=(
+                str(obj["fingerprint"]) if obj.get("fingerprint") else None
+            ),
+            samples=int(obj.get("samples") or 0),
+            provenance=[str(p) for p in prov] if prov else None,
         )
 
     def save(self, path: str) -> str:
@@ -246,18 +272,110 @@ def _dcn_guess(ici: LinkCoeffs) -> Tuple[float, float]:
     return ici.alpha * a_ratio, ici.beta * b_ratio
 
 
+#: merge-history entries retained on a calibration artifact — enough to
+#: audit a long re-calibration chain without growing the file unboundedly
+MAX_PROVENANCE = 8
+
+
+def merge_calibration(
+    base: Calibration, update: Calibration, decay: float = 0.5
+) -> Calibration:
+    """Fold a re-calibration into an existing artifact WITH decay — the
+    fix for last-writer-wins (docs/ADAPT.md §3).
+
+    Coefficients blend per class (and per link) by sample-count weight:
+    the update enters at its own ``samples``, the base is discounted by
+    ``decay`` (an unstamped base borrows the update's weight, so a legacy
+    artifact still decays instead of being overwritten).  Classes/links
+    only one side knows survive unchanged — a correction that localized to
+    one link class must not reset the others.  The merged artifact keeps
+    the sample accounting and a bounded provenance chain, so the next
+    merge decays THIS merge in turn.
+    """
+    if base.world != update.world:
+        raise ValueError(
+            f"cannot merge calibrations across worlds "
+            f"({base.world} vs {update.world}); re-calibrate for this world"
+        )
+    if (
+        base.fingerprint is not None
+        and update.fingerprint is not None
+        and base.fingerprint != update.fingerprint
+    ):
+        # blending two fabrics' fits and stamping the chimera with one
+        # fingerprint would make every FUTURE load trust it silently —
+        # the exact hygiene hole the stamps exist to close.  Callers with
+        # a stale artifact start a fresh base instead.
+        raise ValueError(
+            f"cannot merge calibrations across fabrics (base fitted on "
+            f"{base.fingerprint!r}, update on {update.fingerprint!r}); "
+            "seed a fresh artifact for this fabric instead"
+        )
+    if not 0.0 <= decay <= 1.0:
+        raise ValueError(f"decay must be in [0, 1], got {decay}")
+    w_new = float(max(1, update.samples))
+    w_old = decay * float(base.samples if base.samples > 0 else w_new)
+
+    def blend(old: LinkCoeffs, new: LinkCoeffs) -> LinkCoeffs:
+        if w_old + w_new <= 0:
+            return new
+        return LinkCoeffs(
+            alpha=(w_old * old.alpha + w_new * new.alpha) / (w_old + w_new),
+            beta=(w_old * old.beta + w_new * new.beta) / (w_old + w_new),
+        )
+
+    classes = dict(base.classes)
+    for cls_name, c in update.classes.items():
+        classes[cls_name] = (
+            blend(base.classes[cls_name], c) if cls_name in base.classes else c
+        )
+    links = dict(base.links)
+    for link, c in update.links.items():
+        links[link] = blend(base.links[link], c) if link in base.links else c
+    provenance = list(base.provenance or [])
+    if not provenance and base.source:
+        provenance.append(base.source)
+    provenance.append(update.source)
+    return Calibration(
+        world=base.world,
+        classes=classes,
+        links=links,
+        ips=update.ips if update.ips is not None else base.ips,
+        source=f"merged:{update.source}",
+        fingerprint=update.fingerprint or base.fingerprint,
+        samples=int(round(w_old + w_new)),
+        provenance=provenance[-MAX_PROVENANCE:],
+    )
+
+
 def load_calibration(path: str = DEFAULT_CALIBRATION_PATH) -> LinkCostModel:
     """Artifact → ready-to-use cost model (raises if absent/incompatible)."""
     return Calibration.load(path).cost_model()
 
 
+def _stamp_warning(what: str) -> None:
+    print(f"[sim] calibration WARNING: {what}", file=sys.stderr, flush=True)
+
+
 def load_or_default(
-    path: str = DEFAULT_CALIBRATION_PATH, world: Optional[int] = None
+    path: str = DEFAULT_CALIBRATION_PATH,
+    world: Optional[int] = None,
+    fingerprint: Optional[str] = None,
 ) -> LinkCostModel:
     """Artifact if present, else the synthetic defaults — the simulated
-    bench's entry point, which must produce numbers either way."""
+    bench's entry point, which must produce numbers either way.
+
+    ``fingerprint`` (when given) is checked against the artifact's stamp:
+    a calibration fitted on another fabric still *loads* — class-level
+    coefficients transfer better than nothing — but the mismatch is
+    reported LOUDLY, as is a world-size resize, so a stale artifact can
+    never silently price a different pod (docs/ADAPT.md §3)."""
     try:
-        model = load_calibration(path)
+        cal = Calibration.load(path)
+        # build the model INSIDE the fallback guard: an artifact that
+        # parses but carries unusable values (world: 0, ...) must fall
+        # back too — this entry point produces numbers either way
+        model = cal.cost_model()
     except (OSError, ValueError, KeyError, TypeError) as e:
         # unreadable OR structurally malformed (hand-edited / partial tool /
         # version-gated) artifacts all fall back — this entry point must
@@ -272,7 +390,23 @@ def load_or_default(
                 flush=True,
             )
         return LinkCostModel.uniform(world or 8, source="defaults")
+    if (
+        fingerprint is not None
+        and cal.fingerprint is not None
+        and cal.fingerprint != fingerprint
+    ):
+        _stamp_warning(
+            f"{path} was fitted on fabric {cal.fingerprint!r} but this "
+            f"world's fingerprint is {fingerprint!r}; class coefficients "
+            "still price the sweep, but re-calibrate before trusting a "
+            "ranking on them"
+        )
     if world is not None and world != model.world:
+        _stamp_warning(
+            f"{path} was fitted at world={model.world}, loading for "
+            f"world={world}; per-link fits outside the new range fall back "
+            "to class means"
+        )
         # a calibration from another world still prices links by class —
         # keeping the recorded host layout when it covers the new rank
         # range, so cross-host edges stay classed DCN after the resize

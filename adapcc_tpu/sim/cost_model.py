@@ -159,6 +159,24 @@ class LinkCostModel:
             source=self.source,
         )
 
+    def to_graphs(self) -> Tuple[list, list]:
+        """(bandwidth [GB/s], latency [s]) matrices read off the calibrated
+        coefficients — the synthesizer-input spelling of this model, so
+        candidate *shapes* (ParTrees master routing included) can be
+        synthesized for exactly the network a replay will price.  Shared by
+        the simulated bench and the online re-rank (docs/ADAPT.md)."""
+        w = self.world
+        bw = [[0.0] * w for _ in range(w)]
+        lat = [[0.0] * w for _ in range(w)]
+        for s in range(w):
+            for d in range(w):
+                if s == d:
+                    continue
+                c = self.coeffs(s, d)
+                lat[s][d] = c.alpha
+                bw[s][d] = 1.0 / (c.beta * 1e9) if c.beta > 0 else 1e6
+        return bw, lat
+
     # -- construction from profiles --------------------------------------------
 
     @classmethod
@@ -254,6 +272,20 @@ class LinkCostModel:
 DEFAULT_HBM_BYTES_PER_S = 800e9
 
 
+def bottleneck_ring_link(
+    model: "LinkCostModel", world: Optional[int] = None
+) -> Link:
+    """The slowest (r → r+1) ring hop itself — the LINK that paces a
+    lockstep ring.  The passive re-calibration (adapcc_tpu/adapt) assigns
+    its α-β correction to this link's *class*: a collective that slowed
+    down was paced here, so this is where the observed seconds localize."""
+    w = model.world if world is None else int(world)
+    if w < 2:
+        return (0, 0)  # degenerate ring
+    ring_links = [(r, (r + 1) % w) for r in range(w)]
+    return max(ring_links, key=lambda l: model.coeffs(*l).time(1 << 20))
+
+
 def bottleneck_ring_coeffs(
     model: "LinkCostModel", world: Optional[int] = None
 ) -> LinkCoeffs:
@@ -262,14 +294,7 @@ def bottleneck_ring_coeffs(
     sweep, the codec sweep, the tuner's prior) judges candidates there.
     One shared definition: the benches and the tuner can never disagree
     about which link paces the ring."""
-    w = model.world if world is None else int(world)
-    if w < 2:
-        return model.coeffs(0, 0)  # degenerate ring: the class coefficients
-    ring_links = [(r, (r + 1) % w) for r in range(w)]
-    return max(
-        (model.coeffs(s, d) for s, d in ring_links),
-        key=lambda c: c.time(1 << 20),
-    )
+    return model.coeffs(*bottleneck_ring_link(model, world))
 
 
 def staged_ring_allreduce_time(
@@ -735,6 +760,78 @@ def failover_cost(
 
 
 # --------------------------------------------------------------------------- #
+# online re-adaptation pricing (adapcc_tpu/adapt): the stall a strategy
+# change costs, hot-swap vs full rebuild — the A/B drift_loop measures
+# --------------------------------------------------------------------------- #
+
+#: re-synthesis walltime folded into a full rebuild: candidate emission +
+#: ranking on the host (a deliberately round number of the right order for
+#: a sub-pod world; replaced by any measured calibration — world=64 MILP
+#: synthesis measures 0.09 s, ParTrees less)
+DEFAULT_RESYNTHESIS_S = 0.1
+
+
+def full_rebuild_stall_s(
+    world: int,
+    coeffs: LinkCoeffs,
+    compile_s: float = DEFAULT_COLD_COMPILE_S,
+    synthesis_s: float = DEFAULT_RESYNTHESIS_S,
+) -> float:
+    """The stall one ``reconstruct_topology`` cycle costs: active probe
+    traffic (every directed pair pays the profiler's two probe rounds),
+    re-synthesis, and the cold trace+compile of the new schedule — the
+    price the closed adaptation loop (docs/ADAPT.md) exists to NOT pay.
+    Strictly above :func:`plan_swap_stall_s`'s cached swap by construction
+    (the compile term alone dwarfs a dispatch-time cache-key switch)."""
+    world = int(world)
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    probes = world * max(0, world - 1) * (
+        coeffs.time(LATENCY_PROBE_BYTES) + coeffs.time(BANDWIDTH_PROBE_BYTES)
+    )
+    return probes + synthesis_s + compile_s
+
+
+def adaptation_cost(
+    world: int,
+    nbytes: float,
+    coeffs: LinkCoeffs,
+    stale_steady_s: float,
+    adapted_steady_s: float,
+    standby_cached: bool = True,
+    compile_s: float = DEFAULT_COLD_COMPILE_S,
+    synthesis_s: float = DEFAULT_RESYNTHESIS_S,
+) -> Dict[str, float]:
+    """Price one drift incident's re-adaptation decision (docs/ADAPT.md):
+    keep running the stale strategy, hot-swap to the re-ranked one through
+    the standby cache, or pay a full rebuild.
+
+    ``stale_steady_s`` / ``adapted_steady_s`` are the caller's per-step
+    predictions under the *corrected* (degraded) costs — the incumbent vs
+    the re-ranked winner.  Returns the two one-time stalls plus the
+    per-step gain and each arm's break-even step count (``inf`` when
+    adaptation predicts no gain — then neither stall is worth paying).
+    Deterministic, analytic — the adapt-sweep rows ride on it.
+    """
+    if stale_steady_s < 0 or adapted_steady_s < 0:
+        raise ValueError("steady-state predictions must be >= 0")
+    hot = plan_swap_stall_s(standby_cached)
+    full = full_rebuild_stall_s(world, coeffs, compile_s, synthesis_s)
+    gain = stale_steady_s - adapted_steady_s
+    return {
+        "stale_steady_s": float(stale_steady_s),
+        "adapted_steady_s": float(adapted_steady_s),
+        "gain_per_step_s": gain,
+        "hot_swap_stall_s": hot,
+        "full_rebuild_stall_s": full,
+        "hot_swap_break_even_steps": hot / gain if gain > 0 else float("inf"),
+        "full_rebuild_break_even_steps": (
+            full / gain if gain > 0 else float("inf")
+        ),
+    }
+
+
+# --------------------------------------------------------------------------- #
 # latency-optimal algorithm pricing (adapcc_tpu/comm/latency): recursive
 # doubling + binomial trees vs the ring, on the physical ring embedding
 # --------------------------------------------------------------------------- #
@@ -783,26 +880,54 @@ def recursive_doubling_allreduce_time(
     rejects such worlds; this term exists so the selector can still rank
     them.)  ``world < 2`` is free.
     """
+    # recursive-halving reduce-scatter (distances p/2 … 1, messages
+    # n/2 … n/p) + the all-gather mirroring the same (distance, size)
+    # pairs back up — one _rd_half_time term per half, fold-in included
+    return 2.0 * _rd_half_time(world, nbytes, coeffs)
+
+
+def _rd_half_time(world: int, nbytes: float, coeffs: LinkCoeffs) -> float:
+    """One rd half-schedule on the ring embedding: the recursive-HALVING
+    reduce-scatter's rounds (distances p/2 … 1, messages n/2 … n/p) — which
+    the recursive-doubling all-gather mirrors exactly, so one term prices
+    both halves.  Non-power-of-two worlds price one full-payload fold-in
+    transfer (the data plane rejects them; the term exists so selectors can
+    still rank)."""
     world = int(world)
     if world < 2:
         return 0.0
     total = 0.0
-    p = 1 << (world.bit_length() - 1)  # largest power of two <= world
+    p = 1 << (world.bit_length() - 1)
     if p != world:
-        # fold-in: remainder ranks send their payload to a core neighbor
-        # before the schedule and receive the result after it
-        total += 2.0 * coeffs.time(nbytes)
-    # recursive-halving reduce-scatter: distances p/2, p/4, ..., 1 with
-    # messages n/2, n/4, ..., n/p; the all-gather mirrors the same
-    # (distance, size) pairs back up, hence the factor 2
-    rs = 0.0
+        total += coeffs.time(nbytes)
     d = p // 2
     msg = float(nbytes) / 2.0
     while d >= 1:
-        rs += coeffs.alpha + coeffs.beta * _ring_hops(d, p) * msg
+        total += coeffs.alpha + coeffs.beta * _ring_hops(d, p) * msg
         d //= 2
         msg /= 2.0
-    return total + 2.0 * rs
+    return total
+
+
+def recursive_halving_reduce_scatter_time(
+    world: int, nbytes: float, coeffs: LinkCoeffs
+) -> float:
+    """Analytical latency of the recursive-halving reduce-scatter
+    (:func:`adapcc_tpu.comm.latency.rd_reduce_scatter_shard`): the RS half
+    of :func:`recursive_doubling_allreduce_time` — ``log2(p)·α`` fixed cost
+    at the ring's ``(p−1)/p·n`` wire volume, hop-serialized on the ring
+    embedding.  ``nbytes`` is the full (pre-scatter) payload."""
+    return _rd_half_time(world, nbytes, coeffs)
+
+
+def recursive_doubling_all_gather_time(
+    world: int, nbytes: float, coeffs: LinkCoeffs
+) -> float:
+    """Analytical latency of the recursive-doubling all-gather
+    (:func:`adapcc_tpu.comm.latency.rd_all_gather_shard`): the AG mirror of
+    the halving schedule — identical (distance, size) pairs, so identical
+    cost.  ``nbytes`` is the full (post-gather) payload."""
+    return _rd_half_time(world, nbytes, coeffs)
 
 
 def binomial_tree_time(

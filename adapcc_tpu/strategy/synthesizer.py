@@ -150,20 +150,60 @@ class Synthesizer:
         bandwidth_graph: Optional[Sequence[Sequence[float]]] = None,
         latency_graph: Optional[Sequence[Sequence[float]]] = None,
         collective: str = "allreduce",
+        model=None,
     ):
         """Order labeled candidates fastest-first on the α-β replay.
 
-        The cost model comes from the profiled matrices when given (the
-        exact inputs ``synthesize`` receives from the bootstrap), else from
-        the persisted calibration artifact / synthetic defaults.  Returns
+        The cost model is ``model`` when given (the online re-adaptation
+        path hands in its drift-corrected model, docs/ADAPT.md), else from
+        the profiled matrices (the exact inputs ``synthesize`` receives
+        from the bootstrap), else from the persisted calibration artifact /
+        synthetic defaults.  Returns
         :class:`adapcc_tpu.sim.rank.RankedCandidate` rows.
         """
         from adapcc_tpu import sim
 
-        model = self._cost_model(bandwidth_graph, latency_graph)
+        if model is None:
+            model = self._cost_model(bandwidth_graph, latency_graph)
         return sim.rank_candidates(
             list(candidates), model, max(1, int(nbytes)), collective
         )
+
+    def resynthesize(
+        self,
+        model,
+        nbytes: int,
+        parallel_degree: int = 1,
+        incumbent: Optional[Strategy] = None,
+        collective: str = "allreduce",
+    ):
+        """Online re-rank under a drift-corrected cost model (docs/ADAPT.md):
+        synthesize the candidate pool from the model's own link matrices
+        (so candidate SHAPES — ParTrees master routing included — see the
+        corrected network), rank on the corrected replay, and re-price the
+        winner's wire codec on its corrected bottleneck edge.
+
+        ``incumbent`` is listed FIRST, so a prediction-identical
+        alternative keeps the executing strategy (no compiled-program
+        churn for nothing — the rank_candidates tie rule).  Returns the
+        full ranked list; callers gate adoption on their own hysteresis.
+        Pure host work: no probe traffic, no compilation.
+        """
+        bw, lat = model.to_graphs()
+        cands: List[Tuple[str, Strategy]] = []
+        if incumbent is not None:
+            cands.append(("incumbent", incumbent))
+        cands.extend(self.candidates(parallel_degree, bw, lat))
+        ranked = self.rank(
+            cands, nbytes, collective=collective, model=model
+        )
+        winner = ranked[0]
+        if winner.strategy is not None and winner.strategy is not incumbent:
+            winner.strategy.synthesis = f"{winner.label}+adapt-rerank"
+            winner.strategy.wire_dtype = self._choose_wire_dtype(
+                winner.strategy, nbytes, bw, lat
+            )
+        return ranked
 
     def _cost_model(self, bandwidth_graph, latency_graph):
         import numpy as np

@@ -352,6 +352,17 @@ class TuningDatabase:
             return []
         return [s for _, s in sorted(cell.samples)[-MAX_SAMPLES_PER_KEY:]]
 
+    def timed_samples(self, key: TuningKey) -> List[Tuple[float, float]]:
+        """``(ts, seconds)`` pairs in the same deterministic order/bound as
+        :meth:`samples` — for consumers that must tell WHEN a sample was
+        taken (the drift detector's post-swap watermark: evidence recorded
+        under a retired plan must not re-fire against its successor)."""
+        self._ensure_loaded()
+        cell = self._cells.get(key)
+        if not cell:
+            return []
+        return sorted(cell.samples)[-MAX_SAMPLES_PER_KEY:]
+
     def stats(self, key: TuningKey) -> Optional[TuningStats]:
         xs = self.samples(key)
         if not xs:

@@ -126,6 +126,20 @@ def build_parser() -> argparse.ArgumentParser:
         "leaf; schedule = bucketed strategy-tree allreduce (multi-tree "
         "strategies run merged rounds); auto picks by topology",
     )
+    p.add_argument(
+        "--adapt", choices=["off", "detect", "swap"], default="off",
+        help="closed-loop online adaptation (docs/ADAPT.md; requires "
+        "--dp-mode ddp): feed each step's walltime to the passive drift "
+        "detector and run detect -> recalibrate -> re-rank every "
+        "--adapt-every steps; 'swap' additionally adopts the re-ranked "
+        "strategy through the epoch hot-swap.  ADAPCC_ADAPT overrides "
+        "(malformed value -> loud error); ADAPCC_DRIFT_FACTOR / "
+        "ADAPCC_DRIFT_WINDOW tune the detector",
+    )
+    p.add_argument(
+        "--adapt-every", type=int, default=8,
+        help="steps between adaptation passes (--adapt detect|swap)",
+    )
     return p
 
 
@@ -205,6 +219,19 @@ def make_workload(name: str, batch: int, rng):
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    # the adaptation mode actually in force (ADAPCC_ADAPT wins over the
+    # flag; malformed env/flag -> loud error before any engine side effects)
+    from adapcc_tpu.adapt import adapt_mode
+
+    adapt = adapt_mode(args.adapt)
+    if args.adapt_every < 1:
+        raise ValueError(f"--adapt-every must be >= 1, got {args.adapt_every}")
+    if adapt != "off" and args.dp_mode != "ddp":
+        raise ValueError(
+            "--adapt/ADAPCC_ADAPT requires --dp-mode ddp: the closed loop "
+            "re-ranks and hot-swaps the DDP gradient hook's strategy "
+            "(zero1/fsdp sync via GSPMD and carry no strategy to swap)"
+        )
     if args.dp_mode != "ddp":
         # sharded-state modes sync via GSPMD/psum, not the adaptive hook —
         # the relay/straggler machinery rides the hook, so reject the combo
@@ -394,6 +421,30 @@ def main(argv=None) -> None:
         if fault_plan is not None:
             print(f"fault injection: {fault_plan!r}")
 
+        # closed-loop online adaptation (docs/ADAPT.md): the controller
+        # rides the communicator's own seams (engine, synthesizer, tuning
+        # database, calibration artifact); step walltimes are its passive
+        # measurement feed — zero probe traffic
+        adapt_ctl = None
+        grad_bytes = 0
+        if adapt != "off":
+            # prewarm the TRAINER's step program for a winning candidate
+            # before adoption, so the swap is a cache hit there too (no
+            # recompile on the failover step).  The closure reads the live
+            # `state`, so the AOT trace sees the real shapes.  Banked
+            # trainer modes (async relay / error feedback) cannot prewarm
+            # — adoption falls back to the documented cold rebuild.
+            prewarm = None
+            if comm_args.is_bsp and not args.error_feedback:
+                prewarm = lambda s: trainer.prewarm(s, state, batch_fn())  # noqa: E731
+            adapt_ctl = AdapCC.communicator.adaptation_controller(
+                trainer=trainer, mode=args.adapt, trainer_prewarm=prewarm,
+            )
+            grad_bytes = sum(
+                leaf.nbytes for leaf in jax.tree_util.tree_leaves(params)
+            )
+            print(f"online adaptation: mode={adapt} every={args.adapt_every}")
+
         def run_step(step):
             nonlocal state
             # periodic re-adaptation (reference train_ddp.py:45-46)
@@ -403,9 +454,38 @@ def main(argv=None) -> None:
             mask = None
             if fault_plan is not None:
                 mask = jnp.asarray(fault_plan.mask_at(step))
+            t0 = time.perf_counter() if adapt_ctl is not None else 0.0
             state, loss = trainer.step(
                 state, batch_fn(), step_idx=step, active_mask=mask
             )
+            if adapt_ctl is not None:
+                # the block serializes the loop by design: the sample is
+                # the step's dispatch-to-completion walltime (the tuner's
+                # record-mode contract)
+                jax.block_until_ready(loss)
+                adapt_ctl.observe_step(time.perf_counter() - t0, grad_bytes)
+                if step > 0 and step % args.adapt_every == 0:
+                    rep = adapt_ctl.maybe_adapt()
+                    if rep.swapped:
+                        print(
+                            f"adapt: step {step} swapped to "
+                            f"{rep.winner_label} ({rep.winner_fingerprint}) "
+                            f"stall={rep.stall_s:.6f}s "
+                            f"trainer_hit={rep.trainer_adopt_hit}"
+                        )
+                    elif rep.outcome == "uninvertible":
+                        # step walltimes alone carry no link algebra, so a
+                        # pure-DDP loop can DETECT drift but not attribute
+                        # it to links — say so instead of silently idling
+                        print(
+                            f"adapt: step {step} drift detected but "
+                            "uninvertible (step-walltime evidence only; "
+                            "link-attributable samples — tuner-recorded "
+                            "engine dispatches — are needed to "
+                            "re-calibrate and swap)"
+                        )
+                    elif rep.outcome not in ("no-drift", "off"):
+                        print(f"adapt: step {step} {rep.outcome}")
             return loss
 
     t_last = time.perf_counter()

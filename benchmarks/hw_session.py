@@ -185,6 +185,7 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
             "busbw_ici_128m", "ring_smoke", "ring_chunk_sweep",
             "busbw_wire_dtype", "busbw_fused_wire", "tuner_convergence",
             "overlap_ab", "small_msg_crossover", "elastic_failover",
+            "online_adaptation",
         ):
             _skip(name, gate, out_path)
         return
@@ -330,6 +331,32 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
         900, out_path,
         extra_env={"ADAPCC_FAULT_PLAN": plan_path},
         rec_extra={"fault_plan": plan_path},
+    )
+    # online adaptation on real chips (the hardware twin of `make
+    # adapt-bench`, docs/ADAPT.md): the passive loop live inside a real
+    # DDP workload.  ADAPCC_ADAPT=swap arms the plane; the tight
+    # factor/window make a real drift (thermal, a congested ICI neighbor)
+    # *detectable* within the phase.  What the phase proves on hardware:
+    # a healthy run records zero swaps (the false-positive guard, live),
+    # and a step-time drift surfaces as the loud "uninvertible" line —
+    # step walltimes alone carry no link algebra, so the swap half needs
+    # link-attributable samples (tuner-recorded engine dispatches; the
+    # drift_loop benchmark and the CI drill pin that half on priced
+    # feeds).  The decay-merged calibration artifact, when a swap-capable
+    # feed exists, lands beside the run's other topology products
+    # (topology/calibration.json).
+    _run(
+        "online_adaptation",
+        [py, "-m", "adapcc_tpu.workloads.train_ddp", "--model", "mlp",
+         "--steps", "24", "--batch", "64", "--world", str(world),
+         "--sync-mode", "schedule", "--adapt", "swap",
+         "--adapt-every", "8"],
+        900, out_path,
+        extra_env={
+            "ADAPCC_DRIFT_FACTOR": "1.5",
+            "ADAPCC_DRIFT_WINDOW": "4",
+        },
+        rec_extra={"adapt": "swap"},
     )
 
 

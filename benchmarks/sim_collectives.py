@@ -61,18 +61,9 @@ def _graphs_from_model(
 ) -> Tuple[List[List[float]], List[List[float]]]:
     """(bandwidth [GB/s], latency [s]) matrices for the synthesizers, read
     off the calibrated coefficients so candidate *shapes* see the same
-    network the replay prices."""
-    w = model.world
-    bw = [[0.0] * w for _ in range(w)]
-    lat = [[0.0] * w for _ in range(w)]
-    for s in range(w):
-        for d in range(w):
-            if s == d:
-                continue
-            c = model.coeffs(s, d)
-            lat[s][d] = c.alpha
-            bw[s][d] = 1.0 / (c.beta * 1e9) if c.beta > 0 else 1e6
-    return bw, lat
+    network the replay prices (one definition:
+    :meth:`LinkCostModel.to_graphs`, shared with the online re-rank)."""
+    return model.to_graphs()
 
 
 def strategy_candidates(
@@ -773,6 +764,188 @@ def fault_sweep(
     return rows
 
 
+def adapt_sweep(
+    world: int,
+    sizes: Sequence[int],
+    hosts: int = 2,
+    model: Optional[LinkCostModel] = None,
+    drift_factor: float = 2.0,
+    drift_window: int = 4,
+    drift_onset: int = 4,
+    steps: int = 16,
+    degrade: float = 8.0,
+) -> List[dict]:
+    """Deterministic closed-adaptation-loop rows — the hardware-free
+    regression artifact for drift → re-calibration → re-rank → hot swap
+    (``make adapt-bench``, docs/ADAPT.md).
+
+    Two row families per payload size:
+
+    - **timeline** rows replay one drift incident through the REAL
+      :class:`~adapcc_tpu.adapt.DriftDetector`: per step, the observed
+      dispatch time is the calibrated model's own prediction (healthy
+      before ``drift_onset``, every DCN link ``degrade``× slower after —
+      exactly what a live run's medians converge to), with the detector's
+      ratio and fired bit stamped per step.  Detection lag (steps from
+      onset to fire) falls out of the rows.
+    - the **summary** row prices the incident end to end: the stale
+      strategy's steady state under the degraded costs vs the re-ranked
+      winner's (the sim-rank pass over the synthesizer's own candidate
+      pool, flat-ring incumbent listed first), and the two one-time
+      stalls — ``hot_swap_stall_us`` (the standby-cached epoch swap) vs
+      ``full_rebuild_stall_us`` (probe traffic + re-synthesis + cold
+      compile) via :func:`adapcc_tpu.sim.cost_model.adaptation_cost`, with
+      each arm's break-even step count.  Hot-swap stall is strictly below
+      the full rebuild's by construction — the acceptance property the
+      regression test pins.
+
+    Deterministic: no RNG, no wall clock — same calibration →
+    byte-identical rows.
+    """
+    from adapcc_tpu import sim
+    from adapcc_tpu.adapt import DriftDetector
+    from adapcc_tpu.sim.cost_model import (
+        DCN,
+        LinkCostModel as _Model,
+        adaptation_cost,
+        bottleneck_ring_coeffs,
+    )
+    from adapcc_tpu.strategy.ir import Strategy
+    from adapcc_tpu.tuner.db import TuningDatabase, TuningKey, size_bucket
+    from adapcc_tpu.tuner.policy import TuningPolicy
+
+    if drift_onset < drift_window:
+        raise ValueError(
+            f"drift_onset ({drift_onset}) must be >= drift_window "
+            f"({drift_window}): the detector needs one healthy window "
+            "before the incident or the control property is untestable"
+        )
+    if steps <= drift_onset:
+        raise ValueError(f"steps ({steps}) must exceed onset ({drift_onset})")
+    if degrade <= 1.0:
+        raise ValueError(f"degrade must be > 1, got {degrade}")
+    if model is None:
+        model = load_or_default(world=world)
+    elif model.world != world:
+        raise ValueError(f"model world {model.world} != sweep world {world}")
+    ips = {r: ip for r, ip in enumerate(_ip_table(world, max(2, hosts)))}
+    if model.ips is None:
+        model = model.with_ips(ips)
+    else:
+        ips = model.ips
+    # the degraded network: every DCN link `degrade`x slower (class + any
+    # per-link fits), ICI untouched — the inter-host drift the reference's
+    # variability study measures
+    classes = dict(model.classes)
+    classes[DCN] = classes[DCN].scaled(degrade)
+    links = {
+        l: (c.scaled(degrade) if model.link_class_of(*l) == DCN else c)
+        for l, c in model.links.items()
+    }
+    degraded_model = _Model(
+        world, links=links, classes=classes, ips=ips,
+        source=model.source + f"+dcn-x{degrade:g}",
+    )
+
+    def _pred(m: LinkCostModel, key: TuningKey, nbytes: int) -> float:
+        return TuningPolicy(
+            TuningDatabase(persist=False), world, "adapt-sweep", cost_model=m
+        ).prior_time(key, nbytes)
+
+    rows: List[dict] = []
+    for nbytes in sizes:
+        nbytes = int(nbytes)
+        key = TuningKey(
+            "allreduce", size_bucket(nbytes), world, "adapt-sweep",
+            "xla", 0, "off",
+        )
+        detector = DriftDetector(
+            world, "adapt-sweep", cost_model=model,
+            factor=drift_factor, window=drift_window,
+        )
+        healthy_obs = _pred(model, key, nbytes)
+        degraded_obs = _pred(degraded_model, key, nbytes)
+        detection_step: Optional[int] = None
+        for step in range(steps):
+            obs = healthy_obs if step < drift_onset else degraded_obs
+            detector.observe(key, obs, ts=float(step), nbytes=nbytes)
+            report = detector.check()
+            fired = report.drifted
+            if fired and detection_step is None:
+                detection_step = step
+            signal = report.signals[0] if report.signals else None
+            rows.append({
+                "mode": "simulated",
+                "collective": "allreduce",
+                "impl": "adapt",
+                "phase": "timeline",
+                "world": world,
+                "size_bytes": nbytes,
+                "step": step,
+                "degraded": step >= drift_onset,
+                "observed_us": round(obs * 1e6, 3),
+                "predicted_us": (
+                    round(signal.reference_s * 1e6, 3) if signal else None
+                ),
+                "ratio": round(signal.ratio, 6) if signal else None,
+                "fired": fired,
+                "calibration": model.source,
+            })
+        # the re-rank: the synthesizer's own candidate pool under the
+        # degraded costs, flat-ring incumbent (the stale strategy) first
+        incumbent = Strategy.ring(world, 1, ips)
+        candidates = [("incumbent", incumbent)] + strategy_candidates(
+            world, SIM_STRATEGIES, degraded_model, ips, degree=1
+        )
+        ranked = sim.rank_candidates(
+            candidates, degraded_model, nbytes, "allreduce"
+        )
+        stale = next(r.seconds for r in ranked if r.label == "incumbent")
+        winner = ranked[0]
+        cost = adaptation_cost(
+            world, nbytes, bottleneck_ring_coeffs(model, world),
+            stale_steady_s=stale, adapted_steady_s=winner.seconds,
+        )
+        rows.append({
+            "mode": "simulated",
+            "collective": "allreduce",
+            "impl": "adapt",
+            "phase": "summary",
+            "world": world,
+            "size_bytes": nbytes,
+            "drift_factor": float(drift_factor),
+            "drift_window": int(drift_window),
+            "drift_onset_step": int(drift_onset),
+            "detection_step": detection_step,
+            "detection_lag_steps": (
+                detection_step - drift_onset
+                if detection_step is not None else None
+            ),
+            "degrade": float(degrade),
+            "adapted_label": winner.label,
+            "stale_steady_us": round(cost["stale_steady_s"] * 1e6, 3),
+            "adapted_steady_us": round(cost["adapted_steady_s"] * 1e6, 3),
+            "hot_swap_stall_us": round(cost["hot_swap_stall_s"] * 1e6, 3),
+            "full_rebuild_stall_us": round(
+                cost["full_rebuild_stall_s"] * 1e6, 3
+            ),
+            "hot_swap_break_even_steps": (
+                round(cost["hot_swap_break_even_steps"], 3)
+                if cost["hot_swap_break_even_steps"] != float("inf") else None
+            ),
+            "full_rebuild_break_even_steps": (
+                round(cost["full_rebuild_break_even_steps"], 3)
+                if cost["full_rebuild_break_even_steps"] != float("inf")
+                else None
+            ),
+            "recovered": winner.seconds < stale,
+            "calibration": model.source,
+        })
+    if not rows:
+        raise ValueError(f"adapt sweep produced no rows: sizes={list(sizes)}")
+    return rows
+
+
 def tune_replay_sweep(
     world: int,
     sizes: Sequence[int],
@@ -943,6 +1116,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="latency-sweep algorithm grid",
     )
     ap.add_argument(
+        "--adapt-sweep", action="store_true",
+        help="replay the closed adaptation loop instead of the strategy "
+        "grid: per-step drift-detection timeline rows plus a summary row "
+        "pricing stale-vs-adapted steady state and hot-swap vs "
+        "full-rebuild stall (make adapt-bench; docs/ADAPT.md)",
+    )
+    ap.add_argument(
+        "--degrade-factor", type=float, default=8.0,
+        help="adapt-sweep DCN slowdown injected at the drift onset",
+    )
+    ap.add_argument(
         "--overlap-sweep", action="store_true",
         help="price the overlapped DDP gradient sync over (accum x "
         "bucket cap x overlap schedule) with overlapped_step_time instead "
@@ -968,6 +1152,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--overlap-sweep", args.overlap_sweep),
             ("--latency-sweep", args.latency_sweep),
             ("--fault-sweep", args.fault_sweep),
+            ("--adapt-sweep", args.adapt_sweep),
         ) if on
     ]
     if len(exclusive) > 1:
@@ -976,6 +1161,36 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ap.error(f"{' and '.join(exclusive)} are mutually exclusive; "
                  "run one sweep per invocation")
     model = load_or_default(args.calibration, world=args.world)
+    if args.adapt_sweep:
+        rows = adapt_sweep(
+            world=args.world,
+            sizes=[parse_size(s) for s in args.sizes.split(",")],
+            hosts=args.hosts,
+            model=model,
+            degrade=args.degrade_factor,
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            elif row["phase"] == "timeline":
+                star = "*" if row["fired"] else " "
+                print(
+                    f"[sim] adapt {row['size_bytes']:>12}B "
+                    f"step={row['step']:>2}{star} "
+                    f"obs={row['observed_us']:>10.1f}us  "
+                    f"ratio={row['ratio'] if row['ratio'] else 0:>7.3f}"
+                )
+            else:
+                print(
+                    f"[sim] adapt {row['size_bytes']:>12}B summary "
+                    f"lag={row['detection_lag_steps']} steps  "
+                    f"swap={row['hot_swap_stall_us']:>8.1f}us vs "
+                    f"rebuild={row['full_rebuild_stall_us']:>12.1f}us  "
+                    f"stale={row['stale_steady_us']:>10.1f}us -> "
+                    f"adapted={row['adapted_steady_us']:>10.1f}us "
+                    f"({row['adapted_label']})"
+                )
+        return 0
     if args.fault_sweep:
         rows = fault_sweep(
             world=args.world,

@@ -1,0 +1,811 @@
+"""Closed-loop online adaptation (docs/ADAPT.md).
+
+Covers the passive drift detector (calibration-priced and self-baseline
+references, the pinned false-positive guard, env knobs), the α-β
+re-calibration funnel (inversion, decay merge — never last-writer-wins —
+and the artifact hygiene stamps), the rd reduce-scatter/all-gather
+latency variants at the engine, and the end-to-end CPU drill: an injected
+degraded-link timing series fires the detector within the configured
+window, the re-ranked strategy is adopted via a dispatch-time cache
+switch (``cache_hit`` pinned, trainer ``recompiles`` unchanged), its
+sim-priced steady state under the corrected costs is strictly better than
+the stale strategy's, a healthy-timing control run performs ZERO swaps,
+and the whole decision trajectory is deterministic.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from adapcc_tpu.adapt import (
+    ADAPT_MODE_ENV,
+    AdaptationController,
+    DRIFT_FACTOR_ENV,
+    DRIFT_WINDOW_ENV,
+    DriftDetector,
+    adapt_mode,
+    calibration_of,
+    drift_correction,
+    resolve_drift_factor,
+    resolve_drift_window,
+)
+from adapcc_tpu.comm.engine import CollectiveEngine
+from adapcc_tpu.ddp import DDPTrainer, TrainState
+from adapcc_tpu.models import MLP
+from adapcc_tpu.primitives import ReduceOp
+from adapcc_tpu.sim.calibrate import Calibration, merge_calibration
+from adapcc_tpu.sim.cost_model import (
+    DCN,
+    ICI,
+    LinkCoeffs,
+    LinkCostModel,
+    adaptation_cost,
+    bottleneck_ring_coeffs,
+    full_rebuild_stall_s,
+    plan_swap_stall_s,
+    recursive_doubling_all_gather_time,
+    recursive_halving_reduce_scatter_time,
+)
+from adapcc_tpu.strategy.ir import Strategy
+from adapcc_tpu.strategy.synthesizer import Synthesizer
+from adapcc_tpu.tuner.db import TuningDatabase, TuningKey, size_bucket
+from adapcc_tpu.utils.observability import CollectiveTrace
+
+WORLD = 8
+IPS = {r: f"10.0.0.{r // 2}" for r in range(WORLD)}  # 4 hosts x 2 lanes
+TABLE = [IPS[r] for r in range(WORLD)]
+
+
+def _model(dcn_slowdown: float = 1.0) -> LinkCostModel:
+    return LinkCostModel(
+        WORLD,
+        classes={
+            ICI: LinkCoeffs(1e-6, 1.0 / 45e9),
+            DCN: LinkCoeffs(25e-6, 1.0 / 12.5e9).scaled(dcn_slowdown),
+        },
+        ips=IPS,
+        source=f"test-dcn-x{dcn_slowdown:g}",
+    )
+
+
+def _xla_key(nbytes: int, topology: str = "t") -> TuningKey:
+    return TuningKey(
+        "allreduce", size_bucket(nbytes), WORLD, topology, "xla", 0, "off"
+    )
+
+
+def _predicted(model: LinkCostModel, key: TuningKey) -> float:
+    det = DriftDetector(WORLD, key.topology, cost_model=model, window=4)
+    pred = det.predicted_s(key)
+    assert pred is not None and pred > 0
+    return pred
+
+
+# --------------------------------------------------------------------------- #
+# mode + knob envs
+# --------------------------------------------------------------------------- #
+
+def test_adapt_mode_resolution(monkeypatch):
+    monkeypatch.delenv(ADAPT_MODE_ENV, raising=False)
+    assert adapt_mode() == "off"
+    assert adapt_mode("detect") == "detect"
+    monkeypatch.setenv(ADAPT_MODE_ENV, "swap")
+    assert adapt_mode("off") == "swap"  # env wins
+    monkeypatch.setenv(ADAPT_MODE_ENV, "swapp")
+    with pytest.raises(ValueError, match="ADAPCC_ADAPT"):
+        adapt_mode()
+    monkeypatch.delenv(ADAPT_MODE_ENV, raising=False)
+    with pytest.raises(ValueError, match="ADAPCC_ADAPT"):
+        adapt_mode("on")
+
+
+def test_drift_knob_envs(monkeypatch):
+    monkeypatch.delenv(DRIFT_FACTOR_ENV, raising=False)
+    monkeypatch.delenv(DRIFT_WINDOW_ENV, raising=False)
+    assert resolve_drift_factor() == 2.0
+    assert resolve_drift_window() == 8
+    assert resolve_drift_factor(3.5) == 3.5
+    assert resolve_drift_window(4) == 4
+    monkeypatch.setenv(DRIFT_FACTOR_ENV, "1.5")
+    monkeypatch.setenv(DRIFT_WINDOW_ENV, "16")
+    assert resolve_drift_factor(9.0) == 1.5  # env wins
+    assert resolve_drift_window(4) == 16
+    monkeypatch.setenv(DRIFT_FACTOR_ENV, "fast")
+    with pytest.raises(ValueError, match="ADAPCC_DRIFT_FACTOR"):
+        resolve_drift_factor()
+    monkeypatch.setenv(DRIFT_FACTOR_ENV, "0.5")
+    with pytest.raises(ValueError, match="must be > 1"):
+        resolve_drift_factor()
+    monkeypatch.setenv(DRIFT_WINDOW_ENV, "1")
+    with pytest.raises(ValueError, match="must be >= 2"):
+        resolve_drift_window()
+
+
+# --------------------------------------------------------------------------- #
+# drift detector
+# --------------------------------------------------------------------------- #
+
+def test_detector_fires_within_window_on_degradation():
+    model = _model()
+    det = DriftDetector(WORLD, "t", cost_model=model, factor=2.0, window=4)
+    key = _xla_key(1 << 20)
+    pred = det.predicted_s(key)
+    for i in range(4):
+        det.observe(key, pred * (1.05 if i % 2 else 0.95))
+    assert not det.check().drifted
+    # the degradation lands: at most `window` degraded samples to fire
+    fired_after = None
+    for i in range(4):
+        det.observe(key, pred * 8.0)
+        if det.check().drifted:
+            fired_after = i + 1
+            break
+    assert fired_after is not None and fired_after <= 4
+    sig = det.check().fired[0]
+    assert sig.reference == "calibration" and sig.ratio >= 2.0
+    assert sig.key == key
+
+
+def test_detector_healthy_noise_never_fires():
+    """The pinned false-positive guard: sustained ±30% noise around the
+    prediction must not fire at the default factor — re-synthesis churn on
+    a healthy fabric is the failure mode hysteresis exists to prevent."""
+    model = _model()
+    det = DriftDetector(WORLD, "t", cost_model=model, factor=2.0, window=4)
+    key = _xla_key(1 << 20)
+    pred = det.predicted_s(key)
+    jitter = (0.7, 1.3, 0.9, 1.1, 1.25, 0.75, 1.0, 1.3)
+    for i in range(64):
+        det.observe(key, pred * jitter[i % len(jitter)])
+        assert not det.check().drifted, f"false positive at sample {i}"
+
+
+def test_detector_baseline_mode_for_step_cells():
+    """Cells no link model prices (ddp_step walltimes carry compute)
+    detect against the frozen first-window median."""
+    det = DriftDetector(WORLD, "t", cost_model=_model(), factor=2.0, window=4)
+    for i in range(8):
+        det.observe_step(0.010 * (1.1 if i % 2 else 0.9), nbytes=1 << 20)
+    rep = det.check()
+    assert rep.signals and rep.signals[0].reference == "baseline"
+    assert not rep.drifted
+    for _ in range(4):
+        det.observe_step(0.030, nbytes=1 << 20)
+    assert det.check().drifted  # 3x the healthy baseline
+    det.reset()
+    assert not det.check().signals
+
+
+def test_detector_normalizes_at_true_payload_not_bucket_edge():
+    """A payload just above a power of two lands in a bucket ~2x its
+    size; pricing the reference at the bucket would read its healthy
+    dispatches ~2x too fast and mask a genuine 2x degradation.  Feeds
+    that know the true payload normalize there: healthy ratio ~= 1, and a
+    2x degradation fires at the default factor."""
+    model = _model()
+    det = DriftDetector(WORLD, "t", cost_model=model, factor=2.0, window=4)
+    nbytes = (1 << 20) + (1 << 18)  # 1.25 MB -> 2 MB bucket
+    key = _xla_key(nbytes)
+    true_price = det._price_at(key, nbytes)
+    assert true_price < det.predicted_s(key)  # the bucket edge is bigger
+    for _ in range(4):
+        det.observe(key, true_price, nbytes=nbytes)
+    sig = det.check().signals[0]
+    assert sig.ratio == pytest.approx(1.0, rel=1e-6)
+    for _ in range(4):
+        det.observe(key, true_price * 2.0, nbytes=nbytes)
+    assert det.check().drifted, "a true 2x degradation must fire"
+
+
+def test_detector_ingest_db_is_idempotent_and_world_filtered():
+    model = _model()
+    det = DriftDetector(WORLD, "t", cost_model=model, factor=2.0, window=4)
+    key = _xla_key(1 << 20)
+    other_world = TuningKey("allreduce", 1 << 20, 4, "t", "xla", 0, "off")
+    db = TuningDatabase(persist=False)
+    pred = det.predicted_s(key)
+    for i in range(6):
+        db.record(key, pred * 8.0, ts=float(i))
+        db.record(other_world, 1.0, ts=float(i))
+    ingested, skipped = det.ingest_db(db)
+    assert ingested == 1 and skipped == 1
+    assert det.check().drifted
+    # re-ingesting the same database replaces, not double-counts
+    det.ingest_db(db)
+    assert det.check().fired[0].count == 4
+
+
+def test_detector_trace_feed():
+    from adapcc_tpu.utils.observability import TraceEvent
+
+    model = _model()
+    det = DriftDetector(WORLD, "t", cost_model=model, factor=2.0, window=2)
+    key = _xla_key(1 << 20)
+    pred = det.predicted_s(key)
+    events = [
+        TraceEvent(
+            ts=float(i), primitive="allreduce", impl="xla",
+            nbytes=(1 << 20) * WORLD, step=i,
+            extra={"duration_s": pred * 8.0, "algo": "ring"},
+        )
+        for i in range(3)
+    ]
+    ingested, _ = det.ingest_trace(events)
+    assert ingested == 3
+    assert det.check().drifted
+
+
+# --------------------------------------------------------------------------- #
+# re-calibration: inversion + decay merge + artifact hygiene
+# --------------------------------------------------------------------------- #
+
+def test_drift_correction_scales_bottleneck_class_only():
+    model = _model()
+    det = DriftDetector(WORLD, "t", cost_model=model, factor=2.0, window=4)
+    key = _xla_key(1 << 20)
+    pred = det.predicted_s(key)
+    for _ in range(4):
+        det.observe(key, pred * 10.0)
+    corr = drift_correction(det.check(), model, fingerprint="fp-t")
+    assert corr is not None
+    # the 4-host ring's bottleneck hop crosses hosts: the DCN class moves,
+    # the ICI class is untouched (absent from the correction artifact)
+    assert set(corr.classes) == {DCN}
+    base_dcn = model.classes[DCN]
+    ratio = corr.classes[DCN].time(1 << 17) / base_dcn.time(1 << 17)
+    assert 8.0 < ratio < 12.0  # ~the injected 10x
+    assert corr.fingerprint == "fp-t" and corr.samples == 4
+
+
+def test_drift_correction_two_sizes_fits_alpha_beta():
+    """With two payload decades observed, the correction is a real
+    least-squares (α, β) fit through the per-hop points — the
+    fit_alpha_beta funnel, not a blind scale."""
+    model = _model()
+    degraded = _model(10.0)
+    det = DriftDetector(WORLD, "t", cost_model=model, factor=2.0, window=4)
+    for nbytes in (1 << 16, 1 << 22):
+        key = _xla_key(nbytes)
+        obs = _predicted(degraded, key)
+        for _ in range(4):
+            det.observe(key, obs)
+    corr = drift_correction(det.check(), model)
+    assert corr is not None and DCN in corr.classes
+    fitted, true = corr.classes[DCN], degraded.classes[DCN]
+    # the inversion recovers the degraded line's shape at hop scale
+    for n in (1 << 14, 1 << 18, 1 << 22):
+        assert fitted.time(n) == pytest.approx(true.time(n), rel=0.35)
+
+
+def test_drift_correction_moves_per_link_fitted_models():
+    """A class-only correction under a per-link-fitted artifact (the
+    normal profiler/battery output) would be silently masked —
+    ``LinkCostModel.coeffs`` prefers per-link entries — and the loop
+    could never converge.  The correction must carry ratio-stretched
+    per-link entries for the corrected class, so the merged model's
+    predictions actually move and the detector stops firing."""
+    from adapcc_tpu.sim.calibrate import calibrate_from_matrices, merge_calibration
+
+    lat = np.full((WORLD, WORLD), 1e-5)
+    bw = np.full((WORLD, WORLD), 10.0)
+    np.fill_diagonal(lat, 0.0)
+    np.fill_diagonal(bw, 0.0)
+    base = calibrate_from_matrices(lat, bw, IPS, source="profiled")
+    model = base.cost_model()
+    assert model.links, "precondition: the artifact carries per-link fits"
+    det = DriftDetector(WORLD, "t", cost_model=model, factor=2.0, window=4)
+    key = _xla_key(1 << 20)
+    pred = det.predicted_s(key)
+    for _ in range(4):
+        det.observe(key, pred * 10.0)
+    corr = drift_correction(det.check(), model)
+    assert corr is not None and corr.links, "per-link correction missing"
+    merged = merge_calibration(base, corr, decay=0.5).cost_model()
+    det.set_cost_model(merged)
+    new_pred = det.predicted_s(key)
+    assert new_pred > 2.0 * pred, "merged model's prediction did not move"
+    # re-anchoring dropped the retired-reference windows; the SAME
+    # observed seconds, fed fresh against the caught-up model, no longer
+    # fire — the loop converges instead of re-correcting forever
+    assert not det.check().signals
+    for _ in range(4):
+        det.observe(key, pred * 10.0)
+    assert not det.check().drifted
+
+
+def test_detector_watermark_excludes_retired_plan_history():
+    """reset(watermark=...) must keep the tuning database's pre-swap
+    samples out of the windows — the db is never pruned, so without the
+    watermark the next ingest would replace the just-cleared windows with
+    exactly the evidence the reset discarded."""
+    model = _model()
+    det = DriftDetector(WORLD, "t", cost_model=model, factor=2.0, window=4)
+    key = _xla_key(1 << 20)
+    pred = det.predicted_s(key)
+    db = TuningDatabase(persist=False)
+    for i in range(6):
+        db.record(key, pred * 8.0, ts=100.0 + i)  # the OLD plan's drift
+    det.ingest_db(db)
+    assert det.check().drifted
+    det.reset(watermark=200.0)  # the swap happened at t=200
+    det.ingest_db(db)
+    assert not det.check().signals, "retired-plan history re-entered"
+    # post-swap samples enter normally and can fire again
+    for i in range(4):
+        db.record(key, pred * 8.0, ts=300.0 + i)
+    det.ingest_db(db)
+    assert det.check().drifted
+    # timestamped observe() honors the same floor; live (ts-less) passes
+    det.reset(watermark=400.0)
+    det.observe(key, pred * 8.0, ts=150.0)
+    assert not det._windows.get(key)
+
+
+def test_merge_calibration_decays_instead_of_overwriting():
+    base = Calibration(
+        WORLD, classes={ICI: LinkCoeffs(1e-6, 1e-11)}, samples=8,
+        source="base", fingerprint="fp-a",
+    )
+    update = Calibration(
+        WORLD, classes={ICI: LinkCoeffs(3e-6, 3e-11)}, samples=8,
+        source="recal", fingerprint="fp-a",
+    )
+    merged = merge_calibration(base, update, decay=0.5)
+    a = merged.classes[ICI].alpha
+    assert 1e-6 < a < 3e-6, "merge must blend, not last-writer-win"
+    # weights: 0.5*8 old vs 8 new -> 2/3 toward the update
+    assert a == pytest.approx((0.5 * 8 * 1e-6 + 8 * 3e-6) / (0.5 * 8 + 8))
+    assert merged.samples == 12
+    assert merged.provenance == ["base", "recal"]
+    assert merged.fingerprint == "fp-a"
+    # classes only the base knows survive untouched
+    base2 = Calibration(
+        WORLD,
+        classes={ICI: LinkCoeffs(1e-6, 1e-11), DCN: LinkCoeffs(9e-6, 9e-11)},
+        samples=4, source="b2",
+    )
+    merged2 = merge_calibration(base2, update, decay=0.5)
+    assert merged2.classes[DCN] == base2.classes[DCN]
+    with pytest.raises(ValueError, match="across worlds"):
+        merge_calibration(base, Calibration(4, classes={}), decay=0.5)
+    # cross-fabric merges refuse: blending two pods' fits and stamping
+    # the chimera with one fingerprint would defeat the hygiene stamps
+    other = Calibration(
+        WORLD, classes={ICI: LinkCoeffs(2e-6, 2e-11)}, samples=4,
+        source="elsewhere", fingerprint="fp-b",
+    )
+    with pytest.raises(ValueError, match="across fabrics"):
+        merge_calibration(base, other, decay=0.5)
+
+
+def test_calibration_stamps_round_trip(tmp_path):
+    cal = Calibration(
+        WORLD, classes={ICI: LinkCoeffs(1e-6, 1e-11)},
+        fingerprint="fp-x", samples=17, provenance=["a", "b"], source="s",
+    )
+    path = str(tmp_path / "calibration.json")
+    cal.save(path)
+    loaded = Calibration.load(path)
+    assert loaded.fingerprint == "fp-x"
+    assert loaded.samples == 17
+    assert loaded.provenance == ["a", "b"]
+    # pre-stamp artifacts (no hygiene fields) still load
+    raw = json.load(open(path))
+    for k in ("fingerprint", "samples", "provenance"):
+        raw.pop(k)
+    legacy = str(tmp_path / "legacy.json")
+    json.dump(raw, open(legacy, "w"))
+    old = Calibration.load(legacy)
+    assert old.fingerprint is None and old.samples == 0
+
+
+def test_load_or_default_warns_on_mismatch(tmp_path, capsys):
+    from adapcc_tpu.sim.calibrate import load_or_default
+
+    path = str(tmp_path / "calibration.json")
+    Calibration(
+        WORLD, classes={ICI: LinkCoeffs(1e-6, 1e-11)}, fingerprint="fp-old",
+    ).save(path)
+    load_or_default(path, world=WORLD, fingerprint="fp-old")
+    assert "WARNING" not in capsys.readouterr().err
+    load_or_default(path, world=WORLD, fingerprint="fp-new")
+    assert "fp-old" in capsys.readouterr().err
+    model = load_or_default(path, world=4)
+    err = capsys.readouterr().err
+    assert "world=4" in err and model.world == 4
+    # an artifact that PARSES but carries unusable values still falls
+    # back — this entry point must produce numbers either way
+    bad = str(tmp_path / "bad.json")
+    raw = json.load(open(path))
+    raw["world"] = 0
+    json.dump(raw, open(bad, "w"))
+    model = load_or_default(bad, world=WORLD)
+    assert model.world == WORLD and model.source == "defaults"
+    assert "unusable" in capsys.readouterr().err
+
+
+# --------------------------------------------------------------------------- #
+# adaptation pricing
+# --------------------------------------------------------------------------- #
+
+def test_adaptation_cost_hot_swap_strictly_below_rebuild():
+    coeffs = bottleneck_ring_coeffs(_model(), WORLD)
+    cost = adaptation_cost(
+        WORLD, 1 << 20, coeffs, stale_steady_s=2e-3, adapted_steady_s=1e-3
+    )
+    assert cost["hot_swap_stall_s"] < cost["full_rebuild_stall_s"]
+    assert cost["hot_swap_stall_s"] == plan_swap_stall_s(True)
+    assert cost["full_rebuild_stall_s"] == full_rebuild_stall_s(WORLD, coeffs)
+    assert (
+        cost["hot_swap_break_even_steps"]
+        < cost["full_rebuild_break_even_steps"]
+    )
+    no_gain = adaptation_cost(
+        WORLD, 1 << 20, coeffs, stale_steady_s=1e-3, adapted_steady_s=1e-3
+    )
+    assert no_gain["hot_swap_break_even_steps"] == float("inf")
+
+
+def test_rd_rs_ag_pricing_mirrors_allreduce_halves():
+    from adapcc_tpu.sim.cost_model import recursive_doubling_allreduce_time
+
+    coeffs = LinkCoeffs(1e-6, 1e-10)
+    n = 1 << 20
+    rs = recursive_halving_reduce_scatter_time(WORLD, n, coeffs)
+    ag = recursive_doubling_all_gather_time(WORLD, n, coeffs)
+    assert rs == ag  # mirrored (distance, size) pairs
+    assert rs + ag == pytest.approx(
+        recursive_doubling_allreduce_time(WORLD, n, coeffs)
+    )
+
+
+# --------------------------------------------------------------------------- #
+# rd reduce-scatter / all-gather at the engine (PR 8 REMAINING)
+# --------------------------------------------------------------------------- #
+
+def test_engine_rd_reduce_scatter_matches_xla_plane(mesh8):
+    trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh8, Strategy.ring(8), trace=trace)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 32)), jnp.float32)
+    ref = np.asarray(eng.reduce_scatter(x))
+    out = np.asarray(eng.reduce_scatter(x, algo="rd"))
+    np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)
+    ev = trace.events()[-1]
+    assert ev.impl == "rd" and ev.extra["algo"] == "rd"
+    # masked + AVG: identity contribution, active-count normalization
+    ref = np.asarray(
+        eng.reduce_scatter(x, active_gpus=[0, 1, 2, 3, 4, 6, 7],
+                           op=ReduceOp.AVG)
+    )
+    out = np.asarray(
+        eng.reduce_scatter(x, active_gpus=[0, 1, 2, 3, 4, 6, 7],
+                           op=ReduceOp.AVG, algo="rd")
+    )
+    np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)
+    # the default plane's trace now names its algorithm too
+    eng.reduce_scatter(x)
+    assert trace.events()[-1].extra["algo"] == "ring"
+
+
+def test_engine_rd_all_gather_matches_xla_plane(mesh8):
+    trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh8, Strategy.ring(8), trace=trace)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)
+    ref = np.asarray(eng.all_gather(x))
+    out = np.asarray(eng.all_gather(x, algo="rd"))
+    np.testing.assert_allclose(ref, out)
+    ev = trace.events()[-1]
+    assert ev.impl == "rd" and ev.extra["algo"] == "rd"
+    ref = np.asarray(eng.all_gather(x, active_gpus=[1, 2, 5]))
+    out = np.asarray(eng.all_gather(x, active_gpus=[1, 2, 5], algo="rd"))
+    np.testing.assert_allclose(ref, out)
+
+
+def test_engine_rd_rs_ag_support_funnel(mesh4):
+    from adapcc_tpu.comm.latency import latency_algo_unsupported_reason
+
+    # the funnel speaks per primitive: tree has no RS/AG variant
+    assert latency_algo_unsupported_reason(8, "tree") is None
+    assert "no 'tree' variant" in latency_algo_unsupported_reason(
+        8, "tree", primitive="reduce_scatter"
+    )
+    assert latency_algo_unsupported_reason(
+        8, "rd", primitive="all_gather"
+    ) is None
+    assert "power-of-two" in latency_algo_unsupported_reason(
+        6, "rd", primitive="reduce_scatter"
+    )
+    eng = CollectiveEngine(mesh4, Strategy.ring(4))
+    x = jnp.ones((4, 8), jnp.float32)
+    with pytest.raises(ValueError, match="no 'tree' variant"):
+        eng.reduce_scatter(x, algo="tree")
+    with pytest.raises(ValueError, match="no 'tree' variant"):
+        eng.all_gather(x, algo="tree")
+
+
+def test_engine_rd_rs_honors_env_pin(mesh8, monkeypatch):
+    from adapcc_tpu.comm.latency import COLL_ALGO_ENV
+
+    trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh8, Strategy.ring(8), trace=trace)
+    x = jnp.asarray(np.arange(8 * 16, dtype=np.float32).reshape(8, 16))
+    ref = np.asarray(eng.reduce_scatter(x))
+    monkeypatch.setenv(COLL_ALGO_ENV, "rd")
+    out = np.asarray(eng.reduce_scatter(x))
+    np.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)
+    assert trace.events()[-1].impl == "rd"
+    # a pinned variant the plane cannot run is loud, never a silent
+    # fallback under the pinned label
+    monkeypatch.setenv(COLL_ALGO_ENV, "tree")
+    with pytest.raises(ValueError, match="no 'tree' variant"):
+        eng.all_gather(x)
+
+
+# --------------------------------------------------------------------------- #
+# the end-to-end drill
+# --------------------------------------------------------------------------- #
+
+def _controller(engine, mode, model, cal_path=None, **kwargs):
+    return AdaptationController(
+        engine,
+        Synthesizer(None, TABLE),
+        mode=mode,
+        cost_model=model,
+        calibration_path=cal_path,
+        nbytes=1 << 20,
+        parallel_degree=2,
+        warm_shape=(64,),
+        fingerprint="fp-drill",
+        detector=DriftDetector(
+            WORLD, "fp-drill", cost_model=model, factor=2.0, window=4
+        ),
+        **kwargs,
+    )
+
+
+def _feed(ctl, model, scale: float, jitter=(0.95, 1.05)):
+    key = _xla_key(1 << 20, "fp-drill")
+    pred = _predicted(model, key)
+    for i in range(ctl.detector.window):
+        ctl.observe(key, pred * scale * jitter[i % 2])
+
+
+def test_e2e_drill_detect_swap_and_healthy_control(mesh8, tmp_path):
+    """The acceptance drill: degraded series → detector fires within the
+    window → re-calibration → re-rank → hysteresis-gated hot swap that
+    hits the standby cache, with the healthy control making zero swaps."""
+    healthy = _model()
+    degraded = _model(10.0)
+    trace = CollectiveTrace()
+    incumbent = Strategy.ring(WORLD, 1, IPS)
+    eng = CollectiveEngine(mesh8, incumbent, trace=trace)
+    cal_path = str(tmp_path / "calibration.json")
+    ctl = _controller(eng, "swap", healthy, cal_path)
+
+    # -- healthy control: ZERO swaps -------------------------------------
+    _feed(ctl, healthy, 1.0)
+    rep = ctl.maybe_adapt()
+    assert rep.outcome == "no-drift" and not rep.swapped
+    assert eng.strategy.fingerprint() == incumbent.fingerprint()
+    assert eng.epoch == 0 and ctl.swaps == 0
+
+    # -- the degradation lands in the measured series --------------------
+    _feed(ctl, degraded, 1.0)
+    assert ctl.check().drifted, "detector must fire within one window"
+    rep = ctl.maybe_adapt()
+    assert rep.swapped and rep.outcome == "swapped"
+    # the adopted strategy is a different shape
+    assert rep.winner_fingerprint != incumbent.fingerprint()
+    assert eng.strategy.fingerprint() == rep.winner_fingerprint
+    # its sim-priced steady state under the corrected costs is strictly
+    # better than the stale strategy's
+    assert rep.winner_pred_s < rep.incumbent_pred_s
+    # the calibration artifact was decay-merged and stamped
+    cal = Calibration.load(cal_path)
+    assert cal.fingerprint == "fp-drill" and cal.samples > 0
+    assert cal.provenance and cal.provenance[-1] == "drift-recal"
+    # the swap is a dispatch-time cache switch: first post-swap dispatch
+    # replays the AOT-warmed program
+    x = jnp.ones((WORLD, 64), jnp.float32)
+    eng.all_reduce(x, active_gpus=list(range(WORLD)))
+    ev = trace.events()[-1]
+    assert ev.extra["cache_hit"] is True
+    assert ev.extra["epoch"] == rep.epoch == 1
+    # fresh evidence required before any further adaptation
+    assert not ctl.check().drifted
+    assert ctl.maybe_adapt().outcome == "no-drift"
+
+
+def test_e2e_drill_detect_mode_reports_without_swapping(mesh8):
+    healthy = _model()
+    incumbent = Strategy.ring(WORLD, 1, IPS)
+    eng = CollectiveEngine(mesh8, incumbent)
+    ctl = _controller(eng, "detect", healthy)
+    _feed(ctl, _model(10.0), 1.0)
+    rep = ctl.maybe_adapt()
+    assert rep.outcome == "would-swap" and not rep.swapped
+    assert rep.recalibrated and rep.winner_fingerprint is not None
+    assert eng.strategy.fingerprint() == incumbent.fingerprint()
+    assert eng.epoch == 0
+
+
+def test_e2e_drill_off_mode_is_inert(mesh8, monkeypatch):
+    monkeypatch.delenv(ADAPT_MODE_ENV, raising=False)
+    eng = CollectiveEngine(mesh8, Strategy.ring(WORLD, 1, IPS))
+    ctl = _controller(eng, None, _model())
+    _feed(ctl, _model(10.0), 1.0)
+    rep = ctl.maybe_adapt()
+    assert rep.outcome == "off" and not rep.swapped
+
+
+def test_e2e_drill_trainer_swap_keeps_recompiles(mesh8, tmp_path):
+    """The trainer half of the acceptance drill: the adopted strategy's
+    step program was prewarmed, so adoption is a cache hit and
+    ``recompiles`` does not move across the swap + next step."""
+    model_def = MLP(features=(6, 3))
+    params = model_def.init(jax.random.PRNGKey(0), jnp.ones((1, 5)))
+    rng = np.random.default_rng(0)
+    bx = jnp.asarray(rng.normal(size=(8, 5)), jnp.float32)
+    by = jnp.asarray(rng.normal(size=(8, 3)), jnp.float32)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        return jnp.mean((model_def.apply(p, x) - y) ** 2)
+
+    tx = optax.sgd(0.1)
+    incumbent = Strategy.ring(WORLD, 1, IPS)
+    trainer = DDPTrainer(
+        loss_fn, tx, mesh8, incumbent, sync_mode="schedule",
+        dynamic_mask=True,
+    )
+    state = TrainState.create(params, tx)
+    state, _ = trainer.step(state, (bx, by))
+
+    eng = CollectiveEngine(mesh8, incumbent)
+    ctl = _controller(
+        eng, "swap", _model(), str(tmp_path / "cal.json"),
+        trainer=trainer,
+        trainer_prewarm=lambda s: trainer.prewarm(s, state, (bx, by)),
+    )
+    _feed(ctl, _model(10.0), 1.0)
+    rep = ctl.maybe_adapt()
+    assert rep.swapped
+    assert rep.trainer_adopt_hit is True, "adoption missed the prewarm"
+    warm_recompiles = trainer.recompiles
+    state, loss = trainer.step(state, (bx, by))
+    assert np.isfinite(np.asarray(loss)).all()
+    assert trainer.recompiles == warm_recompiles, "failover step recompiled"
+    assert trainer.hook.strategy.fingerprint() == rep.winner_fingerprint
+
+
+def test_e2e_decision_trajectory_is_deterministic(mesh8, tmp_path):
+    """Two fresh controllers fed the same series produce identical
+    decisions: same detection, same corrections, same ranking, same
+    winner — the whole trajectory is a function of the fed samples."""
+    rows = []
+    for run in range(2):
+        eng = CollectiveEngine(mesh8, Strategy.ring(WORLD, 1, IPS))
+        ctl = _controller(
+            eng, "detect", _model(), str(tmp_path / f"cal{run}.json")
+        )
+        _feed(ctl, _model(), 1.0)
+        first = ctl.maybe_adapt()
+        _feed(ctl, _model(10.0), 1.0)
+        second = ctl.maybe_adapt()
+        rows.append([
+            {k: v for k, v in r.to_row().items()
+             if k not in ("aot_warm_s", "stall_s")}
+            | {"ranked": r.ranked}
+            for r in (first, second)
+        ])
+    assert json.dumps(rows[0], sort_keys=True) == json.dumps(
+        rows[1], sort_keys=True
+    )
+    assert rows[0][1]["outcome"] == "would-swap"
+
+
+def test_uninvertible_drift_never_swaps(mesh8):
+    """Drift with no link algebra behind it (baseline-referenced step
+    cells only — e.g. a compute slowdown) must report ``uninvertible``
+    and stop: a compute regression must never hot-swap the comm strategy
+    on evidence that says nothing about links."""
+    eng = CollectiveEngine(mesh8, Strategy.ring(WORLD, 1, IPS))
+    ctl = _controller(eng, "swap", _model())
+    for _ in range(ctl.detector.window):
+        ctl.observe_step(0.010, nbytes=1 << 20)  # healthy baseline
+    assert ctl.maybe_adapt().outcome == "no-drift"
+    for _ in range(ctl.detector.window):
+        ctl.observe_step(0.050, nbytes=1 << 20)  # 5x step-time drift
+    rep = ctl.maybe_adapt()
+    assert rep.fired and rep.outcome == "uninvertible"
+    assert not rep.swapped and not rep.recalibrated
+    assert eng.epoch == 0
+
+
+def test_hysteresis_blocks_sub_margin_winners(mesh8):
+    """A challenger that does not beat the incumbent's prediction by the
+    margin keeps the incumbent — no plan flapping on thin evidence."""
+    healthy = _model()
+    eng = CollectiveEngine(mesh8, Strategy.ring(WORLD, 1, IPS))
+    ctl = _controller(eng, "swap", healthy, hysteresis_margin=1.0)
+    _feed(ctl, _model(10.0), 1.0)
+    rep = ctl.maybe_adapt()
+    # margin=1.0 demands a free lunch: nothing can beat it
+    assert rep.outcome == "hysteresis" and not rep.swapped
+    assert eng.epoch == 0
+
+
+def test_communicator_builds_wired_controller(tmp_path, mesh4):
+    from adapcc_tpu.communicator import Communicator
+    from adapcc_tpu.config import CommArgs
+    from adapcc_tpu.primitives import ALLREDUCE
+
+    args = CommArgs(
+        topology_dir=str(tmp_path),
+        strategy_file=str(tmp_path / "strategy.xml"),
+        logical_graph=str(tmp_path / "lg.xml"),
+    )
+    comm = Communicator(args, mesh=mesh4)
+    comm.init_threads(ALLREDUCE)
+    ctl = comm.adaptation_controller(mode="detect")
+    assert ctl.db is comm.tuner.db
+    assert ctl.fingerprint == comm.tuner.topology
+    assert ctl.calibration_path == str(tmp_path / "calibration.json")
+    assert ctl.engine is comm._engine(ALLREDUCE)
+    rep = ctl.maybe_adapt()  # nothing measured yet: clean no-drift pass
+    assert rep.outcome == "no-drift"
+
+
+# --------------------------------------------------------------------------- #
+# adapt-sweep artifact (make adapt-bench)
+# --------------------------------------------------------------------------- #
+
+def test_adapt_sweep_rows_byte_identical_and_priced():
+    from benchmarks.sim_collectives import adapt_sweep
+
+    sizes = [1 << 20, 16 << 20]
+    rows = adapt_sweep(8, sizes, hosts=2)
+    again = adapt_sweep(8, sizes, hosts=2)
+    assert [json.dumps(r, sort_keys=True) for r in rows] == [
+        json.dumps(r, sort_keys=True) for r in again
+    ]
+    assert all(r["mode"] == "simulated" for r in rows)
+    summaries = [r for r in rows if r["phase"] == "summary"]
+    timeline = [r for r in rows if r["phase"] == "timeline"]
+    assert len(summaries) == len(sizes)
+    assert len(timeline) == len(sizes) * 16
+    for s in summaries:
+        # detection within the configured window of the onset
+        assert s["detection_step"] is not None
+        assert 0 <= s["detection_lag_steps"] <= s["drift_window"]
+        # the acceptance A/B: hot swap strictly below the full rebuild
+        assert s["hot_swap_stall_us"] < s["full_rebuild_stall_us"]
+        assert s["recovered"] is True
+        assert s["adapted_steady_us"] < s["stale_steady_us"]
+    # no timeline row fires before the onset (the control property)
+    for r in timeline:
+        if r["step"] < 4:
+            assert not r["fired"], r
+
+
+def test_adapt_sweep_cli_exclusive_and_emits_json(capsys):
+    from benchmarks.sim_collectives import main
+
+    for other in (["--latency-sweep"], ["--fault-sweep"], ["--ring-sweep"]):
+        with pytest.raises(SystemExit):
+            main(["--adapt-sweep"] + other)
+    capsys.readouterr()
+    assert main([
+        "--adapt-sweep", "--world", "8", "--sizes", "1M", "--hosts", "2",
+        "--json",
+    ]) == 0
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rows and all(r["impl"] == "adapt" for r in rows)
+    assert {r["phase"] for r in rows} == {"timeline", "summary"}

@@ -2,9 +2,9 @@
 
 Every ```python block in docs/PARALLELISM.md, docs/OPERATIONS.md,
 docs/SIMULATION.md, docs/RING.md, docs/QUANT.md, docs/TUNER.md,
-docs/OVERLAP.md, docs/LATENCY.md and docs/ELASTIC.md runs verbatim on
-the virtual pod.  A snippet that stops compiling or produces wrong
-shapes fails here.
+docs/OVERLAP.md, docs/LATENCY.md, docs/ELASTIC.md and docs/ADAPT.md
+runs verbatim on the virtual pod.  A snippet that stops compiling or
+produces wrong shapes fails here.
 """
 
 import os
@@ -24,6 +24,7 @@ _TUNER = os.path.join(_DOCS_DIR, "TUNER.md")
 _OVERLAP = os.path.join(_DOCS_DIR, "OVERLAP.md")
 _LATENCY = os.path.join(_DOCS_DIR, "LATENCY.md")
 _ELASTIC = os.path.join(_DOCS_DIR, "ELASTIC.md")
+_ADAPT = os.path.join(_DOCS_DIR, "ADAPT.md")
 
 
 def _blocks(path):
@@ -214,3 +215,26 @@ def test_elastic_doc_covers_the_contract():
 def test_elastic_doc_snippet_runs(idx):
     code = _blocks(_ELASTIC)[idx]
     exec(compile(code, f"{_ELASTIC}:block{idx}", "exec"), {})
+
+
+def test_adapt_doc_has_snippets():
+    assert len(_blocks(_ADAPT)) >= 5
+
+
+def test_adapt_doc_covers_the_contract():
+    """The closed-adaptation-loop topics the runbook leans on must exist."""
+    text = open(_ADAPT).read()
+    for needle in (
+        "ADAPCC_ADAPT", "ADAPCC_DRIFT_FACTOR", "ADAPCC_DRIFT_WINDOW",
+        "DriftDetector", "drift_correction", "merge_calibration",
+        "resynthesize", "warm_strategy", "advance_epoch", "cache_hit",
+        "hysteresis", "make adapt-bench", "online_adaptation",
+        "fingerprint", "zero probe traffic",
+    ):
+        assert needle in text, f"ADAPT.md lost its {needle!r} coverage"
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_ADAPT))))
+def test_adapt_doc_snippet_runs(idx):
+    code = _blocks(_ADAPT)[idx]
+    exec(compile(code, f"{_ADAPT}:block{idx}", "exec"), {})

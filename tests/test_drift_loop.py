@@ -33,6 +33,23 @@ def test_drift_triggers_strategy_change():
     # the trace actually shows the collapse
     assert summary["bw_after_median"] < 0.5 * summary["bw_before_median"]
 
+    # -- hot-swap arm (docs/ADAPT.md): the A/B this PR adds --------------
+    hot = summary["hotswap"]
+    # attribution control holds on the passive arm too: zero swaps healthy
+    assert hot["control_swapped"] is False
+    # the passive detector fired within its window and the loop swapped
+    assert hot["fired"] and hot["detection_samples"] <= hot["window"]
+    assert hot["swapped"] and hot["strategy_changed"], hot
+    # the swap replayed a warmed program — a dispatch-time cache switch
+    assert hot["cache_hit"] is True
+    # the headline: hot-swap stall strictly below the full-rebuild stall,
+    # measured AND priced
+    assert summary["hotswap_stall_s"] < summary["rebuild_stall_s"], summary
+    priced = hot["priced"]
+    assert priced["hot_swap_stall_s"] < priced["full_rebuild_stall_s"]
+    # re-ranked winner strictly beats the stale strategy's steady state
+    assert priced["adapted_steady_s"] < priced["stale_steady_s"]
+
 
 def test_committed_drift_artifact():
     rows = [json.loads(l) for l in open(_ART) if l.strip()]
