@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench elastic-bench adapt-bench trace-export clean
+.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench elastic-bench adapt-bench chaos-bench trace-export clean
 
 all: native
 
@@ -95,6 +95,17 @@ elastic-bench:
 adapt-bench:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--world 8 --sizes 1M,16M --adapt-sweep --hosts 2 --json
+
+# Supervised-failover pricing on the same simulator (docs/SUPERVISOR.md):
+# deterministic "mode": "simulated" rows over the (heartbeat period x
+# grace) grid — out-of-band detection latency vs the false-positive
+# headroom the confirmation window buys — next to the standby-cached vs
+# cold swap stall, plus the canonical fault plan compiled into its
+# deterministic cross-process chaos schedule (SIGKILL / SIGSTOP duty
+# cycle), the spelling the multi-process drill delivers to real ranks.
+chaos-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--world 8 --sizes 16M,128M --chaos-sweep --json
 
 # Perfetto/chrome://tracing export of a recorded dispatch trace: run a
 # short virtual-pod collective session under ADAPCC_TUNER=record and emit

@@ -145,9 +145,13 @@ class Communicator:
         self.process_rank = 0
         self.num_processes = 1
         self._coordinator_server = None
+        self._coordinator_addr = None
         self._controller = None
         self._hooker = None
         self._controller_thread = None
+        self._heartbeat_client = None
+        self._heartbeat_thread = None
+        self._heartbeat_stop = None
         self._step_queue = None
         self._active_by_step: Dict[int, List[int]] = {}
         # per-step negotiate() round-trip cost (reference instruments its
@@ -484,11 +488,91 @@ class Communicator:
         if is_master:
             self._coordinator_server = CoordinatorServer(self.num_processes, ip=ip, port=port).start()
             port = self._coordinator_server.port  # resolves port=0 to the bound one
+        self._coordinator_addr = (ip, port)
         self._controller = Controller(ip, port)
         self._hooker = Hooker(ip, port)
         self._step_queue = _queue.Queue()
         self._controller_thread = threading.Thread(target=self._controller_loop, daemon=True)
         self._controller_thread.start()
+
+    def start_heartbeat(
+        self,
+        period_s: float = 1.0,
+        median_source=None,
+        gate=None,
+    ) -> None:
+        """Lease liveness to the supervisor daemon (docs/SUPERVISOR.md):
+        a background thread beats this process's rank through the
+        coordinator's heartbeat RPC every ``period_s``, optionally
+        carrying the recent step walltime ``median_source`` reports (the
+        slow-rank rule's evidence).  Requires :meth:`enable_coordinator`
+        first; idempotent per enable cycle."""
+        from adapcc_tpu.coordinator import HeartbeatClient
+
+        if getattr(self, "_coordinator_addr", None) is None:
+            raise RuntimeError(
+                "start_heartbeat needs enable_coordinator first (the "
+                "heartbeat leases through the coordinator channel)"
+            )
+        if getattr(self, "_heartbeat_thread", None) is not None:
+            return
+        import threading
+
+        ip, port = self._coordinator_addr
+        self._heartbeat_client = HeartbeatClient(ip, port, self.process_rank)
+        self._heartbeat_stop = threading.Event()
+        self._heartbeat_thread = threading.Thread(
+            target=self._heartbeat_client.run,
+            args=(period_s, self._heartbeat_stop),
+            kwargs={"median_source": median_source, "gate": gate},
+            name="adapcc-heartbeat",
+            daemon=True,
+        )
+        self._heartbeat_thread.start()
+
+    def supervisor(self, prim: int = ALLREDUCE, **kwargs):
+        """An autonomous :class:`~adapcc_tpu.supervisor.Supervisor` over
+        this world's seams: the ``prim`` engine, a chip-granular
+        coordinator logic, and a journal beside the other topology
+        artifacts unless overridden (docs/SUPERVISOR.md).
+
+        The supervisor's world is the CHIP world (the engine's): when the
+        in-process coordinator server runs at the same granularity (one
+        process per chip — the chaos-drill shape), its logic is shared so
+        real heartbeats feed the daemon; a process-granular server (one
+        process driving many chips) keeps its own world and the daemon
+        gets a standalone chip-world logic — its detection then rides the
+        fault-plan feed and any chip-granular heartbeats wired directly.
+        """
+        from adapcc_tpu.supervisor import Supervisor
+
+        engine = kwargs.pop("engine", None) or self._engine(prim)
+        logic = kwargs.pop("logic", None)
+        if logic is None:
+            if (
+                self._coordinator_server is not None
+                and self._coordinator_server.logic.world_size
+                == engine.world_size
+            ):
+                logic = self._coordinator_server.logic
+            else:
+                from adapcc_tpu.coordinator import CoordinatorLogic
+
+                logic = CoordinatorLogic(engine.world_size)
+        kwargs.setdefault("metrics", self.metrics)
+        kwargs.setdefault(
+            "journal_path",
+            os.path.join(self.args.topology_dir, "supervisor.journal"),
+        )
+        if "cache" not in kwargs:
+            # pre-rank every plausible shrink so the daemon's failover is
+            # a dispatch-time cache-key switch, not a cold re-plan
+            from adapcc_tpu.elastic import StandbyPlanCache
+
+            cache = StandbyPlanCache(engine)
+            cache.build()
+            kwargs["cache"] = cache
+        return Supervisor(logic, engine=engine, **kwargs)
 
     def calibrate_coordinator(self, total_grad_bytes: float) -> bool:
         """Feed measured quantities into the rent-or-buy cost model: the
@@ -568,7 +652,13 @@ class Communicator:
         this step (reference cuda_allreduce_hook → hook_fetch,
         commu.py:385-399).  If the coordinator is unreachable, training
         proceeds with every local participant active — the reference's
-        continue-with-alive-subset stance (README "fault tolerance")."""
+        continue-with-alive-subset stance (README "fault tolerance").
+
+        The client call runs under the ``ADAPCC_RPC_TIMEOUT_S`` deadline
+        with bounded jittered backoff; a dead coordinator surfaces as a
+        :class:`~adapcc_tpu.coordinator.CoordinatorUnavailable` (a
+        ``grpc.RpcError`` subclass, so it lands in the same handler)
+        within the budget instead of blocking indefinitely."""
         if self._hooker is None:
             return list(range(self.world_size))
         import grpc as _grpc
@@ -636,10 +726,17 @@ class Communicator:
         if self._controller_thread is not None:
             self._controller_thread.join(timeout=2)
             self._controller_thread = None
-        for client in (self._controller, self._hooker):
+        if self._heartbeat_stop is not None:
+            self._heartbeat_stop.set()
+        if self._heartbeat_thread is not None:
+            self._heartbeat_thread.join(timeout=2)
+            self._heartbeat_thread = None
+        for client in (self._controller, self._hooker, self._heartbeat_client):
             if client is not None:
                 client.close()
-        self._controller = self._hooker = None
+        self._controller = self._hooker = self._heartbeat_client = None
+        self._heartbeat_stop = None
+        self._coordinator_addr = None
         if self._coordinator_server is not None:
             self._coordinator_server.stop()
             self._coordinator_server = None

@@ -12,8 +12,19 @@ is wire-compatible with the reference's ``coordinator.proto``.
 from adapcc_tpu.coordinator.logic import CoordinatorLogic
 from adapcc_tpu.coordinator.service import (
     CoordinatorServer,
+    CoordinatorUnavailable,
     Controller,
+    HeartbeatClient,
     Hooker,
+    rpc_timeout_s,
 )
 
-__all__ = ["CoordinatorLogic", "CoordinatorServer", "Controller", "Hooker"]
+__all__ = [
+    "CoordinatorLogic",
+    "CoordinatorServer",
+    "CoordinatorUnavailable",
+    "Controller",
+    "HeartbeatClient",
+    "Hooker",
+    "rpc_timeout_s",
+]

@@ -97,6 +97,13 @@ class CoordinatorLogic:
         self._ready: Dict[int, List[int]] = defaultdict(list)
         self._frozen: Dict[int, List[int]] = {}
         self._heartbeats: Dict[int, List[int]] = defaultdict(list)
+        # liveness-lease funnel (docs/SUPERVISOR.md): per-rank beat count,
+        # last-beat monotonic timestamp, and the rank's self-reported
+        # recent step walltime — the raw inputs the supervisor's liveness
+        # state machine and slow-rank rule run over
+        self._beat_counts: Dict[int, int] = {}
+        self._beat_times: Dict[int, float] = {}
+        self._beat_medians: Dict[int, float] = {}
         self._shutdown = False
         self._worldview = WorldView.full(world_size)
         # plan-fold bookkeeping: the newest step whose fault state has been
@@ -193,7 +200,13 @@ class CoordinatorLogic:
                 # relay worker: the train has left, learn who's on it
                 return list(self._frozen[step])
 
-            self._ready[step].append(rank)
+            if rank not in self._ready[step]:
+                # idempotent arrival: the client retries a transport-level
+                # UNAVAILABLE (service.py _call_with_deadline), and gRPC can
+                # surface that AFTER the server processed the call (response
+                # lost to a reset) — a duplicate must not inflate the barrier
+                # count and freeze the step with a live rank missing
+                self._ready[step].append(rank)
             self._cond.notify_all()
 
             if len(self._ready[step]) > 1:
@@ -268,7 +281,11 @@ class CoordinatorLogic:
                 # injected-dead rank: its heartbeat is dropped at the funnel;
                 # it learns the alive picture like everyone else
                 return sorted(set(range(self.world_size)) - down), 0
-            self._heartbeats[step].append(rank)
+            if rank not in self._heartbeats[step]:
+                # idempotent like hook_arrive: a retried arrival whose first
+                # attempt's response was lost must not count twice toward
+                # the barrier
+                self._heartbeats[step].append(rank)
             self._cond.notify_all()
 
             expected = self.world_size - len(down)
@@ -327,6 +344,55 @@ class CoordinatorLogic:
             self._worldview = self._worldview.with_relays(slow)
             return self._worldview
 
+    def heartbeat_arrive(
+        self,
+        rank: int,
+        median_s: Optional[float] = None,
+        now: Optional[float] = None,
+    ) -> Tuple[List[int], int]:
+        """The liveness-lease funnel (docs/SUPERVISOR.md): record that
+        ``rank`` is alive *now* (and, optionally, its recent step
+        walltime — the slow-rank rule's evidence, reported by the
+        straggling process itself).  Returns ``(alive_list, epoch)`` so
+        the beating process observes membership changes passively.
+
+        Unlike the per-step barriers above, heartbeats never block: the
+        call is a timestamp write plus a worldview read.  Detection —
+        deciding that silence means death — is the supervisor's job
+        (:mod:`adapcc_tpu.supervisor.liveness`), not this funnel's; both
+        this funnel and the fault-plan injection feed the same
+        :meth:`worldview`.
+        """
+        if not 0 <= rank < self.world_size:
+            raise ValueError(
+                f"rank {rank} outside world [0, {self.world_size})"
+            )
+        with self._cond:
+            self._check_shutdown_locked()
+            self._beat_counts[rank] = self._beat_counts.get(rank, 0) + 1
+            self._beat_times[rank] = (
+                time.monotonic() if now is None else float(now)
+            )
+            if median_s is not None and median_s > 0:
+                self._beat_medians[rank] = float(median_s)
+            wv = self._worldview
+            return sorted(wv.alive), wv.epoch
+
+    def heartbeat_snapshot(self) -> Dict[int, dict]:
+        """Per-rank beat bookkeeping for the supervisor's sweep:
+        ``{rank: {"beats", "ts", "median_s"}}`` — only ranks that ever
+        beat appear (a rank silent since boot is the liveness table's
+        initial-lease case, not this snapshot's)."""
+        with self._cond:
+            return {
+                r: {
+                    "beats": self._beat_counts[r],
+                    "ts": self._beat_times[r],
+                    "median_s": self._beat_medians.get(r),
+                }
+                for r in self._beat_counts
+            }
+
     def mark_down(self, ranks) -> None:
         with self._cond:
             self._worldview = self._worldview.with_down(ranks)
@@ -334,6 +400,30 @@ class CoordinatorLogic:
     def mark_recovered(self, ranks) -> None:
         with self._cond:
             self._worldview = self._worldview.with_recovered(ranks)
+
+    def set_relays(self, ranks) -> None:
+        """Replace the relay set wholesale — the supervisor's demotion
+        actuator, merging its two slow-rank evidence streams (reported
+        step medians, injected ``slow`` events) into one target."""
+        with self._cond:
+            self._worldview = self._worldview.with_relays(ranks)
+
+    def restore_worldview(self, alive, relays, epoch: int):
+        """Impose a journald world picture (supervisor restart replay,
+        docs/SUPERVISOR.md §4).  Refuses to regress: a live view that
+        moved past the journal's epoch while the supervisor was down
+        stays — replay must reconstruct history, never rewrite it."""
+        from adapcc_tpu.elastic.worldview import WorldView
+
+        with self._cond:
+            if int(epoch) >= self._worldview.epoch:
+                self._worldview = WorldView(
+                    world_size=self.world_size,
+                    alive=frozenset(int(r) for r in alive),
+                    relays=frozenset(int(r) for r in relays),
+                    epoch=int(epoch),
+                )
+            return self._worldview
 
     def shutdown(self) -> None:
         """Drain every blocked waiter with :class:`CoordinatorShutdown`

@@ -272,6 +272,11 @@ class DDPTrainer:
         # switch — the training-loop twin of the engine's standby plan cache
         self._program_cache: dict = {}  # fingerprint → compiled step
         self._host_step = 0
+        # supervised mode (docs/SUPERVISOR.md): when an out-of-band
+        # Supervisor is attached, step() pulls its last ACTUATED
+        # contribution mask instead of negotiating — membership authority
+        # leaves the training loop entirely
+        self._supervisor = None
         # optional gradient-noise-scale measurement (units-test/get_gns.py):
         # the per-rank vs allreduced gradient norms fall out of the sync step
         # for free; the estimator is created at the first step, when the
@@ -659,7 +664,13 @@ class DDPTrainer:
         injecting their own skew signal; requires a dynamic-mask trainer).
         """
         self._check_state(state)
-        if self._compiled is None:
+        # local binding: an out-of-band supervisor's adopt_strategy may
+        # null self._compiled between this resolution and the dispatch
+        # below; the step then finishes on the outgoing program (exactly
+        # like a collective already in flight when an epoch bumps) and the
+        # NEXT step picks up the swapped one
+        fn = self._compiled
+        if fn is None:
             key = self._program_key()
             fn = self._program_cache.get(key)
             if fn is None:
@@ -693,6 +704,11 @@ class DDPTrainer:
                 "this trainer compiled a static full-world step; pass "
                 "dynamic_mask=True to drive explicit active masks"
             )
+        if active_mask is None and self._supervisor is not None:
+            # supervised mode (docs/SUPERVISOR.md): the out-of-band daemon
+            # owns detect → decide → swap; the step only OBSERVES its last
+            # actuated view — the trainer never makes a membership call
+            active_mask = jnp.asarray(self._supervisor.current_mask())
         if active_mask is None and self.hook.communicator is not None:
             active_mask = self.hook.negotiate(idx)
         args = [state, batch]
@@ -722,11 +738,11 @@ class DDPTrainer:
             import time as _time
 
             t0 = _time.perf_counter()
-            out = self._compiled(*args)
+            out = fn(*args)
             jax.block_until_ready(out)
             self._tune_observe(state, _time.perf_counter() - t0)
         else:
-            out = self._compiled(*args)
+            out = fn(*args)
         if not self.bsp:
             *out, self._deferred = out
         elif self.error_feedback:
@@ -1014,6 +1030,24 @@ class DDPTrainer:
             self.donate_state = saved_donate
         self._program_cache[key] = fn
         return True
+
+    def attach_supervisor(self, supervisor) -> "DDPTrainer":
+        """Hand membership authority to an out-of-band
+        :class:`~adapcc_tpu.supervisor.Supervisor` (docs/SUPERVISOR.md):
+        every ``step()`` without an explicit ``active_mask`` consumes the
+        daemon's last actuated view, and strategy swaps arrive through
+        :meth:`adopt_strategy` driven by the daemon — the trainer only
+        observes epoch bumps.  Requires a dynamic-mask step (the mask is
+        runtime state, so supervision never recompiles)."""
+        if supervisor is not None and not self._dynamic_mask:
+            raise ValueError(
+                "a supervised trainer needs dynamic_mask=True: the "
+                "supervisor's world changes arrive as runtime masks, and "
+                "a static full-world step could not shrink without a "
+                "retrace"
+            )
+        self._supervisor = supervisor
+        return self
 
     def adopt_strategy(self, strategy: Strategy) -> bool:
         """Hot-swap the training step onto ``strategy``.

@@ -196,6 +196,19 @@ class FaultPlan:
     def last_step(self) -> int:
         return max((e.step for e in self.events), default=0)
 
+    def chaos_schedule(self, step_period_s: float, **kwargs):
+        """The plan's **cross-process spelling** (docs/SUPERVISOR.md §5):
+        compile the step-indexed events into a wall-clock signal schedule
+        for real worker processes — ``down`` → SIGKILL, ``slow`` → a
+        SIGSTOP/SIGCONT duty cycle stretching wall time by the event's
+        ``slowdown`` (so the slow-rank demotion rule is exercised by a
+        genuinely straggling process), ``recover`` → SIGCONT.  Delegates
+        to :func:`adapcc_tpu.supervisor.chaos.wall_schedule`; pure and
+        deterministic like every other replay of this plan."""
+        from adapcc_tpu.supervisor.chaos import wall_schedule
+
+        return wall_schedule(self, step_period_s, **kwargs)
+
     # -- serialization ---------------------------------------------------------
 
     def to_dict(self) -> dict:

@@ -690,6 +690,39 @@ def detection_latency_s(
     return 0.5 * step_time_s + heartbeat_timeout_s
 
 
+def supervised_detection_latency_s(
+    heartbeat_period_s: float,
+    heartbeat_timeout_s: float,
+    grace: int,
+    sweep_period_s: float = 0.0,
+) -> float:
+    """Expected time from a rank dying to the supervisor daemon
+    *confirming* it dead (docs/SUPERVISOR.md): half a heartbeat period
+    (the death lands uniformly between two beats), the suspicion timeout,
+    the ``grace`` confirmation window (``grace`` further missed periods
+    — the price of the false-positive guard), and half a supervisor
+    sweep period to observe the transition.
+
+    Against :func:`detection_latency_s` (the in-loop controller barrier),
+    this is the out-of-band curve the chaos sweep prices: detection
+    latency is linear in both ``period`` and ``grace``, so the sweep's
+    rows make the trade — faster detection vs more false positives on a
+    jittery control plane — a printed number instead of folklore.
+    """
+    if heartbeat_period_s <= 0 or heartbeat_timeout_s < 0 or sweep_period_s < 0:
+        raise ValueError(
+            "heartbeat period must be > 0, timeout/sweep period >= 0"
+        )
+    if grace < 1:
+        raise ValueError(f"grace must be >= 1, got {grace}")
+    return (
+        0.5 * heartbeat_period_s
+        + heartbeat_timeout_s
+        + grace * heartbeat_period_s
+        + 0.5 * sweep_period_s
+    )
+
+
 def plan_swap_stall_s(
     standby_cached: bool,
     dispatch_s: float = DEFAULT_PLAN_SWAP_DISPATCH_S,

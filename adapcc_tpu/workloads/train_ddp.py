@@ -140,6 +140,20 @@ def build_parser() -> argparse.ArgumentParser:
         "--adapt-every", type=int, default=8,
         help="steps between adaptation passes (--adapt detect|swap)",
     )
+    p.add_argument(
+        "--supervisor", action="store_true",
+        help="autonomous supervisor daemon (docs/SUPERVISOR.md; requires "
+        "--dp-mode ddp): an out-of-band thread owns detect -> decide -> "
+        "swap — heartbeat/fault-plan detection, fsync'd decision journal "
+        "(topology/supervisor.journal), standby-cache failover, and the "
+        "--adapt loop when armed — while the training loop only observes "
+        "epoch bumps.  ADAPCC_SUPERVISOR=on|off overrides (malformed -> "
+        "loud error)",
+    )
+    p.add_argument(
+        "--supervisor-period", type=float, default=0.25,
+        help="supervisor poll cadence in seconds (--supervisor)",
+    )
     return p
 
 
@@ -231,6 +245,21 @@ def main(argv=None) -> None:
             "--adapt/ADAPCC_ADAPT requires --dp-mode ddp: the closed loop "
             "re-ranks and hot-swaps the DDP gradient hook's strategy "
             "(zero1/fsdp sync via GSPMD and carry no strategy to swap)"
+        )
+    # the supervisor mode actually in force (ADAPCC_SUPERVISOR wins over
+    # the flag; malformed env -> loud error before any engine side effects)
+    from adapcc_tpu.supervisor import supervisor_enabled
+
+    supervised = supervisor_enabled(args.supervisor)
+    if args.supervisor_period <= 0:
+        raise ValueError(
+            f"--supervisor-period must be > 0, got {args.supervisor_period}"
+        )
+    if supervised and args.dp_mode != "ddp":
+        raise ValueError(
+            "--supervisor/ADAPCC_SUPERVISOR requires --dp-mode ddp: the "
+            "daemon actuates the DDP gradient hook's strategy through the "
+            "standby cache (zero1/fsdp carry no strategy to swap)"
         )
     if args.dp_mode != "ddp":
         # sharded-state modes sync via GSPMD/psum, not the adaptive hook —
@@ -445,14 +474,68 @@ def main(argv=None) -> None:
             )
             print(f"online adaptation: mode={adapt} every={args.adapt_every}")
 
+        # autonomous supervisor (docs/SUPERVISOR.md): the daemon — not
+        # this loop — folds the fault plan (and any heartbeat silence)
+        # into the worldview, journals every decision, and actuates the
+        # standby-cache swap + trainer adoption; the loop only consumes
+        # the last actuated mask through the attached-trainer seam and
+        # retries EpochMismatch as it always did
+        supervisor = None
+        current_step = [0]
+        if supervised:
+            import os as _os
+
+            # a FRESH run must not replay the previous run's journal into
+            # its healthy world; an elastic restart of the SAME run (the
+            # replay case the journal exists for) is marked by the
+            # launcher's ADAPCC_RESTART_GEN and keeps it
+            journal_path = _os.path.join(
+                comm_args.topology_dir, "supervisor.journal"
+            )
+            if (
+                not _os.environ.get("ADAPCC_RESTART_GEN", "").strip()
+                and _os.path.exists(journal_path)
+            ):
+                _os.remove(journal_path)
+            supervisor = AdapCC.communicator.supervisor(
+                journal_path=journal_path,
+                trainer=trainer,
+                fault_plan=fault_plan,
+                step_source=(
+                    (lambda: current_step[0])
+                    if fault_plan is not None else None
+                ),
+                adapt=adapt_ctl,
+                # polls, not steps: the daemon's clock is its own
+                adapt_every=args.adapt_every if adapt_ctl is not None else 0,
+            )
+            trainer.attach_supervisor(supervisor)
+            if comm_args.is_bsp and not args.error_feedback and args.accum == 1:
+                # AOT-prewarm the step for the top standby plans so the
+                # daemon's adoption is a cache hit on the trainer plane too
+                for splan in supervisor.cache.ranked()[: supervisor.cache.top_k]:
+                    trainer.prewarm(splan.strategy, state, batch_fn())
+            supervisor.start(period_s=args.supervisor_period)
+            print(
+                f"supervisor: period={args.supervisor_period}s "
+                f"journal={supervisor.journal.path}"
+            )
+
         def run_step(step):
             nonlocal state
+            current_step[0] = step
+            if supervisor is not None and fault_plan is not None:
+                # the injected feed is STEP-indexed, so its natural clock
+                # is the step counter: one deterministic tick per step
+                # (the decisions stay the daemon's; wall-clock heartbeat
+                # detection keeps riding the background thread)
+                supervisor.poll()
             # periodic re-adaptation (reference train_ddp.py:45-46)
             if args.profile_freq and step > 0 and step % args.profile_freq == 0:
                 AdapCC.reconstruct_topology(comm_args, ALLREDUCE)
                 trainer.rebuild(AdapCC.communicator.strategy)
             mask = None
-            if fault_plan is not None:
+            if fault_plan is not None and supervisor is None:
                 mask = jnp.asarray(fault_plan.mask_at(step))
             t0 = time.perf_counter() if adapt_ctl is not None else 0.0
             state, loss = trainer.step(
@@ -464,7 +547,9 @@ def main(argv=None) -> None:
                 # record-mode contract)
                 jax.block_until_ready(loss)
                 adapt_ctl.observe_step(time.perf_counter() - t0, grad_bytes)
-                if step > 0 and step % args.adapt_every == 0:
+                if supervisor is not None:
+                    pass  # the daemon runs maybe_adapt on its own cadence
+                elif step > 0 and step % args.adapt_every == 0:
                     rep = adapt_ctl.maybe_adapt()
                     if rep.swapped:
                         print(
@@ -501,6 +586,15 @@ def main(argv=None) -> None:
             t_last = now
 
     if args.dp_mode == "ddp":
+        if supervisor is not None:
+            supervisor.stop()
+            wv = supervisor.worldview()
+            print(
+                f"supervisor: {supervisor.decisions} decisions, "
+                f"wv_epoch={wv.epoch} alive={sorted(wv.alive)} "
+                f"relays={sorted(wv.relays)} "
+                f"journal={supervisor.journal.path}"
+            )
         AdapCC.clear(ALLREDUCE)
 
 

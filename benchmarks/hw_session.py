@@ -178,6 +178,11 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
       measured ring ↔ recursive-doubling crossover the cost model predicts
       (docs/LATENCY.md).  Needs a power-of-two multi-chip world; explicit
       skip row otherwise.
+    - ``supervised_failover`` — the autonomous supervisor driving the
+      elastic_failover fault plan out of band (the hardware twin of
+      ``make chaos-bench``, docs/SUPERVISOR.md): daemon-journaled
+      detection + standby swap while the training loop only observes
+      epoch bumps; the decision journal rides beside the battery output.
     """
     gate = f"world={world} (needs multi-chip ICI)"
     if world < 2:
@@ -185,7 +190,7 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
             "busbw_ici_128m", "ring_smoke", "ring_chunk_sweep",
             "busbw_wire_dtype", "busbw_fused_wire", "tuner_convergence",
             "overlap_ab", "small_msg_crossover", "elastic_failover",
-            "online_adaptation",
+            "online_adaptation", "supervised_failover",
         ):
             _skip(name, gate, out_path)
         return
@@ -357,6 +362,45 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
             "ADAPCC_DRIFT_WINDOW": "4",
         },
         rec_extra={"adapt": "swap"},
+    )
+    # supervised failover on real chips (the hardware twin of `make
+    # chaos-bench`, docs/SUPERVISOR.md): the SAME fault plan as
+    # elastic_failover, but driven by the autonomous daemon — the
+    # supervisor (not the training loop) folds the plan, journals every
+    # decision (fsync'd, the artifact lands beside the battery output as
+    # the run's decision record), and actuates the standby swap while the
+    # loop only observes epoch bumps.  Against elastic_failover's phase
+    # walltime this prices the out-of-band detour; tight heartbeat knobs
+    # keep the daemon's confirmation window inside the phase.
+    sup_plan_path = os.path.join(
+        os.path.dirname(out_path),
+        f"sup_fault_plan_{os.path.basename(out_path)}.json",
+    )
+    with open(sup_plan_path, "w") as f:
+        json.dump(
+            {
+                "world": world,
+                "label": "battery-supervised-failover",
+                "events": [
+                    {"step": 4, "kind": "down", "rank": world - 1},
+                    {"step": 8, "kind": "recover", "rank": world - 1},
+                ],
+            },
+            f,
+        )
+    _run(
+        "supervised_failover",
+        [py, "-m", "adapcc_tpu.workloads.train_ddp", "--model", "mlp",
+         "--steps", "12", "--batch", "64", "--world", str(world),
+         "--sync-mode", "schedule", "--supervisor",
+         "--supervisor-period", "0.1"],
+        900, out_path,
+        extra_env={
+            "ADAPCC_FAULT_PLAN": sup_plan_path,
+            "ADAPCC_HEARTBEAT_TIMEOUT_S": "1.0",
+            "ADAPCC_HEARTBEAT_PERIOD_S": "0.25",
+        },
+        rec_extra={"fault_plan": sup_plan_path, "supervisor": True},
     )
 
 

@@ -764,6 +764,139 @@ def fault_sweep(
     return rows
 
 
+def chaos_sweep(
+    world: int,
+    sizes: Sequence[int],
+    model: Optional[LinkCostModel] = None,
+    periods: Sequence[float] = (0.25, 0.5, 1.0, 2.0),
+    graces: Sequence[int] = (1, 2, 4),
+    timeout_periods: int = 3,
+    sweep_period_s: float = 0.25,
+) -> List[dict]:
+    """Deterministic supervised-failover rows — the hardware-free
+    regression artifact for the autonomous supervisor (``make
+    chaos-bench``, docs/SUPERVISOR.md).
+
+    Two row families per payload size:
+
+    - **detection** rows (``phase: "detection"``) price the out-of-band
+      liveness machine over the (heartbeat period × grace) grid with
+      :func:`adapcc_tpu.sim.cost_model.supervised_detection_latency_s`
+      (suspicion after ``timeout_periods`` missed beats, confirmation
+      after ``grace`` further periods, half a supervisor sweep to
+      observe), next to the swap stall both ways and the degraded steady
+      state from :func:`failover_cost` — so the period/grace trade
+      (detection latency vs false-positive headroom, printed as
+      ``confirm_window_s``, the longest SIGSTOP pause a rank survives
+      undemoted) is data, not folklore;
+    - **schedule** rows (``phase: "schedule"``) compile the canonical
+      fault plan (rank dies → another straggles → both recover) into its
+      cross-process chaos spelling via
+      :meth:`~adapcc_tpu.elastic.faults.FaultPlan.chaos_schedule` — the
+      SIGKILL/SIGSTOP-duty-cycle action list the multi-process drill
+      delivers — and pins its deterministic shape (action counts, first
+      kill offset, stop/cont pairing).
+
+    Deterministic: same calibration → byte-identical rows.
+    """
+    from adapcc_tpu.elastic.faults import FaultEvent, FaultPlan
+    from adapcc_tpu.sim.cost_model import (
+        bottleneck_ring_coeffs,
+        failover_cost,
+        supervised_detection_latency_s,
+    )
+
+    if model is None:
+        model = load_or_default(world=world)
+    elif model.world != world:
+        raise ValueError(f"model world {model.world} != sweep world {world}")
+    coeffs = bottleneck_ring_coeffs(model, world)
+    slowdown = 4.0
+    plan = FaultPlan(
+        [
+            FaultEvent(step=2, kind="down", rank=world - 1),
+            FaultEvent(step=3, kind="slow", rank=1, slowdown=slowdown),
+            FaultEvent(step=6, kind="recover", rank=world - 1),
+            FaultEvent(step=7, kind="recover", rank=1),
+        ],
+        world=world,
+        label="canonical-failover",
+    )
+    rows: List[dict] = []
+    for nbytes in sizes:
+        healthy = None
+        for period in periods:
+            timeout = timeout_periods * period
+            for grace in graces:
+                detect = supervised_detection_latency_s(
+                    period, timeout, grace, sweep_period_s
+                )
+                cost = failover_cost(
+                    world, nbytes, coeffs, n_down=1,
+                    heartbeat_timeout_s=timeout, standby_cached=True,
+                )
+                cold = failover_cost(
+                    world, nbytes, coeffs, n_down=1,
+                    heartbeat_timeout_s=timeout, standby_cached=False,
+                )
+                healthy = cost["healthy_s"]
+                rows.append({
+                    "mode": "simulated",
+                    "collective": "allreduce",
+                    "impl": "supervisor",
+                    "phase": "detection",
+                    "world": world,
+                    "size_bytes": int(nbytes),
+                    "heartbeat_period_s": period,
+                    "heartbeat_timeout_s": timeout,
+                    "grace": int(grace),
+                    "sweep_period_s": sweep_period_s,
+                    "detection_us": round(detect * 1e6, 3),
+                    # the false-positive headroom the grace window buys:
+                    # a pause shorter than this never demotes the rank
+                    "confirm_window_s": round(
+                        timeout + grace * period, 9
+                    ),
+                    "swap_cached_us": round(cost["swap_s"] * 1e6, 3),
+                    "swap_cold_us": round(cold["swap_s"] * 1e6, 3),
+                    "degraded_ratio": round(cost["degraded_ratio"], 6),
+                    # steady-state collectives burnt while undetected
+                    "detection_steps_lost": round(detect / healthy, 1)
+                    if healthy > 0 else None,
+                    "calibration": model.source,
+                })
+        # the canonical plan's cross-process spelling at a step period of
+        # one healthy collective (floored so the schedule stays sane on a
+        # sub-microsecond sim step)
+        step_period = max(float(healthy or 0.0), 0.05)
+        schedule = plan.chaos_schedule(step_period)
+        kills = [a for a in schedule if a.kind == "kill"]
+        stops = [a for a in schedule if a.kind == "stop"]
+        conts = [a for a in schedule if a.kind == "cont"]
+        rows.append({
+            "mode": "simulated",
+            "collective": "allreduce",
+            "impl": "supervisor",
+            "phase": "schedule",
+            "scenario": plan.label,
+            "world": world,
+            "size_bytes": int(nbytes),
+            "step_period_s": round(step_period, 9),
+            "actions": len(schedule),
+            "kills": len(kills),
+            "stops": len(stops),
+            "conts": len(conts),
+            "first_kill_s": round(kills[0].at_s, 9) if kills else None,
+            "slowdown": slowdown,
+            # the duty cycle's invariant: every stop has a cont after it
+            "stop_cont_paired": len(stops) <= len(conts),
+            "calibration": model.source,
+        })
+    if not rows:
+        raise ValueError(f"chaos sweep produced no rows: sizes={list(sizes)}")
+    return rows
+
+
 def adapt_sweep(
     world: int,
     sizes: Sequence[int],
@@ -1105,6 +1238,22 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="fault-sweep heartbeat timeout priced into detection latency",
     )
     ap.add_argument(
+        "--chaos-sweep", action="store_true",
+        help="price the autonomous supervisor's out-of-band detection "
+        "over the (heartbeat period x grace) grid — detection latency vs "
+        "false-positive headroom — plus the canonical fault plan's "
+        "deterministic chaos (SIGKILL/SIGSTOP) schedule (make "
+        "chaos-bench; docs/SUPERVISOR.md)",
+    )
+    ap.add_argument(
+        "--hb-periods", default="0.25,0.5,1,2",
+        help="chaos-sweep heartbeat period grid (seconds)",
+    )
+    ap.add_argument(
+        "--hb-graces", default="1,2,4",
+        help="chaos-sweep confirmation-count grid",
+    )
+    ap.add_argument(
         "--latency-sweep", action="store_true",
         help="price the latency-bound allreduce algorithms (ring vs "
         "recursive doubling vs binomial tree) over --sizes instead of the "
@@ -1153,6 +1302,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--latency-sweep", args.latency_sweep),
             ("--fault-sweep", args.fault_sweep),
             ("--adapt-sweep", args.adapt_sweep),
+            ("--chaos-sweep", args.chaos_sweep),
         ) if on
     ]
     if len(exclusive) > 1:
@@ -1161,6 +1311,39 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ap.error(f"{' and '.join(exclusive)} are mutually exclusive; "
                  "run one sweep per invocation")
     model = load_or_default(args.calibration, world=args.world)
+    if args.chaos_sweep:
+        if args.hosts > 1:
+            # the liveness machine is topology-blind (a heartbeat is a
+            # heartbeat): silently accepting --hosts would read as
+            # "priced the multi-host layout" when nothing used it
+            ap.error("--hosts has no effect on --chaos-sweep")
+        rows = chaos_sweep(
+            world=args.world,
+            sizes=[parse_size(s) for s in args.sizes.split(",")],
+            model=model,
+            periods=[float(p) for p in args.hb_periods.split(",") if p],
+            graces=[int(g) for g in args.hb_graces.split(",") if g],
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            elif row["phase"] == "detection":
+                print(
+                    f"[sim] chaos {row['size_bytes']:>12}B "
+                    f"period={row['heartbeat_period_s']:>5}s "
+                    f"grace={row['grace']} "
+                    f"detect={row['detection_us']:>12.1f}us  "
+                    f"confirm_window={row['confirm_window_s']:>6.2f}s  "
+                    f"swap={row['swap_cached_us']:>7.1f}us"
+                )
+            else:
+                print(
+                    f"[sim] chaos {row['size_bytes']:>12}B schedule "
+                    f"{row['actions']} actions ({row['kills']} kill, "
+                    f"{row['stops']} stop/{row['conts']} cont) "
+                    f"first_kill={row['first_kill_s']}s"
+                )
+        return 0
     if args.adapt_sweep:
         rows = adapt_sweep(
             world=args.world,

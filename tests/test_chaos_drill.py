@@ -1,0 +1,360 @@
+"""End-to-end multi-process chaos drill (docs/SUPERVISOR.md §5).
+
+The acceptance drill for the autonomous supervisor: REAL worker processes
+lease liveness through the coordinator's heartbeat RPC over the wire, the
+deterministic chaos harness SIGKILLs one mid-run, and detection comes
+from genuine cross-process silence — no ``ADAPCC_FAULT_PLAN``, no
+injected arrivals.  The supervisor (out of band, on its own thread)
+confirms the death through the grace window, journals the decision, and
+actuates the standby-cache swap; the training loop only consumes the
+actuated mask.  Pinned:
+
+- the shrink is a standby-cache hit on BOTH planes (engine dispatch
+  trace ``cache_hit``; trainer ``recompiles`` unchanged);
+- the run completes with final loss within the pinned tolerance of an
+  uninterrupted baseline;
+- a supervisor restart mid-run replays its journal to an identical
+  WorldView with ZERO duplicate epoch bumps.
+
+A second drill SIGSTOP-duty-cycles a worker (the chaos spelling of a
+FaultPlan ``slow`` event): the genuinely straggling process's
+self-reported step walltimes inflate and the slow-rank rule demotes it
+to a relay — then promotes it back after SIGCONT.
+
+Wall-clock timing is involved (that is the point), so the knobs leave
+generous margins: workers beat every ~70 ms against a 2 s suspicion
+timeout; only multi-second stalls of a *live* worker could false-fire.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from adapcc_tpu.comm.engine import CollectiveEngine
+from adapcc_tpu.coordinator import CoordinatorLogic, CoordinatorServer
+from adapcc_tpu.ddp import DDPTrainer, TrainState
+from adapcc_tpu.elastic import FaultEvent, FaultPlan, StandbyPlanCache
+from adapcc_tpu.models import MLP
+from adapcc_tpu.strategy.ir import Strategy
+from adapcc_tpu.supervisor import (
+    ChaosInjector,
+    LivenessConfig,
+    Supervisor,
+)
+from adapcc_tpu.utils.observability import CollectiveTrace, MetricsRegistry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# A wire-compatible heartbeat worker with NO heavy imports (it must start
+# in milliseconds so the drill spends its wall clock on detection, not on
+# interpreter startup): the cont_request protobuf is two varint fields —
+# step (field 1: the step walltime in µs) and world_rank (field 2).
+WORKER = textwrap.dedent(
+    """
+    import sys, time
+    import grpc
+
+    rank, port, step_s = int(sys.argv[1]), int(sys.argv[2]), float(sys.argv[3])
+
+    def varint(n):
+        out = bytearray()
+        while True:
+            b = n & 0x7F
+            n >>= 7
+            out.append(b | (0x80 if n else 0))
+            if not n:
+                return bytes(out)
+
+    def cont_request(median_us, world_rank):
+        return b"\\x08" + varint(median_us) + b"\\x10" + varint(world_rank)
+
+    channel = grpc.insecure_channel(f"127.0.0.1:{port}")
+    beat = channel.unary_unary(
+        "/coordinator.Coordinator/heartbeat",
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    while True:
+        t0 = time.monotonic()
+        time.sleep(step_s)          # the "training step": SIGSTOP stretches it
+        dt = time.monotonic() - t0  # self-reported step walltime
+        try:
+            beat(cont_request(max(1, int(dt * 1e6)), rank), timeout=2.0)
+        except grpc.RpcError:
+            pass                    # keep leasing through control blips
+    """
+)
+
+
+def _spawn_workers(tmp_path, port, world, step_s):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    return {
+        r: subprocess.Popen(
+            [sys.executable, str(script), str(r), str(port), str(step_s)],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        for r in range(world)
+    }
+
+
+def _kill_all(procs):
+    for p in procs.values():
+        if p.poll() is None:
+            try:
+                p.send_signal(signal.SIGCONT)  # un-freeze before killing
+            except ProcessLookupError:
+                pass
+            p.kill()
+    for p in procs.values():
+        try:
+            p.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def _wait_for_beats(logic, world, deadline_s=30.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if len(logic.heartbeat_snapshot()) == world:
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        f"only {sorted(logic.heartbeat_snapshot())} of {world} workers "
+        "ever heartbeat"
+    )
+
+
+def test_chaos_drill_sigkill_detection_swap_and_restart(mesh4, tmp_path):
+    world, steps = 4, 40
+    model = MLP(features=(4, 2))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(world, 3)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(world, 2)), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x[:1])
+
+    def loss_fn(p, batch):
+        bx, by = batch
+        return jnp.mean((model.apply(p, bx) - by) ** 2)
+
+    def make_trainer():
+        return DDPTrainer(
+            loss_fn, optax.sgd(0.1), mesh4, Strategy.ring(world),
+            dynamic_mask=True, sync_mode="schedule",
+        )
+
+    # -- baseline: the uninterrupted run ------------------------------------
+    base_trainer = make_trainer()
+    base_state = TrainState.create(params, base_trainer.tx)
+    for _ in range(steps):
+        base_state, base_loss = base_trainer.step(base_state, (x, y))
+
+    # -- supervised run ------------------------------------------------------
+    assert not os.environ.get("ADAPCC_FAULT_PLAN", "").strip(), (
+        "the drill's detection must come from heartbeat loss alone"
+    )
+    trace = CollectiveTrace()
+    engine = CollectiveEngine(mesh4, Strategy.ring(world), trace=trace)
+    payload = jnp.ones((world, 2), jnp.float32)
+    engine.all_reduce(payload)
+    cache = StandbyPlanCache(engine, nbytes=payload.nbytes, top_k=world)
+    cache.build()
+    cache.warm((2,), jnp.float32)
+
+    trainer = make_trainer()
+    state = TrainState.create(params, trainer.tx)
+    state, _ = trainer.step(state, (x, y))  # compile the healthy step
+    for splan in cache.ranked():
+        trainer.prewarm(splan.strategy, state, (x, y))
+    warm_recompiles = trainer.recompiles
+    state = TrainState.create(params, trainer.tx)
+    trainer.reset()
+
+    logic = CoordinatorLogic(world)
+    srv = CoordinatorServer(world, port=0, logic=logic).start()
+    metrics = MetricsRegistry()
+    journal_path = str(tmp_path / "sup.journal")
+    config = LivenessConfig(timeout_s=2.0, period_s=0.25, grace=2)
+    sup = Supervisor(
+        logic, engine, cache=cache, trainer=trainer,
+        journal_path=journal_path, config=config, metrics=metrics,
+    )
+    trainer.attach_supervisor(sup)
+
+    procs = _spawn_workers(tmp_path, srv.port, world, step_s=0.05)
+    # the chaos harness, not the test, delivers the fault: the canonical
+    # one-rank-down plan compiled to its wall-clock SIGKILL schedule
+    plan = FaultPlan(
+        [FaultEvent(step=2, kind="down", rank=2)], world=world,
+        label="drill-sigkill",
+    )
+    injector = ChaosInjector(plan, step_period_s=1.0)  # kill at t≈2 s
+    try:
+        _wait_for_beats(logic, world)
+        sup.start(period_s=0.05)
+        injector.start({r: p.pid for r, p in procs.items()})
+
+        losses = []
+        masks_seen = set()
+        restarted = False
+        t0 = time.monotonic()
+        for step in range(steps):
+            mask = sup.current_mask()
+            masks_seen.add(tuple(mask.astype(int)))
+            state, loss = trainer.step(state, (x, y), step_idx=step)
+            losses.append(float(np.mean(np.asarray(loss))))
+            # the engine plane dispatches under the supervisor's epoch
+            wv = sup.applied_view
+            out = engine.all_reduce(
+                payload,
+                active_gpus=wv.active_list() if wv.degraded else None,
+                epoch=sup.engine_epoch,
+            )
+            assert float(np.asarray(out)[0, 0]) == len(wv.active_list())
+            if not restarted and sup.worldview().dead:
+                # -- supervisor restart mid-run (the crash-safety pin) --
+                restarted = True
+                view_before = sup.applied_view
+                epoch_before = engine.epoch
+                sup.stop()
+                sup = Supervisor(
+                    logic, engine, cache=cache, trainer=trainer,
+                    journal_path=journal_path, config=config,
+                    metrics=metrics,
+                )
+                assert sup.applied_view == view_before
+                assert engine.epoch == epoch_before, (
+                    "journal replay duplicated an epoch bump"
+                )
+                trainer.attach_supervisor(sup)
+                sup.start(period_s=0.05)
+            # pace the loop so detection has wall clock to happen in; exit
+            # early only if we somehow overrun the drill budget
+            time.sleep(0.12)
+            assert time.monotonic() - t0 < 60, "drill overran its budget"
+        sup.stop()
+        injector.stop()
+
+        # -- the fault really happened, detected from silence alone ----------
+        assert procs[2].wait(timeout=5) == -9, "chaos never killed rank 2"
+        st = sup.journal.replay()
+        kinds = [d.kind for d in st.decisions]
+        dead = [d for d in st.decisions if d.kind == "dead"]
+        assert len(dead) == 1 and dead[0].payload == {
+            "rank": 2, "origin": "heartbeat",
+        }, kinds
+        assert "suspect" in kinds  # the grace window was walked, not skipped
+        epochs = [d for d in st.decisions if d.kind == "epoch"]
+        assert len(epochs) == 1, (
+            f"expected exactly one epoch decision, got {kinds}"
+        )
+        assert epochs[0].payload["alive"] == [0, 1, 3]
+        assert st.unapplied == []
+
+        # -- the swap hit the standby cache on both planes -------------------
+        swap = next(d for d in st.decisions if d.kind == "swap")
+        assert swap.payload["warmed"] is True
+        failover_events = [
+            e for e in trace.events()
+            if e.primitive == "allreduce" and e.extra.get("epoch") == 1
+        ]
+        assert failover_events, "no dispatch recorded under the failover epoch"
+        assert failover_events[0].extra["cache_hit"] is True
+        assert trainer.recompiles == warm_recompiles, (
+            "the failover paid a trainer recompile the prewarm should "
+            "have absorbed"
+        )
+
+        # -- the run completed, and training carried through ------------------
+        assert len(losses) == steps and all(np.isfinite(losses))
+        assert (1, 1, 0, 1) in masks_seen, (
+            f"the actuated mask never excluded the dead rank: {masks_seen}"
+        )
+        final, base_final = losses[-1], float(np.mean(np.asarray(base_loss)))
+        assert abs(final - base_final) <= 0.05, (
+            f"drill final loss {final:.4f} vs baseline {base_final:.4f}"
+        )
+        # liveness observability rode along: per-rank gauges + decisions
+        snap = metrics.snapshot()
+        assert snap["gauges"]["liveness/rank2/state"] == 2.0
+        assert snap["counters"]["supervisor/decisions/dead"] == 1.0
+    finally:
+        sup.stop()
+        injector.stop()
+        _kill_all(procs)
+        srv.stop()
+
+
+def test_chaos_drill_sigstop_straggler_demoted_then_promoted(tmp_path):
+    """Satellite 3: a FaultPlan ``slow`` event's cross-process spelling —
+    the chaos injector SIGSTOP-duty-cycles a real worker, its
+    self-reported step walltimes inflate ~4x, and the supervisor's
+    slow-rank rule demotes the genuinely straggling process to a relay
+    (epoch bump), then promotes it back after SIGCONT.  Control-plane
+    only: no engine is needed to decide membership."""
+    world = 4
+    logic = CoordinatorLogic(world, slow_factor=2.0)
+    srv = CoordinatorServer(world, port=0, logic=logic).start()
+    sup = Supervisor(
+        logic,
+        journal_path=str(tmp_path / "sup.journal"),
+        config=LivenessConfig(timeout_s=3.0, period_s=0.25, grace=2),
+    )
+    procs = _spawn_workers(tmp_path, srv.port, world, step_s=0.1)
+    # slow from t≈1 s to t≈5 s at slowdown 4 (stopped 75% of each window)
+    plan = FaultPlan(
+        [FaultEvent(step=1, kind="slow", rank=1, slowdown=4.0),
+         FaultEvent(step=5, kind="recover", rank=1)],
+        world=world,
+        label="drill-sigstop",
+    )
+    injector = ChaosInjector(plan, step_period_s=1.0)
+    try:
+        _wait_for_beats(logic, world)
+        sup.start(period_s=0.1)
+        injector.start({r: p.pid for r, p in procs.items()})
+
+        def wait_relays(want, deadline_s, what):
+            deadline = time.monotonic() + deadline_s
+            while time.monotonic() < deadline:
+                if sup.worldview().relays == want:
+                    return
+                time.sleep(0.1)
+            raise AssertionError(
+                f"{what}: relays={sorted(sup.worldview().relays)}, "
+                f"medians={sup.table.medians()}"
+            )
+
+        # demotion while the duty cycle runs...
+        wait_relays(frozenset({1}), 8.0, "straggler never demoted")
+        assert sorted(sup.worldview().alive) == [0, 1, 2, 3], (
+            "a straggler is demoted, not dead: SIGSTOP blips inside the "
+            "grace window must not kill the rank"
+        )
+        # ...promotion once SIGCONT lets it catch back up (the rolling
+        # median needs a few healthy steps to fall below the factor)
+        wait_relays(frozenset(), 20.0, "recovered straggler never promoted")
+        st = sup.journal.replay()
+        kinds = [d.kind for d in st.decisions]
+        demote = next(d for d in st.decisions if d.kind == "demote")
+        assert demote.payload["ranks"] == [1]
+        assert float(demote.payload["medians"]["1"]) > 0.2  # really slow
+        assert "promote" in kinds
+        assert "dead" not in kinds, kinds
+        assert sup.worldview().epoch >= 2  # demote + promote both bumped
+    finally:
+        sup.stop()
+        injector.stop()
+        _kill_all(procs)
+        srv.stop()

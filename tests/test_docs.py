@@ -2,9 +2,9 @@
 
 Every ```python block in docs/PARALLELISM.md, docs/OPERATIONS.md,
 docs/SIMULATION.md, docs/RING.md, docs/QUANT.md, docs/TUNER.md,
-docs/OVERLAP.md, docs/LATENCY.md, docs/ELASTIC.md and docs/ADAPT.md
-runs verbatim on the virtual pod.  A snippet that stops compiling or
-produces wrong shapes fails here.
+docs/OVERLAP.md, docs/LATENCY.md, docs/ELASTIC.md, docs/ADAPT.md and
+docs/SUPERVISOR.md runs verbatim on the virtual pod.  A snippet that
+stops compiling or produces wrong shapes fails here.
 """
 
 import os
@@ -25,6 +25,7 @@ _OVERLAP = os.path.join(_DOCS_DIR, "OVERLAP.md")
 _LATENCY = os.path.join(_DOCS_DIR, "LATENCY.md")
 _ELASTIC = os.path.join(_DOCS_DIR, "ELASTIC.md")
 _ADAPT = os.path.join(_DOCS_DIR, "ADAPT.md")
+_SUPERVISOR = os.path.join(_DOCS_DIR, "SUPERVISOR.md")
 
 
 def _blocks(path):
@@ -238,3 +239,28 @@ def test_adapt_doc_covers_the_contract():
 def test_adapt_doc_snippet_runs(idx):
     code = _blocks(_ADAPT)[idx]
     exec(compile(code, f"{_ADAPT}:block{idx}", "exec"), {})
+
+
+def test_supervisor_doc_has_snippets():
+    assert len(_blocks(_SUPERVISOR)) >= 5
+
+
+def test_supervisor_doc_covers_the_contract():
+    """The out-of-band supervision topics the runbook leans on."""
+    text = open(_SUPERVISOR).read()
+    for needle in (
+        "ADAPCC_SUPERVISOR", "ADAPCC_RPC_TIMEOUT_S",
+        "ADAPCC_HEARTBEAT_TIMEOUT_S", "ADAPCC_HEARTBEAT_PERIOD_S",
+        "ADAPCC_HEARTBEAT_GRACE", "CoordinatorUnavailable",
+        "HeartbeatClient", "LivenessTable", "DecisionJournal", "fsync",
+        "zero duplicate epoch bumps", "chaos_schedule", "SIGKILL",
+        "SIGSTOP", "cache_hit", "make chaos-bench", "supervised_failover",
+        "attach_supervisor", "train_ddp --supervisor",
+    ):
+        assert needle in text, f"SUPERVISOR.md lost its {needle!r} coverage"
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_SUPERVISOR))))
+def test_supervisor_doc_snippet_runs(idx):
+    code = _blocks(_SUPERVISOR)[idx]
+    exec(compile(code, f"{_SUPERVISOR}:block{idx}", "exec"), {})

@@ -1,0 +1,803 @@
+"""Autonomous supervisor daemon (docs/SUPERVISOR.md).
+
+Covers the liveness state machine (healthy → suspected → dead with the
+grace-window false-positive guard), the fsync'd write-ahead decision
+journal (torn-tail tolerance, monotone-seq enforcement, crash-window
+replay with zero double-actuation), the supervisor's detect → decide →
+swap loop over a real engine + standby cache (heartbeat-silence and
+fault-plan feeds into ONE worldview, standby cache hit pinned from the
+dispatch trace, liveness table in the trace extras, metrics gauges),
+the coordinator heartbeat RPC + client-side deadlines
+(``CoordinatorUnavailable`` within ``ADAPCC_RPC_TIMEOUT_S``), and the
+chaos harness's deterministic schedule compilation.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adapcc_tpu.comm.engine import CollectiveEngine
+from adapcc_tpu.coordinator import (
+    CoordinatorLogic,
+    CoordinatorServer,
+    CoordinatorUnavailable,
+    HeartbeatClient,
+    Hooker,
+)
+from adapcc_tpu.elastic import FaultEvent, FaultPlan, StandbyPlanCache
+from adapcc_tpu.strategy.ir import Strategy
+from adapcc_tpu.supervisor import (
+    DEAD,
+    HEALTHY,
+    SUSPECTED,
+    BeatChaos,
+    ChaosInjector,
+    DecisionJournal,
+    LivenessConfig,
+    LivenessTable,
+    Supervisor,
+    supervisor_enabled,
+    wall_schedule,
+)
+from adapcc_tpu.utils.observability import CollectiveTrace, MetricsRegistry
+
+
+# --------------------------------------------------------------------------- #
+# liveness state machine
+# --------------------------------------------------------------------------- #
+
+def test_liveness_escalates_healthy_suspected_dead():
+    cfg = LivenessConfig(timeout_s=1.0, period_s=0.5, grace=2)
+    tab = LivenessTable(4, cfg, now=0.0)
+    assert tab.sweep(0.9) == []                       # inside the timeout
+    assert {t[2] for t in tab.sweep(1.5)} == {SUSPECTED}
+    # confirm window = timeout + grace*period = 2.0; not there yet
+    assert tab.sweep(1.9) == []
+    dead = tab.sweep(2.1)
+    assert {t[1:] for t in dead} == {(SUSPECTED, DEAD)}
+    assert sorted(tab.dead()) == [0, 1, 2, 3]
+
+
+def test_liveness_false_positive_guard_within_grace():
+    """A paused-then-resumed rank inside the grace window is NEVER
+    demoted: suspicion clears on the next beat, and the dead transition
+    never fires — the property the SIGSTOP chaos blip rides on."""
+    cfg = LivenessConfig(timeout_s=1.0, period_s=0.5, grace=2)
+    tab = LivenessTable(2, cfg, now=0.0)
+    tab.beat(0, 0.4)
+    tab.beat(1, 0.4)
+    # rank 1 pauses: silence past the timeout -> suspected, not dead
+    tab.beat(0, 1.6)
+    assert tab.sweep(1.6) == [(1, HEALTHY, SUSPECTED)]
+    # ...resumes before the confirm window (0.4 + 2.0 = 2.4) expires
+    t = tab.beat(1, 2.2)
+    assert t == (1, SUSPECTED, HEALTHY)
+    assert tab.sweep(2.3) == []
+    assert tab.state(1) == HEALTHY and tab.dead() == []
+
+
+def test_liveness_sweep_is_cadence_independent():
+    """Transitions are a pure function of (timestamps, now): sweeping
+    once late sees exactly what sweeping every tick saw."""
+    cfg = LivenessConfig(timeout_s=1.0, period_s=0.5, grace=1)
+    fine, coarse = (
+        LivenessTable(2, cfg, now=0.0),
+        LivenessTable(2, cfg, now=0.0),
+    )
+    for t in np.arange(0.1, 3.0, 0.1):
+        fine.sweep(float(t))
+    coarse.sweep(3.0)
+    assert fine.dead() == coarse.dead() == [0, 1]
+
+
+def test_liveness_medians_and_gauges():
+    cfg = LivenessConfig(timeout_s=10.0, period_s=1.0, grace=1)
+    tab = LivenessTable(3, cfg, now=0.0)
+    for s in (0.05, 0.06, 0.07):
+        tab.beat(0, 1.0, median_s=s)
+    tab.beat(1, 1.0, median_s=0.2)
+    assert tab.medians() == {0: 0.06, 1: 0.2}
+    metrics = MetricsRegistry()
+    tab.sweep(2.5)
+    tab.export_gauges(metrics, 2.5)
+    g = metrics.snapshot()["gauges"]
+    assert g["liveness/rank0/age_s"] == pytest.approx(1.5)
+    assert g["liveness/rank2/age_s"] == pytest.approx(2.5)
+    assert g["liveness/rank0/state"] == 0
+    assert g["liveness/rank2/missed"] == 2.0
+
+
+def test_liveness_env_knobs_are_loud(monkeypatch):
+    monkeypatch.setenv("ADAPCC_HEARTBEAT_PERIOD_S", "fast")
+    with pytest.raises(ValueError, match="ADAPCC_HEARTBEAT_PERIOD_S"):
+        LivenessConfig.from_env()
+    monkeypatch.setenv("ADAPCC_HEARTBEAT_PERIOD_S", "0.5")
+    monkeypatch.setenv("ADAPCC_HEARTBEAT_GRACE", "0")
+    with pytest.raises(ValueError, match="ADAPCC_HEARTBEAT_GRACE"):
+        LivenessConfig.from_env()
+    monkeypatch.setenv("ADAPCC_HEARTBEAT_GRACE", "3")
+    monkeypatch.setenv("ADAPCC_HEARTBEAT_TIMEOUT_S", "2.5")
+    cfg = LivenessConfig.from_env()
+    assert (cfg.timeout_s, cfg.period_s, cfg.grace) == (2.5, 0.5, 3)
+
+
+def test_supervisor_env_gate_is_loud(monkeypatch):
+    monkeypatch.setenv("ADAPCC_SUPERVISOR", "maybe")
+    with pytest.raises(ValueError, match="ADAPCC_SUPERVISOR"):
+        supervisor_enabled()
+    monkeypatch.setenv("ADAPCC_SUPERVISOR", "on")
+    assert supervisor_enabled(False) is True
+    monkeypatch.setenv("ADAPCC_SUPERVISOR", "off")
+    assert supervisor_enabled(True) is False
+
+
+# --------------------------------------------------------------------------- #
+# decision journal
+# --------------------------------------------------------------------------- #
+
+def test_journal_round_trip_and_applied_markers(tmp_path):
+    j = DecisionJournal(str(tmp_path / "j.journal"))
+    j.append("suspect", rank=2)
+    d = j.append("epoch", alive=[0, 1, 3], relays=[], wv_epoch=1)
+    j.mark_applied(d.seq)
+    st = j.replay()
+    assert [x.kind for x in st.decisions] == ["suspect", "epoch"]
+    assert st.applied == {d.seq}
+    assert st.unapplied == []
+    assert st.last_view == {"alive": [0, 1, 3], "relays": [], "wv_epoch": 1}
+
+
+def test_journal_tolerates_torn_tail_only(tmp_path):
+    path = str(tmp_path / "j.journal")
+    j = DecisionJournal(path)
+    j.append("suspect", rank=1)
+    j.append("epoch", alive=[0], relays=[], wv_epoch=1)
+    j.close()
+    with open(path, "a") as f:  # the crash-mid-write window
+        f.write('{"v": 1, "seq": 2, "kind": "de')
+    st = DecisionJournal(path).replay()
+    assert len(st.decisions) == 2  # torn tail dropped, not fatal
+    # corruption anywhere ELSE is loud
+    lines = open(path).read().splitlines()
+    lines[0] = "garbage"
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with pytest.raises(ValueError, match="corrupt journal record"):
+        DecisionJournal(path)
+
+
+def test_journal_rejects_broken_seq_chain(tmp_path):
+    path = str(tmp_path / "j.journal")
+    with open(path, "w") as f:
+        f.write(json.dumps({"v": 1, "seq": 0, "kind": "suspect"}) + "\n")
+        f.write(json.dumps({"v": 1, "seq": 5, "kind": "suspect"}) + "\n")
+    with pytest.raises(ValueError, match="monotone"):
+        DecisionJournal(path)
+
+
+def test_journal_repairs_torn_tail_before_appending(tmp_path):
+    """Review regression: reopening a torn journal must TRUNCATE the torn
+    bytes before the first append — otherwise the new record merges into
+    the torn line and the next replay either silently drops a durable
+    decision or rejects the whole journal."""
+    path = str(tmp_path / "j.journal")
+    j = DecisionJournal(path)
+    j.append("suspect", rank=1)
+    d = j.append("epoch", alive=[0], relays=[], wv_epoch=1)
+    j.mark_applied(d.seq)
+    j.close()
+    with open(path, "a") as f:  # crash mid-write of the next record
+        f.write('{"v": 1, "seq": 3, "kind": "ep')
+    j2 = DecisionJournal(path)
+    j2.append("epoch", alive=[], relays=[], wv_epoch=2)
+    j2.mark_applied(3)
+    j2.close()
+    # every later replay sees ALL four durable records, cleanly
+    st = DecisionJournal(path).replay()
+    assert [x.kind for x in st.decisions] == ["suspect", "epoch", "epoch"]
+    assert [x.seq for x in st.decisions] == [0, 1, 3]
+    assert st.applied == {1, 3} and st.next_seq == 5
+
+
+def test_journal_append_continues_sequence(tmp_path):
+    path = str(tmp_path / "j.journal")
+    j = DecisionJournal(path)
+    j.append("suspect", rank=0)
+    j.close()
+    j2 = DecisionJournal(path)
+    d = j2.append("suspect", rank=1)
+    assert d.seq == 1
+    assert [x.seq for x in j2.replay().decisions] == [0, 1]
+
+
+# --------------------------------------------------------------------------- #
+# supervisor loop (engine + standby cache, injected clock)
+# --------------------------------------------------------------------------- #
+
+def _supervised_world(mesh4, tmp_path, metrics=None, warm=True):
+    trace = CollectiveTrace()
+    engine = CollectiveEngine(mesh4, Strategy.ring(4), trace=trace)
+    x = jnp.ones((4, 8), jnp.float32)
+    engine.all_reduce(x)
+    cache = StandbyPlanCache(engine, nbytes=x.nbytes, top_k=4)
+    cache.build()
+    if warm:
+        cache.warm((8,), jnp.float32)
+    logic = CoordinatorLogic(4)
+    clock = [0.0]
+    sup = Supervisor(
+        logic,
+        engine,
+        cache=cache,
+        journal_path=str(tmp_path / "sup.journal"),
+        config=LivenessConfig(timeout_s=1.0, period_s=0.5, grace=2),
+        metrics=metrics,
+        clock=lambda: clock[0],
+    )
+    return sup, logic, engine, trace, cache, clock, x
+
+
+def test_supervisor_detects_silence_and_swaps_warm(mesh4, tmp_path):
+    metrics = MetricsRegistry()
+    sup, logic, engine, trace, cache, clock, x = _supervised_world(
+        mesh4, tmp_path, metrics=metrics
+    )
+    for r in range(4):
+        logic.heartbeat_arrive(r, now=0.0)
+    assert sup.poll(0.5) == []
+    # rank 2 goes silent; the others keep leasing
+    for t in (1.0, 1.6, 2.2, 2.8):
+        for r in (0, 1, 3):
+            logic.heartbeat_arrive(r, now=t)
+        sup.poll(t)
+    decisions = sup.journal.replay().decisions
+    kinds = [d.kind for d in decisions]
+    assert kinds == ["suspect", "dead", "epoch", "swap"]
+    assert decisions[1].payload["origin"] == "heartbeat"
+    wv = sup.worldview()
+    assert sorted(wv.alive) == [0, 1, 3] and wv.epoch == 1
+    assert list(sup.current_mask().astype(int)) == [1, 1, 0, 1]
+    # the failover dispatch replays a warm program under the new epoch
+    out = engine.all_reduce(
+        x, active_gpus=wv.active_list(), epoch=sup.engine_epoch
+    )
+    assert float(np.asarray(out)[0, 0]) == 3.0
+    ev = trace.events()[-1]
+    assert ev.extra["cache_hit"] is True and ev.extra["epoch"] == 1
+    # the epoch bump carried the liveness table into the trace extras
+    sup_events = [e for e in trace.events() if e.primitive == "supervisor"]
+    assert len(sup_events) == 1
+    liveness = sup_events[0].extra["liveness"]
+    assert [row["state"] for row in liveness] == [
+        "healthy", "healthy", "dead", "healthy",
+    ]
+    assert sup_events[0].extra["alive"] == [0, 1, 3]
+    counters = metrics.snapshot()["counters"]
+    assert counters["supervisor/decisions"] == 4.0
+    assert counters["supervisor/decisions/dead"] == 1.0
+    gauges = metrics.snapshot()["gauges"]
+    assert gauges["liveness/rank2/state"] == 2.0
+    assert gauges["supervisor/wv_epoch"] == 1.0
+
+
+def test_supervisor_false_positive_guard_never_bumps_epoch(mesh4, tmp_path):
+    """The acceptance guard: a paused-then-resumed rank within grace is
+    never demoted — no dead decision, no epoch bump, same mask."""
+    sup, logic, engine, trace, cache, clock, x = _supervised_world(
+        mesh4, tmp_path, warm=False
+    )
+    for r in range(4):
+        logic.heartbeat_arrive(r, now=0.0)
+    # rank 1 pauses long enough to be suspected (timeout 1.0) but beats
+    # again inside the confirm window (1.0 + 2*0.5 = 2.0)
+    for t in (0.6, 1.2, 1.8):
+        for r in (0, 2, 3):
+            logic.heartbeat_arrive(r, now=t)
+        sup.poll(t)
+    logic.heartbeat_arrive(1, now=1.9)
+    sup.poll(1.9)
+    kinds = [d.kind for d in sup.journal.replay().decisions]
+    assert kinds == ["suspect", "clear"]
+    assert sup.worldview().epoch == 0
+    assert engine.epoch == 0
+    assert list(sup.current_mask().astype(int)) == [1, 1, 1, 1]
+
+
+def test_supervisor_recovery_restores_base_plan(mesh4, tmp_path):
+    sup, logic, engine, trace, cache, clock, x = _supervised_world(
+        mesh4, tmp_path
+    )
+    for r in range(4):
+        logic.heartbeat_arrive(r, now=0.0)
+    for t in (1.0, 2.2):
+        for r in (0, 1, 3):
+            logic.heartbeat_arrive(r, now=t)
+        sup.poll(t)
+    assert sorted(sup.worldview().alive) == [0, 1, 3]
+    # rank 2 comes back (the restarted process leases again)
+    logic.heartbeat_arrive(2, now=2.4)
+    for r in (0, 1, 3):
+        logic.heartbeat_arrive(r, now=2.4)
+    sup.poll(2.4)
+    wv = sup.worldview()
+    assert sorted(wv.alive) == [0, 1, 2, 3] and wv.epoch == 2
+    kinds = [d.kind for d in sup.journal.replay().decisions]
+    assert kinds[-3:] == ["recover", "epoch", "swap"]
+    # the recovery swap is the base plan, warm by construction
+    swap = sup.journal.replay().decisions[-1]
+    assert swap.payload["label"] == "base" and swap.payload["warmed"]
+    out = engine.all_reduce(x, epoch=sup.engine_epoch)
+    assert float(np.asarray(out)[0, 0]) == 4.0
+
+
+def test_supervisor_fault_plan_feed_demotes_straggler(mesh4, tmp_path):
+    """Feed B: a plan's ``slow`` event demotes through the SAME decision
+    stream, and the relay-only change actuates as a base-plan epoch bump
+    (relay masks are runtime state)."""
+    plan = FaultPlan(
+        [FaultEvent(step=3, kind="slow", rank=1, slowdown=4.0),
+         FaultEvent(step=6, kind="recover", rank=1)],
+        world=4,
+    )
+    trace = CollectiveTrace()
+    engine = CollectiveEngine(mesh4, Strategy.ring(4), trace=trace)
+    engine.all_reduce(jnp.ones((4, 8), jnp.float32))
+    cache = StandbyPlanCache(engine, nbytes=64)
+    cache.build()
+    logic = CoordinatorLogic(4)
+    step = [0]
+    sup = Supervisor(
+        logic, engine, cache=cache,
+        journal_path=str(tmp_path / "sup.journal"),
+        fault_plan=plan, step_source=lambda: step[0],
+        config=LivenessConfig(timeout_s=100.0, period_s=1.0, grace=1),
+        clock=lambda: 0.0,
+    )
+    for s in range(8):
+        step[0] = s
+        sup.poll()
+    st = sup.journal.replay()
+    kinds = [d.kind for d in st.decisions]
+    assert kinds == [
+        "demote", "epoch", "swap", "promote", "epoch", "swap",
+    ]
+    assert st.decisions[0].payload["ranks"] == [1]
+    assert sup.worldview().relays == frozenset()
+    assert sup.worldview().epoch == 2
+
+
+def test_supervisor_without_heartbeats_never_declares_deaths(
+    mesh4, tmp_path
+):
+    """Review regression: until the FIRST beat ever arrives no liveness
+    lease exists, so a deployment that never wires heartbeats (the
+    fault-plan-only workload / battery spelling) must not watch its
+    whole world age past the confirm window and kill everyone."""
+    sup, logic, engine, trace, cache, clock, x = _supervised_world(
+        mesh4, tmp_path, warm=False
+    )
+    # far past timeout + grace*period with zero beats ever
+    assert sup.poll(100.0) == []
+    assert sup.worldview().epoch == 0 and engine.epoch == 0
+    assert sup.journal.replay().decisions == []
+    # once ANY rank leases, a rank that never did is detected like one
+    # that stopped (the died-during-launch case)
+    logic.heartbeat_arrive(0, now=100.0)
+    logic.heartbeat_arrive(0, now=103.5)
+    sup.poll(104.0)
+    assert set(sup.worldview().dead) == {1, 2, 3}
+    assert 0 in sup.worldview().alive
+
+
+def test_supervisor_world_change_seam_drives_rebalance(mesh4, tmp_path):
+    """The ZeRO-1 rebalance hookup: ``on_world_change`` fires once per
+    actuated membership change with (last-actuated, new) views IN WAL
+    ORDER — after the journal append, before the applied marker — so a
+    rebalance callback (e.g. ``shrink_zero1_trainer_state``) runs under
+    the same crash-safety contract as the swap itself."""
+    calls = []
+    sup, logic, engine, trace, cache, clock, x = _supervised_world(
+        mesh4, tmp_path
+    )
+    sup.on_world_change = lambda old, new: calls.append((old, new))
+    logic.mark_down([2])
+    sup.poll(0.0)
+    logic.mark_recovered([2])
+    sup.poll(0.0)
+    assert len(calls) == 2
+    (old1, new1), (old2, new2) = calls
+    assert sorted(old1.alive) == [0, 1, 2, 3]
+    assert sorted(new1.alive) == [0, 1, 3]
+    assert old2 == new1 and sorted(new2.alive) == [0, 1, 2, 3]
+    # the applied marker landed only after the callback ran
+    st = sup.journal.replay()
+    assert len(st.epoch_bumps()) == 2 and st.unapplied == []
+
+
+def test_supervisor_requires_step_source_with_plan(mesh4, tmp_path):
+    plan = FaultPlan([FaultEvent(step=0, kind="down", rank=0)], world=4)
+    with pytest.raises(ValueError, match="step_source"):
+        Supervisor(CoordinatorLogic(4), fault_plan=plan)
+    with pytest.raises(ValueError, match="world"):
+        Supervisor(
+            CoordinatorLogic(8), fault_plan=plan, step_source=lambda: 0
+        )
+
+
+# --------------------------------------------------------------------------- #
+# journal replay / restart (the crash drill's unit half)
+# --------------------------------------------------------------------------- #
+
+def test_supervisor_restart_replays_identical_worldview(mesh4, tmp_path):
+    sup, logic, engine, trace, cache, clock, x = _supervised_world(
+        mesh4, tmp_path
+    )
+    for r in range(4):
+        logic.heartbeat_arrive(r, now=0.0)
+    for t in (1.0, 2.2):
+        for r in (0, 1, 3):
+            logic.heartbeat_arrive(r, now=t)
+        sup.poll(t)
+    epoch_before = engine.epoch
+    view_before = sup.applied_view
+    # restart: a fresh supervisor resumes from the same journal against
+    # the same live logic/engine
+    sup2 = Supervisor(
+        logic, engine, cache=cache,
+        journal_path=str(tmp_path / "sup.journal"),
+        config=LivenessConfig(timeout_s=1.0, period_s=0.5, grace=2),
+        clock=lambda: 2.2,
+    )
+    assert sup2.applied_view == view_before
+    assert sup2.worldview() == sup.worldview()
+    assert engine.epoch == epoch_before  # ZERO duplicate epoch bumps
+    assert sup2.engine_epoch == sup.engine_epoch
+    # and the journal did not grow from the replay
+    assert sup2.journal.replay().next_seq == sup.journal.replay().next_seq
+
+
+def test_supervisor_crash_mid_decision_completes_exactly_once(
+    mesh4, tmp_path
+):
+    """Kill the supervisor between the write-ahead append and the
+    actuation: the restart completes the journaled decision exactly once
+    (engine epoch +1, applied marker landed); a SECOND restart is a pure
+    no-op."""
+    sup, logic, engine, trace, cache, clock, x = _supervised_world(
+        mesh4, tmp_path
+    )
+    # simulate the crash window: decision journaled, actuation never ran
+    logic.mark_down([3])
+    wv = logic.worldview()
+    sup.journal.append(
+        "epoch", alive=sorted(wv.alive), relays=[], wv_epoch=wv.epoch
+    )
+    sup.journal.close()
+    epoch_before = engine.epoch
+    sup2 = Supervisor(
+        logic, engine, cache=cache,
+        journal_path=str(tmp_path / "sup.journal"),
+        config=LivenessConfig(timeout_s=1.0, period_s=0.5, grace=2),
+        clock=lambda: 0.0,
+    )
+    assert engine.epoch == epoch_before + 1  # completed exactly once
+    assert sorted(sup2.applied_view.alive) == [0, 1, 2]
+    assert sup2.journal.replay().unapplied == []
+    sup3 = Supervisor(
+        logic, engine, cache=cache,
+        journal_path=str(tmp_path / "sup.journal"),
+        config=LivenessConfig(timeout_s=1.0, period_s=0.5, grace=2),
+        clock=lambda: 0.0,
+    )
+    assert engine.epoch == epoch_before + 1  # and never twice
+    assert sup3.applied_view == sup2.applied_view
+
+
+def test_supervisor_restart_never_regresses_live_logic(mesh4, tmp_path):
+    """A coordinator that moved PAST the journal while the supervisor was
+    down keeps its newer view on resume (replay reconstructs history, it
+    must not rewrite it)."""
+    sup, logic, engine, trace, cache, clock, x = _supervised_world(
+        mesh4, tmp_path
+    )
+    logic.mark_down([2])
+    sup.poll(0.0)
+    # while the supervisor is "down", the world moves on
+    logic.mark_down([3])
+    live = logic.worldview()
+    sup2 = Supervisor(
+        logic, engine, cache=cache,
+        journal_path=str(tmp_path / "sup.journal"),
+        config=LivenessConfig(timeout_s=1.0, period_s=0.5, grace=2),
+        clock=lambda: 0.0,
+    )
+    assert logic.worldview() == live
+    # the next poll reconciles the un-journaled change through the
+    # normal decide -> swap path
+    sup2.poll(0.0)
+    assert sorted(sup2.applied_view.alive) == [0, 1]
+
+
+# --------------------------------------------------------------------------- #
+# heartbeat RPC + client deadlines (satellite 1)
+# --------------------------------------------------------------------------- #
+
+def test_heartbeat_rpc_round_trip_and_snapshot():
+    logic = CoordinatorLogic(4)
+    srv = CoordinatorServer(4, port=0, logic=logic).start()
+    try:
+        hb = HeartbeatClient("127.0.0.1", srv.port, 2)
+        alive, epoch = hb.beat(median_s=0.0625)
+        assert alive == [0, 1, 2, 3] and epoch == 0
+        logic.mark_down([3])
+        alive, epoch = hb.beat()
+        assert alive == [0, 1, 2] and epoch == 1
+        snap = logic.heartbeat_snapshot()
+        assert snap[2]["beats"] == 2
+        assert snap[2]["median_s"] == pytest.approx(0.0625, rel=1e-4)
+        hb.close()
+    finally:
+        srv.stop()
+
+
+def test_dead_coordinator_surfaces_unavailable_within_budget():
+    """Satellite 1's contract: a dead coordinator is a loud, NAMED error
+    within the configured deadline — never an indefinite block."""
+    for client in (
+        HeartbeatClient("127.0.0.1", 1, 0, timeout_s=0.4),
+        Hooker("127.0.0.1", 1, timeout_s=0.4),
+    ):
+        t0 = time.monotonic()
+        with pytest.raises(CoordinatorUnavailable, match="coordinator"):
+            if isinstance(client, HeartbeatClient):
+                client.beat()
+            else:
+                client.send_ready_request(0, 0)
+        elapsed = time.monotonic() - t0
+        assert 0.3 < elapsed < 5.0, elapsed
+        client.close()
+
+
+def test_rpc_timeout_env_is_loud(monkeypatch):
+    from adapcc_tpu.coordinator import rpc_timeout_s
+
+    monkeypatch.setenv("ADAPCC_RPC_TIMEOUT_S", "soon")
+    with pytest.raises(ValueError, match="ADAPCC_RPC_TIMEOUT_S"):
+        rpc_timeout_s()
+    monkeypatch.setenv("ADAPCC_RPC_TIMEOUT_S", "-1")
+    with pytest.raises(ValueError, match="must be > 0"):
+        rpc_timeout_s()
+    monkeypatch.setenv("ADAPCC_RPC_TIMEOUT_S", "2.5")
+    assert rpc_timeout_s() == 2.5
+    monkeypatch.delenv("ADAPCC_RPC_TIMEOUT_S")
+    assert rpc_timeout_s(7.0) == 7.0
+
+
+def test_retried_arrival_is_idempotent():
+    """Review regression: gRPC can surface UNAVAILABLE after the server
+    processed a call (response lost to a reset), so the client retry
+    re-sends — a duplicate arrival must not inflate the barrier count
+    and freeze the step with a live rank missing."""
+    logic = CoordinatorLogic(
+        2, relay_threshold=2.0, time_slot=0.01, fault_timeout=2.0
+    )
+    results = []
+
+    def arrive(rank):
+        results.append(logic.hook_arrive(0, rank))
+
+    t1 = threading.Thread(target=arrive, args=(0,))
+    t1.start()
+    time.sleep(0.05)
+    t2 = threading.Thread(target=arrive, args=(0,))  # the retry
+    t2.start()
+    time.sleep(0.05)
+    t3 = threading.Thread(target=arrive, args=(1,))
+    t3.start()
+    for t in (t1, t2, t3):
+        t.join(timeout=10)
+    assert all(sorted(r) == [0, 1] for r in results), results
+    assert logic._ready[0] == [0, 1]
+
+
+def test_unavailable_is_an_rpc_error():
+    """Existing handlers catch grpc.RpcError; the named error must land
+    in them (the compatibility half of the satellite)."""
+    import grpc
+
+    e = CoordinatorUnavailable("gone")
+    assert isinstance(e, grpc.RpcError)
+    assert e.code() is grpc.StatusCode.UNAVAILABLE
+    assert "gone" in e.details()
+
+
+# --------------------------------------------------------------------------- #
+# chaos harness determinism (satellite 4)
+# --------------------------------------------------------------------------- #
+
+def test_chaos_schedule_is_deterministic_and_complete():
+    plan = FaultPlan.seeded(world=8, steps=12, seed=7, n_faults=3)
+    s1 = wall_schedule(plan, step_period_s=0.1)
+    s2 = plan.chaos_schedule(0.1)
+    assert s1 == s2  # same plan, byte-identical schedule
+    assert s1 == sorted(s1, key=lambda a: (a.at_s, a.rank, a.kind))
+    downs = [e for e in plan.events if e.kind == "down"]
+    assert sum(1 for a in s1 if a.kind == "kill") == len(downs)
+    # every stop is followed by a cont for the same rank (no rank is
+    # left frozen by the schedule itself)
+    last = {}
+    for a in s1:
+        if a.kind in ("stop", "cont"):
+            last[a.rank] = a.kind
+    assert all(k == "cont" for k in last.values())
+
+
+def test_chaos_duty_cycle_matches_slowdown():
+    """The stop fraction of each duty window equals 1 - 1/slowdown, the
+    stretch that makes the straggler's wall time ~slowdown x."""
+    plan = FaultPlan(
+        [FaultEvent(step=0, kind="slow", rank=1, slowdown=4.0),
+         FaultEvent(step=5, kind="recover", rank=1)],
+        world=2,
+    )
+    sched = wall_schedule(plan, step_period_s=0.2, duty_window_s=0.2)
+    stops = [a for a in sched if a.kind == "stop"]
+    conts = [a for a in sched if a.kind == "cont"]
+    # windows at 0.0, 0.2, ..., <1.0 -> 5 stop/cont pairs + recover cont
+    assert len(stops) == 5 and len(conts) == 6
+    for s in stops:
+        c = min(
+            (a.at_s for a in conts if a.rank == s.rank and a.at_s > s.at_s)
+        )
+        assert (c - s.at_s) == pytest.approx(0.2 * (1 - 1 / 4.0))
+
+
+def test_chaos_injector_delivers_kill():
+    import subprocess
+    import sys
+
+    plan = FaultPlan([FaultEvent(step=1, kind="down", rank=0)], world=2)
+    proc = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+    try:
+        inj = ChaosInjector(plan, step_period_s=0.05)
+        delivered = inj.run({0: proc.pid, 1: os.getpid()})
+        assert [a.kind for a in delivered] == ["kill"]
+        assert proc.wait(timeout=5) == -9  # SIGKILL
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_chaos_injector_rejects_unmapped_ranks():
+    plan = FaultPlan([FaultEvent(step=0, kind="down", rank=1)], world=2)
+    with pytest.raises(ValueError, match="no pid"):
+        ChaosInjector(plan, step_period_s=0.05).run({0: os.getpid()})
+
+
+def test_beat_chaos_gate_is_deterministic():
+    g1 = BeatChaos(drop_rate=0.5, delay_s=0.1, delay_rate=0.5, seed=3)
+    g2 = BeatChaos(drop_rate=0.5, delay_s=0.1, delay_rate=0.5, seed=3)
+    decisions = [g1.gate(r, s) for r in range(4) for s in range(50)]
+    assert decisions == [g2.gate(r, s) for r in range(4) for s in range(50)]
+    drops = sum(1 for send, _ in decisions if not send)
+    assert 0 < drops < len(decisions)  # actually exercising both arms
+    assert BeatChaos().gate(0, 0) == (True, 0.0)
+
+
+# --------------------------------------------------------------------------- #
+# sim pricing + chaos sweep rows (satellite 6)
+# --------------------------------------------------------------------------- #
+
+def test_supervised_detection_latency_pricing():
+    from adapcc_tpu.sim.cost_model import (
+        detection_latency_s,
+        supervised_detection_latency_s,
+    )
+
+    d = supervised_detection_latency_s(0.5, 1.5, 2, sweep_period_s=0.25)
+    assert d == pytest.approx(0.25 + 1.5 + 1.0 + 0.125)
+    # grace and period both buy false-positive headroom linearly
+    assert supervised_detection_latency_s(0.5, 1.5, 3) > d - 0.125
+    assert supervised_detection_latency_s(0.25, 1.5, 2) < d
+    with pytest.raises(ValueError):
+        supervised_detection_latency_s(0.0, 1.0, 1)
+    with pytest.raises(ValueError):
+        supervised_detection_latency_s(0.5, 1.0, 0)
+    # the out-of-band curve sits above the in-loop barrier's floor for
+    # the same timeout (the confirmation window is the added price)
+    assert d > detection_latency_s(1.5)
+
+
+def test_chaos_sweep_rows_are_deterministic_and_labeled():
+    from benchmarks.sim_collectives import chaos_sweep
+
+    rows1 = chaos_sweep(8, [1 << 20], periods=(0.5, 1.0), graces=(1, 2))
+    rows2 = chaos_sweep(8, [1 << 20], periods=(0.5, 1.0), graces=(1, 2))
+    assert rows1 == rows2
+    assert all(r["mode"] == "simulated" for r in rows1)
+    detection = [r for r in rows1 if r["phase"] == "detection"]
+    schedule = [r for r in rows1 if r["phase"] == "schedule"]
+    assert len(detection) == 4 and len(schedule) == 1
+    # detection latency is monotone in grace at fixed period...
+    by_key = {(r["heartbeat_period_s"], r["grace"]): r for r in detection}
+    assert by_key[(0.5, 2)]["detection_us"] > by_key[(0.5, 1)]["detection_us"]
+    # ...and the cached swap is strictly cheaper than the cold one
+    assert all(r["swap_cached_us"] < r["swap_cold_us"] for r in detection)
+    assert schedule[0]["kills"] == 1 and schedule[0]["stop_cont_paired"]
+
+
+def test_chaos_sweep_cli_exclusive(tmp_path):
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "benchmarks.sim_collectives", "--world", "8",
+         "--sizes", "1M", "--chaos-sweep", "--fault-sweep"],
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode != 0
+    assert "mutually exclusive" in r.stderr
+
+
+# --------------------------------------------------------------------------- #
+# trainer seam
+# --------------------------------------------------------------------------- #
+
+def test_trainer_supervised_mask_seam(mesh4, tmp_path):
+    import optax
+
+    from adapcc_tpu.ddp import DDPTrainer, TrainState
+    from adapcc_tpu.models import MLP
+
+    model = MLP(features=(4, 2))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(4, 3)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(4, 2)), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x[:1])
+
+    def loss_fn(p, batch):
+        bx, by = batch
+        return jnp.mean((model.apply(p, bx) - by) ** 2)
+
+    static = DDPTrainer(loss_fn, optax.sgd(0.1), mesh4, Strategy.ring(4))
+    with pytest.raises(ValueError, match="dynamic_mask"):
+        static.attach_supervisor(object())
+
+    trainer = DDPTrainer(
+        loss_fn, optax.sgd(0.1), mesh4, Strategy.ring(4), dynamic_mask=True
+    )
+    engine = CollectiveEngine(mesh4, Strategy.ring(4))
+    cache = StandbyPlanCache(engine, nbytes=64)
+    cache.build()
+    logic = CoordinatorLogic(4)
+    sup = Supervisor(
+        logic, engine, cache=cache, trainer=trainer,
+        config=LivenessConfig(timeout_s=1.0, period_s=0.5, grace=1),
+        clock=lambda: 0.0,
+    )
+    trainer.attach_supervisor(sup)
+    state = TrainState.create(params, trainer.tx)
+    state, _ = trainer.step(state, (x, y))
+    # the daemon kills rank 3; the NEXT step consumes the actuated mask
+    logic.mark_down([3])
+    sup.poll(0.0)
+    masked_state, masked_loss = trainer.step(state, (x, y))
+    # oracle: explicit mask on a fresh supervisor-free trainer
+    oracle = DDPTrainer(
+        loss_fn, optax.sgd(0.1), mesh4, Strategy.ring(4), dynamic_mask=True
+    )
+    o_state = TrainState.create(params, oracle.tx)
+    o_state, _ = oracle.step(o_state, (x, y))
+    mask = jnp.asarray([True, True, True, False])
+    o_state, o_loss = oracle.step(o_state, (x, y), active_mask=mask)
+    np.testing.assert_allclose(
+        np.asarray(masked_loss), np.asarray(o_loss), rtol=1e-6
+    )
