@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench elastic-bench adapt-bench chaos-bench trace-export clean
+.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench hier-bench elastic-bench adapt-bench chaos-bench trace-export clean
 
 all: native
 
@@ -77,6 +77,16 @@ overlap-bench:
 latency-bench:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--world 8 --sizes 1K,16K,64K,256K,1M,16M --latency-sweep --json
+
+# Hierarchical (DCN x ICI) two-level-vs-flat sweep on the same simulator
+# (docs/HIERARCHY.md): deterministic "mode": "simulated" rows over the
+# (pods x pod_size x size) grid pricing the composed RS-within-pod ->
+# AR-across-leaders -> AG-within-pod plan against the flat ring on the
+# DCN bottleneck, with the per-row decision and the pod-count crossover
+# flagged — the wire-time half of the hierarchical synthesis story.
+hier-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--sizes 1M,16M,128M --hier-sweep --pods 2,4,8 --pod-sizes 4,8 --json
 
 # Elastic failover sweep on the same simulator (docs/ELASTIC.md):
 # deterministic "mode": "simulated" rows pricing each injected fault's

@@ -79,6 +79,10 @@ class AdaptationReport:
     winner_pred_s: Optional[float] = None
     swapped: bool = False
     epoch: Optional[int] = None
+    #: drift localization (docs/HIERARCHY.md §5): "dcn" when the incumbent
+    #: is a composed two-level plan and the correction named only the DCN
+    #: class, so ONLY the leader level was re-solved (pod level kept warm)
+    resolved_level: Optional[str] = None
     #: AOT warm walltime (off the swap's critical path)
     aot_warm_s: Optional[float] = None
     #: the swap stall itself: advance_epoch + trainer adoption walltime
@@ -109,6 +113,7 @@ class AdaptationReport:
             ),
             "swapped": self.swapped,
             "epoch": self.epoch,
+            "resolved_level": self.resolved_level,
             "aot_warm_s": self.aot_warm_s,
             "stall_s": self.stall_s,
             "trainer_adopt_hit": self.trainer_adopt_hit,
@@ -270,6 +275,74 @@ class AdaptationController:
         self.reports.append(report)
         return report
 
+    def _adapt_leader_level(
+        self, report: AdaptationReport, plan, incumbent, drift, mode: str
+    ) -> AdaptationReport:
+        """The localized half of the loop: re-solve ONLY the DCN leader
+        level under the corrected model, hysteresis-gate, and hot-swap
+        through the standby cache.  The pod level is never re-solved —
+        ``resolve_leader_level`` carries the pod solve over by identity —
+        and the warmed composed program makes the first post-swap dispatch
+        a ``cache_hit`` (the same no-recompile property the elastic
+        failover pins)."""
+        from adapcc_tpu.sim.cost_model import DCN, ICI, two_level_allreduce_time
+        from adapcc_tpu.strategy.hierarchy import resolve_leader_level
+
+        model = self._model
+        new = resolve_leader_level(plan, model, nbytes=self.nbytes)
+        ici, dcn = model.classes[ICI], model.classes[DCN]
+        inc_s = two_level_allreduce_time(
+            plan.sketch.num_pods, plan.sketch.pod_size, self.nbytes,
+            ici, dcn, pod_algo=plan.pod_algo, leader_algo=plan.leader_algo,
+        )
+        report.resolved_level = "dcn"
+        report.incumbent_pred_s = inc_s
+        report.winner_label = f"two-level[{new.leader_algo}]"
+        report.winner_pred_s = new.predicted_s
+        report.ranked = [
+            {"label": report.winner_label,
+             "pred_us": round(new.predicted_s * 1e6, 3)},
+            {"label": "incumbent", "pred_us": round(inc_s * 1e6, 3)},
+        ]
+        if new.strategy.fingerprint() == incumbent.fingerprint():
+            report.outcome = "incumbent-wins"
+            report.winner_fingerprint = incumbent.fingerprint()
+            return self._done(report)
+        report.winner_fingerprint = new.strategy.fingerprint()
+        evidence = max((s.count for s in drift.fired), default=0)
+        if (
+            new.predicted_s >= inc_s * (1.0 - self.hysteresis_margin)
+            or evidence < self.min_samples
+        ):
+            report.outcome = "hysteresis"
+            return self._done(report)
+        if mode == "detect":
+            report.outcome = "would-swap"
+            return self._done(report)
+        t0 = time.perf_counter()
+        self.cache.warm_strategy(
+            new.strategy,
+            self.warm_shape,
+            self.warm_dtype,
+            label=report.winner_label,
+            predicted_s=new.predicted_s,
+        )
+        if self.trainer_prewarm is not None:
+            self.trainer_prewarm(new.strategy)
+        report.aot_warm_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        report.epoch = self.cache.adopt(new.strategy)
+        if self.trainer is not None:
+            report.trainer_adopt_hit = self.trainer.adopt_strategy(
+                new.strategy
+            )
+        report.stall_s = time.perf_counter() - t1
+        report.swapped = True
+        report.outcome = "swapped"
+        self.swaps += 1
+        self.detector.reset(watermark=time.time())
+        return self._done(report)
+
     def maybe_adapt(self) -> AdaptationReport:
         """Run one pass of the loop (module doc).  Deterministic given the
         fed samples; returns a stage-by-stage report either way."""
@@ -317,6 +390,17 @@ class AdaptationController:
         self.cache.cost_model = model
         report.recalibrated = True
         report.calibration_source = merged.source
+        # -- drift localization (docs/HIERARCHY.md §5) -------------------------
+        # a DCN-class correction on a composed two-level incumbent says
+        # nothing about the ICI level: re-solve ONLY the leader schedule
+        # and keep every pod-level decision (and its compiled programs)
+        # warm, instead of re-ranking the whole candidate pool
+        from adapcc_tpu.sim.cost_model import DCN
+        from adapcc_tpu.strategy.hierarchy import plan_of
+
+        plan = plan_of(incumbent)
+        if plan is not None and set(correction.classes) == {DCN}:
+            return self._adapt_leader_level(report, plan, incumbent, drift, mode)
         # -- re-rank -----------------------------------------------------------
         ranked = self.synthesizer.resynthesize(
             self._model,

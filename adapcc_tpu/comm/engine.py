@@ -1028,6 +1028,76 @@ class CollectiveEngine:
                 cache_hit=cache_hit, **extras,
             )
             return out
+        plan2l = None
+        if (
+            self.two_level
+            and op is not ReduceOp.MAX
+            # an explicit "ring" pin (env or argument) names the LEGACY
+            # ring/psum plane — the composed plan must stand down like
+            # every other unpinned selector, or the pin's A/B (e.g. the
+            # small_msg_crossover battery arms) silently times the wrong
+            # program under the pinned label
+            and algo_req in (None, "auto")
+        ):
+            from adapcc_tpu.strategy.hierarchy import plan_of
+
+            candidate = plan_of(self.strategy)
+            # only the RS/AG pod algorithm has a composed data plane; a
+            # "replicate" plan IS the projected schedule path below, and
+            # MAX has no psum_scatter spelling — both ride the fixed path
+            if candidate is not None and candidate.pod_algo == "rs-ag":
+                plan2l = candidate
+        if plan2l is not None:
+            from adapcc_tpu.comm.two_level import (
+                allreduce_two_level_composed_shard,
+            )
+
+            per_shard = functools.partial(
+                allreduce_two_level_composed_shard,
+                plan=plan2l,
+                num_slices=self.num_slices,
+                ici_size=self.ici_size,
+                op=op,
+            )
+            key = (
+                "allreduce2l-composed", self.strategy.fingerprint(),
+                plan2l.leader_algo, stacked.shape, stacked.dtype.name, op,
+            )
+            cache_hit = key in self._cache
+            timing = tuner is not None and tuner.recording
+            t0 = time.perf_counter()
+            out = self._shard_mapped(key, per_shard, 2)(stacked, mask)
+            extras = {
+                "algo": "two-level",
+                # the EXECUTED plan is an artifact, not a guess: which
+                # levels ran which schedule, on what sketch
+                "hier": {
+                    "pods": plan2l.sketch.num_pods,
+                    "pod_size": plan2l.sketch.pod_size,
+                    "pod_algo": plan2l.pod_algo,
+                    "leader_algo": plan2l.leader_algo,
+                    "resolved_level": plan2l.resolved_level,
+                },
+            }
+            if timing:
+                from adapcc_tpu.tuner.policy import TWO_LEVEL_PATH
+
+                jax.block_until_ready(out)
+                duration = time.perf_counter() - t0
+                extras["duration_s"] = duration
+                tuner.observe_dispatch(
+                    tuner.key_for(
+                        "allreduce", per_rank_bytes, TWO_LEVEL_PATH,
+                        NO_CHUNK, "off",
+                    ),
+                    key,
+                    duration,
+                )
+            self._record(
+                "allreduce", "two_level[composed]", stacked,
+                cache_hit=cache_hit, **extras,
+            )
+            return out
         if self.use_xla_fastpath and active_gpus is None:
             per_shard = functools.partial(self._psum_shard, op=op)
             key = ("psum", stacked.shape, stacked.dtype.name, op)

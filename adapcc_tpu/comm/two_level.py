@@ -64,15 +64,42 @@ def build_two_level_mesh(
     Flat rank ``r`` (the strategy/ip-table world rank) sits at mesh position
     ``(r // ici_size, r % ici_size)`` — the same slice grouping the detector
     writes into the logical graph (hosts = slices).
+
+    Ragged layouts reject loudly (a world that does not divide into equal
+    slices has no two-level sketch — docs/HIERARCHY.md §1), as does
+    ``ici_size=1`` (a slice of one rank has no ICI level).  The single-pod
+    degenerate case (``num_slices=1``) falls back to the flat plane: it
+    returns the ordinary 1-D ranks mesh, because one pod IS a flat world
+    and every two-level code path would only add a trivial DCN axis.
     """
+    if num_slices < 1:
+        raise ValueError(f"num_slices must be >= 1, got {num_slices}")
+    if ici_size is not None and ici_size < 2:
+        raise ValueError(
+            f"ici_size must be >= 2, got {ici_size}: a slice of one rank "
+            "has no ICI level — use the flat ranks mesh"
+        )
     devs = list(devices) if devices is not None else list(jax.devices())
     if ici_size is None:
         if len(devs) % num_slices:
-            raise ValueError(f"{len(devs)} devices do not split into {num_slices} slices")
+            raise ValueError(
+                f"{len(devs)} devices do not split into {num_slices} slices"
+            )
         ici_size = len(devs) // num_slices
+        if ici_size < 2:
+            raise ValueError(
+                f"{len(devs)} devices over {num_slices} slices leave "
+                f"ici_size={ici_size}: a slice of one rank has no ICI "
+                "level — use the flat ranks mesh"
+            )
     need = num_slices * ici_size
     if len(devs) < need:
         raise ValueError(f"need {need} devices, have {len(devs)}")
+    if num_slices == 1:
+        # degenerate single-pod world: the flat plane, by construction
+        from adapcc_tpu.comm.mesh import build_world_mesh
+
+        return build_world_mesh(ici_size, devices=devs)
     grid = np.array(devs[:need]).reshape(num_slices, ici_size)
     return Mesh(grid, (DCN_AXIS, ICI_AXIS))
 
@@ -113,6 +140,10 @@ def slice_tree(tree: Tree, rank_slice: Sequence[int], num_slices: int) -> Tree:
 
 
 def mesh_rank_slice(num_slices: int, ici_size: int) -> List[int]:
+    if num_slices < 1 or ici_size < 1:
+        raise ValueError(
+            f"need num_slices/ici_size >= 1, got {num_slices}x{ici_size}"
+        )
     return [r // ici_size for r in range(num_slices * ici_size)]
 
 
@@ -346,6 +377,86 @@ def reduce_scatter_two_level_shard(
     xp = x.reshape(S, I, c).swapaxes(0, 1).reshape(-1)
     part = lax.psum_scatter(xp, ici_axis, scatter_dimension=0, tiled=True)
     return lax.psum_scatter(part, dcn_axis, scatter_dimension=0, tiled=True)
+
+
+def allreduce_two_level_composed_shard(
+    x: jnp.ndarray,
+    active_mask: jnp.ndarray,
+    plan,
+    num_slices: int,
+    ici_size: int,
+    dcn_axis: str = DCN_AXIS,
+    ici_axis: str = ICI_AXIS,
+    op: ReduceOp = ReduceOp.SUM,
+) -> jnp.ndarray:
+    """The synthesized bandwidth-optimal two-level allreduce — the
+    execution of a :class:`~adapcc_tpu.strategy.hierarchy.TwoLevelPlan`
+    with ``pod_algo="rs-ag"`` (docs/HIERARCHY.md §3); call inside
+    shard_map on a ``(dcn, ici)`` mesh:
+
+    1. **RS-within-pod** — ``psum_scatter`` over the ICI axis: lane ``i``
+       is left holding the fully pod-reduced chunk ``i`` (1/ici of the
+       payload);
+    2. **AR-across-leaders** — every lane allreduces ITS chunk over the
+       DCN axis, so DCN carries ``1/ici_size`` of the buffer (the wire-time
+       win over the replicate-first fixed schedule, which ships the whole
+       payload).  The schedule is the plan's solved leader level: binomial
+       ``tree`` rounds (the leader strategy's trees lowered to ppermutes
+       over the DCN axis), or the segmented leader ring (``rs-ag``) as
+       XLA ``psum_scatter`` + ``all_gather`` over the DCN axis;
+    3. **AG-within-pod** — ``all_gather`` over the ICI axis restores the
+       full payload on every lane.
+
+    Relay contract unchanged: inactive ranks contribute zeros but stay on
+    the data path and receive the result; ``AVG`` divides by the active
+    count.  ``MAX`` is rejected (``psum_scatter`` has no max variant —
+    the engine routes MAX through the projected schedule path instead).
+    The payload is zero-padded to a multiple of the world internally and
+    sliced back, so any size works.
+    """
+    if op is ReduceOp.MAX:
+        raise ValueError(
+            "the composed two-level path supports SUM/AVG only "
+            "(psum_scatter has no max variant); MAX rides the projected "
+            "schedule path"
+        )
+    leader_strategy = plan.leader_strategy
+    world = num_slices * ici_size
+    flat_rank = lax.axis_index(dcn_axis) * ici_size + lax.axis_index(ici_axis)
+    my_active = active_mask[flat_rank]
+
+    flat = x.reshape(-1)
+    contrib = jnp.where(my_active, flat, jnp.zeros_like(flat))
+    pad = (-flat.size) % world
+    if pad:
+        contrib = jnp.concatenate(
+            [contrib, jnp.zeros((pad,), dtype=flat.dtype)]
+        )
+    # phase 1: reduce-scatter within the pod — lane i owns chunk i
+    chunk = lax.psum_scatter(
+        contrib, ici_axis, scatter_dimension=0, tiled=True
+    )
+    # phase 2: leader-level allreduce of the chunk over the DCN axis
+    if plan.leader_algo == "rs-ag":
+        part = lax.psum_scatter(
+            chunk, dcn_axis, scatter_dimension=0, tiled=True
+        )
+        chunk = lax.all_gather(part, dcn_axis, axis=0, tiled=True)
+    else:  # "tree": the solved leader trees lowered to DCN ppermute rounds
+        def per_segment(seg: jnp.ndarray, tree: Tree) -> jnp.ndarray:
+            acc = _run_reduce_rounds(
+                seg, tree.reduce_rounds(), dcn_axis, num_slices, op
+            )
+            return _run_broadcast_rounds(
+                acc, tree.broadcast_rounds(), dcn_axis, num_slices
+            )
+
+        chunk = _run_segments(chunk, leader_strategy, per_segment)
+    # phase 3: all-gather within the pod restores the full payload
+    full = lax.all_gather(chunk, ici_axis, axis=0, tiled=True)
+    if pad:
+        full = full[: flat.size]
+    return _avg_normalize(full.reshape(x.shape), active_mask, op)
 
 
 def reduce_two_level_shard(

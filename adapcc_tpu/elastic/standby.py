@@ -315,6 +315,46 @@ class StandbyPlanCache:
         self.adaptive[strategy.fingerprint()] = plan
         return plan
 
+    def warm_leader_alternatives(
+        self,
+        shape: Tuple[int, ...],
+        dtype=np.float32,
+        primitives: Sequence[str] = ("all_reduce",),
+    ) -> List[StandbyPlan]:
+        """Per-LEVEL standby plans (docs/HIERARCHY.md §5): when the
+        engine's strategy is a composed two-level plan, pre-compile the
+        composed program for every leader schedule the DCN level could
+        re-solve to — so a drift-localized leader swap
+        (:func:`adapcc_tpu.strategy.hierarchy.resolve_leader_level`) is a
+        dispatch-time cache hit even when it lands on the schedule the
+        healthy solve did NOT pick.  The pod level is shared by
+        construction (the variants differ only across leaders).  No-op on
+        engines without a composed plan."""
+        from adapcc_tpu.strategy.hierarchy import (
+            LEADER_ALGOS,
+            leader_variant,
+            plan_of,
+        )
+
+        plan = plan_of(self.engine.strategy)
+        if plan is None:
+            return []
+        warmed: List[StandbyPlan] = []
+        for algo in LEADER_ALGOS:
+            if algo == plan.leader_algo:
+                continue  # the incumbent's own program is already live
+            variant = leader_variant(plan, algo)
+            warmed.append(
+                self.warm_strategy(
+                    variant.strategy,
+                    shape,
+                    dtype,
+                    primitives,
+                    label=f"leader-{algo}",
+                )
+            )
+        return warmed
+
     def adopt(self, strategy: Strategy) -> int:
         """Hot-swap the engine onto a candidate strategy under a fresh
         epoch (the adoption half of :meth:`warm_strategy`): one

@@ -1084,6 +1084,155 @@ def choose_allreduce_algo(
     return winner, times
 
 
+# --------------------------------------------------------------------------- #
+# two-level (DCN × ICI) composition pricing (adapcc_tpu/strategy/hierarchy):
+# RS-within-pod → AR-across-leaders → AG-within-pod vs the flat ring
+# --------------------------------------------------------------------------- #
+
+#: the composed plan's per-level schedule vocabularies; mirror
+#: ``adapcc_tpu.strategy.hierarchy.POD_ALGOS`` / ``LEADER_ALGOS`` (drift
+#: pinned by a test — the pricing must speak the synthesizer's vocabulary)
+TWO_LEVEL_POD_ALGOS = ("rs-ag", "replicate")
+TWO_LEVEL_LEADER_ALGOS = ("tree", "rs-ag")
+
+
+def two_level_leader_time(
+    num_pods: int, nbytes: float, dcn: LinkCoeffs, algo: str = "tree"
+) -> float:
+    """One cross-pod-leader allreduce of ``nbytes`` per leader, on the DCN
+    class coefficients — the DCN-level solve's candidate pricing
+    (:func:`adapcc_tpu.strategy.hierarchy.solve_leader_level`).
+
+    - ``"tree"`` — binomial over the leaders: ``2·ceil(log2 P)`` rounds,
+      each moving the full payload (reduce up + broadcast down).  DCN is a
+      switched fabric, so unlike :func:`binomial_tree_time` there is no
+      ring-embedding hop serialization.
+    - ``"rs-ag"`` — segmented leader ring (reduce-scatter + all-gather):
+      ``2·(P−1)`` rounds of ``nbytes/P`` each — the bandwidth-optimal
+      schedule, paying ``2(P−1)`` α instead of ``2·log2 P``.
+
+    The α/β trade is the point: a latency-degraded DCN (congestion raising
+    α) flips the winner to "tree", which is exactly the leader-level
+    re-solve the drift localization executes (docs/HIERARCHY.md §5).
+    """
+    P = int(num_pods)
+    if P < 2:
+        return 0.0
+    if algo == "tree":
+        rounds = (P - 1).bit_length()  # ceil(log2 P)
+        return 2.0 * rounds * dcn.time(nbytes)
+    if algo == "rs-ag":
+        return 2.0 * (P - 1) * (dcn.alpha + dcn.beta * float(nbytes) / P)
+    raise ValueError(
+        f"unknown leader algo {algo!r}; expected one of "
+        f"{TWO_LEVEL_LEADER_ALGOS}"
+    )
+
+
+def two_level_allreduce_time(
+    num_pods: int,
+    pod_size: int,
+    nbytes: float,
+    ici: LinkCoeffs,
+    dcn: LinkCoeffs,
+    pod_algo: str = "rs-ag",
+    leader_algo: str = "tree",
+) -> float:
+    """Analytical latency of the composed two-level allreduce
+    (docs/HIERARCHY.md): the ICI phases plus the leader-level allreduce of
+    whatever payload the pod algorithm leaves on DCN.
+
+    - ``pod_algo="rs-ag"`` — reduce-scatter within the pod ((I−1) ring
+      hops of ``n/I``), leader level carries ``n/I``, all-gather within
+      the pod after ((I−1) hops of ``n/I``): DCN traffic shrinks by the
+      pod size — the wire-time half of the hierarchy win.
+    - ``pod_algo="replicate"`` — the fixed schedule ``comm/two_level.py``
+      shipped before the sketch existed: slice-local psum (priced as the
+      same bandwidth-optimal 2(I−1)·t(n/I) ICI work), but the leader
+      level carries the FULL payload and the broadcast down the leader
+      tree lands on every lane (no AG phase).
+
+    Strictly below the flat ring (``quantized_ring_allreduce_time`` on the
+    DCN bottleneck — a flat lockstep ring advances at its slowest link) on
+    every multi-pod topology where DCN is the slow class; the regression
+    tests pin the ≥4-pod gap and :func:`two_level_crossover_pods` records
+    where it opens.
+    """
+    P, I = int(num_pods), int(pod_size)
+    if P < 1 or I < 1:
+        raise ValueError(f"need num_pods/pod_size >= 1, got {P}x{I}")
+    if P * I < 2:
+        return 0.0
+    if pod_algo not in TWO_LEVEL_POD_ALGOS:
+        raise ValueError(
+            f"unknown pod algo {pod_algo!r}; expected one of "
+            f"{TWO_LEVEL_POD_ALGOS}"
+        )
+    n = float(nbytes)
+    ici_phases = 2.0 * (I - 1) * ici.time(n / I) if I > 1 else 0.0
+    if pod_algo == "rs-ag":
+        leader_payload = n / I
+    else:
+        leader_payload = n
+    return ici_phases + two_level_leader_time(
+        P, leader_payload, dcn, leader_algo
+    )
+
+
+def choose_two_level(
+    num_pods: int,
+    pod_size: int,
+    nbytes: float,
+    ici: LinkCoeffs,
+    dcn: LinkCoeffs,
+) -> Tuple[str, Dict[str, float]]:
+    """Two-level vs flat for one topology and payload — the pod-count-aware
+    decision the hierarchical sweep stamps per row.  Returns ``(winner,
+    {"two_level": s, "flat": s})``: the two-level arm is the best composed
+    configuration (both pod algorithms × their best leader schedule), the
+    flat arm is the lockstep flat ring paced by the DCN bottleneck (the
+    schedule a hierarchy-blind synthesizer would run).  ``num_pods < 2``
+    is flat by construction (a single pod has no DCN level; the flat arm
+    prices on ICI there)."""
+    P, I = int(num_pods), int(pod_size)
+    if P < 2:
+        flat = quantized_ring_allreduce_time(max(P * I, 1), nbytes, ici, "off")
+        return "flat", {"two_level": flat, "flat": flat}
+    two = min(
+        two_level_allreduce_time(
+            P, I, nbytes, ici, dcn, pod_algo=pa, leader_algo=la
+        )
+        for pa in TWO_LEVEL_POD_ALGOS
+        for la in TWO_LEVEL_LEADER_ALGOS
+    )
+    flat = quantized_ring_allreduce_time(P * I, nbytes, dcn, "off")
+    times = {"two_level": two, "flat": flat}
+    # ties keep flat: no hierarchy churn for a prediction-identical plan
+    return ("two_level" if two < flat else "flat"), times
+
+
+def two_level_crossover_pods(
+    pod_size: int,
+    nbytes: float,
+    ici: LinkCoeffs,
+    dcn: LinkCoeffs,
+    max_pods: int = 4096,
+) -> Optional[int]:
+    """The smallest pod count at which the composed two-level plan beats
+    the flat ring for this payload (None when it never does within
+    ``max_pods``) — the pod-count-aware crossover the hierarchical sweep
+    records.  On healthy ICI-fast/DCN-slow coefficients this is 2: the
+    flat ring pays ``2(P·I−1)`` DCN-paced rounds the moment one pod
+    boundary exists."""
+    P = 2
+    while P <= max_pods:
+        winner, _ = choose_two_level(P, pod_size, nbytes, ici, dcn)
+        if winner == "two_level":
+            return P
+        P *= 2
+    return None
+
+
 def ring_allreduce_time(
     world: int, nbytes: float, coeffs: LinkCoeffs, chunks: int = 1
 ) -> float:

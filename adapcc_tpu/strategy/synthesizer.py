@@ -94,6 +94,11 @@ class Synthesizer:
                 prim, parallel_degree, transmission_size, bandwidth_graph,
                 latency_graph, local_rank0_list,
             )
+        if self.policy == "hier":
+            return self._hierarchical(
+                parallel_degree, transmission_size, bandwidth_graph,
+                latency_graph,
+            )
         ips = {r: ip for r, ip in enumerate(self.ip_table)}
         if self.policy == "ring":
             s = Strategy.ring(world, max(1, parallel_degree), ips)
@@ -103,6 +108,52 @@ class Synthesizer:
             raise ValueError(f"unknown synthesis policy {self.policy!r}")
         s.synthesis = self.policy
         return s
+
+    def _hierarchical(
+        self,
+        parallel_degree: int,
+        transmission_size: int,
+        bandwidth_graph,
+        latency_graph,
+    ) -> Strategy:
+        """The ``hier`` policy (docs/HIERARCHY.md): derive the DCN×ICI
+        sketch from the ip table (``ADAPCC_HIER_SKETCH`` overrides,
+        malformed → loud), solve each level against the per-link-class
+        α-β costs, and compose the two-level plan.  Per-level work is
+        O(pod) + O(num_pods) — never O(world) — which is what lets
+        world=4096 synthesis fit the MILP budget the flat solver blows.
+        A flat (single-pod) world rejects loudly: there is no hierarchy
+        to sketch, and silently synthesizing a flat shape under the
+        ``hier`` label would invalidate the scaling curve."""
+        from adapcc_tpu.strategy import hierarchy
+
+        world = len(self.ip_table)
+        sketch = hierarchy.resolve_sketch(world, self.ip_table)
+        if sketch is None:
+            raise ValueError(
+                f"policy 'hier' needs a multi-pod hierarchy, but the "
+                f"{world}-rank ip table resolves to a single pod / flat "
+                f"world; use a flat policy, or pin "
+                f"{hierarchy.HIER_SKETCH_ENV}"
+            )
+        usable = (
+            bandwidth_graph is not None
+            and latency_graph is not None
+            and len(bandwidth_graph) == world
+        )
+        model = hierarchy.model_from_graphs(
+            sketch,
+            bandwidth_graph if usable else None,
+            latency_graph if usable else None,
+        )
+        nbytes = (
+            transmission_size if transmission_size and transmission_size > 0
+            else DEFAULT_CHUNK_BYTES
+        )
+        plan = hierarchy.synthesize_two_level(
+            sketch, model, nbytes=nbytes, num_trans=max(1, parallel_degree)
+        )
+        return plan.strategy
 
     # -- simulated ranking pass ------------------------------------------------
 

@@ -121,12 +121,33 @@ def parse_strategy_xml(text_or_path: str, chunk_bytes: int = 4 * 1024 * 1024) ->
             c if c is not None else chunk_bytes for c in per_tree_chunks
         ]
     raw_wire = doc.attrib.get("wire_dtype")
-    return Strategy(
+    strategy = Strategy(
         trees, world_size, chunk_bytes,
         synthesis=doc.attrib.get("synthesis") or None,
         tree_chunk_bytes=tree_chunk_bytes,
         wire_dtype=_valid_wire_dtype(raw_wire) if raw_wire else "off",
     )
+    raw_hier = doc.attrib.get("hier")
+    if raw_hier:
+        # a composed two-level plan's sketch rides the artifact: reattach
+        # it so a parsed strategy executes the composed phases, not the
+        # projected fixed schedule.  Malformed attributes fail at the file
+        # that carries them (the chunk_bytes / wire_dtype precedent).
+        from adapcc_tpu.strategy import hierarchy
+
+        m = re.fullmatch(r"([1-9]\d*)x([1-9]\d*)", raw_hier)
+        if not m:
+            raise ValueError(
+                f"<trees hier={raw_hier!r}>: expected '<pods>x<pod_size>'"
+            )
+        sketch = hierarchy.HierarchySketch(int(m.group(1)), int(m.group(2)))
+        hierarchy.plan_from_strategy(
+            strategy,
+            sketch,
+            doc.attrib.get("hier_pod_algo", "rs-ag"),
+            doc.attrib.get("hier_leader_algo", "tree"),
+        )
+    return strategy
 
 
 def emit_strategy_xml(strategy: Strategy, path: Optional[str] = None) -> str:
@@ -139,6 +160,15 @@ def emit_strategy_xml(strategy: Strategy, path: Optional[str] = None) -> str:
         # fallback in production must be distinguishable from an optimum)
         doc.set("synthesis", strategy.synthesis)
     doc.set("chunk_bytes", str(strategy.chunk_bytes))
+    plan = getattr(strategy, "_two_level_plan", None)
+    if plan is not None:
+        # the composed plan's sketch + per-level schedules are part of the
+        # artifact: a re-parsed strategy must execute the same phases
+        doc.set(
+            "hier", f"{plan.sketch.num_pods}x{plan.sketch.pod_size}"
+        )
+        doc.set("hier_pod_algo", plan.pod_algo)
+        doc.set("hier_leader_algo", plan.leader_algo)
     if strategy.wire_dtype != "off":
         # only a non-default codec is persisted: reference XMLs and pre-quant
         # artifacts stay byte-stable, and absence unambiguously means "off"

@@ -73,6 +73,14 @@ ALGO_OF_PATH = {RD_PATH: "rd", TREE_PATH: "tree"}
 #: mesh, recorded by the engine and folded in via the known-keys rule)
 A2A_XLA_PATH = "xla"
 
+#: the composed two-level allreduce (adapcc_tpu/strategy/hierarchy: the
+#: RS-within-pod → AR-across-leaders → AG-within-pod plan executed by
+#: comm/two_level.py) as a key-vocabulary path: record-mode engines on a
+#: (dcn, ici) mesh time composed dispatches into this cell, and a pre-PR
+#: tuning.jsonl loads byte-identical next to it (a vocabulary extension,
+#: not a schema change — same rule as the rd/tree cells)
+TWO_LEVEL_PATH = "two-level"
+
 #: the fused XLA collective plane (``engine.all_reduce``'s psum fastpath)
 #: as an allreduce cell: the baseline the algorithm cells compete against
 #: from THAT entry point — it can neither execute nor time the Pallas

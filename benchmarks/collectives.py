@@ -128,15 +128,33 @@ def _make_ops(engine, elems: int, dtype=jnp.float32) -> Dict[str, tuple]:
     # gather/scatter route hierarchically on a (dcn, ici) mesh — label the
     # rows with the impl that actually runs, not the flat default
     gs_impl = "two_level" if two_level else "xla"
-    ops: Dict[str, tuple] = {
-        ("allreduce", "xla"): (lambda: engine.all_reduce(flat), per_rank),
-        ("allreduce", "strategy"): (
+    composed = False
+    if two_level:
+        from adapcc_tpu.strategy.hierarchy import plan_of
+
+        plan = plan_of(engine.strategy)
+        composed = plan is not None and plan.pod_algo == "rs-ag"
+    ops: Dict[str, tuple] = {}
+    if composed:
+        # a composed two-level plan outranks the GSPMD fastpath by design
+        # (DCN-volume control is the point), so the bare call IS the
+        # composed plan — an "xla" row here would time the same program
+        # under a baseline label.  The flat-baseline arm comes from the
+        # projected (non --hier) invocation.
+        ops[("allreduce", "two_level_composed")] = (
             lambda: engine.all_reduce(flat, active_gpus=list(range(world))),
             per_rank,
-        ),
-        ("all_gather", gs_impl): (lambda: engine.all_gather(flat), total),
-        ("reduce_scatter", gs_impl): (lambda: engine.reduce_scatter(flat), per_rank),
-    }
+        )
+    else:
+        ops[("allreduce", "xla")] = (lambda: engine.all_reduce(flat), per_rank)
+        ops[("allreduce", "strategy")] = (
+            lambda: engine.all_reduce(flat, active_gpus=list(range(world))),
+            per_rank,
+        )
+    ops[("all_gather", gs_impl)] = (lambda: engine.all_gather(flat), total)
+    ops[("reduce_scatter", gs_impl)] = (
+        lambda: engine.reduce_scatter(flat), per_rank,
+    )
     # subset rows: one rank masked out — regression-pins the cost of the
     # active-mask relay path on the gather/scatter primitives (VERDICT r4
     # item 3); same bytes accounting as the full-world rows.  world >= 2
@@ -245,7 +263,11 @@ def run_sweep(
                     algbw_gbps=algbw,
                     busbw_gbps=algbw * BUS_FACTORS[coll](world),
                     dtype=jnp.dtype(dtype).name,
-                    strategy=_strategy_label(engine) if impl == "strategy" else "",
+                    strategy=(
+                        _strategy_label(engine)
+                        if impl in ("strategy", "two_level_composed")
+                        else ""
+                    ),
                 )
             )
     return results
@@ -296,6 +318,17 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         "is ParTrees-synthesized over the slice layout and executes as "
         "ICI-collective + DCN master-tree rounds (comm/two_level.py)",
     )
+    ap.add_argument(
+        "--hier", action="store_true",
+        help="under --two-level: synthesize the composed two-level plan "
+        "(strategy/hierarchy.py — RS-within-pod, AR-across-leaders, "
+        "AG-within-pod) instead of the ParTrees projection.  Allreduce "
+        "then emits a single 'two_level_composed' row (the composed plan "
+        "outranks the GSPMD fastpath, so there is no honest in-invocation "
+        "'xla' baseline); the flat/projected arms come from a separate "
+        "non --hier invocation — the A/B the hw battery's "
+        "two_level_synth entry assembles (docs/HIERARCHY.md)",
+    )
     ap.add_argument("--json", action="store_true", help="emit JSON lines instead of a table")
     args = ap.parse_args(argv)
 
@@ -339,17 +372,38 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         dcn, ici = int(m.group(1)), int(m.group(2))
         world = dcn * ici
         mesh = build_two_level_mesh(dcn, ici)
-        # uniform profile → ParTrees emits the masters-plus-chains hierarchy
-        # that the two-level executor splits into ICI + DCN phases
-        ones = [[1.0] * world for _ in range(world)]
-        strategy = Synthesizer(None, mesh_ip_table(mesh)).synthesize(
-            ALLREDUCE, args.trans, 4 << 20, ones, ones
-        )
+        if args.hier:
+            # the synthesized composed plan (docs/HIERARCHY.md): the
+            # engine dispatches its RS→AR→AG phases for the strategy rows
+            from adapcc_tpu.strategy.hierarchy import (
+                HierarchySketch,
+                synthesize_two_level,
+            )
+
+            plan = synthesize_two_level(
+                HierarchySketch(dcn, ici, tuple(mesh_ip_table(mesh))),
+                nbytes=4 << 20,
+                num_trans=args.trans,
+            )
+            strategy = plan.strategy
+        else:
+            # uniform profile → ParTrees emits the masters-plus-chains
+            # hierarchy that the two-level executor splits into ICI + DCN
+            # phases
+            ones = [[1.0] * world for _ in range(world)]
+            strategy = Synthesizer(None, mesh_ip_table(mesh)).synthesize(
+                ALLREDUCE, args.trans, 4 << 20, ones, ones
+            )
         # impls stays None (no filter): _make_ops already emits only the
         # surfaces a two-level mesh supports (no pallas_ring rows there),
         # and a hardcoded label list would silently drop any future impl —
         # exactly the bug that once hid the two_level/subset rows
     else:
+        if args.hier:
+            ap.error(
+                "--hier synthesizes a two-level plan; it needs --two-level "
+                '"DxI" to name the pod layout'
+            )
         world = args.world or len(jax.devices())
         mesh = build_world_mesh(world)
         strategy = (

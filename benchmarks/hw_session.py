@@ -178,6 +178,12 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
       measured ring ↔ recursive-doubling crossover the cost model predicts
       (docs/LATENCY.md).  Needs a power-of-two multi-chip world; explicit
       skip row otherwise.
+    - ``two_level_synth`` — the composed-vs-flat two-level A/B (the
+      hardware twin of ``make hier-bench``, docs/HIERARCHY.md): the
+      synthesized RS→AR→AG plan vs the ParTrees projection vs the flat
+      psum on a 2×(world/2) virtual pod mesh.  Explicit skip row at
+      world=1 and odd/small worlds; single-host worlds are ordering
+      evidence only (the DCN axis rides ICI).
     - ``supervised_failover`` — the autonomous supervisor driving the
       elastic_failover fault plan out of band (the hardware twin of
       ``make chaos-bench``, docs/SUPERVISOR.md): daemon-journaled
@@ -189,8 +195,8 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
         for name in (
             "busbw_ici_128m", "ring_smoke", "ring_chunk_sweep",
             "busbw_wire_dtype", "busbw_fused_wire", "tuner_convergence",
-            "overlap_ab", "small_msg_crossover", "elastic_failover",
-            "online_adaptation", "supervised_failover",
+            "overlap_ab", "small_msg_crossover", "two_level_synth",
+            "elastic_failover", "online_adaptation", "supervised_failover",
         ):
             _skip(name, gate, out_path)
         return
@@ -303,6 +309,36 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
                 900, out_path,
                 extra_env={"ADAPCC_COLL_ALGO": algo},
                 rec_extra={"coll_algo": algo},
+            )
+    # composed-vs-flat two-level A/B (the hardware twin of `make
+    # hier-bench`, docs/HIERARCHY.md): one run on a 2x(world/2) virtual
+    # pod mesh with the SYNTHESIZED composed plan (--hier emits ONE
+    # 'two_level_composed' allreduce row — RS-within-pod ->
+    # AR-across-leaders -> AG-within-pod; the composed plan outranks the
+    # GSPMD fastpath, so that invocation has no honest 'xla' baseline),
+    # one with the ParTrees projection (whose 'xla' row IS the flat psum
+    # baseline and whose 'strategy' row is the replicate-first fixed
+    # schedule) — three arms of the same 128 MB allreduce across the two
+    # invocations.  Single-host worlds route the "DCN" axis over ICI, so
+    # the numbers are ordering evidence for the schedule shapes, not a
+    # DCN measurement; a multi-host window upgrades them automatically.
+    if world < 4 or world % 2:
+        _skip(
+            "two_level_synth",
+            f"world={world} (a 2x{max(world // 2, 1)} virtual pod needs an "
+            "even world >= 4)",
+            out_path,
+        )
+    else:
+        for arm in ("composed", "projected"):
+            _run(
+                "two_level_synth",
+                [py, "-m", "benchmarks.collectives",
+                 "--two-level", f"2x{world // 2}",
+                 "--collectives", "allreduce", "--sizes", "128M"]
+                + (["--hier"] if arm == "composed" else []),
+                900, out_path,
+                rec_extra={"two_level": f"2x{world // 2}", "plan": arm},
             )
     # elastic failover drill on real chips (the hardware twin of
     # `make elastic-bench`): a deterministic fault plan — the last rank

@@ -558,6 +558,88 @@ def latency_sweep(
     return rows
 
 
+def hier_sweep(
+    sizes: Sequence[int],
+    pods: Sequence[int] = (2, 4, 8),
+    pod_sizes: Sequence[int] = (4, 8),
+    model: Optional[LinkCostModel] = None,
+) -> List[dict]:
+    """Predicted two-level-vs-flat rows over the (pods × pod_size × size)
+    grid — the hardware-free regression artifact for the hierarchical
+    sketch synthesis (``make hier-bench``, docs/HIERARCHY.md §4).
+
+    Each row prices the best composed two-level allreduce (both pod
+    algorithms × their best leader schedule,
+    :func:`adapcc_tpu.sim.cost_model.two_level_allreduce_time`) against
+    the flat lockstep ring on the DCN bottleneck for one topology cell,
+    stamping the winner in ``chosen`` and the pod count where the
+    hierarchy starts paying in ``crossover_pods``
+    (:func:`~adapcc_tpu.sim.cost_model.two_level_crossover_pods`).  Only
+    the calibration's ICI/DCN *class* coefficients are read — the sweep
+    grid names its own topologies, so the model's world is irrelevant
+    (and world² state is never touched).  Deterministic: same calibration
+    → byte-identical rows.
+    """
+    from adapcc_tpu.sim.cost_model import (
+        DCN,
+        ICI,
+        choose_two_level,
+        two_level_crossover_pods,
+    )
+
+    pods = [int(p) for p in pods]
+    pod_sizes = [int(i) for i in pod_sizes]
+    bad = [p for p in pods if p < 2] + [i for i in pod_sizes if i < 2]
+    if bad:
+        raise ValueError(
+            f"hier sweep needs pods >= 2 and pod sizes >= 2, got pods="
+            f"{pods} pod_sizes={pod_sizes}"
+        )
+    if model is None:
+        model = load_or_default()
+    ici, dcn = model.classes[ICI], model.classes[DCN]
+    rows: List[dict] = []
+    for num_pods in pods:
+        for pod_size in pod_sizes:
+            world = num_pods * pod_size
+            for nbytes in sizes:
+                chosen, times = choose_two_level(
+                    num_pods, pod_size, int(nbytes), ici, dcn
+                )
+                two, flat = times["two_level"], times["flat"]
+                algbw = (
+                    int(nbytes) / two / 1e9 if two > 0 else 0.0
+                )
+                rows.append({
+                    "mode": "simulated",
+                    "collective": "allreduce",
+                    "impl": "two_level",
+                    "strategy": "two-level",
+                    "world": world,
+                    "pods": num_pods,
+                    "pod_size": pod_size,
+                    "size_bytes": int(nbytes),
+                    "pred_two_level_us": round(two * 1e6, 3),
+                    "pred_flat_us": round(flat * 1e6, 3),
+                    "chosen": chosen,
+                    "two_level_faster": chosen == "two_level",
+                    "crossover_pods": two_level_crossover_pods(
+                        pod_size, int(nbytes), ici, dcn
+                    ),
+                    "algbw_gbps": round(algbw, 6),
+                    "busbw_gbps": round(
+                        algbw * BUS_FACTORS["allreduce"](world), 6
+                    ),
+                    "calibration": model.source,
+                })
+    if not rows:
+        raise ValueError(
+            f"hier sweep produced no rows: sizes={list(sizes)} pods={pods} "
+            f"pod_sizes={pod_sizes}"
+        )
+    return rows
+
+
 def overlap_sweep(
     world: int,
     sizes: Sequence[int],
@@ -1254,6 +1336,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="chaos-sweep confirmation-count grid",
     )
     ap.add_argument(
+        "--hier-sweep", action="store_true",
+        help="price the composed two-level allreduce against the flat "
+        "ring over a (pods x pod_size x size) grid, with the per-row "
+        "two-level-vs-flat decision and the pod-count crossover flagged "
+        "(make hier-bench; docs/HIERARCHY.md)",
+    )
+    ap.add_argument(
+        "--pods", default="2,4,8",
+        help="hier-sweep pod-count grid",
+    )
+    ap.add_argument(
+        "--pod-sizes", default="4,8",
+        help="hier-sweep ranks-per-pod grid",
+    )
+    ap.add_argument(
         "--latency-sweep", action="store_true",
         help="price the latency-bound allreduce algorithms (ring vs "
         "recursive doubling vs binomial tree) over --sizes instead of the "
@@ -1299,6 +1396,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--fused-sweep", args.fused_sweep),
             ("--tune-replay", args.tune_replay),
             ("--overlap-sweep", args.overlap_sweep),
+            ("--hier-sweep", args.hier_sweep),
             ("--latency-sweep", args.latency_sweep),
             ("--fault-sweep", args.fault_sweep),
             ("--adapt-sweep", args.adapt_sweep),
@@ -1311,6 +1409,32 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ap.error(f"{' and '.join(exclusive)} are mutually exclusive; "
                  "run one sweep per invocation")
     model = load_or_default(args.calibration, world=args.world)
+    if args.hier_sweep:
+        if args.hosts > 1:
+            # the sweep grid names its own topologies (pods x pod_size);
+            # silently accepting --hosts would read as "priced that host
+            # split" when nothing used it (the --chaos-sweep precedent)
+            ap.error("--hosts has no effect on --hier-sweep (use --pods/"
+                     "--pod-sizes)")
+        rows = hier_sweep(
+            sizes=[parse_size(s) for s in args.sizes.split(",")],
+            pods=[int(p) for p in args.pods.split(",") if p],
+            pod_sizes=[int(i) for i in args.pod_sizes.split(",") if i],
+            model=model,
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            else:
+                star = "*" if row["two_level_faster"] else " "
+                print(
+                    f"[sim] hier {row['size_bytes']:>12}B "
+                    f"pods={row['pods']:>3} pod_size={row['pod_size']:>2}{star} "
+                    f"two_level={row['pred_two_level_us']:>10.1f}us  "
+                    f"flat={row['pred_flat_us']:>10.1f}us  "
+                    f"crossover_pods={row['crossover_pods']}"
+                )
+        return 0
     if args.chaos_sweep:
         if args.hosts > 1:
             # the liveness machine is topology-blind (a heartbeat is a

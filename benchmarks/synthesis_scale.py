@@ -1,4 +1,5 @@
-"""Pod-scale synthesizer benchmark: milp vs partrees vs ring at 32-64 ranks.
+"""Pod-scale synthesizer benchmark: milp vs partrees vs ring vs the
+hierarchical sketch policy, world 32 → 4096.
 
 The reference ships strategy fixtures up to 24 GPUs (`strategy/`, 17 files)
 and its Gurobi study compares solver vs heuristic makespans
@@ -25,6 +26,12 @@ host pair's DCN bandwidth is cut to a fraction, so bandwidth-aware synthesis
 (milp / partrees BDP sort) should beat the oblivious ring on the modeled
 makespan.
 
+The ``hier`` policy rows (docs/HIERARCHY.md) are the pod-cluster
+extension: matrix-free per-level solves whose wall time stays inside
+``MILP_SYNTH_BUDGET_S`` all the way to world=4096, recorded next to the
+flat policies' blowout — every row stamps ``synth_budget_s`` /
+``within_synth_budget`` so the scaling curve is pinned, not eyeballed.
+
 Usage::
 
     python -m benchmarks.synthesis_scale --worlds 32,64 --json
@@ -47,6 +54,19 @@ from adapcc_tpu.primitives import ALLREDUCE
 ICI_BW, ICI_LAT = 400.0, 1e-6
 DCN_BW, DCN_LAT = 25.0, 5e-5
 
+#: largest world the dense-matrix (flat) policies run at in the default
+#: sweep: the flat MILP measures ~5.9 s at 1024 (already 6x the budget —
+#: the row records the blowout) and minutes at 4096; the hierarchical
+#: sketch policy carries the curve beyond this, matrix-free
+MATRIX_POLICY_MAX_WORLD = 1024
+
+
+def synthetic_ip_table(num_hosts: int, per_host: int) -> List[str]:
+    """The matrix-free half of :func:`synthetic_topology` — all the
+    hierarchical sketch policy needs, so pod-cluster worlds never pay the
+    world² matrix build just to benchmark an O(pod)+O(hosts) solve."""
+    return [f"10.8.{h}.1" for h in range(num_hosts) for _ in range(per_host)]
+
 
 def synthetic_topology(
     num_hosts: int, per_host: int, degraded_pair: Optional[Tuple[int, int]] = (0, 1),
@@ -57,26 +77,29 @@ def synthetic_topology(
     ``degraded_pair`` cuts one host pair's DCN bandwidth by
     ``degrade_factor`` — the adaptive-routing case the synthesizers exist
     for (reference README: "adapts to dynamic network conditions").
+    Vectorized: the pod-scale worlds the default grid now reaches would
+    spend longer building matrices in a Python loop than synthesizing.
     """
+    import numpy as np
+
     world = num_hosts * per_host
-    ip_table = [f"10.8.{h}.1" for h in range(num_hosts) for _ in range(per_host)]
-    host_of = [r // per_host for r in range(world)]
-    bw = [[0.0] * world for _ in range(world)]
-    lat = [[0.0] * world for _ in range(world)]
-    for i in range(world):
-        for j in range(world):
-            if i == j:
-                continue
-            if host_of[i] == host_of[j]:
-                bw[i][j], lat[i][j] = ICI_BW, ICI_LAT
-            else:
-                b, l = DCN_BW, DCN_LAT
-                if degraded_pair is not None and {host_of[i], host_of[j]} == set(
-                    degraded_pair
-                ):
-                    b, l = DCN_BW * degrade_factor, DCN_LAT * 4
-                bw[i][j], lat[i][j] = b, l
-    return ip_table, bw, lat
+    ip_table = synthetic_ip_table(num_hosts, per_host)
+    host_of = np.arange(world) // per_host
+    same = host_of[:, None] == host_of[None, :]
+    bw = np.where(same, ICI_BW, DCN_BW)
+    lat = np.where(same, ICI_LAT, DCN_LAT)
+    if degraded_pair is not None:
+        a, b = degraded_pair
+        pair = (
+            (host_of[:, None] == a) & (host_of[None, :] == b)
+        ) | (
+            (host_of[:, None] == b) & (host_of[None, :] == a)
+        )
+        bw = np.where(pair, DCN_BW * degrade_factor, bw)
+        lat = np.where(pair, DCN_LAT * 4, lat)
+    np.fill_diagonal(bw, 0.0)
+    np.fill_diagonal(lat, 0.0)
+    return ip_table, bw.tolist(), lat.tolist()
 
 
 def crosshost_makespan(
@@ -113,36 +136,39 @@ def bench_policy(
     parallel_degree: int = 2,
     transmission_size: int = 4 << 20,
 ) -> dict:
-    """Synthesize + score one policy; returns one artifact row."""
+    """Synthesize + score one policy; returns one artifact row.
+
+    Every row carries ``synth_budget_s`` / ``within_synth_budget`` (the
+    reconstruction budget the pruned MILP earned at 64 ranks, PR 2), so
+    the pod-scale curve is pinned per policy rather than eyeballed.  The
+    ``hier`` policy (docs/HIERARCHY.md) needs no profile matrices — pass
+    ``bw=lat=None`` and the row prices off the sketch's class
+    coefficients; matrix policies reject None loudly.
+    """
     from adapcc_tpu import native
-    from adapcc_tpu.strategy.solver import modeled_makespan
+    from adapcc_tpu.strategy.solver import MILP_SYNTH_BUDGET_S, modeled_makespan
     from adapcc_tpu.strategy.synthesizer import Synthesizer, _infer_local_rank0s
 
     world = len(ip_table)
     masters = _infer_local_rank0s(ip_table)
+    have_matrices = bw is not None and lat is not None
+    if policy != "hier" and not have_matrices:
+        raise ValueError(
+            f"policy {policy!r} synthesizes from profile matrices; only "
+            "'hier' runs matrix-free (the sketch's class coefficients)"
+        )
     t0 = time.perf_counter()
     strategy = Synthesizer(None, ip_table, policy).synthesize(
         ALLREDUCE, parallel_degree, transmission_size, bw, lat
     )
     synth_s = time.perf_counter() - t0
-    if policy == "milp":
-        # regression row for the pruned routing MILP (VERDICT r5 weak #4):
-        # pod-scale synthesis must stay inside the reconstruction budget
-        from adapcc_tpu.strategy.solver import MILP_SYNTH_BUDGET_S
-
-        budget_extra = {
-            "synth_budget_s": MILP_SYNTH_BUDGET_S,
-            "within_synth_budget": synth_s <= MILP_SYNTH_BUDGET_S,
-        }
-    else:
-        budget_extra = {}
 
     t0 = time.perf_counter()
     rounds = sum(
         len(t.reduce_rounds()) + len(t.broadcast_rounds()) for t in strategy.trees
     )
     lower_s = time.perf_counter() - t0
-    return {
+    row = {
         "world": world,
         "hosts": len(masters),
         "policy": policy,
@@ -155,19 +181,37 @@ def bench_policy(
             native.available()
             and world >= type(strategy.trees[0]).NATIVE_LOWERING_THRESHOLD
         ),
+        "synth_budget_s": MILP_SYNTH_BUDGET_S,
+        "within_synth_budget": synth_s <= MILP_SYNTH_BUDGET_S,
+    }
+    if have_matrices:
         # raw model units (reference gurobi objective) — inter-master edges
         # only, comparable between milp and partrees
-        "modeled_makespan": float(
+        row["modeled_makespan"] = float(
             modeled_makespan(
                 strategy, masters, ALLREDUCE, transmission_size, bw, lat
             )
-        ),
+        )
         # seconds → ms, every edge scored — comparable across ALL policies
-        "crosshost_makespan_ms": round(
+        row["crosshost_makespan_ms"] = round(
             crosshost_makespan(strategy, bw, lat, transmission_size) * 1e3, 4
-        ),
-        **budget_extra,
-    }
+        )
+    if policy == "hier":
+        from adapcc_tpu.strategy.hierarchy import plan_of
+
+        plan = plan_of(strategy)
+        row.update({
+            "hier_pods": plan.sketch.num_pods,
+            "hier_pod_size": plan.sketch.pod_size,
+            "pod_algo": plan.pod_algo,
+            "leader_algo": plan.leader_algo,
+            "ici_solve_ms": round(plan.ici_solve.solve_s * 1e3, 4),
+            "dcn_solve_ms": round(plan.dcn_solve.solve_s * 1e3, 4),
+            "pred_two_level_us": round(plan.predicted_s * 1e6, 3),
+            "pred_flat_us": round(plan.flat_pred_s * 1e6, 3),
+            "chosen_vs_flat": plan.chosen_vs_flat,
+        })
+    return row
 
 
 def exec_relative_busbw(
@@ -224,10 +268,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     apply_platform_env()
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--worlds", default="32,64",
+    ap.add_argument("--worlds", default="32,64,256,1024,4096",
                     help="comma list of world sizes (8 ranks per host)")
     ap.add_argument("--per-host", type=int, default=8)
-    ap.add_argument("--policies", default="par-trees,milp,ring")
+    ap.add_argument("--policies", default="par-trees,milp,ring,hier")
     ap.add_argument("--degrade", type=float, default=0.25,
                     help="bandwidth factor for the degraded host pair (1.0 = healthy)")
     ap.add_argument("--exec", action="store_true", dest="exec_",
@@ -241,11 +285,37 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             raise SystemExit(f"world {world} must divide per-host {args.per_host}")
         hosts = world // args.per_host
         degraded = (0, 1) if args.degrade < 1.0 and hosts >= 2 else None
-        ip_table, bw, lat = synthetic_topology(
-            hosts, args.per_host, degraded_pair=degraded,
-            degrade_factor=args.degrade,
-        )
-        for policy in (p for p in args.policies.split(",") if p):
+        policies = [p for p in args.policies.split(",") if p]
+        # matrix policies stop at MATRIX_POLICY_MAX_WORLD: beyond it the
+        # flat synthesis (and the world² matrix build feeding it) is
+        # minutes of wall time — the sketch policy exists exactly because
+        # that does not scale.  Explicit skip rows keep the curve honest.
+        need_matrices = any(p != "hier" for p in policies)
+        if need_matrices and world <= MATRIX_POLICY_MAX_WORLD:
+            ip_table, bw, lat = synthetic_topology(
+                hosts, args.per_host, degraded_pair=degraded,
+                degrade_factor=args.degrade,
+            )
+        else:
+            ip_table, bw, lat = synthetic_ip_table(hosts, args.per_host), None, None
+        for policy in policies:
+            if policy != "hier" and bw is None:
+                rows.append({
+                    "world": world, "hosts": hosts, "policy": policy,
+                    "skipped": (
+                        f"world {world} > {MATRIX_POLICY_MAX_WORLD}: flat "
+                        "synthesis over dense profile matrices exceeds the "
+                        "budget by orders of magnitude at this scale "
+                        "(the hier rows carry the curve)"
+                    ),
+                })
+                continue
+            if policy == "hier" and hosts < 2:
+                rows.append({
+                    "world": world, "hosts": hosts, "policy": policy,
+                    "skipped": "single host: no hierarchy to sketch",
+                })
+                continue
             row = bench_policy(policy, ip_table, bw, lat)
             row["degrade_factor"] = args.degrade if degraded else 1.0
             rows.append(row)

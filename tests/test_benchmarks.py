@@ -527,3 +527,67 @@ def test_latency_sweep_cli_emits_json(capsys):
     rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
     assert rows and all(r["impl"] == "latency" for r in rows)
     assert {r["algo"] for r in rows} == {"ring", "rd", "tree"}
+
+
+def test_hier_sweep_rows_byte_identical_and_decision_flagged():
+    """The hier-bench artifact (docs/HIERARCHY.md §4) is deterministic to
+    the byte over the (pods × pod_size × size) grid and stamps the
+    two-level-vs-flat decision plus the pod-count crossover per row."""
+    from benchmarks.sim_collectives import hier_sweep
+
+    sizes = [64 << 10, 1 << 20, 128 << 20]
+    rows = hier_sweep(sizes, pods=(2, 4), pod_sizes=(4, 8))
+    again = hier_sweep(sizes, pods=(2, 4), pod_sizes=(4, 8))
+    assert [json.dumps(r, sort_keys=True) for r in rows] == [
+        json.dumps(r, sort_keys=True) for r in again
+    ]
+    assert len(rows) == len(sizes) * 2 * 2
+    for r in rows:
+        assert r["mode"] == "simulated" and r["impl"] == "two_level"
+        assert r["world"] == r["pods"] * r["pod_size"]
+        assert r["chosen"] in ("two_level", "flat")
+        assert r["two_level_faster"] == (r["chosen"] == "two_level")
+        # on the default (ICI-fast / DCN-slow) classes, one pod boundary
+        # already pays: every multi-pod cell picks the composed plan
+        assert r["chosen"] == "two_level"
+        assert r["pred_two_level_us"] < r["pred_flat_us"]
+        assert r["crossover_pods"] == 2
+    with pytest.raises(ValueError, match="pods >= 2"):
+        hier_sweep(sizes, pods=(1,), pod_sizes=(4,))
+    with pytest.raises(ValueError, match="pod sizes >= 2"):
+        hier_sweep(sizes, pods=(2,), pod_sizes=(1,))
+
+
+def test_hier_sweep_cli_mutually_exclusive_and_rejects_hosts(capsys):
+    from benchmarks.sim_collectives import main
+
+    for other in (
+        ["--ring-sweep"],
+        ["--tune-replay"],
+        ["--fused-sweep"],
+        ["--overlap-sweep"],
+        ["--fault-sweep"],
+        ["--latency-sweep"],
+        ["--adapt-sweep"],
+        ["--chaos-sweep"],
+        ["--wire-dtype", "off,int8"],
+    ):
+        with pytest.raises(SystemExit):
+            main(["--hier-sweep"] + other)
+    # the sweep grid names its own topologies: --hosts is meaningless
+    with pytest.raises(SystemExit):
+        main(["--hier-sweep", "--hosts", "2"])
+    capsys.readouterr()
+
+
+def test_hier_sweep_cli_emits_json(capsys):
+    from benchmarks.sim_collectives import main
+
+    assert main([
+        "--hier-sweep", "--sizes", "1M,128M", "--pods", "2,4",
+        "--pod-sizes", "4", "--json",
+    ]) == 0
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rows and all(r["impl"] == "two_level" for r in rows)
+    assert {r["pods"] for r in rows} == {2, 4}
+    assert all("pred_flat_us" in r and "chosen" in r for r in rows)

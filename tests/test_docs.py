@@ -2,9 +2,9 @@
 
 Every ```python block in docs/PARALLELISM.md, docs/OPERATIONS.md,
 docs/SIMULATION.md, docs/RING.md, docs/QUANT.md, docs/TUNER.md,
-docs/OVERLAP.md, docs/LATENCY.md, docs/ELASTIC.md, docs/ADAPT.md and
-docs/SUPERVISOR.md runs verbatim on the virtual pod.  A snippet that
-stops compiling or produces wrong shapes fails here.
+docs/OVERLAP.md, docs/LATENCY.md, docs/ELASTIC.md, docs/ADAPT.md,
+docs/SUPERVISOR.md and docs/HIERARCHY.md runs verbatim on the virtual
+pod.  A snippet that stops compiling or produces wrong shapes fails here.
 """
 
 import os
@@ -26,6 +26,7 @@ _LATENCY = os.path.join(_DOCS_DIR, "LATENCY.md")
 _ELASTIC = os.path.join(_DOCS_DIR, "ELASTIC.md")
 _ADAPT = os.path.join(_DOCS_DIR, "ADAPT.md")
 _SUPERVISOR = os.path.join(_DOCS_DIR, "SUPERVISOR.md")
+_HIERARCHY = os.path.join(_DOCS_DIR, "HIERARCHY.md")
 
 
 def _blocks(path):
@@ -264,3 +265,27 @@ def test_supervisor_doc_covers_the_contract():
 def test_supervisor_doc_snippet_runs(idx):
     code = _blocks(_SUPERVISOR)[idx]
     exec(compile(code, f"{_SUPERVISOR}:block{idx}", "exec"), {})
+
+
+def test_hierarchy_doc_has_snippets():
+    assert len(_blocks(_HIERARCHY)) >= 5
+
+
+def test_hierarchy_doc_covers_the_contract():
+    """The pod-scale synthesis topics the hierarchy story leans on."""
+    text = open(_HIERARCHY).read()
+    for needle in (
+        "ADAPCC_HIER_SKETCH", "HierarchySketch", "synthesize_two_level",
+        "resolve_leader_level", "MILP_SYNTH_BUDGET_S", "ragged",
+        "two_level_allreduce_time", "choose_two_level",
+        "two_level_crossover_pods", "psum_scatter", "cache_hit",
+        "resolved_level", "make hier-bench", "two_level_synth",
+        "plan_of", "leader_projection", "4096",
+    ):
+        assert needle in text, f"HIERARCHY.md lost its {needle!r} coverage"
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_HIERARCHY))))
+def test_hierarchy_doc_snippet_runs(idx):
+    code = _blocks(_HIERARCHY)[idx]
+    exec(compile(code, f"{_HIERARCHY}:block{idx}", "exec"), {})

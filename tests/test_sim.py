@@ -690,8 +690,8 @@ def test_hw_session_multichip_phases_skip_cleanly_at_world1(tmp_path):
     assert {r["phase"] for r in rows} == {
         "busbw_ici_128m", "ring_smoke", "ring_chunk_sweep",
         "busbw_wire_dtype", "busbw_fused_wire", "tuner_convergence",
-        "overlap_ab", "small_msg_crossover", "elastic_failover",
-        "online_adaptation", "supervised_failover",
+        "overlap_ab", "small_msg_crossover", "two_level_synth",
+        "elastic_failover", "online_adaptation", "supervised_failover",
     }
     for r in rows:
         assert "world=1" in r["skipped"]

@@ -138,6 +138,47 @@ def test_milp_rows_carry_the_synthesis_budget():
     # best-of-3 in test_solver (one loaded-CI run must not flake tier-1),
     # and this 5x ceiling still catches the unpruned 4-6 s cliff
     assert row["synth_ms"] / 1e3 < 5 * MILP_SYNTH_BUDGET_S, row["synth_ms"]
-    # non-milp rows carry no budget fields (they never had a cliff)
+    # since the pod-scale extension EVERY row carries the budget stamp —
+    # the scaling curve is pinned per policy, not eyeballed from milp rows
     ring_row = bench_policy("ring", ip, bw, lat)
-    assert "within_synth_budget" not in ring_row
+    assert ring_row["synth_budget_s"] == MILP_SYNTH_BUDGET_S
+    assert ring_row["within_synth_budget"] is True
+
+
+def test_hier_policy_rows_and_cli_skip_rows(capsys):
+    """The pod-scale curve: hier rows carry the sketch + per-level solve
+    walltimes and the composed-vs-flat pricing; beyond the matrix cap the
+    flat policies emit explicit skip rows while hier carries the curve."""
+    import json
+
+    from benchmarks.synthesis_scale import main
+
+    assert main([
+        "--worlds", "32,4096", "--policies", "ring,hier", "--json",
+    ]) == 0
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    by = {(r["world"], r["policy"]): r for r in rows}
+    # at 32 both policies synthesize; ring carries matrix scores too
+    assert by[(32, "ring")]["within_synth_budget"]
+    h32 = by[(32, "hier")]
+    assert h32["synthesis"] == "two-level" and h32["hier_pods"] == 4
+    assert h32["pred_two_level_us"] < h32["pred_flat_us"]
+    assert h32["chosen_vs_flat"] == "two_level"
+    # at 4096 the flat policy is an explicit skip row, hier is the curve
+    assert "skipped" in by[(4096, "ring")]
+    h4096 = by[(4096, "hier")]
+    assert h4096["within_synth_budget"], h4096
+    assert h4096["hier_pods"] == 512 and h4096["hier_pod_size"] == 8
+    assert h4096["ici_solve_ms"] < 10 and h4096["dcn_solve_ms"] < 10
+    assert h4096["rounds"] > 0  # the 4096-rank trees lower
+
+
+def test_hier_bench_policy_requires_no_matrices():
+    from benchmarks.synthesis_scale import synthetic_ip_table
+
+    ip = synthetic_ip_table(8, 8)
+    row = bench_policy("hier", ip, None, None)
+    assert row["within_synth_budget"] and row["policy"] == "hier"
+    assert "modeled_makespan" not in row  # no matrices, no matrix scores
+    with pytest.raises(ValueError, match="matrix-free"):
+        bench_policy("ring", ip, None, None)
