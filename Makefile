@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench hier-bench elastic-bench adapt-bench chaos-bench trace-export clean
+.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench hier-bench elastic-bench adapt-bench chaos-bench fabric-bench trace-export clean
 
 all: native
 
@@ -116,6 +116,17 @@ adapt-bench:
 chaos-bench:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--world 8 --sizes 16M,128M --chaos-sweep --json
+
+# Multi-tenant fabric sweep on the same simulator (docs/FABRIC.md):
+# deterministic "mode": "simulated" rows over (congestion intensity x
+# priority mix) on a two-pod split of --world — the coordinated high-low
+# fabric (the low-priority job's synthesizer constrained off the high
+# job's occupied links) priced against the uncoordinated high-high
+# pile-up, with per-job steady states, Jain's fairness index, and the
+# high-beats-uncoordinated acceptance flag stamped per row.
+fabric-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--world 8 --sizes 1M,16M --fabric-sweep --intensities 1,2,4 --json
 
 # Perfetto/chrome://tracing export of a recorded dispatch trace: run a
 # short virtual-pod collective session under ADAPCC_TUNER=record and emit

@@ -18,7 +18,15 @@ with **zero probe traffic on the hot path**:
 - :mod:`adapcc_tpu.adapt.controller` — sim re-rank over candidate
   strategies under the corrected costs, top-k AOT-compiled through the
   PR-7 :class:`StandbyPlanCache`, adoption a hysteresis-gated
-  ``advance_epoch`` cache-key switch (``ADAPCC_ADAPT=off|detect|swap``).
+  ``advance_epoch`` cache-key switch (``ADAPCC_ADAPT=off|detect|swap``);
+- :mod:`adapcc_tpu.adapt.triage` — congestion-vs-degradation triage over
+  a fired drift report (docs/FABRIC.md): congestion re-routes under a
+  TRANSIENT contended model and restores the incumbent when the window
+  clears; only degradation takes the re-calibrate path above;
+- :mod:`adapcc_tpu.adapt.fabric` — the multi-tenant QoS harness: two
+  prioritized jobs on one simulated topology, the low-priority job's
+  synthesizer constrained off the links the high-priority job occupies
+  (``ADAPCC_JOB_PRIORITY``), the fairness/throughput frontier priced.
 """
 
 from adapcc_tpu.adapt.controller import (
@@ -27,6 +35,19 @@ from adapcc_tpu.adapt.controller import (
     AdaptationController,
     AdaptationReport,
     adapt_mode,
+)
+from adapcc_tpu.adapt.fabric import (
+    JOB_PRIORITIES,
+    JOB_PRIORITY_ENV,
+    FabricJob,
+    FabricPlan,
+    SharedFabric,
+    job_priority,
+)
+from adapcc_tpu.adapt.triage import (
+    TriageVerdict,
+    classify_drift,
+    contended_view,
 )
 from adapcc_tpu.adapt.detector import (
     DEFAULT_DRIFT_FACTOR,
@@ -57,10 +78,19 @@ __all__ = [
     "DriftDetector",
     "DriftReport",
     "DriftSignal",
+    "FabricJob",
+    "FabricPlan",
+    "JOB_PRIORITIES",
+    "JOB_PRIORITY_ENV",
+    "SharedFabric",
+    "TriageVerdict",
     "adapt_mode",
     "calibration_of",
+    "classify_drift",
+    "contended_view",
     "corrected_model",
     "drift_correction",
+    "job_priority",
     "resolve_drift_factor",
     "resolve_drift_window",
 ]

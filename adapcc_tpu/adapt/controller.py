@@ -28,7 +28,7 @@ from __future__ import annotations
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Any, Callable, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -66,8 +66,14 @@ class AdaptationReport:
 
     mode: str
     #: "off" | "no-drift" | "uninvertible" | "incumbent-wins" |
-    #: "hysteresis" | "would-swap" (detect mode) | "swapped"
+    #: "hysteresis" | "would-swap" (detect mode) | "swapped" |
+    #: "congestion-would-reroute" (detect mode) | "congestion-reroute" |
+    #: "congestion-hysteresis" | "congestion-active" |
+    #: "congestion-sustained" | "congestion-cleared" (docs/FABRIC.md)
     outcome: str
+    #: the triage verdict behind a fired pass: "congestion" |
+    #: "degradation" | None (no drift, or uninvertible evidence)
+    triage: Optional[str] = None
     drift: Optional[DriftReport] = None
     recalibrated: bool = False
     calibration_source: Optional[str] = None
@@ -97,6 +103,7 @@ class AdaptationReport:
         return {
             "mode": self.mode,
             "outcome": self.outcome,
+            "triage": self.triage,
             "fired": self.fired,
             "recalibrated": self.recalibrated,
             "calibration": self.calibration_source,
@@ -148,6 +155,7 @@ class AdaptationController:
         warm_shape: Tuple[int, ...] = (1024,),
         warm_dtype=np.float32,
         decay: float = 0.5,
+        congestion_profile=None,
     ) -> None:
         adapt_mode(mode)  # validate BOTH the env and the explicit mode now
         if top_k < 1:
@@ -207,6 +215,31 @@ class AdaptationController:
         )
         self.swaps = 0
         self.reports: List[AdaptationReport] = []
+        #: the congestion-reroute state: set exactly while a transient
+        #: re-route is live, carrying the pre-congestion incumbent so the
+        #: clear restores it (reversibility is the acceptance property)
+        self._congestion: Optional[Tuple[Any, Any]] = None  # (strategy, verdict)
+        #: deterministic congestion-injection funnel (docs/FABRIC.md §4)
+        self._profile = None
+        #: per-(factors, model) pricing-policy cache for the tick funnel
+        self._tick_policies: Dict[Any, Any] = {}
+        if congestion_profile is not None:
+            self.attach_congestion_profile(congestion_profile)
+        # two payload decades for the priced probe cells: the α-β triage
+        # needs >= 2 distinct sizes to separate bandwidth contention from
+        # degradation (adapcc_tpu/adapt/triage.py module doc).  A payload
+        # whose bucket is already at the 4 KiB floor would collapse both
+        # probes into ONE cell — single-size evidence is never separable,
+        # so every congestion window would be mis-triaged as degradation;
+        # stretch the top probe to the 16 MiB decade instead (β-dominated
+        # on every calibrated fabric here — a 1 MiB probe can sit under
+        # the drift threshold on α-heavy classes; the probe cells price
+        # the fabric, they need not equal the job payload).
+        from adapcc_tpu.tuner.db import size_bucket
+
+        top = size_bucket(self.nbytes)
+        lo = max(4096, top >> 8)
+        self._probe_sizes: Tuple[int, ...] = (lo, top if top > lo else lo << 12)
 
     # -- mode ------------------------------------------------------------------
 
@@ -240,6 +273,74 @@ class AdaptationController:
     def check(self) -> DriftReport:
         self.refresh()
         return self.detector.check()
+
+    # -- congestion injection funnel (docs/FABRIC.md §4) -----------------------
+
+    @property
+    def rerouted(self) -> bool:
+        """True exactly while a transient congestion re-route is live."""
+        return self._congestion is not None
+
+    def attach_congestion_profile(self, profile) -> None:
+        """Arm the deterministic congestion-injection funnel
+        (``ADAPCC_CONGESTION_PROFILE``): :meth:`tick` will feed the drift
+        detector contention-scaled priced samples per step — the
+        observation-funnel twin of the coordinator's fault-plan
+        injection, so the triage drill fires deterministically instead of
+        waiting for a real neighbor."""
+        if profile.world != self.engine.world_size:
+            raise ValueError(
+                f"congestion profile world {profile.world} != engine world "
+                f"{self.engine.world_size}"
+            )
+        self._profile = profile
+
+    def _tick_policy(self, factors):
+        """The pricing policy for one step's contention factors, cached:
+        tick() runs on the training hot path (once per step), and the
+        policy only changes when the window factors or the live model do
+        — never rebuild it per probe per step."""
+        from adapcc_tpu.tuner.db import TuningDatabase
+        from adapcc_tpu.tuner.policy import TuningPolicy
+
+        fkey = tuple(sorted(factors.items()))
+        cached = self._tick_policies.get(fkey)
+        if cached is not None and cached[0] is self._model:
+            return cached[1]
+        model = self._model.contended(factors) if factors else self._model
+        policy = TuningPolicy(
+            TuningDatabase(persist=False),
+            self.engine.world_size,
+            self.detector.topology,
+            cost_model=model,
+        )
+        self._tick_policies[fkey] = (self._model, policy)
+        return policy
+
+    def _priced(self, policy, key, nbytes: int) -> Optional[float]:
+        try:
+            pred = policy.prior_time(key, int(nbytes))
+        except (KeyError, ValueError):
+            return None
+        return pred if pred > 0 else None
+
+    def tick(self, step: int) -> None:
+        """Feed one step of the attached congestion profile: each probe
+        cell (two payload decades, :meth:`DriftDetector.probe_key`)
+        observes the calibration price under that step's CONTENDED model
+        — the class's β scaled by the window factor, α intact — so a
+        window fires the detector with the congestion signature and a
+        healthy step feeds reversal evidence.  No-op without a profile;
+        deterministic (no RNG, no wall clock)."""
+        if self._profile is None:
+            return
+        factors = self._profile.factors_at(int(step))
+        policy = self._tick_policy(factors)
+        for nbytes in self._probe_sizes:
+            key = self.detector.probe_key(nbytes)
+            pred = self._priced(policy, key, nbytes)
+            if pred is not None:
+                self.detector.observe(key, pred, nbytes=nbytes)
 
     # -- the loop --------------------------------------------------------------
 
@@ -343,12 +444,213 @@ class AdaptationController:
         self.detector.reset(watermark=time.time())
         return self._done(report)
 
+    def _swap_stages(self, report: AdaptationReport, winner_strategy,
+                     label: str, predicted_s: float, warm_extra=()) -> None:
+        """The shared swap tail: AOT warm (winner + any extra candidates)
+        → trainer prewarm → one ``advance_epoch`` adoption → trainer
+        adoption, with the warm/stall walltimes stamped on the report."""
+        t0 = time.perf_counter()
+        self.cache.warm_strategy(
+            winner_strategy,
+            self.warm_shape,
+            self.warm_dtype,
+            label=label,
+            predicted_s=predicted_s,
+        )
+        for cand in warm_extra:
+            self.cache.warm_strategy(
+                cand.strategy,
+                self.warm_shape,
+                self.warm_dtype,
+                label=cand.label,
+                predicted_s=cand.seconds,
+            )
+        if self.trainer_prewarm is not None:
+            self.trainer_prewarm(winner_strategy)
+        report.aot_warm_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        report.epoch = self.cache.adopt(winner_strategy)
+        if self.trainer is not None:
+            report.trainer_adopt_hit = self.trainer.adopt_strategy(
+                winner_strategy
+            )
+        report.stall_s = time.perf_counter() - t1
+        report.swapped = True
+        self.swaps += 1
+        self.detector.reset(watermark=time.time())
+
+    def _congestion_pass(self, mode: str) -> AdaptationReport:
+        """One pass while a transient re-route is live: the feeds keep
+        monitoring the fabric against the UNCHANGED calibration, and the
+        incumbent is restored the moment a full window reads healthy —
+        the reversibility half of the triage (docs/FABRIC.md §3).  The
+        full window IS the restore hysteresis: one healthy dispatch never
+        flaps the plan back."""
+        incumbent, verdict = self._congestion
+        drift = self.check()
+        report = AdaptationReport(
+            mode=mode,
+            outcome="congestion-active",
+            triage="congestion",
+            drift=drift,
+            incumbent_fingerprint=self.engine.strategy.fingerprint(),
+            winner_fingerprint=incumbent.fingerprint(),
+        )
+        if not drift.signals:
+            return self._done(report)  # no full window yet: keep riding
+        if drift.drifted:
+            report.outcome = "congestion-sustained"
+            return self._done(report)
+        # cleared: restore the pre-congestion incumbent — its compiled
+        # programs never left the engine cache, so the restore's first
+        # dispatch replays warm (the same no-recompile property the
+        # grow-back drill pins on StandbyPlanCache.restore_full)
+        t1 = time.perf_counter()
+        report.epoch = self.cache.adopt(incumbent)
+        if self.trainer is not None:
+            report.trainer_adopt_hit = self.trainer.adopt_strategy(incumbent)
+        report.stall_s = time.perf_counter() - t1
+        report.swapped = True
+        report.outcome = "congestion-cleared"
+        report.winner_label = "incumbent-restored"
+        self.swaps += 1
+        self._congestion = None
+        self.detector.reset(watermark=time.time())
+        return self._done(report)
+
+    def _reroute_congestion(
+        self, report: AdaptationReport, verdict, drift, mode: str, incumbent
+    ) -> AdaptationReport:
+        """The congestion half of the triage: re-route off the contended
+        class under a TRANSIENT contended model — ``topology/
+        calibration.json`` stays byte-unchanged, the detector keeps its
+        healthy reference (a congested fabric SHOULD keep reading as
+        contended), and the incumbent is remembered for the restore.  A
+        composed two-level incumbent with DCN-class congestion re-solves
+        only the leader level (PR 11's ``resolve_leader_level`` seam);
+        everything else re-ranks the synthesizer's candidate pool under
+        the contended costs, so trees that avoid the hot class win."""
+        from adapcc_tpu.adapt.triage import contended_view
+        from adapcc_tpu.sim.cost_model import DCN, ICI, two_level_allreduce_time
+        from adapcc_tpu.strategy.hierarchy import plan_of, resolve_leader_level
+
+        contended = contended_view(self._model, verdict)
+        evidence = max((s.count for s in drift.fired), default=0)
+        plan = plan_of(incumbent)
+        sketch = None
+        if plan is None and verdict.link_class == DCN:
+            from adapcc_tpu.strategy.hierarchy import resolve_sketch
+
+            try:
+                sketch = resolve_sketch(
+                    self.engine.world_size, self.synthesizer.ip_table
+                )
+            except ValueError:
+                sketch = None  # ragged/flat layout: no hierarchy to escape to
+        if plan is not None and verdict.link_class == DCN:
+            # leader-level localization: the pod level never re-solves
+            new = resolve_leader_level(plan, contended, nbytes=self.nbytes)
+            ici, dcn = contended.classes[ICI], contended.classes[DCN]
+            inc_s = two_level_allreduce_time(
+                plan.sketch.num_pods, plan.sketch.pod_size, self.nbytes,
+                ici, dcn, pod_algo=plan.pod_algo,
+                leader_algo=plan.leader_algo,
+            )
+            report.resolved_level = "dcn"
+            winner_strategy = new.strategy
+            winner_label = f"two-level[{new.leader_algo}]+congestion"
+            winner_s = new.predicted_s
+            report.ranked = [
+                {"label": winner_label, "pred_us": round(winner_s * 1e6, 3)},
+                {"label": "incumbent", "pred_us": round(inc_s * 1e6, 3)},
+            ]
+            warm_extra = ()
+        elif sketch is not None:
+            # a FLAT incumbent under DCN congestion: the principled escape
+            # off the contended class is the two-level hierarchy — the
+            # composed plan ships 1/pod_size of the payload over DCN
+            # (docs/HIERARCHY.md), which no flat re-shape can match.  Both
+            # arms price in the same analytic family: the solver's own
+            # predicted_s vs its flat DCN-paced comparator, both under the
+            # contended coefficients.
+            from adapcc_tpu.strategy.hierarchy import synthesize_two_level
+
+            tl = synthesize_two_level(
+                sketch, contended, nbytes=self.nbytes,
+                num_trans=self.parallel_degree,
+            )
+            inc_s = tl.flat_pred_s
+            winner_strategy = tl.strategy
+            winner_label = (
+                f"two-level[{tl.pod_algo}/{tl.leader_algo}]+congestion"
+            )
+            winner_s = tl.predicted_s
+            report.ranked = [
+                {"label": winner_label, "pred_us": round(winner_s * 1e6, 3)},
+                {"label": "incumbent", "pred_us": round(inc_s * 1e6, 3)},
+            ]
+            warm_extra = ()
+        else:
+            ranked = self.synthesizer.resynthesize(
+                contended,
+                self.nbytes,
+                parallel_degree=self.parallel_degree,
+                incumbent=incumbent,
+                provenance="congestion-reroute",
+            )
+            report.ranked = [
+                {"label": r.label, "pred_us": round(r.seconds * 1e6, 3)}
+                for r in ranked
+            ]
+            winner = ranked[0]
+            inc_s = next(
+                (r.seconds for r in ranked if r.label == "incumbent"), None
+            )
+            winner_strategy = winner.strategy
+            winner_label = winner.label
+            winner_s = winner.seconds
+            warm_extra = [
+                r for r in ranked[1: self.top_k]
+                if r.strategy is not None
+                and r.strategy is not incumbent
+                and r.strategy is not winner_strategy
+            ]
+        report.incumbent_pred_s = inc_s
+        report.winner_label = winner_label
+        report.winner_pred_s = winner_s
+        if (
+            winner_strategy is None
+            or winner_strategy.fingerprint() == incumbent.fingerprint()
+        ):
+            report.outcome = "incumbent-wins"
+            report.winner_fingerprint = incumbent.fingerprint()
+            return self._done(report)
+        report.winner_fingerprint = winner_strategy.fingerprint()
+        if (
+            inc_s is None
+            or winner_s >= inc_s * (1.0 - self.hysteresis_margin)
+            or evidence < self.min_samples
+        ):
+            report.outcome = "congestion-hysteresis"
+            return self._done(report)
+        if mode == "detect":
+            report.outcome = "congestion-would-reroute"
+            return self._done(report)
+        self._swap_stages(
+            report, winner_strategy, winner_label, winner_s, warm_extra
+        )
+        report.outcome = "congestion-reroute"
+        self._congestion = (incumbent, verdict)
+        return self._done(report)
+
     def maybe_adapt(self) -> AdaptationReport:
         """Run one pass of the loop (module doc).  Deterministic given the
         fed samples; returns a stage-by-stage report either way."""
         mode = self.mode
         if mode == "off":
             return self._done(AdaptationReport(mode=mode, outcome="off"))
+        if self._congestion is not None:
+            return self._congestion_pass(mode)
         drift = self.check()
         incumbent = self.engine.strategy
         report = AdaptationReport(
@@ -359,6 +661,18 @@ class AdaptationController:
         )
         if not drift.drifted:
             return self._done(report)
+        # -- triage (docs/FABRIC.md §2): congestion re-routes, degradation
+        # re-calibrates — a transient neighbor must never corrupt the
+        # persistent α-β artifact
+        from adapcc_tpu.adapt.triage import classify_drift
+
+        verdict = classify_drift(drift, self._model)
+        if verdict is not None:
+            report.triage = verdict.kind
+            if verdict.kind == "congestion":
+                return self._reroute_congestion(
+                    report, verdict, drift, mode, incumbent
+                )
         # -- re-calibrate ------------------------------------------------------
         from adapcc_tpu.sim.calibrate import merge_calibration
 
@@ -443,36 +757,20 @@ class AdaptationController:
             report.outcome = "would-swap"
             return self._done(report)
         # -- swap --------------------------------------------------------------
-        t0 = time.perf_counter()
+        # _swap_stages resets the detector with a wall-clock watermark:
+        # stale windows measured the OLD plan and would immediately
+        # re-fire against the new one, and the attached tuning database
+        # still HOLDS the old plan's samples — the next refresh() would
+        # otherwise re-ingest exactly what was cleared.
         challengers = [
             r for r in ranked
-            if r.strategy is not None and r.strategy is not incumbent
+            if r.strategy is not None
+            and r.strategy is not incumbent
+            and r.strategy is not winner.strategy
         ]
-        for cand in challengers[: self.top_k]:
-            self.cache.warm_strategy(
-                cand.strategy,
-                self.warm_shape,
-                self.warm_dtype,
-                label=cand.label,
-                predicted_s=cand.seconds,
-            )
-        if self.trainer_prewarm is not None:
-            self.trainer_prewarm(winner.strategy)
-        report.aot_warm_s = time.perf_counter() - t0
-        t1 = time.perf_counter()
-        report.epoch = self.cache.adopt(winner.strategy)
-        if self.trainer is not None:
-            report.trainer_adopt_hit = self.trainer.adopt_strategy(
-                winner.strategy
-            )
-        report.stall_s = time.perf_counter() - t1
-        report.swapped = True
+        self._swap_stages(
+            report, winner.strategy, winner.label, winner.seconds,
+            warm_extra=challengers[: max(0, self.top_k - 1)],
+        )
         report.outcome = "swapped"
-        self.swaps += 1
-        # fresh evidence for the adopted strategy: stale windows measured
-        # the OLD plan and would immediately re-fire against the new one.
-        # The watermark matters as much as the clear — the attached tuning
-        # database still HOLDS the old plan's samples, and the next
-        # refresh() would otherwise re-ingest exactly what was cleared.
-        self.detector.reset(watermark=time.time())
         return self._done(report)

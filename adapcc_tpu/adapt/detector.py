@@ -306,6 +306,26 @@ class DriftDetector:
         self.ingested += 1
         self._freeze_baseline(key)
 
+    def probe_key(self, nbytes: int, path: str = "xla") -> TuningKey:
+        """The canonical PRICED cell for one payload size: the plain
+        allreduce on the given data-plane path — the cell the calibration
+        prices with the classic ring term.  One spelling shared by the
+        congestion-profile injection funnel
+        (:meth:`AdaptationController.tick`), the triage drills, and the
+        fabric sweep, so an injected observation and a live dispatch can
+        never land in different cells for the same payload."""
+        from adapcc_tpu.tuner.db import size_bucket
+
+        return TuningKey(
+            primitive="allreduce",
+            size_bucket=size_bucket(max(1, int(nbytes))),
+            world=self.world,
+            topology=self.topology,
+            path=path,
+            chunk_bytes=0,
+            wire_dtype="off",
+        )
+
     def observe_step(
         self, seconds: float, nbytes: int, label: str = "ddp_step"
     ) -> TuningKey:

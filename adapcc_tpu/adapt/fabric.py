@@ -1,0 +1,452 @@
+"""Multi-tenant QoS: prioritized jobs sharing one fabric, yielding links.
+
+The Big Send-off (PAPERS.md) frames datacenter collectives as tenants of
+a shared fabric: the schedule that wins a clean-network benchmark can be
+exactly the one that starves a neighbor on a shared DCN link.  This
+module is the two-job harness that makes the trade a printed number:
+
+- every :class:`FabricJob` carries a priority (``ADAPCC_JOB_PRIORITY``:
+  ``high`` | ``low``, malformed → loud), its OWN
+  :class:`~adapcc_tpu.elastic.worldview.WorldView` and
+  :class:`~adapcc_tpu.coordinator.logic.CoordinatorLogic` (per-job
+  worldviews, one fabric — supervisor isolation is per tenant), and its
+  own :class:`~adapcc_tpu.strategy.synthesizer.Synthesizer` over the
+  SHARED ip table;
+- :meth:`SharedFabric.plan` assigns strategies in priority order: each
+  job's candidates are ranked under a model where every link a
+  higher-priority job's strategy occupies is CONTENDED by the share
+  penalty (β × penalty — :func:`~adapcc_tpu.sim.cost_model.
+  contended_coeffs`), so the low-priority job's winning tree *avoids*
+  the high-priority job's hot links instead of fighting for them —
+  graceful yielding, synthesized rather than policed;
+- the resulting :class:`FabricPlan` prices the fairness/throughput
+  frontier: each job's steady state under coordinated sharing vs the
+  uncoordinated baseline (every job greedily picks the clean-network
+  winner, maximally overlapping), with Jain's fairness index and
+  aggregate throughput stamped per row.  Deterministic — same model →
+  byte-identical frontier rows (the ``--fabric-sweep`` property).
+
+The acceptance shape (docs/FABRIC.md §5): under coordination the
+high-priority job's links stay uncontended, so its steady state is
+STRICTLY better than under the uncoordinated pile-up — priority costs
+the low job bounded slowdown instead of costing both jobs the fabric.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from adapcc_tpu.sim.cost_model import (
+    Link,
+    LinkCostModel,
+    contended_coeffs,
+)
+from adapcc_tpu.strategy.ir import Strategy, Tree
+
+#: per-job priority env (docs/OPERATIONS.md): which tenant yields when
+#: strategies would collide on a shared link
+JOB_PRIORITY_ENV = "ADAPCC_JOB_PRIORITY"
+
+JOB_PRIORITIES = ("high", "low")
+
+#: bandwidth penalty a contended shared link costs each of its users:
+#: two lockstep collectives on one wire each see half the bandwidth
+DEFAULT_SHARE_PENALTY = 2.0
+
+
+def job_priority(explicit: Optional[str] = None) -> str:
+    """The job priority in force: ``ADAPCC_JOB_PRIORITY`` env > the
+    explicit argument > "high" (a job that never declared a priority must
+    not silently yield).  Malformed → loud error, never a silent default
+    (the ADAPCC_RING_CHUNK_BYTES policy)."""
+    env = os.environ.get(JOB_PRIORITY_ENV)
+    value = env if env is not None and env.strip() else explicit
+    if value is None:
+        return "high"
+    prio = str(value).strip().lower()
+    if prio not in JOB_PRIORITIES:
+        raise ValueError(
+            f"{JOB_PRIORITY_ENV}={value!r}: expected one of "
+            f"{'|'.join(JOB_PRIORITIES)}"
+        )
+    return prio
+
+
+# --------------------------------------------------------------------------- #
+# link occupancy
+# --------------------------------------------------------------------------- #
+
+def strategy_links(strategy: Strategy) -> FrozenSet[Link]:
+    """Every directed link a strategy's trees occupy: reduce traverses
+    child → parent, broadcast parent → child, so each tree edge claims
+    BOTH directions — the occupancy set the yielding model contends."""
+    links: set = set()
+    for tree in strategy.trees:
+        for child, parent in tree.parent.items():
+            links.add((parent, child))
+            links.add((child, parent))
+    return frozenset(links)
+
+
+def hot_links(
+    strategy: Strategy, model: LinkCostModel, band: float = 0.5
+) -> FrozenSet[Link]:
+    """The strategy's BOTTLENECK links: occupied links whose per-1MB cost
+    sits within ``band`` of the most expensive occupied link (a lockstep
+    schedule is paced there — on a pod fabric this is the strategy's DCN
+    edge set).  The avoidance drill pins disjointness of these sets, not
+    of full occupancy: two spanning trees on one pod necessarily share
+    some fast ICI wire, but they need never collide on the slow links
+    that pace them."""
+    if not 0.0 < band <= 1.0:
+        raise ValueError(f"band must be in (0, 1], got {band}")
+    links = strategy_links(strategy)
+    if not links:
+        return frozenset()
+    probe = 1 << 20
+    cost = {l: model.coeffs(*l).time(probe) for l in links}
+    top = max(cost.values())
+    return frozenset(l for l, c in cost.items() if c >= band * top)
+
+
+def contend_links(
+    model: LinkCostModel, links: Sequence[Link], factor: float
+) -> LinkCostModel:
+    """A copy of the model with the NAMED directed links contended by
+    ``factor`` (β scaled, α intact — per-link congestion, the yielding
+    price).  Per-link entries win over class means in ``coeffs``, so the
+    contention is visible to every pricing pass."""
+    if factor < 1.0:
+        raise ValueError(f"share factor must be >= 1, got {factor}")
+    contended = dict(model.links)
+    for l in links:
+        contended[l] = contended_coeffs(model.coeffs(*l), factor)
+    return LinkCostModel(
+        model.world,
+        links=contended,
+        classes=model.classes,
+        ips=model.ips,
+        source=f"{model.source}+shared[{len(set(links))}links]",
+    )
+
+
+# --------------------------------------------------------------------------- #
+# jobs
+# --------------------------------------------------------------------------- #
+
+@dataclass
+class FabricJob:
+    """One tenant: a named job with a priority, its own worldview and
+    coordinator logic over the SHARED topology, and its own synthesizer.
+    Per-job state is deliberately isolated — one tenant's failover or
+    adaptation must never mutate another's world picture."""
+
+    name: str
+    priority: str = "high"
+    nbytes: int = 16 << 20
+    degree: int = 1
+    worldview: object = None
+    coordinator: object = None
+    synthesizer: object = None
+
+    def __post_init__(self) -> None:
+        if self.priority not in JOB_PRIORITIES:
+            raise ValueError(
+                f"job {self.name!r}: unknown priority {self.priority!r}; "
+                f"expected one of {JOB_PRIORITIES}"
+            )
+        if self.nbytes < 1:
+            raise ValueError(f"job {self.name!r}: nbytes must be >= 1")
+
+
+@dataclass
+class JobAssignment:
+    """One job's planned strategy plus its priced steady states."""
+
+    job: FabricJob
+    label: str
+    strategy: Strategy
+    #: predicted steady state on the model this job was ranked under
+    #: (higher-priority occupancy already contended)
+    ranked_s: float
+    #: steady state under the final shared fabric (every co-tenant link
+    #: contended by its user count)
+    shared_s: float = 0.0
+    #: steady state this job would see alone on the clean fabric
+    alone_s: float = 0.0
+    yielded_links: int = 0
+
+    def to_row(self) -> dict:
+        return {
+            "job": self.job.name,
+            "priority": self.job.priority,
+            "strategy": self.label,
+            "pred_us": round(self.shared_s * 1e6, 3),
+            "alone_us": round(self.alone_s * 1e6, 3),
+            "slowdown": round(
+                self.shared_s / self.alone_s if self.alone_s > 0 else 1.0, 6
+            ),
+            "yielded_links": self.yielded_links,
+        }
+
+
+@dataclass
+class FabricPlan:
+    """The planned fabric: per-job assignments plus the frontier row."""
+
+    assignments: List[JobAssignment]
+    share_penalty: float
+    coordinated: bool
+    #: directed links used by more than one job's strategy
+    shared_links: FrozenSet[Link] = frozenset()
+
+    def job(self, name: str) -> JobAssignment:
+        for a in self.assignments:
+            if a.job.name == name:
+                return a
+        raise KeyError(f"no job {name!r} in this fabric plan")
+
+    def fairness(self) -> float:
+        """Jain's index over per-job sharing efficiencies (alone ÷
+        shared, each in (0, 1]): 1.0 = every tenant keeps the same
+        fraction of its clean-fabric throughput, i.e. pays the same
+        contention tax.  (Jain is not inversion-invariant — the index
+        over slowdowns would be a different number.)"""
+        xs = [
+            a.alone_s / a.shared_s if a.shared_s > 0 else 1.0
+            for a in self.assignments
+        ]
+        n = len(xs)
+        if n == 0:
+            return 1.0
+        s = sum(xs)
+        sq = sum(x * x for x in xs)
+        return (s * s) / (n * sq) if sq > 0 else 1.0
+
+    def throughput_gbps(self) -> float:
+        """Aggregate fabric throughput in **gigabits/s** (the unit link
+        specs quote — the 12.5 GB/s DCN class is 100 Gbps):
+        Σ job payload ÷ job steady state, × 8."""
+        return sum(
+            a.job.nbytes * 8.0 / a.shared_s / 1e9
+            for a in self.assignments
+            if a.shared_s > 0
+        )
+
+    def to_row(self) -> dict:
+        return {
+            "coordinated": self.coordinated,
+            "share_penalty": self.share_penalty,
+            "shared_links": len(self.shared_links),
+            "fairness": round(self.fairness(), 6),
+            "throughput_gbps": round(self.throughput_gbps(), 6),
+            "jobs": [a.to_row() for a in self.assignments],
+        }
+
+
+# --------------------------------------------------------------------------- #
+# the shared fabric
+# --------------------------------------------------------------------------- #
+
+def _priority_order(jobs: Sequence[FabricJob]) -> List[FabricJob]:
+    """High first; ties keep registration order (stable sort)."""
+    return sorted(jobs, key=lambda j: JOB_PRIORITIES.index(j.priority))
+
+
+def _rotated_chain(world: int, start: int, ips: Dict[int, str]) -> Strategy:
+    """A chain strategy rotated to start at ``start`` — the rotation
+    moves WHICH pod boundary the chain crosses, which is exactly the
+    degree of freedom a yielding job needs to route around an occupied
+    cross-pod link."""
+    order = [(start + i) % world for i in range(world)]
+    children: Dict[int, List[int]] = {
+        order[i]: [order[i + 1]] for i in range(world - 1)
+    }
+    s = Strategy([Tree(order[0], children, ips)], world)
+    s.synthesis = f"ring@{start}"
+    return s
+
+
+class SharedFabric:
+    """One simulated topology, many prioritized tenants (module doc)."""
+
+    def __init__(
+        self,
+        model: LinkCostModel,
+        ip_table: Sequence[str],
+        share_penalty: float = DEFAULT_SHARE_PENALTY,
+    ) -> None:
+        if len(ip_table) != model.world:
+            raise ValueError(
+                f"ip table has {len(ip_table)} entries for a world-"
+                f"{model.world} model"
+            )
+        if share_penalty < 1.0:
+            raise ValueError(
+                f"share_penalty must be >= 1, got {share_penalty}"
+            )
+        self.ip_table = list(ip_table)
+        self.ips = {r: ip for r, ip in enumerate(self.ip_table)}
+        self.model = (
+            model if model.ips is not None else model.with_ips(self.ips)
+        )
+        self.share_penalty = float(share_penalty)
+        self.jobs: List[FabricJob] = []
+
+    @property
+    def world(self) -> int:
+        return self.model.world
+
+    def add_job(
+        self,
+        name: str,
+        priority: Optional[str] = None,
+        nbytes: int = 16 << 20,
+        degree: int = 1,
+    ) -> FabricJob:
+        """Register a tenant with its own worldview + coordinator logic
+        (isolation) and its own synthesizer over the shared ip table.
+        An EXPLICIT ``priority`` wins here; only an unset one resolves
+        through :func:`job_priority` (env).  ``ADAPCC_JOB_PRIORITY`` is a
+        per-process knob — a harness registering both tenants in one
+        process must not have the env clobber both to the same class
+        (the "high-low" plan would silently measure low-low)."""
+        from adapcc_tpu.coordinator.logic import CoordinatorLogic
+        from adapcc_tpu.elastic.worldview import WorldView
+        from adapcc_tpu.strategy.synthesizer import Synthesizer
+
+        if any(j.name == name for j in self.jobs):
+            raise ValueError(f"job {name!r} already registered")
+        job = FabricJob(
+            name=name,
+            priority=(
+                str(priority).strip().lower()
+                if priority is not None
+                else job_priority()
+            ),
+            nbytes=int(nbytes),
+            degree=max(1, int(degree)),
+            worldview=WorldView.full(self.world),
+            coordinator=CoordinatorLogic(self.world),
+            synthesizer=Synthesizer(None, self.ip_table),
+        )
+        self.jobs.append(job)
+        return job
+
+    # -- candidates ------------------------------------------------------------
+
+    def _candidates(self, job: FabricJob) -> List[Tuple[str, Strategy]]:
+        """The job's candidate pool: its synthesizer's own shapes plus a
+        rotated chain per pod boundary — the rotations give a yielding
+        job cross-pod edges the incumbent tenants do NOT occupy, so
+        avoidance is expressible, not just priced."""
+        bw, lat = self.model.to_graphs()
+        cands = list(job.synthesizer.candidates(job.degree, bw, lat))
+        starts = sorted(
+            {
+                r
+                for r in range(self.world)
+                if r == 0 or self.ip_table[r - 1] != self.ip_table[r]
+            }
+        )
+        for start in starts:
+            s = _rotated_chain(self.world, start, self.ips)
+            cands.append((s.synthesis, s))
+        return cands
+
+    # -- planning --------------------------------------------------------------
+
+    def plan(self, coordinated: bool = True) -> FabricPlan:
+        """Assign every registered job a strategy (module doc).
+
+        ``coordinated=True`` ranks each job under the occupancy of every
+        higher-priority tenant (contended by the share penalty), so lower
+        priorities yield.  ``coordinated=False`` is the baseline: every
+        job greedily ranks on the clean model — what an uncoordinated
+        fabric does, and what the frontier row prices it against.
+        Deterministic: no RNG, no wall clock.
+        """
+        if not self.jobs:
+            raise ValueError("no jobs registered on this fabric")
+        from adapcc_tpu import sim
+
+        assignments: List[JobAssignment] = []
+        occupied: set = set()
+        for job in _priority_order(self.jobs):
+            if coordinated and occupied:
+                ranked_model = contend_links(
+                    self.model, sorted(occupied), self.share_penalty
+                )
+            else:
+                ranked_model = self.model
+            ranked = sim.rank_candidates(
+                self._candidates(job), ranked_model, job.nbytes, "allreduce"
+            )
+            winner = ranked[0]
+            assignments.append(
+                JobAssignment(
+                    job=job,
+                    label=winner.label,
+                    strategy=winner.strategy,
+                    ranked_s=winner.seconds,
+                    yielded_links=len(occupied) if coordinated else 0,
+                )
+            )
+            occupied |= strategy_links(winner.strategy)
+        # -- price the final shared fabric: each link contended by its
+        # user count (two tenants on one wire each see half of it)
+        use_count: Dict[Link, int] = {}
+        for a in assignments:
+            for l in strategy_links(a.strategy):
+                use_count[l] = use_count.get(l, 0) + 1
+        shared = frozenset(l for l, n in use_count.items() if n > 1)
+        shared_model = self.model
+        for n_users in sorted({n for n in use_count.values() if n > 1}):
+            links = [l for l, n in use_count.items() if n == n_users]
+            shared_model = contend_links(
+                shared_model, sorted(links),
+                1.0 + (self.share_penalty - 1.0) * (n_users - 1),
+            )
+        for a in assignments:
+            a.alone_s = sim.simulate_strategy(
+                a.strategy, self.model, a.job.nbytes, "allreduce",
+                keep_transfers=False,
+            ).seconds
+            a.shared_s = sim.simulate_strategy(
+                a.strategy, shared_model, a.job.nbytes, "allreduce",
+                keep_transfers=False,
+            ).seconds
+        return FabricPlan(
+            assignments=assignments,
+            share_penalty=self.share_penalty,
+            coordinated=coordinated,
+            shared_links=shared,
+        )
+
+    def frontier(self) -> dict:
+        """The fairness/throughput frontier row: the coordinated plan
+        priced against the uncoordinated pile-up — one deterministic
+        artifact row (the ``--fabric-sweep`` unit)."""
+        coord = self.plan(coordinated=True)
+        unco = self.plan(coordinated=False)
+        row = {
+            "mode": "simulated",
+            "world": self.world,
+            "share_penalty": self.share_penalty,
+            "coordinated": coord.to_row(),
+            "uncoordinated": unco.to_row(),
+        }
+        highs_c = [
+            a for a in coord.assignments if a.job.priority == "high"
+        ]
+        highs_u = {a.job.name: a for a in unco.assignments}
+        # bool(highs_c): a fabric with no high-priority tenant has no
+        # acceptance claim to make — all([]) must not stamp a vacuous True
+        row["high_priority_wins"] = bool(highs_c) and all(
+            a.shared_s < highs_u[a.job.name].shared_s or
+            a.shared_s == a.alone_s
+            for a in highs_c
+        )
+        return row

@@ -1,0 +1,204 @@
+"""Congestion-vs-degradation triage over a fired drift report.
+
+PR 9's closed loop treats every fired window as *degradation*: invert,
+decay-merge into ``topology/calibration.json``, re-rank, swap.  That is
+the wrong robustness behavior for a congested link — transient neighbor
+traffic would be "fixed" by permanently corrupting the α-β calibration,
+and when the window clears the artifact remembers a fabric that no longer
+exists.  This module is the missing classification step:
+
+- **congestion** — the regression is localized to a shared link class
+  with the *bandwidth share* signature: the fitted β blew past the drift
+  factor while α stayed mostly intact
+  (:func:`~adapcc_tpu.sim.cost_model.contended_coeffs` is exactly this
+  shape).  The right response is a transient re-route off the hot class
+  (:meth:`AdaptationController.maybe_adapt` →
+  ``outcome="congestion-reroute"``) with the calibration artifact
+  **byte-untouched** and the incumbent restored when the window clears.
+- **degradation** — anything else: both terms stretched (a genuinely
+  slow wire prices like :meth:`LinkCoeffs.scaled`), α-dominated drift,
+  or evidence at a single payload size (one size cannot separate α from
+  β, so the conservative call keeps PR 9's re-calibrate path — a real
+  degradation mis-read as congestion would re-route forever and never
+  fix the model, the worse failure).
+
+The α/β separation needs fired windows at **two or more distinct payload
+sizes** — the same requirement the PR-11 leader-level re-fit drill
+established; the controller's congestion-profile injection funnel feeds
+two payload decades for exactly this reason.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+from typing import Optional
+
+from adapcc_tpu.adapt.detector import DriftReport
+from adapcc_tpu.adapt.recalibrate import _hop_points
+from adapcc_tpu.sim.cost_model import (
+    LinkCostModel,
+    bottleneck_ring_coeffs,
+    bottleneck_ring_link,
+    fit_alpha_beta,
+)
+
+#: fitted-α tolerance: congestion leaves α within this factor of the
+#: calibrated value (bandwidth share is stolen; propagation is not)
+CONGESTION_ALPHA_BAND = 1.5
+
+#: fitted-β threshold: the effective-bandwidth cut must at least match
+#: the default drift factor, or the evidence is noise the window absorbed
+CONGESTION_BETA_SEPARATION = 2.0
+
+TRIAGE_KINDS = ("congestion", "degradation")
+
+
+@dataclass(frozen=True)
+class TriageVerdict:
+    """One fired drift report's classification."""
+
+    kind: str                 #: "congestion" | "degradation"
+    link_class: str           #: the link class the fitted evidence names
+    alpha_ratio: float        #: fitted α ÷ calibrated α
+    beta_ratio: float         #: fitted β ÷ calibrated β
+    #: whether the evidence spanned >= 2 payload sizes (α/β separable);
+    #: False forces the conservative "degradation" call
+    separable: bool
+
+    @property
+    def factor(self) -> float:
+        """The effective contention factor a congestion verdict carries —
+        the β inflation (the bandwidth share the neighbor took)."""
+        return self.beta_ratio
+
+    def to_row(self) -> dict:
+        return {
+            "kind": self.kind,
+            "link_class": self.link_class,
+            "alpha_ratio": round(self.alpha_ratio, 6),
+            "beta_ratio": round(self.beta_ratio, 6),
+            "separable": self.separable,
+        }
+
+
+def classify_drift(
+    report: DriftReport,
+    model: LinkCostModel,
+    alpha_band: float = CONGESTION_ALPHA_BAND,
+    separation: float = CONGESTION_BETA_SEPARATION,
+) -> Optional[TriageVerdict]:
+    """Classify a fired drift report (module doc), or None when no fired
+    signal carries link algebra (baseline-referenced cells only — the
+    ``uninvertible`` outcome the controller already stops on).
+
+    Deterministic, analytic: the SAME per-hop inversion the
+    re-calibration uses (:mod:`adapcc_tpu.adapt.recalibrate`), so triage
+    and re-calibration can never disagree about what the evidence says.
+    """
+    if alpha_band < 1.0:
+        raise ValueError(f"alpha_band must be >= 1, got {alpha_band}")
+    if separation <= 1.0:
+        raise ValueError(
+            f"separation must be > 1, got {separation}: at <= 1 healthy "
+            "noise would classify as congestion"
+        )
+    fired_points, _samples = _hop_points(report.fired, model.world)
+    if not fired_points:
+        return None
+    # the FIT spans every full priced window, fired or not: a small-
+    # payload window that stayed healthy while the large one blew past
+    # the factor is not absence of evidence — it IS the α-intact half of
+    # the congestion signature (an α-degraded wire would have fired the
+    # small window too)
+    points, _ = _hop_points(
+        [s for s in report.signals if s.reference == "calibration"],
+        model.world,
+    )
+    link = bottleneck_ring_link(model, model.world)
+    cls = model.link_class_of(*link)
+    current = bottleneck_ring_coeffs(model, model.world)
+    distinct_sizes = {round(b, 3) for b, _ in points}
+    if len(distinct_sizes) < 2:
+        # one payload size cannot separate α from β: the conservative
+        # call is degradation (PR 9's re-calibrate path), never a
+        # re-route on inseparable evidence
+        nbytes, seconds = fired_points[0]
+        predicted = current.time(nbytes)
+        ratio = seconds / predicted if predicted > 0 else 1.0
+        return TriageVerdict(
+            kind="degradation",
+            link_class=cls,
+            alpha_ratio=ratio,
+            beta_ratio=ratio,
+            separable=False,
+        )
+    fitted = fit_alpha_beta(points)
+    # attribute the evidence to a link class by the α signature: the
+    # priced ring is paced by the CONTENDED fabric's bottleneck hop, and
+    # congestion leaves that hop's α intact — so the class whose healthy
+    # α the fit REPRODUCES (two-sided: within the band either way) is
+    # the class the fit measured.  A contended ICI that overtook the
+    # healthy DCN bottleneck fits ICI's µs-scale α, not DCN's; pinning
+    # the healthy bottleneck's class would re-route off the wrong
+    # (still-healthy) class.  The band is deliberately two-sided and
+    # exclusive: a fit whose α lands BETWEEN classes (e.g. an ICI wire
+    # degraded far enough that its stretched α drifts toward DCN's)
+    # matches nothing and keeps the healthy-bottleneck anchor, where the
+    # two-sided α test below reads it as degradation — a degradation
+    # misread as congestion would re-route forever and never fix the
+    # model, the worse failure.  (A degradation whose stretched α lands
+    # EXACTLY on another class's α is observationally equivalent to that
+    # class's congestion through a scalar probe; no triage can split it.)
+    if fitted.alpha > 0:
+        candidates = [
+            (c, co)
+            for c, co in model.classes.items()
+            if co.alpha > 0
+            and max(fitted.alpha / co.alpha, co.alpha / fitted.alpha)
+            <= alpha_band
+        ]
+        if len(candidates) == 1:
+            cls, current = candidates[0]
+        elif len(candidates) > 1 and not any(c == cls for c, _ in candidates):
+            cls, current = min(
+                candidates,
+                key=lambda item: abs(math.log(fitted.alpha / item[1].alpha)),
+            )
+    alpha_ratio = fitted.alpha / current.alpha if current.alpha > 0 else 1.0
+    beta_ratio = fitted.beta / current.beta if current.beta > 0 else 1.0
+    # α must be INTACT both ways: a fitted α well below the anchor class
+    # is not "intact", it is evidence the anchor is wrong (some other
+    # stretched wire overtook it) — degradation, never a re-route
+    alpha_intact = (
+        max(alpha_ratio, 1.0 / alpha_ratio) <= alpha_band
+        if alpha_ratio > 0
+        else False
+    )
+    congestion = (
+        alpha_intact
+        and beta_ratio >= separation
+        and beta_ratio > alpha_ratio
+    )
+    return TriageVerdict(
+        kind="congestion" if congestion else "degradation",
+        link_class=cls,
+        alpha_ratio=alpha_ratio,
+        beta_ratio=beta_ratio,
+        separable=True,
+    )
+
+
+def contended_view(
+    model: LinkCostModel, verdict: TriageVerdict
+) -> LinkCostModel:
+    """The TRANSIENT cost model a congestion verdict implies: the live
+    model with the named class contended by the fitted β inflation —
+    never merged, never persisted (the calibration artifact stays
+    byte-unchanged; reversibility is the point)."""
+    if verdict.kind != "congestion":
+        raise ValueError(
+            f"contended_view needs a congestion verdict, got {verdict.kind!r}"
+        )
+    return model.contended({verdict.link_class: max(1.0, verdict.factor)})

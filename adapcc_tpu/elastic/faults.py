@@ -288,25 +288,16 @@ def load_fault_plan(
     """The ``ADAPCC_FAULT_PLAN`` funnel: None when the env is unset, the
     parsed plan otherwise.  A set-but-broken value (missing file, malformed
     JSON, world mismatch) raises loudly — a typo'd injection artifact must
-    never silently run a healthy world (the ADAPCC_MERGE_ROUNDS policy)."""
-    env = env if env is not None else os.environ
-    path = env.get(FAULT_PLAN_ENV, "").strip()
-    if not path:
-        return None
-    if not os.path.exists(path):
-        raise FileNotFoundError(
-            f"{FAULT_PLAN_ENV}={path!r}: no such fault-plan artifact"
-        )
-    try:
-        plan = FaultPlan.load(path)
-    except (json.JSONDecodeError, KeyError, TypeError) as e:
-        raise ValueError(
-            f"{FAULT_PLAN_ENV}={path!r} is not a fault-plan JSON artifact: {e}"
-        ) from e
-    if world is not None and plan.world != world:
-        raise ValueError(
-            f"{FAULT_PLAN_ENV}={path!r} was authored for world={plan.world} "
-            f"but this run has world={world}; re-author the plan — injecting "
-            "it as-is would shift which ranks die"
-        )
-    return plan
+    never silently run a healthy world (the ADAPCC_MERGE_ROUNDS policy).
+    One shared funnel with ``ADAPCC_CONGESTION_PROFILE``
+    (:func:`adapcc_tpu.utils.artifacts.load_env_json_artifact`)."""
+    from adapcc_tpu.utils.artifacts import load_env_json_artifact
+
+    return load_env_json_artifact(
+        FAULT_PLAN_ENV,
+        FaultPlan.from_dict,
+        kind="fault-plan",
+        world=world,
+        env=env,
+        mismatch_hint="injecting it as-is would shift which ranks die",
+    )

@@ -28,20 +28,31 @@ Layers:
   simulated numbers stay anchored to the last good hardware round.
 """
 
+from adapcc_tpu.sim.congestion import (
+    CONGESTION_PROFILE_ENV,
+    CongestionProfile,
+    CongestionWindow,
+    load_congestion_profile,
+)
 from adapcc_tpu.sim.cost_model import (
     DCN,
     ICI,
     LinkCoeffs,
     LinkCostModel,
     choose_wire_dtype,
+    congested_ring_allreduce_time,
+    congested_two_level_allreduce_time,
+    contended_coeffs,
     fit_alpha_beta,
     quantized_ring_allreduce_time,
     wire_bytes_per_element,
 )
 from adapcc_tpu.sim.events import EventSimulator, SimReport, Transfer, TreeSchedule
 from adapcc_tpu.sim.replay import (
+    CongestionStepRow,
     SimTimeline,
     simulate_broadcast,
+    simulate_congestion_profile,
     simulate_flow_broadcast,
     simulate_reduce,
     simulate_strategy,
@@ -62,12 +73,21 @@ from adapcc_tpu.sim.calibrate import (
 )
 
 __all__ = [
+    "CONGESTION_PROFILE_ENV",
+    "CongestionProfile",
+    "CongestionStepRow",
+    "CongestionWindow",
     "DCN",
     "ICI",
     "LinkCoeffs",
     "LinkCostModel",
     "choose_wire_dtype",
+    "congested_ring_allreduce_time",
+    "congested_two_level_allreduce_time",
+    "contended_coeffs",
     "fit_alpha_beta",
+    "load_congestion_profile",
+    "simulate_congestion_profile",
     "quantized_ring_allreduce_time",
     "wire_bytes_per_element",
     "EventSimulator",

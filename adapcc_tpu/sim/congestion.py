@@ -1,0 +1,267 @@
+"""Deterministic background-traffic model: congestion windows on shared
+link classes.
+
+Production pods run many jobs over shared DCN, and The Big Send-off
+(PAPERS.md) shows collectives must be designed to *survive* datacenter-
+scale contention, not just win clean-network benchmarks.  A congested
+link is not a degraded link: a neighbor's traffic steals **bandwidth
+share** for a bounded window and then gives it back, while the wire's
+propagation latency is mostly untouched — so the right model is a
+time-windowed *effective-bandwidth* scaling (β × factor, α intact:
+:func:`adapcc_tpu.sim.cost_model.contended_coeffs`), and the right
+response is a re-route, never a re-calibration (docs/FABRIC.md).
+
+A :class:`CongestionProfile` is the congestion twin of
+:class:`~adapcc_tpu.elastic.faults.FaultPlan`: a deterministic,
+serializable schedule of :class:`CongestionWindow` entries — each naming
+a shared link class (``ici`` | ``dcn``), a step range, and the bandwidth
+contention factor — replayed by ``state-at-step`` folding so two runs of
+the same profile see byte-identical contention timelines on any backend.
+
+Injection points:
+
+- the simulated replay (:func:`adapcc_tpu.sim.replay.
+  simulate_congestion_profile`) prices every step's collective under that
+  step's contended model;
+- the adaptation controller's observation funnel
+  (:meth:`adapcc_tpu.adapt.AdaptationController.tick`) feeds the drift
+  detector contention-scaled priced samples, so the congestion-vs-
+  degradation triage fires *deterministically* — the observation-funnel
+  twin of the coordinator's fault-plan injection.
+
+``ADAPCC_CONGESTION_PROFILE`` points at a JSON artifact through the SAME
+shared funnel as ``ADAPCC_FAULT_PLAN``
+(:func:`adapcc_tpu.utils.artifacts.load_env_json_artifact`): unset →
+None, set-but-broken → loud, world mismatch → loud.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from adapcc_tpu.sim.cost_model import DCN, ICI
+
+#: env var pointing at a congestion-profile JSON artifact
+CONGESTION_PROFILE_ENV = "ADAPCC_CONGESTION_PROFILE"
+
+#: link classes background traffic can contend; anything else is a loud
+#: error, never a silent no-op
+CONGESTION_CLASSES = (ICI, DCN)
+
+#: default bandwidth-contention factor for seeded profiles: a neighbor
+#: job taking 3/4 of the shared links' bandwidth (effective β × 4)
+DEFAULT_CONGESTION_FACTOR = 4.0
+
+
+@dataclass(frozen=True)
+class CongestionWindow:
+    """One bounded burst of background traffic: steps in
+    ``[start, until)`` see the named link class's effective bandwidth cut
+    by ``factor`` (β × factor — α is untouched, the congestion-vs-
+    degradation signature the triage keys on)."""
+
+    start: int
+    until: int
+    link_class: str
+    factor: float = DEFAULT_CONGESTION_FACTOR
+
+    def __post_init__(self) -> None:
+        if self.link_class not in CONGESTION_CLASSES:
+            raise ValueError(
+                f"unknown congestion link class {self.link_class!r}; "
+                f"expected one of {CONGESTION_CLASSES}"
+            )
+        if self.start < 0:
+            raise ValueError(f"window start must be >= 0, got {self.start}")
+        if self.until <= self.start:
+            raise ValueError(
+                f"window [{self.start}, {self.until}) is empty: 'until' "
+                "must exceed 'start'"
+            )
+        if self.factor < 1.0:
+            raise ValueError(
+                f"congestion factor must be >= 1 (1 = no contention), got "
+                f"{self.factor}"
+            )
+
+    def active_at(self, step: int) -> bool:
+        return self.start <= step < self.until
+
+    def to_dict(self) -> dict:
+        return {
+            "start": self.start,
+            "until": self.until,
+            "link_class": self.link_class,
+            "factor": self.factor,
+        }
+
+    @classmethod
+    def from_dict(cls, obj: Mapping) -> "CongestionWindow":
+        return cls(
+            start=int(obj["start"]),
+            until=int(obj["until"]),
+            link_class=str(obj["link_class"]),
+            factor=float(obj.get("factor", DEFAULT_CONGESTION_FACTOR)),
+        )
+
+
+class CongestionProfile:
+    """A deterministic, serializable schedule of congestion windows.
+
+    ``world`` is the world size the profile was authored for; every
+    consumer validates it against the runtime world (a profile's windows
+    are priced against that world's topology — injecting one authored for
+    another pod would contend the wrong links).
+    """
+
+    def __init__(
+        self,
+        windows: Sequence[CongestionWindow],
+        world: int,
+        label: str = "congestion-profile",
+    ) -> None:
+        if world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        self.world = world
+        self.label = label
+        self.windows: Tuple[CongestionWindow, ...] = tuple(
+            sorted(windows, key=lambda w: (w.start, w.until, w.link_class))
+        )
+
+    # -- replay ----------------------------------------------------------------
+
+    def active_at(self, step: int) -> List[CongestionWindow]:
+        return [w for w in self.windows if w.active_at(step)]
+
+    def factors_at(self, step: int) -> Dict[str, float]:
+        """Per-class contention factor at one step.  Overlapping windows
+        on the same class take the MAX factor (the hottest neighbor sets
+        the share; stacking products would price phantom traffic) —
+        deterministic either way."""
+        factors: Dict[str, float] = {}
+        for w in self.active_at(step):
+            factors[w.link_class] = max(
+                factors.get(w.link_class, 1.0), w.factor
+            )
+        return factors
+
+    def healthy_at(self, step: int) -> bool:
+        return not self.active_at(step)
+
+    def contended_model(self, model, step: int):
+        """The cost model this step's traffic actually offers: the given
+        model with every active window's class contended
+        (:meth:`LinkCostModel.contended` — β scaled, α intact)."""
+        factors = self.factors_at(step)
+        return model.contended(factors) if factors else model
+
+    def last_step(self) -> int:
+        return max((w.until for w in self.windows), default=0)
+
+    def classes(self) -> Tuple[str, ...]:
+        return tuple(sorted({w.link_class for w in self.windows}))
+
+    # -- serialization ---------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {
+            "world": self.world,
+            "label": self.label,
+            "windows": [w.to_dict() for w in self.windows],
+        }
+
+    @classmethod
+    def from_dict(cls, obj: Mapping) -> "CongestionProfile":
+        return cls(
+            windows=[
+                CongestionWindow.from_dict(w) for w in obj.get("windows", ())
+            ],
+            world=int(obj["world"]),
+            label=str(obj.get("label", "congestion-profile")),
+        )
+
+    def save(self, path: str) -> str:
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "CongestionProfile":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    # -- canned profiles -------------------------------------------------------
+
+    @classmethod
+    def seeded(
+        cls,
+        world: int,
+        steps: int,
+        seed: int = 0,
+        n_windows: int = 2,
+        classes: Sequence[str] = (DCN,),
+        factor: float = DEFAULT_CONGESTION_FACTOR,
+    ) -> "CongestionProfile":
+        """Deterministic pseudo-random profile: ``n_windows`` bounded
+        bursts at distinct steps, each a few steps long, cycling over
+        ``classes``.  Same (world, steps, seed) → the same profile, byte
+        for byte — the property every fabric-sweep row rides on."""
+        if steps < 2:
+            raise ValueError("a seeded congestion profile needs steps >= 2")
+        bad = [c for c in classes if c not in CONGESTION_CLASSES]
+        if bad:
+            raise ValueError(
+                f"unknown congestion classes {bad}; expected a subset of "
+                f"{CONGESTION_CLASSES}"
+            )
+        rng = np.random.default_rng(seed)
+        n_windows = max(1, min(n_windows, steps // 2))
+        starts = sorted(
+            int(s)
+            for s in rng.choice(max(1, steps - 1), size=n_windows, replace=False)
+        )
+        windows = [
+            CongestionWindow(
+                start=start,
+                until=min(steps, start + 2 + int(rng.integers(0, 3))),
+                link_class=classes[i % len(classes)],
+                factor=factor,
+            )
+            for i, start in enumerate(starts)
+        ]
+        return cls(windows, world, label=f"seeded:{seed}")
+
+    def __repr__(self) -> str:
+        return (
+            f"CongestionProfile(world={self.world}, "
+            f"windows={len(self.windows)}, label={self.label!r})"
+        )
+
+
+def load_congestion_profile(
+    world: Optional[int] = None, env: Optional[Mapping[str, str]] = None
+) -> Optional[CongestionProfile]:
+    """The ``ADAPCC_CONGESTION_PROFILE`` funnel — the SAME shared loader
+    as ``ADAPCC_FAULT_PLAN`` (:mod:`adapcc_tpu.utils.artifacts`): None
+    when the env is unset; a set-but-broken value (missing file, garbage
+    JSON, world mismatch) raises loudly, never a silently uncontended
+    run."""
+    from adapcc_tpu.utils.artifacts import load_env_json_artifact
+
+    return load_env_json_artifact(
+        CONGESTION_PROFILE_ENV,
+        CongestionProfile.from_dict,
+        kind="congestion-profile",
+        world=world,
+        env=env,
+        mismatch_hint=(
+            "injecting it as-is would contend another pod's link layout"
+        ),
+    )

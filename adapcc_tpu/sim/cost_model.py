@@ -149,6 +149,53 @@ class LinkCostModel:
                     model.links[link] = self.coeffs(*link).scaled(slowdown)
         return model
 
+    def contended(
+        self, class_factors: Mapping[str, float]
+    ) -> "LinkCostModel":
+        """A copy with the named link classes' effective bandwidth cut by
+        their factor — congestion, not degradation: β scales (a neighbor's
+        traffic steals bandwidth share), α is untouched (the wire's
+        propagation latency survives contention).  Per-link fits of a
+        contended class scale the same way, so a per-link-fitted artifact
+        prices the contention too.  Contrast :meth:`degraded`/
+        :meth:`LinkCoeffs.scaled`, which stretch BOTH terms — that α/β
+        signature difference is exactly what the congestion-vs-degradation
+        triage keys on (docs/FABRIC.md)."""
+        for cls_name, factor in class_factors.items():
+            if cls_name not in self.classes:
+                raise ValueError(
+                    f"unknown link class {cls_name!r}; expected one of "
+                    f"{sorted(self.classes)}"
+                )
+            if factor < 1.0:
+                raise ValueError(
+                    f"contention factor must be >= 1, got {factor} for "
+                    f"class {cls_name!r}"
+                )
+        classes = {
+            cls_name: (
+                contended_coeffs(c, class_factors[cls_name])
+                if cls_name in class_factors
+                else c
+            )
+            for cls_name, c in self.classes.items()
+        }
+        links = {
+            l: (
+                contended_coeffs(c, class_factors[self.link_class_of(*l)])
+                if self.link_class_of(*l) in class_factors
+                else c
+            )
+            for l, c in self.links.items()
+        }
+        joined = ",".join(
+            f"{cls}x{f:g}" for cls, f in sorted(class_factors.items())
+        )
+        return LinkCostModel(
+            self.world, links=links, classes=classes, ips=self.ips,
+            source=f"{self.source}+contended[{joined}]",
+        )
+
     def with_ips(self, ips: Optional[Mapping[int, str]]) -> "LinkCostModel":
         """A copy pricing the same coefficients under ``ips``'s host layout
         — the one way callers (sim_collectives.sweep, the sim-rank policy's
@@ -295,6 +342,71 @@ def bottleneck_ring_coeffs(
     One shared definition: the benches and the tuner can never disagree
     about which link paces the ring."""
     return model.coeffs(*bottleneck_ring_link(model, world))
+
+
+# --------------------------------------------------------------------------- #
+# contention pricing (adapcc_tpu/sim/congestion): background traffic on a
+# shared link class — effective-bandwidth scaling, NOT latency degradation
+# --------------------------------------------------------------------------- #
+
+def contended_coeffs(coeffs: LinkCoeffs, factor: float) -> LinkCoeffs:
+    """One link under background traffic: a neighbor taking
+    ``(factor−1)/factor`` of the bandwidth share leaves ``β × factor``
+    effective inverse bandwidth, while α — propagation, not queue depth in
+    this model — is untouched.  The deliberate contrast to
+    :meth:`LinkCoeffs.scaled` (degradation: both terms stretch) is the
+    α/β signature the congestion-vs-degradation triage separates
+    (docs/FABRIC.md §2)."""
+    if factor < 1.0:
+        raise ValueError(
+            f"contention factor must be >= 1 (1 = no contention), got "
+            f"{factor}"
+        )
+    return LinkCoeffs(coeffs.alpha, coeffs.beta * factor)
+
+
+def congested_ring_allreduce_time(
+    world: int,
+    nbytes: float,
+    coeffs: LinkCoeffs,
+    factor: float,
+    wire_dtype: str = "off",
+) -> float:
+    """The ring allreduce with its bottleneck hop contended by ``factor``
+    — :func:`quantized_ring_allreduce_time` on
+    :func:`contended_coeffs`.  ``factor=1`` is exactly the healthy price,
+    so one term prices the whole congestion A/B."""
+    return quantized_ring_allreduce_time(
+        world, nbytes, contended_coeffs(coeffs, factor), wire_dtype
+    )
+
+
+def congested_two_level_allreduce_time(
+    num_pods: int,
+    pod_size: int,
+    nbytes: float,
+    ici: LinkCoeffs,
+    dcn: LinkCoeffs,
+    dcn_factor: float = 1.0,
+    ici_factor: float = 1.0,
+    pod_algo: str = "rs-ag",
+    leader_algo: str = "tree",
+) -> float:
+    """The composed two-level allreduce under per-class contention —
+    :func:`two_level_allreduce_time` with each level's class contended.
+    This is the term the leader-level congestion re-solve prices: a
+    contended DCN raises the β-heavy rs-ag leader ring faster than the
+    α-heavy binomial tree, which is exactly the flip
+    ``resolve_leader_level`` executes under a contended model."""
+    return two_level_allreduce_time(
+        num_pods,
+        pod_size,
+        nbytes,
+        contended_coeffs(ici, ici_factor),
+        contended_coeffs(dcn, dcn_factor),
+        pod_algo=pod_algo,
+        leader_algo=leader_algo,
+    )
 
 
 def staged_ring_allreduce_time(

@@ -270,6 +270,93 @@ def simulate_fault_plan(
     return rows
 
 
+@dataclass
+class CongestionStepRow:
+    """One step of a congestion-profile replay: the collective's predicted
+    cost under that step's contended link classes, next to the healthy
+    price — so the per-step contention tax is a printed number."""
+
+    step: int
+    congested: bool
+    factors: Tuple[Tuple[str, float], ...]  # sorted (class, factor) pairs
+    seconds: float
+    healthy_s: float
+    mode: str = "simulated"
+
+    @property
+    def contention_ratio(self) -> float:
+        return self.seconds / self.healthy_s if self.healthy_s > 0 else 1.0
+
+    def to_row(self) -> dict:
+        return {
+            "mode": self.mode,
+            "step": self.step,
+            "congested": self.congested,
+            "factors": {cls: f for cls, f in self.factors},
+            "pred_time_us": round(self.seconds * 1e6, 3),
+            "healthy_us": round(self.healthy_s * 1e6, 3),
+            "contention_ratio": round(self.contention_ratio, 6),
+        }
+
+
+def simulate_congestion_profile(
+    strategy: Strategy,
+    cost_model: LinkCostModel,
+    nbytes: float,
+    profile,
+    steps: Optional[int] = None,
+    collective: str = "allreduce",
+) -> List[CongestionStepRow]:
+    """Replay a :class:`~adapcc_tpu.sim.congestion.CongestionProfile`
+    through the event simulator: every step's collective is priced under
+    that step's contended model (each active window's link class gets its
+    effective bandwidth cut — β scaled, α intact, the congestion
+    signature), next to the healthy price.
+
+    This is the CPU-exercisable twin of a live run under neighbor
+    traffic: the same profile injected at the adaptation controller's
+    observation funnel produces the same windows, and these rows price
+    what each window costs the strategy that did NOT re-route.
+    Deterministic — same profile, same calibration → byte-identical rows.
+    """
+    if profile.world != strategy.world_size:
+        raise ValueError(
+            f"congestion profile world {profile.world} != strategy world "
+            f"{strategy.world_size}"
+        )
+    n_steps = steps if steps is not None else profile.last_step() + 1
+    healthy_s = simulate_strategy(
+        strategy, cost_model, nbytes, collective, keep_transfers=False
+    ).seconds
+    rows: List[CongestionStepRow] = []
+    # every step inside one window prices identically — simulate once per
+    # distinct factors tuple, not once per step
+    priced: Dict[Tuple[Tuple[str, float], ...], float] = {(): healthy_s}
+    for step in range(n_steps):
+        factors = profile.factors_at(step)
+        fkey = tuple(sorted(factors.items()))
+        seconds = priced.get(fkey)
+        if seconds is None:
+            seconds = simulate_strategy(
+                strategy,
+                cost_model.contended(factors),
+                nbytes,
+                collective,
+                keep_transfers=False,
+            ).seconds
+            priced[fkey] = seconds
+        rows.append(
+            CongestionStepRow(
+                step=step,
+                congested=bool(factors),
+                factors=fkey,
+                seconds=seconds,
+                healthy_s=healthy_s,
+            )
+        )
+    return rows
+
+
 def simulate_flow_broadcast(
     flow, cost_model: LinkCostModel, nbytes: float
 ) -> SimTimeline:

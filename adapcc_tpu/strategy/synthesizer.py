@@ -227,18 +227,25 @@ class Synthesizer:
         parallel_degree: int = 1,
         incumbent: Optional[Strategy] = None,
         collective: str = "allreduce",
+        provenance: str = "adapt-rerank",
     ):
-        """Online re-rank under a drift-corrected cost model (docs/ADAPT.md):
-        synthesize the candidate pool from the model's own link matrices
-        (so candidate SHAPES — ParTrees master routing included — see the
-        corrected network), rank on the corrected replay, and re-price the
-        winner's wire codec on its corrected bottleneck edge.
+        """Online re-rank under a drift-corrected (or transiently
+        contended — docs/FABRIC.md) cost model: synthesize the candidate
+        pool from the model's own link matrices (so candidate SHAPES —
+        ParTrees master routing included — see the corrected network),
+        rank on the corrected replay, and re-price the winner's wire
+        codec on its corrected bottleneck edge.  Candidates priced under
+        a contention model rank exactly as they would execute there, so
+        trees that avoid the hot links win the re-rank.
 
         ``incumbent`` is listed FIRST, so a prediction-identical
         alternative keeps the executing strategy (no compiled-program
-        churn for nothing — the rank_candidates tie rule).  Returns the
-        full ranked list; callers gate adoption on their own hysteresis.
-        Pure host work: no probe traffic, no compilation.
+        churn for nothing — the rank_candidates tie rule).
+        ``provenance`` stamps the winner's synthesis label ("adapt-rerank"
+        for the re-calibrate path, "congestion-reroute" for the transient
+        triage path — the artifact must say WHY the shape changed).
+        Returns the full ranked list; callers gate adoption on their own
+        hysteresis.  Pure host work: no probe traffic, no compilation.
         """
         bw, lat = model.to_graphs()
         cands: List[Tuple[str, Strategy]] = []
@@ -250,7 +257,7 @@ class Synthesizer:
         )
         winner = ranked[0]
         if winner.strategy is not None and winner.strategy is not incumbent:
-            winner.strategy.synthesis = f"{winner.label}+adapt-rerank"
+            winner.strategy.synthesis = f"{winner.label}+{provenance}"
             winner.strategy.wire_dtype = self._choose_wire_dtype(
                 winner.strategy, nbytes, bw, lat
             )

@@ -407,10 +407,23 @@ class Supervisor:
             and self.polls % self.adapt_every == 0
         ):
             rep = self.adapt.maybe_adapt()
-            if rep.outcome not in ("off", "no-drift"):
+            # steady states are not decisions: while a re-route is live
+            # every pass reads "congestion-active"/"congestion-sustained",
+            # and journaling each would fsync an append per poll for the
+            # whole window without recording anything actionable — only
+            # the transitions (reroute, cleared, swap, …) ride the WAL
+            if rep.outcome not in (
+                "off", "no-drift", "congestion-active",
+                "congestion-sustained",
+            ):
+                # the triage verdict rides the journal: a later audit must
+                # be able to tell a transient congestion re-route (model
+                # untouched, restore pending) from a re-calibrated
+                # degradation swap (docs/FABRIC.md §3)
                 note(
                     "adapt",
                     outcome=rep.outcome,
+                    triage=rep.triage,
                     winner=rep.winner_fingerprint,
                     engine_epoch=rep.epoch,
                 )

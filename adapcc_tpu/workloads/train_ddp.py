@@ -284,6 +284,16 @@ def main(argv=None) -> None:
                 "drives the DDP trainer's per-step relay masks; zero1/fsdp "
                 "have no relay plane to inject into)"
             )
+        from adapcc_tpu.sim.congestion import CONGESTION_PROFILE_ENV as _CONG
+
+        if _os.environ.get(_CONG, "").strip():
+            # congestion injection rides the DDP adaptation controller;
+            # same set-but-quiet contract as the fault plan above
+            raise ValueError(
+                f"{_CONG} requires --dp-mode ddp (congestion injection "
+                "feeds the adaptation controller's observation funnel, "
+                "which rides the DDP gradient hook)"
+            )
     if args.zero1_ring and args.dp_mode != "zero1":
         raise ValueError("--zero1-ring requires --dp-mode zero1")
     # one wire-codec knob across modes: --wire-dtype wins over the older
@@ -445,10 +455,25 @@ def main(argv=None) -> None:
         # so the run exercises a real world shrink + recovery.  This is the
         # data plane the elastic_failover battery entry measures.
         from adapcc_tpu.elastic import load_fault_plan
+        from adapcc_tpu.sim.congestion import (
+            CONGESTION_PROFILE_ENV,
+            load_congestion_profile,
+        )
 
         fault_plan = load_fault_plan(world=world)
         if fault_plan is not None:
             print(f"fault injection: {fault_plan!r}")
+        congestion_profile = load_congestion_profile(world=world)
+        if congestion_profile is not None and adapt == "off":
+            # the profile feeds the adaptation controller's triage; a set
+            # profile with the loop disarmed would silently inject nothing
+            # — the exact "set-but-broken is quiet" failure the env
+            # contract forbids
+            raise ValueError(
+                f"{CONGESTION_PROFILE_ENV} requires --adapt detect|swap "
+                "(congestion injection rides the adaptation controller's "
+                "observation funnel; with the loop off nothing consumes it)"
+            )
 
         # closed-loop online adaptation (docs/ADAPT.md): the controller
         # rides the communicator's own seams (engine, synthesizer, tuning
@@ -473,6 +498,15 @@ def main(argv=None) -> None:
                 leaf.nbytes for leaf in jax.tree_util.tree_leaves(params)
             )
             print(f"online adaptation: mode={adapt} every={args.adapt_every}")
+            # deterministic congestion injection (docs/FABRIC.md §4): with
+            # ADAPCC_CONGESTION_PROFILE set, each step ticks the profile's
+            # windows into the controller's PRICED observation feed (the
+            # observation-funnel twin of the fault-plan injection above),
+            # so the congestion-vs-degradation triage is exercisable on a
+            # live run — re-route inside a window, restore after it
+            if congestion_profile is not None:
+                adapt_ctl.attach_congestion_profile(congestion_profile)
+                print(f"congestion injection: {congestion_profile!r}")
 
         # autonomous supervisor (docs/SUPERVISOR.md): the daemon — not
         # this loop — folds the fault plan (and any heartbeat silence)
@@ -547,6 +581,10 @@ def main(argv=None) -> None:
                 # record-mode contract)
                 jax.block_until_ready(loss)
                 adapt_ctl.observe_step(time.perf_counter() - t0, grad_bytes)
+                # the congestion profile's step tick (no-op when no
+                # profile is attached): window steps feed contended priced
+                # samples, healthy steps feed reversal evidence
+                adapt_ctl.tick(step)
                 if supervisor is not None:
                     pass  # the daemon runs maybe_adapt on its own cadence
                 elif step > 0 and step % args.adapt_every == 0:

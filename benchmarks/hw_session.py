@@ -189,6 +189,14 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
       ``make chaos-bench``, docs/SUPERVISOR.md): daemon-journaled
       detection + standby swap while the training loop only observes
       epoch bumps; the decision journal rides beside the battery output.
+    - ``fabric_contention`` — the congestion-triage A/B (the hardware
+      twin of ``make fabric-bench``, docs/FABRIC.md): the SAME injected
+      congestion profile (a bounded DCN window mid-run) under
+      ``--adapt detect`` (triage reports, never swaps) vs ``--adapt
+      swap`` (congestion re-routes through the standby cache and the
+      incumbent restores after the window) — the phase walltimes price
+      what the re-route buys, and the printed outcomes record the
+      triage's verdicts on real hardware.
     """
     gate = f"world={world} (needs multi-chip ICI)"
     if world < 2:
@@ -197,6 +205,7 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
             "busbw_wire_dtype", "busbw_fused_wire", "tuner_convergence",
             "overlap_ab", "small_msg_crossover", "two_level_synth",
             "elastic_failover", "online_adaptation", "supervised_failover",
+            "fabric_contention",
         ):
             _skip(name, gate, out_path)
         return
@@ -438,6 +447,48 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
         },
         rec_extra={"fault_plan": sup_plan_path, "supervisor": True},
     )
+    # congestion-triage A/B on real chips (the hardware twin of `make
+    # fabric-bench`, docs/FABRIC.md): a bounded DCN congestion window
+    # injected via ADAPCC_CONGESTION_PROFILE into the adaptation
+    # controller's PRICED observation funnel (the congestion analog of the
+    # fault-plan injection above — the run is real, the neighbor traffic
+    # is injected, and the artifact says so).  detect arm: the triage
+    # classifies and reports, zero swaps; swap arm: congestion re-routes
+    # through the standby cache inside the window and the incumbent is
+    # restored after it clears — calibration.json must come back
+    # byte-identical (congestion never re-calibrates).  Tight drift knobs
+    # keep detection inside the phase.
+    cong_path = os.path.join(
+        os.path.dirname(out_path),
+        f"congestion_profile_{os.path.basename(out_path)}.json",
+    )
+    with open(cong_path, "w") as f:
+        json.dump(
+            {
+                "world": world,
+                "label": "battery-fabric-contention",
+                "windows": [
+                    {"start": 6, "until": 14, "link_class": "dcn",
+                     "factor": 4.0},
+                ],
+            },
+            f,
+        )
+    for arm in ("detect", "swap"):
+        _run(
+            "fabric_contention",
+            [py, "-m", "adapcc_tpu.workloads.train_ddp", "--model", "mlp",
+             "--steps", "20", "--batch", "64", "--world", str(world),
+             "--sync-mode", "schedule", "--adapt", arm,
+             "--adapt-every", "4"],
+            900, out_path,
+            extra_env={
+                "ADAPCC_CONGESTION_PROFILE": cong_path,
+                "ADAPCC_DRIFT_FACTOR": "1.5",
+                "ADAPCC_DRIFT_WINDOW": "4",
+            },
+            rec_extra={"congestion_profile": cong_path, "adapt": arm},
+        )
 
 
 def run_simulated_fallback(py: str, out_path: str, world: int = 8) -> dict:

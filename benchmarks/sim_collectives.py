@@ -30,7 +30,11 @@ import json
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from adapcc_tpu.sim.calibrate import DEFAULT_CALIBRATION_PATH, load_or_default
-from adapcc_tpu.sim.cost_model import DEFAULT_HBM_BYTES_PER_S, LinkCostModel
+from adapcc_tpu.sim.cost_model import (
+    DCN,
+    DEFAULT_HBM_BYTES_PER_S,
+    LinkCostModel,
+)
 from adapcc_tpu.sim.replay import simulate_flow_broadcast, simulate_strategy
 from adapcc_tpu.strategy.ir import Strategy
 
@@ -1161,6 +1165,122 @@ def adapt_sweep(
     return rows
 
 
+def fabric_sweep(
+    world: int,
+    sizes: Sequence[int],
+    intensities: Sequence[float] = (1.0, 2.0, 4.0),
+    mixes: Sequence[str] = ("high-low", "high-high"),
+    share_penalty: float = 2.0,
+    model: Optional[LinkCostModel] = None,
+) -> List[dict]:
+    """Deterministic multi-tenant fabric rows — the hardware-free
+    regression artifact for priority-aware synthesis and graceful QoS
+    yielding (``make fabric-bench``, docs/FABRIC.md).
+
+    The grid is (payload size × background congestion intensity ×
+    priority mix) on a fixed two-pod split of ``--world``:
+
+    - **intensity** scales the shared DCN class's effective bandwidth
+      (β × intensity, α intact — ambient neighbor traffic both tenants
+      suffer, :meth:`LinkCostModel.contended`);
+    - mix ``"high-low"`` is the coordinated fabric: the low-priority
+      job's candidates are ranked under the high-priority job's link
+      occupancy (contended by the share penalty), so its winning tree
+      yields the high job's hot links;
+    - mix ``"high-high"`` is the uncoordinated baseline: two equal
+      tenants greedily pick the clean-network winner and pile onto the
+      same links.
+
+    Every row carries both jobs' priced steady states under the final
+    shared fabric, Jain's fairness index, and aggregate throughput; the
+    ``high-low`` rows additionally stamp ``high_beats_uncoordinated`` —
+    the acceptance property that priority coordination makes the high
+    job's sharing steady state strictly better than the pile-up.
+    Deterministic: no RNG, no wall clock — same calibration →
+    byte-identical rows.
+    """
+    from adapcc_tpu.adapt.fabric import SharedFabric
+
+    if world < 4 or world % 2:
+        raise ValueError(
+            f"fabric sweep needs an even world >= 4 (two pods of world/2), "
+            f"got {world}"
+        )
+    bad = [m for m in mixes if m not in ("high-low", "high-high")]
+    if bad:
+        raise ValueError(
+            f"unknown priority mixes {bad}; expected a subset of "
+            "['high-low', 'high-high']"
+        )
+    if any(i < 1.0 for i in intensities):
+        raise ValueError(
+            f"congestion intensities must be >= 1, got {list(intensities)}"
+        )
+    if model is None:
+        model = load_or_default(world=world)
+    elif model.world != world:
+        raise ValueError(f"model world {model.world} != sweep world {world}")
+    table = _ip_table(world, 2)
+    ips = {r: ip for r, ip in enumerate(table)}
+    base = model.with_ips(ips)
+
+    def _plan(ambient, mix: str):
+        fab = SharedFabric(ambient, table, share_penalty=share_penalty)
+        if mix == "high-low":
+            fab.add_job("job0", priority="high", nbytes=nbytes)
+            fab.add_job("job1", priority="low", nbytes=nbytes)
+            return fab.plan(coordinated=True)
+        fab.add_job("job0", priority="high", nbytes=nbytes)
+        fab.add_job("job1", priority="high", nbytes=nbytes)
+        return fab.plan(coordinated=False)
+
+    rows: List[dict] = []
+    for nbytes in sizes:
+        nbytes = int(nbytes)
+        for intensity in intensities:
+            intensity = float(intensity)
+            ambient = (
+                base.contended({DCN: intensity}) if intensity > 1.0 else base
+            )
+            plans = {mix: _plan(ambient, mix) for mix in mixes}
+            baseline = plans.get("high-high") or _plan(ambient, "high-high")
+            for mix in mixes:
+                plan = plans[mix]
+                j0, j1 = plan.job("job0"), plan.job("job1")
+                row = {
+                    "mode": "simulated",
+                    "collective": "allreduce",
+                    "impl": "fabric",
+                    "world": world,
+                    "size_bytes": nbytes,
+                    "intensity": intensity,
+                    "mix": mix,
+                    "share_penalty": float(share_penalty),
+                    "coordinated": plan.coordinated,
+                    "job0_strategy": j0.label,
+                    "job1_strategy": j1.label,
+                    "job0_us": round(j0.shared_s * 1e6, 3),
+                    "job1_us": round(j1.shared_s * 1e6, 3),
+                    "job0_alone_us": round(j0.alone_s * 1e6, 3),
+                    "job1_alone_us": round(j1.alone_s * 1e6, 3),
+                    "shared_links": len(plan.shared_links),
+                    "fairness": round(plan.fairness(), 6),
+                    "throughput_gbps": round(plan.throughput_gbps(), 6),
+                    "calibration": model.source,
+                }
+                if mix == "high-low":
+                    row["high_beats_uncoordinated"] = (
+                        j0.shared_s < baseline.job("job0").shared_s
+                    )
+                rows.append(row)
+    if not rows:
+        raise ValueError(
+            f"fabric sweep produced no rows: sizes={list(sizes)} "
+            f"intensities={list(intensities)} mixes={list(mixes)}"
+        )
+    return rows
+
+
 def tune_replay_sweep(
     world: int,
     sizes: Sequence[int],
@@ -1373,6 +1493,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="adapt-sweep DCN slowdown injected at the drift onset",
     )
     ap.add_argument(
+        "--fabric-sweep", action="store_true",
+        help="price the multi-tenant fabric instead of the strategy grid: "
+        "two prioritized jobs on a two-pod split of --world, over "
+        "(congestion intensity x priority mix), with the coordinated "
+        "high-low yield priced against the uncoordinated high-high "
+        "pile-up per row (make fabric-bench; docs/FABRIC.md)",
+    )
+    ap.add_argument(
+        "--intensities", default="1,2,4",
+        help="fabric-sweep background DCN congestion factor grid",
+    )
+    ap.add_argument(
         "--overlap-sweep", action="store_true",
         help="price the overlapped DDP gradient sync over (accum x "
         "bucket cap x overlap schedule) with overlapped_step_time instead "
@@ -1401,6 +1533,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--fault-sweep", args.fault_sweep),
             ("--adapt-sweep", args.adapt_sweep),
             ("--chaos-sweep", args.chaos_sweep),
+            ("--fabric-sweep", args.fabric_sweep),
         ) if on
     ]
     if len(exclusive) > 1:
@@ -1409,6 +1542,38 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ap.error(f"{' and '.join(exclusive)} are mutually exclusive; "
                  "run one sweep per invocation")
     model = load_or_default(args.calibration, world=args.world)
+    if args.fabric_sweep:
+        if args.hosts > 1:
+            # the sweep fixes its own two-pod split of --world; silently
+            # accepting --hosts would read as "priced that host split"
+            # when nothing used it (the --hier-sweep precedent)
+            ap.error("--hosts has no effect on --fabric-sweep (the sweep "
+                     "uses a fixed two-pod split of --world)")
+        rows = fabric_sweep(
+            world=args.world,
+            sizes=[parse_size(s) for s in args.sizes.split(",")],
+            intensities=[
+                float(i) for i in args.intensities.split(",") if i
+            ],
+            model=model,
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            else:
+                star = (
+                    "*" if row.get("high_beats_uncoordinated") else " "
+                )
+                print(
+                    f"[sim] fabric {row['size_bytes']:>12}B "
+                    f"x{row['intensity']:g} {row['mix']:<9}{star} "
+                    f"high={row['job0_us']:>10.1f}us "
+                    f"({row['job0_strategy']})  "
+                    f"peer={row['job1_us']:>10.1f}us "
+                    f"({row['job1_strategy']})  "
+                    f"fair={row['fairness']:.4f}"
+                )
+        return 0
     if args.hier_sweep:
         if args.hosts > 1:
             # the sweep grid names its own topologies (pods x pod_size);

@@ -591,3 +591,84 @@ def test_hier_sweep_cli_emits_json(capsys):
     assert rows and all(r["impl"] == "two_level" for r in rows)
     assert {r["pods"] for r in rows} == {2, 4}
     assert all("pred_flat_us" in r and "chosen" in r for r in rows)
+
+
+# ------------------------------------------------ fabric sweep (PR 12)
+
+
+def test_fabric_sweep_rows_byte_identical_and_decision_flagged():
+    """The fabric-bench artifact (docs/FABRIC.md §5) is deterministic to
+    the byte over the (size × congestion intensity × priority mix) grid,
+    and every coordinated high-low row stamps the acceptance flag: the
+    high-priority job's sharing steady state beats the uncoordinated
+    pile-up."""
+    from benchmarks.sim_collectives import fabric_sweep
+
+    sizes = [1 << 20, 16 << 20]
+    rows = fabric_sweep(8, sizes, intensities=(1.0, 4.0))
+    again = fabric_sweep(8, sizes, intensities=(1.0, 4.0))
+    assert [json.dumps(r, sort_keys=True) for r in rows] == [
+        json.dumps(r, sort_keys=True) for r in again
+    ]
+    assert len(rows) == len(sizes) * 2 * 2  # sizes x intensities x mixes
+    for r in rows:
+        assert r["mode"] == "simulated" and r["impl"] == "fabric"
+        assert r["world"] == 8
+        assert r["mix"] in ("high-low", "high-high")
+        assert r["coordinated"] == (r["mix"] == "high-low")
+        assert r["job0_us"] > 0 and r["job1_us"] > 0
+        assert 0.0 < r["fairness"] <= 1.0
+        if r["mix"] == "high-low":
+            assert r["high_beats_uncoordinated"] is True, (
+                "priority coordination must leave the high job strictly "
+                "better off than the uncoordinated pile-up"
+            )
+            # yielding costs the low job, never the high job
+            assert r["job0_us"] <= r["job1_us"]
+        else:
+            assert "high_beats_uncoordinated" not in r
+    with pytest.raises(ValueError, match="even world"):
+        fabric_sweep(7, sizes)
+    with pytest.raises(ValueError, match="mixes"):
+        fabric_sweep(8, sizes, mixes=("high-medium",))
+    with pytest.raises(ValueError, match="intensities"):
+        fabric_sweep(8, sizes, intensities=(0.5,))
+
+
+def test_fabric_sweep_cli_mutually_exclusive_and_rejects_hosts(capsys):
+    from benchmarks.sim_collectives import main
+
+    for other in (
+        ["--ring-sweep"],
+        ["--tune-replay"],
+        ["--fused-sweep"],
+        ["--overlap-sweep"],
+        ["--fault-sweep"],
+        ["--latency-sweep"],
+        ["--adapt-sweep"],
+        ["--chaos-sweep"],
+        ["--hier-sweep"],
+    ):
+        with pytest.raises(SystemExit):
+            main(["--fabric-sweep"] + other)
+    # the sweep fixes its own two-pod split of --world: --hosts is
+    # meaningless and silently accepting it would mislabel the artifact
+    with pytest.raises(SystemExit):
+        main(["--fabric-sweep", "--hosts", "2"])
+    capsys.readouterr()
+
+
+def test_fabric_sweep_cli_emits_json(capsys):
+    from benchmarks.sim_collectives import main
+
+    assert main([
+        "--fabric-sweep", "--world", "8", "--sizes", "1M,16M",
+        "--intensities", "1,4", "--json",
+    ]) == 0
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rows and all(r["impl"] == "fabric" for r in rows)
+    assert {r["intensity"] for r in rows} == {1.0, 4.0}
+    assert {r["mix"] for r in rows} == {"high-low", "high-high"}
+    assert all(
+        r["high_beats_uncoordinated"] for r in rows if r["mix"] == "high-low"
+    )

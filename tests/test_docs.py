@@ -3,8 +3,9 @@
 Every ```python block in docs/PARALLELISM.md, docs/OPERATIONS.md,
 docs/SIMULATION.md, docs/RING.md, docs/QUANT.md, docs/TUNER.md,
 docs/OVERLAP.md, docs/LATENCY.md, docs/ELASTIC.md, docs/ADAPT.md,
-docs/SUPERVISOR.md and docs/HIERARCHY.md runs verbatim on the virtual
-pod.  A snippet that stops compiling or produces wrong shapes fails here.
+docs/SUPERVISOR.md, docs/HIERARCHY.md and docs/FABRIC.md runs verbatim
+on the virtual pod.  A snippet that stops compiling or produces wrong
+shapes fails here.
 """
 
 import os
@@ -27,6 +28,7 @@ _ELASTIC = os.path.join(_DOCS_DIR, "ELASTIC.md")
 _ADAPT = os.path.join(_DOCS_DIR, "ADAPT.md")
 _SUPERVISOR = os.path.join(_DOCS_DIR, "SUPERVISOR.md")
 _HIERARCHY = os.path.join(_DOCS_DIR, "HIERARCHY.md")
+_FABRIC = os.path.join(_DOCS_DIR, "FABRIC.md")
 
 
 def _blocks(path):
@@ -289,3 +291,28 @@ def test_hierarchy_doc_covers_the_contract():
 def test_hierarchy_doc_snippet_runs(idx):
     code = _blocks(_HIERARCHY)[idx]
     exec(compile(code, f"{_HIERARCHY}:block{idx}", "exec"), {})
+
+
+def test_fabric_doc_has_snippets():
+    assert len(_blocks(_FABRIC)) >= 5
+
+
+def test_fabric_doc_covers_the_contract():
+    """The multi-tenant fabric topics the triage/QoS story leans on."""
+    text = open(_FABRIC).read()
+    for needle in (
+        "ADAPCC_CONGESTION_PROFILE", "ADAPCC_JOB_PRIORITY",
+        "CongestionProfile", "contended_coeffs", "classify_drift",
+        "congestion-reroute", "congestion-cleared", "byte-untouched",
+        "resolve_leader_level", "synthesize_two_level", "SharedFabric",
+        "hot_links", "high_beats_uncoordinated", "make fabric-bench",
+        "fabric_contention", "load_env_json_artifact", "cache_hit",
+        "simulate_congestion_profile",
+    ):
+        assert needle in text, f"FABRIC.md lost its {needle!r} coverage"
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_FABRIC))))
+def test_fabric_doc_snippet_runs(idx):
+    code = _blocks(_FABRIC)[idx]
+    exec(compile(code, f"{_FABRIC}:block{idx}", "exec"), {})

@@ -1,0 +1,619 @@
+"""Multi-tenant fabric: congestion-vs-degradation triage + QoS yielding
+(docs/FABRIC.md).
+
+Covers the deterministic congestion model (``CongestionProfile`` windows,
+``contended_coeffs`` β-only scaling, the shared ``ADAPCC_CONGESTION_PROFILE``
+env→artifact funnel, the replay rows), the analytic triage
+(``classify_drift``: β-inflated/α-intact → congestion, both-stretched or
+single-size evidence → degradation), and the two acceptance drills:
+
+- **triage drill** (CPU, deterministic): an injected congestion window
+  fires the detector, classifies as congestion, re-routes off the hot
+  DCN class via a standby hot-swap (``cache_hit`` pinned) with
+  ``topology/calibration.json`` byte-UNCHANGED; when the window clears
+  the incumbent is restored (reversibility pinned); an injected
+  degradation keeps PR 9's re-calibrate path; a healthy ±5% feed never
+  triggers either.
+- **QoS drill**: two prioritized jobs on one simulated multi-pod
+  topology — the low-priority job's strategy avoids the high-priority
+  job's bottleneck links, the priced fairness/throughput frontier row is
+  byte-deterministic, and the high job's steady state under coordinated
+  sharing is strictly better than the uncoordinated pile-up.
+"""
+
+import json
+
+import jax.numpy as jnp
+import pytest
+
+from adapcc_tpu.adapt import (
+    AdaptationController,
+    DriftDetector,
+    TriageVerdict,
+    calibration_of,
+    classify_drift,
+    contended_view,
+    job_priority,
+)
+from adapcc_tpu.adapt.fabric import (
+    JOB_PRIORITY_ENV,
+    SharedFabric,
+    contend_links,
+    hot_links,
+    strategy_links,
+)
+from adapcc_tpu.comm.engine import CollectiveEngine
+from adapcc_tpu.sim.calibrate import Calibration
+from adapcc_tpu.sim.congestion import (
+    CONGESTION_PROFILE_ENV,
+    CongestionProfile,
+    CongestionWindow,
+    load_congestion_profile,
+)
+from adapcc_tpu.sim.cost_model import (
+    DCN,
+    ICI,
+    LinkCoeffs,
+    LinkCostModel,
+    congested_ring_allreduce_time,
+    congested_two_level_allreduce_time,
+    contended_coeffs,
+    quantized_ring_allreduce_time,
+    two_level_allreduce_time,
+)
+from adapcc_tpu.sim.replay import simulate_congestion_profile, simulate_strategy
+from adapcc_tpu.strategy.ir import Strategy
+from adapcc_tpu.strategy.synthesizer import Synthesizer
+from adapcc_tpu.tuner.db import TuningDatabase
+from adapcc_tpu.tuner.policy import TuningPolicy
+from adapcc_tpu.utils.observability import CollectiveTrace
+
+WORLD = 8
+IPS = {r: f"10.0.0.{r // 2}" for r in range(WORLD)}  # 4 hosts x 2 lanes
+TABLE = [IPS[r] for r in range(WORLD)]
+POD_IPS = {r: f"10.0.0.{r // 4}" for r in range(WORLD)}  # 2 pods x 4
+POD_TABLE = [POD_IPS[r] for r in range(WORLD)]
+
+
+def _model(ips=IPS, source="test-fabric") -> LinkCostModel:
+    return LinkCostModel(
+        WORLD,
+        classes={
+            ICI: LinkCoeffs(1e-6, 1.0 / 45e9),
+            DCN: LinkCoeffs(25e-6, 1.0 / 12.5e9),
+        },
+        ips=ips,
+        source=source,
+    )
+
+
+# --------------------------------------------------------------------------- #
+# the congestion model
+# --------------------------------------------------------------------------- #
+
+def test_congestion_window_validation_is_loud():
+    with pytest.raises(ValueError, match="link class"):
+        CongestionWindow(0, 4, "pcie")
+    with pytest.raises(ValueError, match="empty"):
+        CongestionWindow(4, 4, DCN)
+    with pytest.raises(ValueError, match=">= 0"):
+        CongestionWindow(-1, 4, DCN)
+    with pytest.raises(ValueError, match="factor"):
+        CongestionWindow(0, 4, DCN, factor=0.5)
+    with pytest.raises(ValueError, match="world"):
+        CongestionProfile([CongestionWindow(0, 4, DCN)], world=0)
+
+
+def test_congestion_profile_replay_state():
+    """factors_at folds overlapping windows by MAX per class (the hottest
+    neighbor sets the share), and healthy steps read exactly healthy."""
+    prof = CongestionProfile(
+        [
+            CongestionWindow(2, 6, DCN, 4.0),
+            CongestionWindow(4, 8, DCN, 2.0),   # overlaps: max wins
+            CongestionWindow(5, 7, ICI, 3.0),
+        ],
+        world=WORLD,
+    )
+    assert prof.healthy_at(0) and prof.factors_at(0) == {}
+    assert prof.factors_at(2) == {DCN: 4.0}
+    assert prof.factors_at(5) == {DCN: 4.0, ICI: 3.0}
+    assert prof.factors_at(7) == {DCN: 2.0}
+    assert prof.last_step() == 8
+    assert prof.classes() == (DCN, ICI)
+    model = _model()
+    contended = prof.contended_model(model, 5)
+    assert contended.classes[DCN].beta == pytest.approx(
+        model.classes[DCN].beta * 4.0
+    )
+    assert prof.contended_model(model, 0) is model  # healthy: untouched
+
+
+def test_congestion_profile_seeded_and_roundtrip(tmp_path):
+    a = CongestionProfile.seeded(WORLD, steps=16, seed=7)
+    b = CongestionProfile.seeded(WORLD, steps=16, seed=7)
+    assert a.to_dict() == b.to_dict(), "same seed must be byte-identical"
+    assert a.to_dict() != CongestionProfile.seeded(WORLD, 16, seed=8).to_dict()
+    assert all(w.link_class == DCN for w in a.windows)
+    path = str(tmp_path / "profile.json")
+    a.save(path)
+    assert CongestionProfile.load(path).to_dict() == a.to_dict()
+    with pytest.raises(ValueError, match="unknown congestion classes"):
+        CongestionProfile.seeded(WORLD, 16, classes=("nvlink",))
+
+
+def test_load_congestion_profile_env_funnel(tmp_path, monkeypatch):
+    """The shared ADAPCC_FAULT_PLAN funnel semantics, verbatim: unset →
+    None; set-but-broken (missing, garbage, world mismatch) → loud."""
+    monkeypatch.delenv(CONGESTION_PROFILE_ENV, raising=False)
+    assert load_congestion_profile() is None
+
+    path = tmp_path / "profile.json"
+    CongestionProfile([CongestionWindow(2, 5, DCN)], world=WORLD).save(
+        str(path)
+    )
+    monkeypatch.setenv(CONGESTION_PROFILE_ENV, str(path))
+    prof = load_congestion_profile(world=WORLD)
+    assert prof is not None and prof.factors_at(3) == {DCN: 4.0}
+    with pytest.raises(ValueError, match="world"):
+        load_congestion_profile(world=4)
+    monkeypatch.setenv(CONGESTION_PROFILE_ENV, str(tmp_path / "missing.json"))
+    with pytest.raises(FileNotFoundError):
+        load_congestion_profile()
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json{")
+    monkeypatch.setenv(CONGESTION_PROFILE_ENV, str(bad))
+    with pytest.raises(ValueError, match="congestion-profile"):
+        load_congestion_profile()
+
+
+def test_contended_coeffs_scale_beta_only():
+    """The congestion signature: β × factor, α untouched — the deliberate
+    contrast to degradation's ``scaled`` (both terms stretch)."""
+    c = LinkCoeffs(25e-6, 1.0 / 12.5e9)
+    cont = contended_coeffs(c, 4.0)
+    assert cont.alpha == c.alpha
+    assert cont.beta == pytest.approx(c.beta * 4.0)
+    assert c.scaled(4.0).alpha == pytest.approx(c.alpha * 4.0)  # contrast
+    with pytest.raises(ValueError, match="factor"):
+        contended_coeffs(c, 0.9)
+    model = _model()
+    cm = model.contended({DCN: 4.0})
+    assert cm.classes[DCN].alpha == model.classes[DCN].alpha
+    assert cm.classes[DCN].beta == pytest.approx(model.classes[DCN].beta * 4)
+    assert cm.classes[ICI] == model.classes[ICI]
+    assert "contended[dcnx4]" in cm.source
+    with pytest.raises(ValueError, match="unknown link class"):
+        model.contended({"pcie": 2.0})
+    with pytest.raises(ValueError, match="factor"):
+        model.contended({DCN: 0.5})
+
+
+def test_congested_time_terms_price_the_window():
+    dcn = LinkCoeffs(25e-6, 1.0 / 12.5e9)
+    ici = LinkCoeffs(1e-6, 1.0 / 45e9)
+    nbytes = 16 << 20
+    healthy = quantized_ring_allreduce_time(WORLD, nbytes, dcn, "off")
+    assert congested_ring_allreduce_time(WORLD, nbytes, dcn, 1.0) == healthy
+    assert congested_ring_allreduce_time(WORLD, nbytes, dcn, 4.0) > healthy
+    flat_two = two_level_allreduce_time(2, 4, nbytes, ici, dcn)
+    cong_two = congested_two_level_allreduce_time(
+        2, 4, nbytes, ici, dcn, dcn_factor=4.0
+    )
+    assert cong_two > flat_two
+    assert congested_two_level_allreduce_time(
+        2, 4, nbytes, ici, dcn
+    ) == pytest.approx(flat_two)  # factor=1 is exactly the healthy price
+
+
+def test_simulate_congestion_profile_rows_deterministic():
+    model = _model()
+    strategy = Strategy.ring(WORLD, 1, IPS)
+    prof = CongestionProfile([CongestionWindow(2, 5, DCN, 4.0)], WORLD)
+    rows = simulate_congestion_profile(strategy, model, 16 << 20, prof)
+    again = simulate_congestion_profile(strategy, model, 16 << 20, prof)
+    assert [r.to_row() for r in rows] == [r.to_row() for r in again]
+    assert len(rows) == prof.last_step() + 1 == 6
+    healthy = simulate_strategy(
+        strategy, model, 16 << 20, "allreduce", keep_transfers=False
+    ).seconds
+    for r in rows:
+        assert r.to_row()["mode"] == "simulated"
+        assert r.healthy_s == healthy
+        if 2 <= r.step < 5:
+            assert r.congested and r.contention_ratio > 1.5
+            assert dict(r.factors) == {DCN: 4.0}
+        else:
+            assert not r.congested and r.seconds == healthy
+    with pytest.raises(ValueError, match="world"):
+        simulate_congestion_profile(
+            Strategy.ring(4), model, 16 << 20, prof
+        )
+
+
+# --------------------------------------------------------------------------- #
+# the triage classifier
+# --------------------------------------------------------------------------- #
+
+def _fed_detector(model: LinkCostModel, observed: LinkCostModel,
+                  sizes=(65536, 16 << 20), window: int = 4) -> DriftDetector:
+    """A detector calibrated on ``model`` fed full priced windows measured
+    under ``observed`` at the given payload sizes."""
+    det = DriftDetector(WORLD, "fp", cost_model=model, factor=2.0,
+                        window=window)
+    pol = TuningPolicy(
+        TuningDatabase(persist=False), WORLD, "fp", cost_model=observed
+    )
+    for nb in sizes:
+        key = det.probe_key(nb)
+        for _ in range(window):
+            det.observe(key, pol.prior_time(key, nb), nbytes=nb)
+    return det
+
+
+def test_classify_drift_congestion_signature():
+    """A contended DCN (β × 4, α intact) at two payload decades: the big
+    payload fires, the small one stays healthy — and that α-intact
+    evidence is exactly what separates congestion from degradation."""
+    model = _model()
+    det = _fed_detector(model, model.contended({DCN: 4.0}))
+    report = det.check()
+    assert report.drifted
+    v = classify_drift(report, model)
+    assert isinstance(v, TriageVerdict)
+    assert v.kind == "congestion" and v.separable
+    assert v.link_class == DCN
+    assert v.beta_ratio == pytest.approx(4.0, rel=0.2)
+    assert v.alpha_ratio < 1.5
+    assert v.factor == v.beta_ratio
+    view = contended_view(model, v)
+    assert view.classes[DCN].beta == pytest.approx(
+        model.classes[DCN].beta * v.beta_ratio
+    )
+    assert view.classes[DCN].alpha == model.classes[DCN].alpha
+
+
+def test_classify_drift_attributes_the_contended_class():
+    """Congestion on the NON-bottleneck class: an ICI window hot enough
+    to overtake the healthy DCN bottleneck must be attributed to ICI by
+    the α signature (the fit reproduces ICI's µs-scale α, not DCN's) —
+    re-routing off the still-healthy DCN class would be the wrong-class
+    failure the triage exists to prevent."""
+    model = _model()
+    det = _fed_detector(model, model.contended({ICI: 64.0}))
+    report = det.check()
+    assert report.drifted
+    v = classify_drift(report, model)
+    assert v.kind == "congestion" and v.link_class == ICI
+    assert contended_view(model, v).classes[ICI].beta > (
+        model.classes[ICI].beta
+    )
+
+
+def test_classify_drift_degradation_signature():
+    """A genuinely slow wire (both terms × 6) classifies degradation —
+    and single-size evidence is the conservative degradation call (one
+    size cannot separate α from β; a mis-read would re-route forever)."""
+    model = _model()
+    degraded = LinkCostModel(
+        WORLD,
+        classes={ICI: model.classes[ICI], DCN: model.classes[DCN].scaled(6.0)},
+        ips=IPS,
+        source="deg",
+    )
+    v = classify_drift(_fed_detector(model, degraded).check(), model)
+    assert v.kind == "degradation" and v.separable
+    assert v.alpha_ratio > 1.5  # α stretched too: not a contention shape
+    # single payload size: inseparable → conservative degradation
+    v1 = classify_drift(
+        _fed_detector(model, model.contended({DCN: 4.0}),
+                      sizes=(16 << 20,)).check(),
+        model,
+    )
+    assert v1.kind == "degradation" and not v1.separable
+    with pytest.raises(ValueError, match="congestion verdict"):
+        contended_view(model, v1)
+
+
+def test_classify_drift_mid_band_alpha_is_degradation():
+    """An ICI wire degraded ×8 fits α = 8µs — between ICI's 1µs and
+    DCN's 25µs, reproducing NEITHER class's α within the band.  The
+    attribution must not re-anchor to the nearer class and read the
+    below-band α as 'intact': a degradation misread as congestion would
+    re-route forever and never fix the model."""
+    model = _model()
+    degraded = LinkCostModel(
+        WORLD,
+        classes={ICI: model.classes[ICI].scaled(8.0), DCN: model.classes[DCN]},
+        ips=IPS,
+        source="ici-deg",
+    )
+    report = _fed_detector(model, degraded).check()
+    if report.drifted:
+        v = classify_drift(report, model)
+        assert v.kind == "degradation", (
+            f"ICI degradation misread as {v.kind} on {v.link_class}"
+        )
+
+
+# --------------------------------------------------------------------------- #
+# the triage drill (acceptance): congestion re-routes + restores,
+# degradation re-calibrates, healthy never fires — all deterministic CPU
+# --------------------------------------------------------------------------- #
+
+def _controller(engine, mode, model, cal_path=None, profile=None):
+    return AdaptationController(
+        engine,
+        Synthesizer(None, TABLE),
+        mode=mode,
+        cost_model=model,
+        calibration_path=cal_path,
+        nbytes=16 << 20,
+        parallel_degree=2,
+        warm_shape=(64,),
+        fingerprint="fp",
+        detector=DriftDetector(
+            WORLD, "fp", cost_model=model, factor=2.0, window=4
+        ),
+        congestion_profile=profile,
+    )
+
+
+def test_triage_drill_congestion_reroutes_and_restores(mesh8, tmp_path):
+    """The acceptance drill: an injected congestion window → the detector
+    fires → triage says congestion → re-route off the hot DCN class via a
+    standby hot-swap (``cache_hit`` pinned) with the calibration artifact
+    byte-UNCHANGED; after the window clears the incumbent is restored
+    (reversibility) — and the restore's dispatch is warm too."""
+    model = _model()
+    cal_path = str(tmp_path / "calibration.json")
+    calibration_of(model, fingerprint="fp", samples=3).save(cal_path)
+    cal_before = open(cal_path, "rb").read()
+
+    trace = CollectiveTrace()
+    incumbent = Strategy.ring(WORLD, 1, IPS)
+    eng = CollectiveEngine(mesh8, incumbent, trace=trace)
+    x = jnp.ones((WORLD, 64), jnp.float32)
+    eng.all_reduce(x, active_gpus=list(range(WORLD)))  # incumbent, warm
+    profile = CongestionProfile([CongestionWindow(4, 8, DCN, 4.0)], WORLD)
+    ctl = _controller(eng, "swap", model, cal_path, profile=profile)
+
+    # healthy steps: the loop stays quiet
+    for step in range(4):
+        ctl.tick(step)
+    rep = ctl.maybe_adapt()
+    assert rep.outcome == "no-drift" and rep.triage is None
+    assert not ctl.rerouted and eng.epoch == 0
+
+    # the congestion window: triage fires, the re-route avoids the hot
+    # DCN class (the two-level escape ships 1/pod_size over DCN)
+    for step in range(4, 8):
+        ctl.tick(step)
+    rep = ctl.maybe_adapt()
+    assert rep.outcome == "congestion-reroute" and rep.triage == "congestion"
+    assert rep.swapped and ctl.rerouted
+    assert rep.winner_label.endswith("+congestion")
+    assert rep.winner_pred_s < rep.incumbent_pred_s
+    assert rep.winner_fingerprint != incumbent.fingerprint()
+    # the calibration artifact is byte-unchanged: congestion NEVER merges
+    assert open(cal_path, "rb").read() == cal_before
+    # the swap is a dispatch-time cache switch
+    eng.all_reduce(x, active_gpus=list(range(WORLD)))
+    ev = trace.events()[-1]
+    assert ev.extra["cache_hit"] is True and ev.extra["epoch"] == 1
+
+    # the window clears: a full healthy window restores the incumbent
+    for step in range(8, 12):
+        ctl.tick(step)
+    rep = ctl.maybe_adapt()
+    assert rep.outcome == "congestion-cleared" and rep.swapped
+    assert not ctl.rerouted
+    assert eng.strategy.fingerprint() == incumbent.fingerprint()
+    # the incumbent's programs never left the cache: restore replays warm
+    eng.all_reduce(x, active_gpus=list(range(WORLD)))
+    assert trace.events()[-1].extra["cache_hit"] is True
+    assert open(cal_path, "rb").read() == cal_before
+    # and the loop is quiet again
+    assert ctl.maybe_adapt().outcome in ("no-drift", "congestion-active")
+
+
+def test_triage_drill_detect_mode_reports_without_swapping(mesh8, tmp_path):
+    model = _model()
+    incumbent = Strategy.ring(WORLD, 1, IPS)
+    eng = CollectiveEngine(mesh8, incumbent)
+    profile = CongestionProfile([CongestionWindow(0, 4, DCN, 4.0)], WORLD)
+    ctl = _controller(eng, "detect", model, profile=profile)
+    for step in range(4):
+        ctl.tick(step)
+    rep = ctl.maybe_adapt()
+    assert rep.outcome == "congestion-would-reroute"
+    assert rep.triage == "congestion" and not rep.swapped
+    assert not ctl.rerouted
+    assert eng.strategy.fingerprint() == incumbent.fingerprint()
+    assert eng.epoch == 0
+
+
+def test_triage_probe_sizes_stay_separable_for_small_payloads(mesh8):
+    """A payload whose size bucket sits at the 4 KiB probe floor must NOT
+    collapse both probe cells into one size — single-size evidence is
+    never separable, so every congestion window would be conservatively
+    mis-triaged as degradation and merged into the calibration."""
+    model = _model()
+    eng = CollectiveEngine(mesh8, Strategy.ring(WORLD, 1, IPS))
+    profile = CongestionProfile([CongestionWindow(0, 4, DCN, 4.0)], WORLD)
+    ctl = AdaptationController(
+        eng,
+        Synthesizer(None, TABLE),
+        mode="detect",
+        cost_model=model,
+        nbytes=2048,  # bucket <= floor: the degenerate case
+        parallel_degree=2,
+        warm_shape=(64,),
+        fingerprint="fp",
+        detector=DriftDetector(
+            WORLD, "fp", cost_model=model, factor=2.0, window=4
+        ),
+        congestion_profile=profile,
+    )
+    lo, hi = ctl._probe_sizes
+    assert lo != hi and hi >= lo << 12
+    for step in range(4):
+        ctl.tick(step)
+    rep = ctl.maybe_adapt()
+    assert rep.triage == "congestion"
+    assert rep.outcome == "congestion-would-reroute"
+
+
+def test_triage_drill_degradation_keeps_recalibrate_path(mesh8, tmp_path):
+    """The degradation arm: both α and β stretched → triage says
+    degradation → PR 9's re-calibrate path fires exactly as before (the
+    artifact IS merged and stamped — the opposite of the congestion
+    contract), and no transient re-route state is created."""
+    model = _model()
+    cal_path = str(tmp_path / "calibration.json")
+    eng = CollectiveEngine(mesh8, Strategy.ring(WORLD, 1, IPS))
+    ctl = _controller(eng, "swap", model, cal_path)
+    degraded = LinkCostModel(
+        WORLD,
+        classes={ICI: model.classes[ICI], DCN: model.classes[DCN].scaled(6.0)},
+        ips=IPS,
+        source="deg",
+    )
+    pol = TuningPolicy(
+        TuningDatabase(persist=False), WORLD, "fp", cost_model=degraded
+    )
+    for nb in ctl._probe_sizes:
+        key = ctl.detector.probe_key(nb)
+        for _ in range(4):
+            ctl.observe(key, pol.prior_time(key, nb), nbytes=nb)
+    rep = ctl.maybe_adapt()
+    assert rep.triage == "degradation"
+    assert rep.recalibrated and not ctl.rerouted
+    cal = Calibration.load(cal_path)
+    assert cal.provenance and cal.provenance[-1] == "drift-recal"
+
+
+def test_triage_drill_healthy_jitter_never_fires(mesh8):
+    """±5% noise around the calibrated price at both probe decades: no
+    drift, no triage, no swap — the false-positive guard."""
+    model = _model()
+    eng = CollectiveEngine(mesh8, Strategy.ring(WORLD, 1, IPS))
+    ctl = _controller(eng, "swap", model)
+    pol = TuningPolicy(
+        TuningDatabase(persist=False), WORLD, "fp", cost_model=model
+    )
+    for nb in ctl._probe_sizes:
+        key = ctl.detector.probe_key(nb)
+        for i in range(4):
+            jitter = 0.95 if i % 2 else 1.05
+            ctl.observe(key, pol.prior_time(key, nb) * jitter, nbytes=nb)
+    rep = ctl.maybe_adapt()
+    assert rep.outcome == "no-drift" and rep.triage is None
+    assert not rep.swapped and not ctl.rerouted and ctl.swaps == 0
+
+
+# --------------------------------------------------------------------------- #
+# QoS: prioritized tenants on one fabric
+# --------------------------------------------------------------------------- #
+
+def test_job_priority_env_funnel(monkeypatch):
+    monkeypatch.delenv(JOB_PRIORITY_ENV, raising=False)
+    assert job_priority() == "high"          # undeclared never yields
+    assert job_priority("low") == "low"
+    monkeypatch.setenv(JOB_PRIORITY_ENV, "low")
+    assert job_priority("high") == "low"     # env wins
+    monkeypatch.setenv(JOB_PRIORITY_ENV, "medium")
+    with pytest.raises(ValueError, match=JOB_PRIORITY_ENV):
+        job_priority()
+
+
+def test_strategy_links_claim_both_directions():
+    s = Strategy.ring(4)
+    links = strategy_links(s)
+    for child, parent in s.trees[0].parent.items():
+        assert (parent, child) in links and (child, parent) in links
+    model = _model()
+    target = sorted(strategy_links(Strategy.ring(WORLD, 1, IPS)))[:2]
+    shared = contend_links(model, target, 2.0)
+    for l in target:
+        assert shared.coeffs(*l).beta == pytest.approx(
+            model.coeffs(*l).beta * 2.0
+        )
+        assert shared.coeffs(*l).alpha == model.coeffs(*l).alpha
+    with pytest.raises(ValueError, match="share factor"):
+        contend_links(model, target, 0.5)
+
+
+def test_qos_two_job_drill_low_yields_and_high_wins():
+    """The acceptance drill: on a two-pod fabric the coordinated plan
+    keeps the two tenants' BOTTLENECK link sets disjoint (the low job
+    yields the high job's hot cross-pod edges), the high job's shared
+    steady state is strictly better than the uncoordinated pile-up, and
+    the priced frontier row is byte-deterministic."""
+    model = _model(ips=POD_IPS)
+    fab = SharedFabric(model, POD_TABLE)
+    fab.add_job("training", priority="high", nbytes=16 << 20)
+    fab.add_job("batch", priority="low", nbytes=16 << 20)
+
+    plan = fab.plan(coordinated=True)
+    hi, lo = plan.job("training"), plan.job("batch")
+    assert hi.job.priority == "high" and lo.job.priority == "low"
+    assert lo.yielded_links > 0 and hi.yielded_links == 0
+    # the low job's chosen tree avoids the high job's bottleneck links
+    assert not (hot_links(hi.strategy, model) & hot_links(lo.strategy, model))
+    assert 0.0 < plan.fairness() <= 1.0
+    assert plan.throughput_gbps() > 0
+
+    unco = fab.plan(coordinated=False)
+    assert hi.shared_s < unco.job("training").shared_s, (
+        "coordination must make the high-priority job's sharing steady "
+        "state strictly better than the uncoordinated pile-up"
+    )
+    row = fab.frontier()
+    assert row["mode"] == "simulated" and row["high_priority_wins"]
+    assert json.dumps(row, sort_keys=True) == json.dumps(
+        fab.frontier(), sort_keys=True
+    ), "the frontier row must be byte-deterministic"
+    # every tenant pays a bounded contention tax, not starvation
+    for a in plan.assignments:
+        assert a.shared_s >= a.alone_s
+        assert a.shared_s < a.alone_s * 3.0
+
+
+def test_shared_fabric_validation_is_loud():
+    model = _model(ips=POD_IPS)
+    with pytest.raises(ValueError, match="ip table"):
+        SharedFabric(model, POD_TABLE[:-1])
+    with pytest.raises(ValueError, match="share_penalty"):
+        SharedFabric(model, POD_TABLE, share_penalty=0.5)
+    fab = SharedFabric(model, POD_TABLE)
+    with pytest.raises(ValueError, match="no jobs"):
+        fab.plan()
+    fab.add_job("a")
+    with pytest.raises(ValueError, match="already registered"):
+        fab.add_job("a")
+    with pytest.raises(ValueError, match="high|low"):
+        fab.add_job("b", priority="medium")
+    plan = fab.plan()
+    with pytest.raises(KeyError, match="no job"):
+        plan.job("ghost")
+
+
+# --------------------------------------------------------------------------- #
+# workload wiring: set-but-quiet is forbidden
+# --------------------------------------------------------------------------- #
+
+def test_train_ddp_rejects_congestion_profile_outside_ddp_mode(
+    tmp_path, monkeypatch
+):
+    from adapcc_tpu.workloads.train_ddp import main as train_main
+
+    path = tmp_path / "profile.json"
+    CongestionProfile([CongestionWindow(1, 3, DCN)], world=8).save(str(path))
+    monkeypatch.setenv(CONGESTION_PROFILE_ENV, str(path))
+    with pytest.raises(ValueError, match="requires --dp-mode ddp"):
+        train_main(["--dp-mode", "zero1", "--steps", "1"])
+    # and a profile with the adaptation loop disarmed injects into
+    # nothing: loud, never silently un-injected
+    with pytest.raises(ValueError, match="--adapt"):
+        train_main(["--dp-mode", "ddp", "--steps", "1", "--adapt", "off"])

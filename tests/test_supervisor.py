@@ -801,3 +801,68 @@ def test_trainer_supervised_mask_seam(mesh4, tmp_path):
     np.testing.assert_allclose(
         np.asarray(masked_loss), np.asarray(o_loss), rtol=1e-6
     )
+
+
+def test_fault_plan_recover_grows_back_through_restore_full(
+    mesh4, tmp_path
+):
+    """Grow-back coverage (docs/FABRIC.md rides the same seam): a
+    ``FaultPlan`` ``recover`` event restores the FULL world through
+    ``StandbyPlanCache.restore_full`` — the epoch bumps forward (never
+    back), the base plan's compiled programs never left the cache so the
+    first full-world dispatch is a ``cache_hit``, and the journal records
+    the recovery as a warm base-plan swap.  Shrink is drilled above and
+    in PR 7/10; this pins the re-expansion half."""
+    plan = FaultPlan(
+        [FaultEvent(step=2, kind="down", rank=1),
+         FaultEvent(step=5, kind="recover", rank=1)],
+        world=4,
+    )
+    trace = CollectiveTrace()
+    engine = CollectiveEngine(mesh4, Strategy.ring(4), trace=trace)
+    x = jnp.ones((4, 8), jnp.float32)
+    engine.all_reduce(x)  # the full-world program, warm from step 0
+    cache = StandbyPlanCache(engine, nbytes=x.nbytes, top_k=4)
+    cache.build()
+    cache.warm((8,), jnp.float32)
+    logic = CoordinatorLogic(4)
+    step = [0]
+    sup = Supervisor(
+        logic, engine, cache=cache,
+        journal_path=str(tmp_path / "sup.journal"),
+        fault_plan=plan, step_source=lambda: step[0],
+        config=LivenessConfig(timeout_s=100.0, period_s=1.0, grace=1),
+        clock=lambda: 0.0,
+    )
+    # -- shrink: the down event actuates a standby swap ------------------
+    for s in range(5):
+        step[0] = s
+        sup.poll()
+    assert sorted(sup.worldview().alive) == [0, 2, 3]
+    assert sup.worldview().epoch == 1 and sup.engine_epoch == 1
+    out = engine.all_reduce(
+        x, active_gpus=[0, 2, 3], epoch=sup.engine_epoch
+    )
+    assert float(np.asarray(out)[0, 0]) == 3.0
+    # -- grow back: the recover event restores the full world ------------
+    step[0] = 5
+    sup.poll()
+    wv = sup.worldview()
+    assert sorted(wv.alive) == [0, 1, 2, 3] and wv.dead == frozenset()
+    assert wv.epoch == 2, "re-expansion must bump the epoch FORWARD"
+    assert sup.engine_epoch == 2
+    assert engine.strategy.fingerprint() == cache.base_strategy.fingerprint()
+    st = sup.journal.replay()
+    kinds = [d.kind for d in st.decisions]
+    assert kinds[-3:] == ["recover", "epoch", "swap"]
+    swap = st.decisions[-1]
+    assert swap.payload["label"] == "base" and swap.payload["warmed"]
+    assert swap.payload["engine_epoch"] == 2
+    # restore_full: the base plan's programs never left the cache, so the
+    # first full-world dispatch after grow-back replays warm
+    out = engine.all_reduce(x, epoch=sup.engine_epoch)
+    ev = trace.events()[-1]
+    assert ev.extra["cache_hit"] is True, "grow-back dispatch recompiled"
+    assert ev.extra["epoch"] == 2
+    assert float(np.asarray(out)[0, 0]) == 4.0
+    assert st.unapplied == []
