@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench hier-bench elastic-bench adapt-bench chaos-bench fabric-bench trace-export clean
+.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench hier-bench elastic-bench adapt-bench chaos-bench fabric-bench recovery-bench trace-export clean
 
 all: native
 
@@ -127,6 +127,17 @@ chaos-bench:
 fabric-bench:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--world 8 --sizes 1M,16M --fabric-sweep --intensities 1,2,4 --json
+
+# Durable-recovery pricing on the same simulator (docs/RECOVERY.md):
+# deterministic "mode": "simulated" rows over the (world x payload) grid
+# — the per-step wire overhead of k-replicated ZeRO-1 shards against the
+# baseline step comm (the < 5% acceptance bound stamped per row), and the
+# in-fabric shard repair (one hop + warm swap, zero lost steps) priced
+# against a checkpoint reload (full-state read + save_interval/2 steps of
+# re-done work).
+recovery-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--sizes 1M,64M --recovery-sweep --json
 
 # Perfetto/chrome://tracing export of a recorded dispatch trace: run a
 # short virtual-pod collective session under ADAPCC_TUNER=record and emit

@@ -17,17 +17,57 @@ group.
 from __future__ import annotations
 
 import base64
+import hashlib
+import json
 import os
+import queue
+import re
 import shutil
 import subprocess
 import sys
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
 from flax import serialization
+
+#: async crash-consistent checkpointing gate for the workloads
+#: (docs/RECOVERY.md §2): ``on`` routes epoch saves through the
+#: :class:`AsyncCheckpointManager` background pipeline; malformed → loud
+ASYNC_CKPT_ENV = "ADAPCC_ASYNC_CKPT"
+
+
+def async_checkpointing_enabled(explicit: bool = False) -> bool:
+    """The ``ADAPCC_ASYNC_CKPT`` funnel: env > explicit flag > off
+    (malformed → loud, the ADAPCC_MERGE_ROUNDS policy)."""
+    raw = os.environ.get(ASYNC_CKPT_ENV, "").strip().lower()
+    if not raw:
+        return bool(explicit)
+    if raw in ("on", "1", "true"):
+        return True
+    if raw in ("off", "0", "false"):
+        return False
+    raise ValueError(f"{ASYNC_CKPT_ENV}={raw!r}: expected on|off")
+
+
+def _fsync_path(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: str) -> None:
+    """Durably commit a directory entry change (rename, create): the rename
+    itself is atomic but not *durable* until the parent directory's
+    metadata hits disk — a crash after rename but before the dir fsync can
+    resurface the old name, which is exactly the torn-checkpoint window
+    the durability satellite closes."""
+    _fsync_path(path)
 
 
 # --- snapshot container (reference State, main_elastic.py:188-237) ------------
@@ -137,9 +177,12 @@ class TrainCheckpointState:
 def save_checkpoint(
     state: TrainCheckpointState, filename: str, is_best: bool = False
 ) -> None:
-    """Atomic save: write tmp, then rename-commit, so an interrupt mid-write
-    never corrupts the live checkpoint; ``is_best`` keeps a ``model_best``
-    copy beside it (both reference behaviors)."""
+    """Atomic **and crash-durable** save: write tmp, flush + fsync the
+    bytes, rename-commit, then fsync the parent directory — the rename
+    alone orders the name change but does not make it durable, and an
+    unfsynced payload can commit a name pointing at unwritten blocks
+    (docs/RECOVERY.md §2).  ``is_best`` keeps a ``model_best`` copy beside
+    it (both reference behaviors)."""
     checkpoint_dir = os.path.dirname(filename) or "."
     os.makedirs(checkpoint_dir, exist_ok=True)
     # pid-suffixed tmp: concurrent savers on a shared fs each write their own
@@ -147,12 +190,18 @@ def save_checkpoint(
     tmp_filename = f"{filename}.tmp.{os.getpid()}"
     with open(tmp_filename, "wb") as f:
         f.write(state.to_bytes())
+        f.flush()
+        os.fsync(f.fileno())
     os.rename(tmp_filename, filename)
+    _fsync_dir(checkpoint_dir)
     if is_best:
         best = os.path.join(checkpoint_dir, "model_best.ckpt")
         best_tmp = f"{best}.tmp.{os.getpid()}"
         shutil.copyfile(filename, best_tmp)
+        with open(best_tmp, "rb") as f:
+            os.fsync(f.fileno())
         os.rename(best_tmp, best)
+        _fsync_dir(checkpoint_dir)
 
 
 def load_checkpoint(state: TrainCheckpointState, filename: str) -> bool:
@@ -170,8 +219,60 @@ def load_checkpoint(state: TrainCheckpointState, filename: str) -> bool:
 _BLOB_CHUNK_CHARS = 2 * 1024 * 1024
 
 
+def _rendezvous_fetch(key: str, what: str, budget_s: float) -> str:
+    """One rendezvous KV fetch under the PR-10 deadline + bounded-backoff
+    funnel (``ADAPCC_RPC_TIMEOUT_S``): a dead peer that never publishes
+    its key surfaces as a loud :class:`~adapcc_tpu.coordinator.service.
+    CoordinatorUnavailable` naming exactly what was waited for, never an
+    indefinite block inside the restore barrier."""
+    import random
+
+    from adapcc_tpu.coordinator.service import (
+        RPC_BACKOFF_INITIAL_S,
+        RPC_BACKOFF_MAX_S,
+        RPC_TIMEOUT_ENV,
+        CoordinatorUnavailable,
+    )
+    from adapcc_tpu.launch.dispatcher import fetch_value
+
+    rng = random.Random(0xCCC ^ hash(key) & 0xFFFF)
+    deadline = time.monotonic() + budget_s
+    backoff = RPC_BACKOFF_INITIAL_S
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise CoordinatorUnavailable(
+                f"elastic rendezvous: {what} got no answer within "
+                f"{budget_s:.3f}s ({RPC_TIMEOUT_ENV} budget) — a dead peer "
+                "must surface loudly, not hang the restore barrier"
+            )
+        try:
+            # per-attempt slice so a transient KV hiccup retries inside the
+            # budget instead of burning it all on one blocked get
+            slice_ms = max(1, int(min(remaining, 2.0) * 1000))
+            return fetch_value(key, slice_ms)
+        except Exception as e:  # noqa: BLE001 — the KV timeout type varies
+            if "jax.distributed.initialize" in str(e):
+                # the transport was never brought up: permanent, not a
+                # slow peer — burning the whole budget retrying it would
+                # bury the real cause under a misleading timeout
+                raise
+            sleep = min(
+                backoff * (1.0 + rng.random()),
+                RPC_BACKOFF_MAX_S,
+                max(0.0, deadline - time.monotonic()),
+            )
+            if sleep > 0:
+                time.sleep(sleep)
+            backoff = min(backoff * 2, RPC_BACKOFF_MAX_S)
+
+
 def restore_newest_across_processes(
-    state: TrainCheckpointState, filename: str, timeout_ms: int = 120_000
+    state: TrainCheckpointState,
+    filename: str,
+    timeout_ms: int = 120_000,
+    gen: Optional[str] = None,
+    load_local: bool = True,
 ) -> TrainCheckpointState:
     """Elastic-restart restore: load the local checkpoint (if any), then adopt
     the freshest one any process holds.
@@ -180,25 +281,61 @@ def restore_newest_across_processes(
     its epoch to the coordinator KV store; the holder of the max epoch
     publishes the snapshot blob and everyone else applies it — the KV-store
     analog of the reference's gloo max-epoch broadcast.  Restart generations
-    are keyed by ``ADAPCC_RESTART_GEN`` (set by the elastic supervisor) so a
-    relaunched world never reads the previous generation's keys.
+    are keyed by ``ADAPCC_RESTART_GEN`` (set by the elastic supervisor; a
+    rejoining replacement worker passes the supervisor-journaled admit
+    generation via ``gen=`` instead, docs/RECOVERY.md §3) so a relaunched
+    world never reads a previous generation's keys.
+
+    Every fetch runs under the ``ADAPCC_RPC_TIMEOUT_S`` deadline with
+    bounded jittered backoff (the PR-10 coordinator-RPC funnel): a peer
+    that died between publishing and serving its blob surfaces as a loud
+    ``CoordinatorUnavailable`` naming the missing key, never an
+    indefinite block.  ``timeout_ms`` caps the budget from above for
+    callers that want a tighter barrier; the env deadline applies only
+    when the operator actually set it.  ``load_local=False`` skips the
+    local single-file load for callers that already restored fresher
+    state through another funnel (the async step manager's verified
+    restore).
     """
-    load_checkpoint(state, filename)
+    if load_local:
+        load_checkpoint(state, filename)
     if jax.process_count() <= 1:
         return state
 
-    from adapcc_tpu.launch.dispatcher import fetch_value, publish_value
+    from adapcc_tpu.coordinator.service import RPC_TIMEOUT_ENV, rpc_timeout_s
+    from adapcc_tpu.launch.dispatcher import publish_value
 
-    gen = os.environ.get("ADAPCC_RESTART_GEN", "0")
+    if gen is None:
+        gen = os.environ.get("ADAPCC_RESTART_GEN", "0")
+        prefix = f"adapcc/elastic/g{gen}"
+    else:
+        # rejoin catch-up: the admit generation is a coordinator counter,
+        # deliberately namespaced APART from the supervisor's restart
+        # generations — a full-world restart publishes under g<N>, and a
+        # later rejoin whose admit counter happens to reach the same N
+        # must never read those stale epochs/blobs as its own
+        prefix = f"adapcc/elastic/rejoin/g{gen}"
     me = jax.process_index()
     n = jax.process_count()
-    prefix = f"adapcc/elastic/g{gen}"
+    # the env deadline wins only when the operator actually set it: the
+    # default rpc budget (30 s) must not silently shrink the documented
+    # 120 s restore barrier under it (staggered relaunches legitimately
+    # take that long to reach the rendezvous)
+    if os.environ.get(RPC_TIMEOUT_ENV, "").strip():
+        budget_s = min(rpc_timeout_s(), timeout_ms / 1000.0)
+    else:
+        budget_s = timeout_ms / 1000.0
 
     publish_value(f"{prefix}/epoch/{me}", str(state.epoch))
     with ThreadPoolExecutor(max_workers=min(32, n)) as pool:
         epochs = list(
             pool.map(
-                lambda p: int(fetch_value(f"{prefix}/epoch/{p}", timeout_ms)), range(n)
+                lambda p: int(
+                    _rendezvous_fetch(
+                        f"{prefix}/epoch/{p}", f"epoch of peer {p}", budget_s
+                    )
+                ),
+                range(n),
             )
         )
     max_epoch = max(epochs)
@@ -220,9 +357,20 @@ def restore_newest_across_processes(
         for i, chunk in enumerate(chunks):
             publish_value(f"{prefix}/blob/{i}", chunk)
     elif state.epoch < max_epoch:
-        count = int(fetch_value(f"{prefix}/blob/count", timeout_ms))
+        count = int(
+            _rendezvous_fetch(
+                f"{prefix}/blob/count",
+                f"checkpoint blob count from rank {max_rank}",
+                budget_s,
+            )
+        )
         encoded = "".join(
-            fetch_value(f"{prefix}/blob/{i}", timeout_ms) for i in range(count)
+            _rendezvous_fetch(
+                f"{prefix}/blob/{i}",
+                f"checkpoint blob chunk {i}/{count} from rank {max_rank}",
+                budget_s,
+            )
+            for i in range(count)
         )
         state.load_bytes(base64.b64decode(encoded))
     return state
@@ -301,6 +449,386 @@ class CheckpointManager:
 
     def close(self) -> None:
         self._mgr.close()
+
+
+# --- async crash-consistent step-directory manager ----------------------------
+
+MANIFEST_NAME = "MANIFEST.json"
+MANIFEST_VERSION = 1
+
+_STEP_DIR_RE = re.compile(r"^step-(\d+)$")
+_TMP_DIR_RE = re.compile(r"^\.tmp-step-(\d+)-")
+
+
+class CheckpointCorrupt(ValueError):
+    """A published checkpoint failed integrity verification (checksum
+    mismatch, truncated shard, manifest naming a missing file).  Loud by
+    design: restoring a torn artifact silently is the failure mode the
+    manifest exists to close."""
+
+
+class AsyncCheckpointManager:
+    """Crash-consistent directory-of-steps manager with an async save
+    pipeline and content verification (docs/RECOVERY.md §2).
+
+    Layout: one ``step-<n>/`` directory per checkpoint, holding the
+    serialized shard blobs plus a ``MANIFEST.json`` recording each shard's
+    byte count and sha256.  The publish protocol makes a checkpoint
+    all-or-nothing::
+
+        write shards into .tmp-step-<n>-<pid>/   (fsync each file)
+        write MANIFEST.json into the tmp dir      (fsync)
+        rename .tmp-step-<n>-<pid>/ → step-<n>/   (atomic)
+        fsync the parent directory                (durable)
+
+    so the ONE legal kind of crash damage is a leftover ``.tmp-*``
+    directory — ignored on scan exactly like the supervisor journal's
+    torn tail.  A *published* step that fails verification (bit flip,
+    truncation, a shard deleted out from under the manifest) rejects
+    loudly at restore with :class:`CheckpointCorrupt`.
+
+    ``save(step, state)`` is synchronous; ``save_async(step, state)``
+    snapshots the (immutable) device buffers on the caller's thread and
+    runs serialize → checksum → publish on a background thread, so the
+    training loop never stalls on checkpoint I/O.  A pipeline error is
+    re-raised loudly at the next ``save``/``wait``/``close`` — async must
+    not mean silently lossy.
+
+    Retention is **keep-last-good**: ``max_to_keep`` counts only steps
+    that pass verification at GC time, so the newest *verified*
+    checkpoint is never collected just because a newer corrupt directory
+    exists above it (the corrupt one is the casualty, with a stderr
+    warning).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+        if max_to_keep < 1:
+            raise ValueError(f"max_to_keep must be >= 1, got {max_to_keep}")
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self.restores = 0
+        self._queue: "queue.Queue" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._spawn_lock = threading.Lock()
+        self._idle = threading.Event()
+        self._idle.set()
+
+    # -- scan ------------------------------------------------------------------
+
+    def published_steps(self) -> List[int]:
+        """Step numbers with a *published* (renamed-in) directory, sorted.
+        ``.tmp-*`` leftovers — the mid-save crash window — are ignored by
+        construction; a published dir missing its manifest cannot exist
+        without tampering and raises loudly on access."""
+        steps = []
+        for name in os.listdir(self.directory):
+            m = _STEP_DIR_RE.match(name)
+            if m:
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def torn_saves(self) -> List[str]:
+        """Leftover ``.tmp-*`` directories (crash-mid-save debris): never
+        restorable, safe to ignore, listed so operators can see the crash
+        happened."""
+        return sorted(
+            name
+            for name in os.listdir(self.directory)
+            if _TMP_DIR_RE.match(name)
+        )
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.published_steps()
+        return steps[-1] if steps else None
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step-{int(step)}")
+
+    # -- integrity -------------------------------------------------------------
+
+    def _manifest(self, step: int) -> Dict[str, Any]:
+        path = os.path.join(self._step_dir(step), MANIFEST_NAME)
+        if not os.path.exists(path):
+            raise CheckpointCorrupt(
+                f"published checkpoint step-{step} has no {MANIFEST_NAME}: "
+                "the publish protocol writes it before the rename, so this "
+                "directory was tampered with — refusing to restore"
+            )
+        with open(path, encoding="utf-8") as f:
+            try:
+                manifest = json.load(f)
+            except ValueError as e:
+                # json.JSONDecodeError — a bit flip or truncation INSIDE
+                # the manifest is the same corruption class as one inside
+                # a shard: reject as corrupt so latest_good_step/_gc fall
+                # back to an older verified step instead of crashing
+                raise CheckpointCorrupt(
+                    f"step-{step} {MANIFEST_NAME} is not valid JSON "
+                    f"({e}) — manifest corrupt, refusing to restore"
+                ) from e
+        if not isinstance(manifest, dict):
+            raise CheckpointCorrupt(
+                f"step-{step} {MANIFEST_NAME} holds "
+                f"{type(manifest).__name__}, expected an object"
+            )
+        if manifest.get("version") != MANIFEST_VERSION:
+            raise CheckpointCorrupt(
+                f"step-{step} manifest version {manifest.get('version')!r} "
+                f"!= {MANIFEST_VERSION}"
+            )
+        shards = manifest.get("shards")
+        if not isinstance(shards, dict) or not all(
+            isinstance(rec, dict) and "bytes" in rec and "sha256" in rec
+            for rec in shards.values()
+        ):
+            raise CheckpointCorrupt(
+                f"step-{step} manifest shard table is malformed — "
+                "manifest corrupt, refusing to restore"
+            )
+        return manifest
+
+    def verify(self, step: int) -> None:
+        """Raise :class:`CheckpointCorrupt` unless every shard the
+        manifest names exists with the recorded size and sha256."""
+        manifest = self._manifest(step)
+        d = self._step_dir(step)
+        for name, rec in sorted(manifest["shards"].items()):
+            path = os.path.join(d, name)
+            if not os.path.exists(path):
+                raise CheckpointCorrupt(
+                    f"step-{step} manifest names shard {name!r} but the "
+                    "file is missing — refusing to restore a partial "
+                    "checkpoint"
+                )
+            blob = open(path, "rb").read()
+            if len(blob) != int(rec["bytes"]):
+                raise CheckpointCorrupt(
+                    f"step-{step} shard {name!r} is {len(blob)} bytes, "
+                    f"manifest records {rec['bytes']} — truncated or torn, "
+                    "refusing to restore"
+                )
+            digest = hashlib.sha256(blob).hexdigest()
+            if digest != rec["sha256"]:
+                raise CheckpointCorrupt(
+                    f"step-{step} shard {name!r} sha256 {digest[:12]}… != "
+                    f"manifest {rec['sha256'][:12]}… — payload corrupt, "
+                    "refusing to restore"
+                )
+
+    def _verify_quiet(self, step: int) -> bool:
+        try:
+            self.verify(step)
+            return True
+        except CheckpointCorrupt:
+            return False
+
+    def latest_good_step(self) -> Optional[int]:
+        """Newest published step that passes verification — what a
+        restart restores from when the newest directory is damaged."""
+        for step in reversed(self.published_steps()):
+            if self._verify_quiet(step):
+                return step
+        return None
+
+    # -- save pipeline ---------------------------------------------------------
+
+    def _publish(self, step: int, blobs: Dict[str, bytes]) -> None:
+        final = self._step_dir(step)
+        if os.path.exists(final):
+            if self._verify_quiet(step):
+                raise ValueError(
+                    f"checkpoint step-{step} already published; steps are "
+                    "immutable once committed (save under a new step "
+                    "instead)"
+                )
+            # a resume that restored latest_good_step() re-runs the steps
+            # a newer CORRUPT directory covers — replacing the damaged
+            # artifact is the recovery, not a mutation of committed state
+            print(
+                f"[adapcc] checkpoint step-{step} exists but fails "
+                "verification; replacing the corrupt artifact",
+                file=sys.stderr,
+                flush=True,
+            )
+            shutil.rmtree(final, ignore_errors=True)
+        tmp = os.path.join(
+            self.directory, f".tmp-step-{int(step)}-{os.getpid()}"
+        )
+        os.makedirs(tmp, exist_ok=True)
+        shards = {}
+        for name, blob in sorted(blobs.items()):
+            path = os.path.join(tmp, name)
+            with open(path, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            shards[name] = {
+                "bytes": len(blob),
+                "sha256": hashlib.sha256(blob).hexdigest(),
+            }
+        manifest = {
+            "version": MANIFEST_VERSION,
+            "step": int(step),
+            "shards": shards,
+        }
+        mpath = os.path.join(tmp, MANIFEST_NAME)
+        with open(mpath, "w", encoding="utf-8") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.rename(tmp, final)
+        _fsync_dir(self.directory)
+        self._gc(trusted=int(step))
+
+    def _gc(self, trusted: Optional[int] = None) -> None:
+        """Keep-last-good retention (class doc): rank by verification at
+        GC time; the newest ``max_to_keep`` *good* steps survive, corrupt
+        directories are collected with a loud stderr note.
+
+        Older retained steps are re-hashed on every pass ON PURPOSE — the
+        corruption this retention policy defends against (bit rot, a
+        sibling process truncating a blob) happens AFTER publish, so a
+        cached verified flag would keep a silently-damaged newest step
+        and evict the good one under it (the retention regression test
+        pins exactly this).  Only ``trusted`` — the step this very call
+        just published, whose checksums were computed from the in-memory
+        bytes — skips the redundant immediate re-read."""
+        published = self.published_steps()
+        good = [
+            s
+            for s in published
+            if s == trusted or self._verify_quiet(s)
+        ]
+        keep = set(good[-self.max_to_keep :])
+        for step in published:
+            if step in keep:
+                continue
+            if step not in good:
+                print(
+                    f"[adapcc] checkpoint step-{step} failed verification; "
+                    "collecting the corrupt artifact (the newest GOOD "
+                    "checkpoint is retained regardless)",
+                    file=sys.stderr,
+                    flush=True,
+                )
+            shutil.rmtree(self._step_dir(step), ignore_errors=True)
+        _fsync_dir(self.directory)
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError(
+                "async checkpoint pipeline failed on a previous save; the "
+                "checkpoint it was writing does NOT exist on disk"
+            ) from err
+
+    def save(self, step: int, state: TrainCheckpointState) -> None:
+        """Synchronous save: serialize → checksum → publish, durable on
+        return."""
+        self.wait()
+        self._publish(int(step), {"state.msgpack": state.to_bytes()})
+
+    def save_async(self, step: int, state: TrainCheckpointState) -> None:
+        """Queue one save on the background pipeline and return
+        immediately.
+
+        The snapshot is taken HERE, on the caller's thread: every device
+        array is materialized to host memory before this returns, so the
+        snapshot stays valid even when the training loop's jitted step
+        DONATES the state's buffers an instant later (reference-capture
+        alone would hand the background thread arrays the next step
+        deletes — the "Array has been deleted" crash).  The D2H copy is
+        the snapshot; serialization, checksumming, and the atomic publish
+        run off-thread, so the loop never stalls on checkpoint I/O.
+        """
+        self._raise_pending()
+        snapshot = jax.tree_util.tree_map(
+            lambda leaf: jax.device_get(leaf)
+            if isinstance(leaf, jax.Array)
+            else leaf,
+            state.capture_snapshot(),
+        )
+        with self._spawn_lock:
+            self._idle.clear()
+            self._queue.put((int(step), snapshot))
+            if self._worker is None:
+                self._worker = threading.Thread(
+                    target=self._drain, name="adapcc-async-ckpt", daemon=True
+                )
+                self._worker.start()
+
+    def _drain(self) -> None:
+        while True:
+            with self._spawn_lock:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    # the exit decision and save_async's put+spawn hold the
+                    # same lock, so an enqueue can never slip between "saw
+                    # empty" and "worker gone"
+                    self._worker = None
+                    self._idle.set()
+                    return
+            step, snapshot = item
+            try:
+                self._publish(
+                    step,
+                    {"state.msgpack": serialization.to_bytes(snapshot)},
+                )
+            except BaseException as e:  # noqa: BLE001 — surfaced at next call
+                with self._spawn_lock:
+                    self._error = e
+                    # drop the rest of the queue: later saves would publish
+                    # out of order around the failure
+                    while True:
+                        try:
+                            self._queue.get_nowait()
+                        except queue.Empty:
+                            break
+                    self._worker = None
+                    self._idle.set()
+                    return
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Block until every queued async save has published (or failed
+        loudly)."""
+        if not self._idle.wait(timeout):
+            raise TimeoutError(
+                f"async checkpoint pipeline still busy after {timeout}s"
+            )
+        self._raise_pending()
+
+    # -- restore ---------------------------------------------------------------
+
+    def restore(
+        self, state: TrainCheckpointState, step: Optional[int] = None
+    ) -> bool:
+        """Verified restore into ``state``.  ``step=None`` restores the
+        newest published step — and fails loudly if that step is corrupt
+        (use :meth:`latest_good_step` to fall back deliberately; silent
+        fallback would mask the corruption).  Returns False only when no
+        checkpoint exists at all."""
+        self.wait()
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                return False
+        elif not os.path.exists(self._step_dir(step)):
+            raise FileNotFoundError(
+                f"no published checkpoint step-{step} in {self.directory}"
+            )
+        self.verify(step)
+        blob = open(
+            os.path.join(self._step_dir(step), "state.msgpack"), "rb"
+        ).read()
+        state.load_bytes(blob)
+        self.restores += 1
+        return True
+
+    def close(self) -> None:
+        self.wait()
 
 
 # --- elastic supervisor (torchrun-elastic analog) ------------------------------

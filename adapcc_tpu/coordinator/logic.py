@@ -106,6 +106,12 @@ class CoordinatorLogic:
         self._beat_medians: Dict[int, float] = {}
         self._shutdown = False
         self._worldview = WorldView.full(world_size)
+        # rejoin bookkeeping (docs/RECOVERY.md §3): bumped whenever a
+        # previously-DEAD rank is re-admitted, so a replacement worker's
+        # rendezvous (restore_newest_across_processes) keys its KV
+        # namespace by the admit generation and never reads the keys of
+        # the world that died
+        self._restart_gen = 0
         # plan-fold bookkeeping: the newest step whose fault state has been
         # applied (late arrivals for older steps must not regress the view)
         # and the relay set the PLAN installed (so plan updates never
@@ -397,9 +403,34 @@ class CoordinatorLogic:
         with self._cond:
             self._worldview = self._worldview.with_down(ranks)
 
-    def mark_recovered(self, ranks) -> None:
+    def mark_recovered(self, ranks) -> int:
+        """Re-admit ``ranks``; returns the (possibly bumped) restart
+        generation — bumped only when a genuinely DEAD rank came back, so
+        a relay promotion never invalidates rendezvous keys.  The
+        supervisor journals this generation in its ``admit`` decision and
+        the replacement worker passes it to
+        :func:`adapcc_tpu.checkpoint.restore_newest_across_processes`
+        (``gen=``) for its catch-up restore."""
         with self._cond:
+            was_dead = frozenset(int(r) for r in ranks) & self._worldview.dead
             self._worldview = self._worldview.with_recovered(ranks)
+            if was_dead:
+                self._restart_gen += 1
+            return self._restart_gen
+
+    @property
+    def restart_generation(self) -> int:
+        with self._cond:
+            return self._restart_gen
+
+    def seed_restart_generation(self, gen: int) -> None:
+        """Fast-forward the admit counter to at least ``gen`` — the
+        supervisor's journal replay calls this with the highest journaled
+        ``admit`` generation, so a restarted supervisor can never hand a
+        new rejoin a generation (and thus a rendezvous namespace) an
+        earlier rejoin already used."""
+        with self._cond:
+            self._restart_gen = max(self._restart_gen, int(gen))
 
     def set_relays(self, ranks) -> None:
         """Replace the relay set wholesale — the supervisor's demotion

@@ -110,6 +110,14 @@ class DDPTrainer:
         # chunk_bytes; None = default).  Payloads above it stream through
         # fixed HBM→VMEM staging instead of living VMEM-resident
         zero1_ring_chunk_bytes: Optional[int] = None,
+        # redundant ZeRO-1 shard placement (elastic/redundancy.py,
+        # docs/RECOVERY.md): replicate each rank's optimizer shard to this
+        # many ring-neighbor holders after every step, piggybacked on the
+        # post-step all-gather window, so a dead rank's shard is repaired
+        # from its in-fabric replica instead of a checkpoint reload.
+        # None = the ADAPCC_SHARD_REPLICAS env funnel (default 0 = off);
+        # requires zero1=True (there is no single-owner state otherwise)
+        shard_replicas: Optional[int] = None,
         # gradient-sync wire codec (quant registry: "off" | "bf16" | "int8",
         # or "strategy" to adopt the synthesized Strategy.wire_dtype).
         # "bf16" halves wire bytes (torch bf16_compress_hook analog, ~bf16-
@@ -182,6 +190,21 @@ class DDPTrainer:
             raise ValueError("zero1_ring=True requires zero1=True")
         self.zero1_ring = zero1_ring
         self.zero1_ring_chunk_bytes = zero1_ring_chunk_bytes
+        from adapcc_tpu.elastic.redundancy import shard_replicas as _replicas
+
+        # env > explicit arg > off (the chunk-bytes precedence ladder);
+        # resolved eagerly so a malformed env var dies at construction
+        self.shard_replicas = _replicas(
+            default=0 if shard_replicas is None else int(shard_replicas)
+        )
+        if self.shard_replicas and not zero1:
+            raise ValueError(
+                "shard_replicas > 0 requires zero1=True: replicated DDP "
+                "state has no single-owner optimizer shard to replicate "
+                "(every rank already holds everything)"
+            )
+        #: the in-fabric replica set (built at init_state when armed)
+        self.replica_store: Optional[Any] = None
         if error_feedback and not bsp:
             raise ValueError(
                 "error_feedback=True requires BSP mode: the async relay "
@@ -363,6 +386,14 @@ class DDPTrainer:
             overlap=self._zero1_overlap(),
         )
         master, opt_state = opt.init(params)
+        if self.shard_replicas:
+            from adapcc_tpu.elastic.redundancy import ShardReplicaStore
+
+            self.replica_store = ShardReplicaStore(
+                self.mesh.shape[self.axis_name],
+                ips=self.hook.strategy.trees[0].ips,
+                replicas=self.shard_replicas,
+            )
         if self.zero1_ring_chunk_bytes is None:
             # adopt the optimizer's (possibly tuner-chosen) staging
             # granularity so the step program and the optimizer execute the
@@ -747,6 +778,19 @@ class DDPTrainer:
             *out, self._deferred = out
         elif self.error_feedback:
             *out, self._residual = out
+        if self.replica_store is not None:
+            # the piggyback window (docs/RECOVERY.md §1): the shard rows
+            # this step's optimizer update just wrote are exactly what the
+            # post-step all-gather broadcast alongside — capture them,
+            # stamped with the STATE's own step counter (not the
+            # process-local _host_step, which restarts at 0 on a resumed
+            # trainer and would make the freshness guard refuse every
+            # repair after a restore) so a later repair's guard compares
+            # like with like against state.step
+            self.replica_store.capture(
+                out[0].opt_state,
+                int(np.asarray(jax.device_get(out[0].step))),
+            )
         if not self.measure_gns:
             return tuple(out) if isinstance(out, list) else out
         new_state, loss, norms = out

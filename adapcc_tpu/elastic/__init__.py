@@ -13,9 +13,16 @@ robustness behaviors on the *compiled* data plane:
   (one-rank-down, one-host-down) AOT-compiled at setup, so a world shrink
   is a dispatch-time cache-key switch, not a cold recompile stall;
 - :mod:`~adapcc_tpu.elastic.rebalance` — ZeRO-1 shard re-balance on a
-  world change, validated through the checkpoint layout-tag funnel.
+  world change (shrink, grow-back, replica repair), validated through the
+  checkpoint layout-tag funnel;
+- :mod:`~adapcc_tpu.elastic.redundancy` — k-replicated ZeRO-1 shard
+  placement (``ADAPCC_SHARD_REPLICAS``): ring-neighbor, host-disjoint
+  replicas piggybacked on the post-step all-gather window, so a dead
+  rank's optimizer shard is repaired from the fabric instead of a
+  checkpoint reload (docs/RECOVERY.md).
 
-See docs/ELASTIC.md for the lifecycle and the failover cost rows.
+See docs/ELASTIC.md for the lifecycle and the failover cost rows, and
+docs/RECOVERY.md for the durable-recovery layer on top of it.
 """
 
 from adapcc_tpu.elastic.faults import (
@@ -27,9 +34,18 @@ from adapcc_tpu.elastic.faults import (
     load_fault_plan,
 )
 from adapcc_tpu.elastic.rebalance import (
+    grow_zero1_trainer_state,
     rebalance_zero1_pair,
+    recover_zero1_trainer_state,
     reshard_zero1_snapshot,
     shrink_zero1_trainer_state,
+)
+from adapcc_tpu.elastic.redundancy import (
+    DEFAULT_SHARD_REPLICAS,
+    SHARD_REPLICAS_ENV,
+    ShardReplicaStore,
+    replica_placement,
+    shard_replicas,
 )
 from adapcc_tpu.elastic.standby import (
     StandbyPlan,
@@ -45,21 +61,28 @@ from adapcc_tpu.elastic.worldview import (
 )
 
 __all__ = [
+    "DEFAULT_SHARD_REPLICAS",
     "DEFAULT_SLOWDOWN",
     "FAULT_PLAN_ENV",
     "FaultEvent",
     "FaultPlan",
     "FaultState",
     "HEARTBEAT_TIMEOUT_ENV",
+    "SHARD_REPLICAS_ENV",
     "SLOW_RANK_FACTOR_ENV",
+    "ShardReplicaStore",
     "StandbyPlan",
     "StandbyPlanCache",
     "WorldView",
     "degraded_scenarios",
+    "grow_zero1_trainer_state",
     "load_fault_plan",
     "rebalance_zero1_pair",
+    "recover_zero1_trainer_state",
     "reemit_for_active",
+    "replica_placement",
     "reshard_zero1_snapshot",
+    "shard_replicas",
     "shrink_zero1_trainer_state",
     "slow_ranks_from_medians",
 ]

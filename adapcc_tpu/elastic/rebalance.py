@@ -184,18 +184,58 @@ def shrink_zero1_trainer_state(
     identical canonical master/opt content, validated through the
     checkpoint funnel.
     """
+    return _rebalance_zero1_trainer_state(
+        trainer, state, old_world, direction="shrink"
+    )
+
+
+def grow_zero1_trainer_state(
+    trainer,
+    state,
+    old_world: Optional[int] = None,
+):
+    """The grow-back twin of :func:`shrink_zero1_trainer_state`: re-balance
+    a ZeRO-1 TrainState produced under a SMALLER world onto ``trainer``'s
+    (already larger) mesh — the rejoin path (docs/RECOVERY.md §3).  Same
+    gather → re-split → re-tag cycle through the same ``apply_snapshot``
+    layout-guard funnel; only the direction check differs, so a rejoin is
+    exactly as validated as a shrink or a resume.
+    """
+    return _rebalance_zero1_trainer_state(
+        trainer, state, old_world, direction="grow"
+    )
+
+
+def _rebalance_zero1_trainer_state(
+    trainer,
+    state,
+    old_world: Optional[int],
+    direction: str,
+):
     from adapcc_tpu.ddp.trainer import TrainState
 
     opt = trainer._zero1_opt
     if opt is None:
         raise ValueError(
-            "call trainer.init_state(params) once before shrinking into it: "
-            "the target optimizer geometry comes from the constructed "
+            "call trainer.init_state(params) once before re-balancing into "
+            "it: the target optimizer geometry comes from the constructed "
             "Zero1Optimizer"
         )
     master, opt_state = state.opt_state
     if old_world is None:
         old_world = int(np.asarray(master).shape[0])
+    if direction == "shrink" and old_world < opt.world:
+        raise ValueError(
+            f"shrink_zero1_trainer_state: old world {old_world} is smaller "
+            f"than the target world {opt.world}; a rejoin that grows the "
+            "shard layout goes through grow_zero1_trainer_state"
+        )
+    if direction == "grow" and old_world > opt.world:
+        raise ValueError(
+            f"grow_zero1_trainer_state: old world {old_world} is larger "
+            f"than the target world {opt.world}; a world loss goes through "
+            "shrink_zero1_trainer_state"
+        )
     # the OLD layout: same ring/align discipline as the target (one trainer
     # configuration, two worlds) — only the world differs
     old_layout = dict(opt.layout_metadata())
@@ -213,6 +253,69 @@ def shrink_zero1_trainer_state(
     # step dies on a device mismatch between params and the resharded pair
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    replicated = NamedSharding(opt.mesh, P())
+
+    def replace(tree):
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(jax.device_get(leaf), replicated)
+            if isinstance(leaf, jax.Array) else leaf,
+            tree,
+        )
+
+    return TrainState(
+        params=replace(state.params),
+        opt_state=(new_master, new_opt_state),
+        step=replace(state.step),
+        model_state=replace(state.model_state),
+    )
+
+
+def recover_zero1_trainer_state(
+    trainer,
+    state,
+    dead,
+    store,
+    expect_step: Optional[int] = None,
+):
+    """Repair a ZeRO-1 TrainState whose ``dead`` ranks' shards are lost,
+    from their in-fabric replicas (docs/RECOVERY.md §1) — **no checkpoint
+    reload on the hot path**.
+
+    ``store`` is the :class:`~adapcc_tpu.elastic.redundancy.
+    ShardReplicaStore` that captured the post-step replica rows;
+    ``expect_step`` (default: the state's own step counter) is the
+    freshness guard — a replica stamped with a different step refuses
+    loudly rather than silently rewinding one shard's optimizer state
+    relative to its peers.  The repaired pair flows through the SAME
+    ``reshard_zero1_snapshot`` → ``apply_snapshot`` layout-guard funnel as
+    a shrink or a resume (a same-world reshard is the identity move, so
+    the funnel purely validates), and the result is re-placed on the
+    trainer's mesh.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from adapcc_tpu.ddp.trainer import TrainState
+
+    opt = trainer._zero1_opt
+    if opt is None:
+        raise ValueError(
+            "call trainer.init_state(params) once before recovering into "
+            "it: the target optimizer geometry comes from the constructed "
+            "Zero1Optimizer"
+        )
+    if expect_step is None:
+        expect_step = int(np.asarray(jax.device_get(state.step)))
+    master, opt_state = store.reconstruct(
+        state.opt_state, dead, step=expect_step
+    )
+    snap = TrainCheckpointState(
+        params=state.params,
+        opt_state=(master, opt_state),
+        step=int(expect_step),
+        extra=opt.checkpoint_extra(),
+    )
+    restored = reshard_zero1_snapshot(snap, state.params, opt)
+    new_master, new_opt_state = opt.restore(restored)
     replicated = NamedSharding(opt.mesh, P())
 
     def replace(tree):

@@ -1,0 +1,284 @@
+"""Redundant ZeRO-1 shard placement: k-replicated optimizer shards.
+
+Shrink-and-continue (PR 7/10) keeps the *collective* alive through a rank
+death, but a ZeRO-1 optimizer shard is single-owner state: in a real
+multi-process deployment the dead rank's flat master slice and moment
+buffers live only in its HBM, and without redundancy the only recovery is
+a checkpoint reload — losing every step since the last save.  This module
+closes that hole the way production collective stacks do (The Big
+Send-off, PAPERS.md): each rank's shard is replicated to ``k``
+ring-neighbor holders, piggybacked on the post-step all-gather window the
+ZeRO-1 cycle already opens (the shard's bytes ride to a neighbor while the
+params broadcast anyway), and a death is repaired by pulling the lost
+shard from its in-fabric replica — no checkpoint reload on the hot path.
+
+Placement rule (:func:`replica_placement`): walk the ring from ``r+1``,
+preferring holders on a **different host** than the primary (a host loss
+must never take a shard and all its replicas together); a single-host
+world (or one with no ip table — the CPU test rig) falls back to plain
+ring neighbors, which is the best a one-host fabric can do.  The rule is
+pure and deterministic: every process derives the identical placement from
+the strategy's host layout, no negotiation.
+
+:class:`ShardReplicaStore` is the in-fabric replica set's process-local
+twin: on a real pod each holder keeps its primaries' rows in device/host
+memory; on the single-process test rig the store materializes the rows a
+holder *would* hold, stamped with the step they were captured at, so
+reconstruction (and its freshness guard) is exercisable on CPU.  The wire
+cost of the replication itself is priced by
+:func:`adapcc_tpu.sim.cost_model.replication_overhead_time` and swept by
+``make recovery-bench`` (docs/RECOVERY.md).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, Mapping, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+#: replica count for ZeRO-1 shards (``0`` disables replication entirely);
+#: malformed → loud error, never a silent default (the ADAPCC_MERGE_ROUNDS
+#: policy)
+SHARD_REPLICAS_ENV = "ADAPCC_SHARD_REPLICAS"
+
+#: one replica survives any single failure unit (rank or — with a
+#: multi-host placement — host), at one shard-send per step of overhead
+DEFAULT_SHARD_REPLICAS = 1
+
+
+def shard_replicas(default: int = DEFAULT_SHARD_REPLICAS) -> int:
+    """The ``ADAPCC_SHARD_REPLICAS`` funnel: env > ``default``."""
+    raw = os.environ.get(SHARD_REPLICAS_ENV, "").strip()
+    if not raw:
+        return int(default)
+    try:
+        value = int(raw)
+    except ValueError as e:
+        raise ValueError(
+            f"{SHARD_REPLICAS_ENV}={raw!r}: expected an integer"
+        ) from e
+    if value < 0:
+        raise ValueError(f"{SHARD_REPLICAS_ENV}={raw!r}: must be >= 0")
+    return value
+
+
+def replica_placement(
+    world: int,
+    ips: Optional[Mapping[int, str]] = None,
+    replicas: int = DEFAULT_SHARD_REPLICAS,
+) -> Dict[int, Tuple[int, ...]]:
+    """Primary rank → its ``replicas`` holder ranks.
+
+    Deterministic walk of the ring from ``r+1``: ranks on a *different
+    host* than ``r`` are preferred holders (a host loss must never take a
+    shard and all its replicas together), rotated by the primary's index
+    within its own host group so holder load stays balanced (two
+    same-host primaries never pile onto the same neighbor); if fewer than
+    ``replicas`` off-host ranks exist (single-host world, no ip table),
+    the remaining slots fill with the nearest on-host ring neighbors — a
+    rank never holds its own shard, and holders are distinct.  Every
+    process computes the identical placement from the same host layout.
+    """
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    if replicas < 0:
+        raise ValueError(f"replicas must be >= 0, got {replicas}")
+    if replicas >= world:
+        raise ValueError(
+            f"replicas={replicas} needs at least replicas+1={replicas + 1} "
+            f"ranks (world={world}): a shard cannot be replicated onto "
+            "more distinct holders than there are other ranks"
+        )
+    ips = dict(ips or {})
+    out: Dict[int, Tuple[int, ...]] = {}
+    for r in range(world):
+        ring = [(r + i) % world for i in range(1, world)]
+        my_host = ips.get(r)
+        off_host = [h for h in ring if ips.get(h) != my_host] if ips else []
+        off_set = frozenset(off_host)
+        on_host = [h for h in ring if h not in off_set]
+        if off_host:
+            # balance: the g-th primary of a host starts g holders into
+            # the off-host walk, so a whole host's shards spread over the
+            # other hosts' ranks instead of piling onto one neighbor
+            g = sum(1 for q in range(r) if ips.get(q) == my_host)
+            g %= len(off_host)
+            off_host = off_host[g:] + off_host[:g]
+        holders = (off_host + on_host)[:replicas]
+        out[r] = tuple(holders)
+    return out
+
+
+def _rows_of(opt_pair: Tuple[Any, Any], world: int):
+    """Validate a ZeRO-1 ``(master [world, L], opt-state shards)`` pair and
+    return it as host arrays (the shape every store operation speaks)."""
+    master, opt_state = opt_pair
+    master = np.asarray(jax.device_get(master))
+    if master.ndim != 2 or master.shape[0] != world:
+        raise ValueError(
+            f"expected a [world={world}, shard] master, got shape "
+            f"{master.shape}"
+        )
+    opt_state = jax.device_get(opt_state)
+    return master, opt_state
+
+
+class ShardReplicaStore:
+    """The in-fabric replica set for one world's ZeRO-1 shards.
+
+    ``capture(opt_pair, step)`` records, for every primary rank, the rows
+    its holders keep — stamped with ``step`` so a reconstruction against a
+    *newer* training state refuses loudly (a stale replica silently
+    rewinding one shard's adam moments is exactly the corruption this
+    store exists to prevent; the caller falls back to the checkpoint
+    path).  ``reconstruct(opt_pair, dead, step)`` returns the pair with
+    every dead rank's rows replaced from its replica — the repair
+    :func:`adapcc_tpu.elastic.rebalance.recover_zero1_pair` routes through
+    the checkpoint layout-guard funnel.
+
+    On a real pod the capture is the piggyback transfer this store's
+    pricing term models (each rank sends its ``state_bytes/world`` rows to
+    ``k`` neighbors inside the post-step all-gather window); the
+    process-local twin materializes the same rows to host memory so the
+    protocol — placement, freshness, repair — runs unchanged on CPU.
+    """
+
+    def __init__(
+        self,
+        world: int,
+        ips: Optional[Mapping[int, str]] = None,
+        replicas: Optional[int] = None,
+    ) -> None:
+        self.world = int(world)
+        self.replicas = shard_replicas() if replicas is None else int(replicas)
+        if self.replicas < 1:
+            raise ValueError(
+                f"a replica store needs replicas >= 1, got {self.replicas} "
+                f"(replicas=0 means replication is off — build no store)"
+            )
+        self.placement = replica_placement(self.world, ips, self.replicas)
+        #: primary rank → (master row, opt-state rows, step captured at)
+        self._held: Dict[int, Tuple[np.ndarray, Any, int]] = {}
+        self.captures = 0
+
+    def holders_of(self, rank: int) -> Tuple[int, ...]:
+        if not 0 <= rank < self.world:
+            raise ValueError(f"rank {rank} outside world [0, {self.world})")
+        return self.placement[rank]
+
+    # -- the piggyback window --------------------------------------------------
+
+    def capture(self, opt_pair: Tuple[Any, Any], step: int) -> None:
+        """Record every rank's replica rows as of ``step`` (the post-step
+        all-gather window: the shard every holder receives is the one just
+        written by this step's optimizer update).
+
+        One flatten + one host materialization for the whole state, then
+        per-rank row slices — the copied bytes total ONE extra state copy
+        per step (the twin of the ``k·state_bytes/world``-per-rank wire
+        piggyback the cost model prices), not world× tree traversals.
+        """
+        master, opt_state = _rows_of(opt_pair, self.world)
+        leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+        arrs = [np.asarray(leaf) for leaf in leaves]
+        step = int(step)
+        for r in range(self.world):
+            rows = [
+                a[r].copy()
+                if a.ndim >= 1 and a.shape[0] == self.world
+                else a.copy()
+                for a in arrs
+            ]
+            self._held[r] = (
+                master[r].copy(),
+                jax.tree_util.tree_unflatten(treedef, rows),
+                step,
+            )
+        self.captures += 1
+
+    def replica_step(self, rank: int) -> Optional[int]:
+        held = self._held.get(rank)
+        return held[2] if held is not None else None
+
+    # -- repair ----------------------------------------------------------------
+
+    def payload_for(self, rank: int, expect_step: Optional[int] = None):
+        """The replica rows for ``rank`` — the bytes its holder would send
+        back.  ``expect_step`` is the freshness guard: a replica older
+        than the state being repaired refuses loudly."""
+        held = self._held.get(rank)
+        if held is None:
+            raise KeyError(
+                f"no replica held for rank {rank}: the store never "
+                "captured a step (replication must run before the first "
+                "failure it is supposed to survive)"
+            )
+        master_row, opt_rows, step = held
+        if expect_step is not None and step != int(expect_step):
+            raise ValueError(
+                f"replica for rank {rank} is stamped step {step} but the "
+                f"repair expects step {expect_step}; restoring it would "
+                "rewind one shard's optimizer state relative to its peers "
+                "— fall back to the checkpoint path"
+            )
+        return master_row, opt_rows, step
+
+    def reconstruct(
+        self,
+        opt_pair: Tuple[Any, Any],
+        dead: Iterable[int],
+        step: Optional[int] = None,
+    ) -> Tuple[np.ndarray, Any]:
+        """Return ``opt_pair`` with every ``dead`` rank's rows replaced by
+        its replica — the in-fabric repair.  Surviving rows pass through
+        untouched; the result is host-resident (the caller re-places it on
+        the mesh through the rebalance funnel)."""
+        dead = sorted({int(r) for r in dead})
+        bad = [r for r in dead if not 0 <= r < self.world]
+        if bad:
+            raise ValueError(f"dead ranks {bad} outside world [0, {self.world})")
+        master, opt_state = _rows_of(opt_pair, self.world)
+        master = master.copy()
+        payloads = {r: self.payload_for(r, expect_step=step) for r in dead}
+        for r, (master_row, _, _) in payloads.items():
+            if master_row.shape != master[r].shape:
+                raise ValueError(
+                    f"replica master row for rank {r} has shape "
+                    f"{master_row.shape}, state expects {master[r].shape}; "
+                    "the replica belongs to a different layout"
+                )
+            master[r] = master_row
+
+        # flatten each dead rank's replica rows ONCE (leaf order is
+        # deterministic — the held rows were captured from this exact
+        # opt_state structure), not once per state leaf
+        row_leaves = {
+            r: jax.tree_util.tree_leaves(opt_rows)
+            for r, (_, opt_rows, _) in payloads.items()
+        }
+        leaf_idx = [0]
+
+        def repair(leaf):
+            arr = np.asarray(leaf)
+            i = leaf_idx[0]
+            leaf_idx[0] += 1
+            if arr.ndim >= 1 and arr.shape[0] == self.world:
+                arr = arr.copy()
+                for r, rows in row_leaves.items():
+                    arr[r] = rows[i]
+                return arr
+            return arr
+
+        new_opt = jax.tree_util.tree_map(repair, opt_state)
+        return master, new_opt
+
+
+__all__ = [
+    "DEFAULT_SHARD_REPLICAS",
+    "SHARD_REPLICAS_ENV",
+    "ShardReplicaStore",
+    "replica_placement",
+    "shard_replicas",
+]

@@ -1366,3 +1366,152 @@ def ring_allreduce_time(
         return 0.0
     per_hop = coeffs.time(nbytes / chunks)
     return (2 * (world - 1) + chunks - 1) * per_hop
+
+
+# --------------------------------------------------------------------------- #
+# durable-recovery pricing (adapcc_tpu/elastic/redundancy): replicated
+# ZeRO-1 shards vs a checkpoint reload — the recovery sweep's rows
+# --------------------------------------------------------------------------- #
+
+#: shared-filesystem read bandwidth a checkpoint reload pays (a round
+#: number of the right order for NFS/GCS-fuse on a pod host; replaced by
+#: any measured figure) — deliberately far below ICI so the sweep shows
+#: WHY the in-fabric repair wins the hot path
+DEFAULT_CKPT_BYTES_PER_S = 1e9
+
+
+def replication_overhead_time(
+    world: int,
+    state_bytes: float,
+    coeffs: LinkCoeffs,
+    replicas: int = 1,
+) -> float:
+    """Per-step wire cost of k-replicated ZeRO-1 shard placement
+    (:func:`adapcc_tpu.elastic.redundancy.replica_placement`).
+
+    Each rank owns ``state_bytes / world`` of optimizer state (flat fp32
+    master + moments) and sends the rows its ``replicas`` holders keep —
+    one shard copy per holder — inside the post-step all-gather window.
+    The sends run concurrently across ranks, each over its own outbound
+    hop, so the bottleneck link carries ``replicas · state_bytes/world``
+    replica bytes per step: that single-hop transfer is the overhead the
+    piggyback adds to the window.  ``replicas=0`` (replication off) is
+    exactly free.
+    """
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    if state_bytes < 0:
+        raise ValueError(f"state_bytes must be >= 0, got {state_bytes}")
+    if replicas < 0:
+        raise ValueError(f"replicas must be >= 0, got {replicas}")
+    if replicas == 0:
+        return 0.0
+    if replicas >= world:
+        raise ValueError(
+            f"replicas={replicas} needs world > replicas (got {world})"
+        )
+    return coeffs.time(replicas * state_bytes / world)
+
+
+def replica_repair_time(
+    world: int,
+    state_bytes: float,
+    coeffs: LinkCoeffs,
+    standby_cached: bool = True,
+) -> float:
+    """Time to repair one dead rank's shard from its in-fabric replica
+    (docs/RECOVERY.md §1): the holder sends the lost ``state_bytes/world``
+    rows back over one hop, plus the plan-swap stall of stepping onto the
+    re-balanced layout — **no checkpoint reload and zero lost steps** on
+    this path."""
+    if world < 2:
+        raise ValueError(f"repair pricing needs world >= 2, got {world}")
+    if state_bytes < 0:
+        raise ValueError(f"state_bytes must be >= 0, got {state_bytes}")
+    return coeffs.time(state_bytes / world) + plan_swap_stall_s(standby_cached)
+
+
+def checkpoint_reload_time(
+    state_bytes: float,
+    lost_steps: float,
+    step_time_s: float,
+    ckpt_bytes_per_s: float = DEFAULT_CKPT_BYTES_PER_S,
+) -> float:
+    """Time the checkpoint-reload arm pays for the same death: read the
+    full ``state_bytes`` back from shared storage, then replay every step
+    since the last save (``lost_steps × step_time_s`` of re-done work —
+    the term the replica path never pays)."""
+    if state_bytes < 0 or lost_steps < 0 or step_time_s < 0:
+        raise ValueError("state_bytes / lost_steps / step_time_s must be >= 0")
+    if ckpt_bytes_per_s <= 0:
+        raise ValueError(
+            f"ckpt_bytes_per_s must be > 0, got {ckpt_bytes_per_s}"
+        )
+    return state_bytes / ckpt_bytes_per_s + lost_steps * step_time_s
+
+
+def recovery_cost(
+    world: int,
+    nbytes: float,
+    coeffs: LinkCoeffs,
+    state_bytes: Optional[float] = None,
+    replicas: int = 1,
+    save_interval_steps: int = 100,
+    step_time_s: Optional[float] = None,
+    wire_dtype: str = "off",
+    standby_cached: bool = True,
+    ckpt_bytes_per_s: float = DEFAULT_CKPT_BYTES_PER_S,
+) -> Dict[str, float]:
+    """Price one rank death both ways (docs/RECOVERY.md) — the rows
+    ``sim_collectives --recovery-sweep`` emits:
+
+    - ``baseline_step_comm_s`` — the healthy per-step ring collective;
+    - ``replication_overhead_s`` / ``replication_overhead_ratio`` — the
+      per-step price of keeping the replicas warm (the acceptance pin:
+      < 5 % of step comm at the default config);
+    - ``replica_repair_s`` — in-fabric repair: one shard over one hop +
+      the warm plan swap, zero lost steps;
+    - ``ckpt_reload_s`` — the alternative: full-state read from storage
+      plus the expected ``save_interval/2`` steps of re-done work;
+    - ``repair_speedup`` — reload / repair (> 1 everywhere the replica
+      path earns its overhead);
+    - ``overhead_break_even_steps`` — steps between failures above which
+      the cumulative replication overhead exceeds what one repair saves
+      (failures rarer than this favor plain checkpointing).
+
+    ``state_bytes`` defaults to ``3 · nbytes`` — fp32 Adam's flat master
+    + two moment banks for an ``nbytes`` gradient; ``step_time_s``
+    defaults to the comm time itself (a fully comm-bound step, the
+    conservative floor for the lost-work term).  Deterministic, analytic.
+    """
+    if world < 2:
+        raise ValueError(f"recovery pricing needs world >= 2, got {world}")
+    if save_interval_steps < 1:
+        raise ValueError(
+            f"save_interval_steps must be >= 1, got {save_interval_steps}"
+        )
+    if state_bytes is None:
+        state_bytes = 3.0 * float(nbytes)
+    baseline = quantized_ring_allreduce_time(world, nbytes, coeffs, wire_dtype)
+    if step_time_s is None:
+        step_time_s = baseline
+    overhead = replication_overhead_time(world, state_bytes, coeffs, replicas)
+    repair = replica_repair_time(world, state_bytes, coeffs, standby_cached)
+    lost_steps = save_interval_steps / 2.0
+    reload = checkpoint_reload_time(
+        state_bytes, lost_steps, step_time_s, ckpt_bytes_per_s
+    )
+    saved = reload - repair
+    return {
+        "baseline_step_comm_s": baseline,
+        "replication_overhead_s": overhead,
+        "replication_overhead_ratio": (
+            overhead / baseline if baseline > 0 else 0.0
+        ),
+        "replica_repair_s": repair,
+        "ckpt_reload_s": reload,
+        "repair_speedup": reload / repair if repair > 0 else float("inf"),
+        "overhead_break_even_steps": (
+            saved / overhead if overhead > 0 and saved > 0 else float("inf")
+        ),
+    }

@@ -206,6 +206,15 @@ class Supervisor:
                 self.engine_epoch = max(
                     self.engine_epoch, int(d.payload["engine_epoch"])
                 )
+            if d.kind in ("admit", "recover") and "gen" in d.payload:
+                # re-seed the admit counter: a fresh logic starts at 0,
+                # and without this a post-restart rejoin would reuse a
+                # journaled generation's rendezvous namespace (and read
+                # the earlier rejoin's stale keys as its own).  Both the
+                # heartbeat admit and the fault-plan recover bump it;
+                # pre-PR-13 recover records carry no gen and are skipped
+                if hasattr(self.logic, "seed_restart_generation"):
+                    self.logic.seed_restart_generation(int(d.payload["gen"]))
         # the fresh liveness table must agree with the replayed view: a
         # journald death stays DEAD (no duplicate suspicion walk, no
         # duplicate dead decision), and beats that PREDATE the restart are
@@ -333,8 +342,13 @@ class Supervisor:
             self.logic.mark_down([rank])
         recovered = self._plan_dead - down
         if recovered:
-            note("recover", ranks=sorted(recovered), origin="plan")
-            self.logic.mark_recovered(recovered)
+            # this path bumps the admit counter too (the ranks were DEAD),
+            # so the journaled record must carry the generation — replay
+            # re-seeds from it exactly like a heartbeat-path admit, or a
+            # restarted supervisor would reissue this generation's
+            # rendezvous namespace to the next rejoin
+            gen = self.logic.mark_recovered(recovered)
+            note("recover", ranks=sorted(recovered), origin="plan", gen=gen)
         self._plan_dead, self._plan_slow = down, slow
 
     def poll(self, now: Optional[float] = None) -> List[dict]:
@@ -363,8 +377,15 @@ class Supervisor:
                 note("dead", rank=rank, origin="heartbeat")
                 self.logic.mark_down([rank])
             elif old == DEAD and new == HEALTHY:
-                note("recover", ranks=[rank], origin="heartbeat")
-                self.logic.mark_recovered([rank])
+                # a replacement (or restarted) worker leased in for a
+                # DEAD rank — the rejoin protocol's admit decision
+                # (docs/RECOVERY.md §3): the journaled generation is the
+                # rendezvous namespace the newcomer's catch-up restore
+                # (restore_newest_across_processes(gen=)) keys by, and the
+                # membership change actuates below as the grow-back epoch
+                # (StandbyPlanCache.restore_full → warm base plan)
+                gen = self.logic.mark_recovered([rank])
+                note("admit", rank=rank, origin="heartbeat", gen=gen)
             elif old == SUSPECTED and new == HEALTHY:
                 # the false-positive guard fired: a paused-then-resumed
                 # rank inside the grace window was never demoted

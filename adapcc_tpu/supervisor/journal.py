@@ -32,9 +32,10 @@ JOURNAL_VERSION = 1
 
 #: decision kinds with side effects on the data plane: these are written
 #: ahead of actuation and need an ``applied`` confirmation marker.  The
-#: other kinds (suspicion, demotion, the ``swap`` record the actuation
-#: itself emits, adaptation reports) are informational — replay folds
-#: them but never re-runs anything for them.
+#: other kinds (suspicion, demotion, worker admission (``admit``, the
+#: rejoin protocol — docs/RECOVERY.md §3), the ``swap`` record the
+#: actuation itself emits, adaptation reports) are informational — replay
+#: folds them but never re-runs anything for them.
 ACTUATING_KINDS = ("epoch", "restore")
 
 

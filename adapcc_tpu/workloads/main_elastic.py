@@ -22,7 +22,10 @@ import numpy as np
 import optax
 
 from adapcc_tpu.checkpoint import (
+    AsyncCheckpointManager,
     TrainCheckpointState,
+    async_checkpointing_enabled,
+    load_checkpoint,
     restore_newest_across_processes,
     run_elastic,
     save_checkpoint,
@@ -138,8 +141,55 @@ def worker(args) -> int:
         opt_state=train_state.opt_state,
         extra={"model_state": model_state} if stateful else {},
     )
+    # async crash-consistent checkpointing (ADAPCC_ASYNC_CKPT,
+    # docs/RECOVERY.md §2): epoch saves run on the manager's background
+    # pipeline (snapshot → serialize → checksum → atomic publish) and the
+    # local restore reads the newest VERIFIED step — a mid-save crash
+    # leaves only ignorable .tmp debris, never a torn live checkpoint
+    amgr = None
+    if async_checkpointing_enabled():
+        steps_dir = args.checkpoint_file + ".steps"
+        if jax.process_count() > 1:
+            # every process owns its own step directory: two publishers
+            # racing one shared step-<n>/ rename is exactly the
+            # cross-process collision the manager's loud
+            # already-published guard rejects (the legacy single-file
+            # path tolerates the race only because last-rename-wins)
+            steps_dir += f".p{jax.process_index()}"
+        amgr = AsyncCheckpointManager(steps_dir)
     try:
-        ckpt = restore_newest_across_processes(ckpt, args.checkpoint_file)
+        restored_step = None
+        if amgr is not None:
+            restored_step = amgr.latest_good_step()
+            if restored_step is not None:
+                amgr.restore(ckpt, restored_step)
+        if restored_step is not None:
+            # the legacy single-file checkpoint may still be FRESHER
+            # (async was off in an earlier run); adopt it only then —
+            # loading it unconditionally would rewind the verified step
+            # restore under a stale leftover file
+            legacy = TrainCheckpointState(
+                params=ckpt.params,
+                opt_state=ckpt.opt_state,
+                extra=dict(ckpt.extra),
+            )
+            try:
+                fresher = (
+                    load_checkpoint(legacy, args.checkpoint_file)
+                    and legacy.epoch > ckpt.epoch
+                )
+            except (KeyError, ValueError, TypeError):
+                # an unreadable/incompatible stale file simply LOSES the
+                # freshness comparison — it must not abort a worker that
+                # already holds a good verified restore
+                fresher = False
+            if fresher:
+                ckpt = legacy
+            ckpt = restore_newest_across_processes(
+                ckpt, args.checkpoint_file, load_local=False
+            )
+        else:
+            ckpt = restore_newest_across_processes(ckpt, args.checkpoint_file)
     except (KeyError, ValueError, TypeError) as e:
         # flax from_bytes raises a raw dict-key/shape mismatch when the file
         # was written under a different --norm mode (e.g. a pre-SyncBN ckpt
@@ -170,14 +220,23 @@ def worker(args) -> int:
         ckpt.step = int(train_state.step)
         if stateful:
             ckpt.extra["model_state"] = train_state.model_state
-        save_checkpoint(ckpt, args.checkpoint_file)
+        if amgr is not None:
+            amgr.save_async(epoch, ckpt)
+        else:
+            save_checkpoint(ckpt, args.checkpoint_file)
 
         # fault injection fires only in the first generation, so the
         # supervisor's restart actually makes progress past the crash point
         gen = int(os.environ.get("ADAPCC_RESTART_GEN", "0"))
         if args.crash_at_epoch is not None and epoch == args.crash_at_epoch and gen == 0:
+            if amgr is not None:
+                # the INJECTED crash is deterministic by contract — the
+                # genuinely-mid-save kill is the chaos drill's job
+                amgr.wait()
             print(f"=> injected fault at epoch {epoch}", flush=True)
             return 17  # nonzero: the supervisor restarts us
+    if amgr is not None:
+        amgr.wait()
     return 0
 
 

@@ -395,6 +395,24 @@ def main(argv=None) -> None:
             overlap="off" if args.zero1_ring else overlap,
         )
         master, z_state = z_opt.init(params)
+        # redundant shard placement (docs/RECOVERY.md §1): with
+        # ADAPCC_SHARD_REPLICAS > 0 every step's freshly-written optimizer
+        # shard rows are captured to their ring-neighbor holders inside
+        # the post-step window — the elastic_rejoin battery A/Bs this
+        # against k=0 to price the piggyback on real chips
+        from adapcc_tpu.elastic.redundancy import (
+            ShardReplicaStore,
+            shard_replicas,
+        )
+
+        z_replicas = shard_replicas(default=0)
+        z_store = None
+        if z_replicas:
+            z_store = ShardReplicaStore(world, replicas=z_replicas)
+            print(
+                f"redundancy: zero1 shard replicas k={z_replicas} "
+                f"(ring-neighbor placement over world={world})"
+            )
         if z_opt.tuned_plan is not None:
             tp = z_opt.tuned_plan
             print(
@@ -426,6 +444,11 @@ def main(argv=None) -> None:
                 params, master, z_state, losses = z_step(
                     params, master, z_state, batch_fn()
                 )
+            if z_store is not None:
+                # the piggyback window: the shard rows this step's update
+                # just wrote ride to their holders, stamped for the
+                # repair path's freshness guard
+                z_store.capture((master, z_state), step)
             return losses
 
     else:

@@ -197,6 +197,16 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
       incumbent restores after the window) — the phase walltimes price
       what the re-route buys, and the printed outcomes record the
       triage's verdicts on real hardware.
+    - ``elastic_rejoin`` — replicated ZeRO-1 shard upkeep priced live
+      (the hardware twin of ``make recovery-bench``, docs/RECOVERY.md):
+      the SAME zero1 workload with ``ADAPCC_SHARD_REPLICAS`` 0 vs 1 —
+      the per-step walltime delta UPPER-BOUNDS the piggyback overhead
+      the sim's < 5 % bound predicts: the single-process replica store
+      is a host-materialized twin (a blocking D2H state copy per step),
+      so the measured delta includes that copy, where a real multi-host
+      deployment pays only the k·state/world ring-neighbor wire transfer
+      (the rejoin protocol itself is process-level and drilled by
+      tests/test_chaos_drill.py).
     """
     gate = f"world={world} (needs multi-chip ICI)"
     if world < 2:
@@ -205,7 +215,7 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
             "busbw_wire_dtype", "busbw_fused_wire", "tuner_convergence",
             "overlap_ab", "small_msg_crossover", "two_level_synth",
             "elastic_failover", "online_adaptation", "supervised_failover",
-            "fabric_contention",
+            "fabric_contention", "elastic_rejoin",
         ):
             _skip(name, gate, out_path)
         return
@@ -488,6 +498,22 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
                 "ADAPCC_DRIFT_WINDOW": "4",
             },
             rec_extra={"congestion_profile": cong_path, "adapt": arm},
+        )
+    # replicated-shard upkeep A/B on real chips (the hardware twin of
+    # `make recovery-bench`, docs/RECOVERY.md): the same ZeRO-1 workload
+    # with replication off vs k=1 — every step's freshly-written shard
+    # rows ride to their ring-neighbor holders inside the post-step
+    # window, and the phase-walltime delta is the measured piggyback
+    # overhead the sim prices (< 5% of step comm at the default config).
+    for k in ("0", "1"):
+        _run(
+            "elastic_rejoin",
+            [py, "-m", "adapcc_tpu.workloads.train_ddp", "--model", "mlp",
+             "--steps", "12", "--batch", "64", "--world", str(world),
+             "--dp-mode", "zero1"],
+            900, out_path,
+            extra_env={"ADAPCC_SHARD_REPLICAS": k},
+            rec_extra={"shard_replicas": int(k)},
         )
 
 

@@ -983,6 +983,116 @@ def chaos_sweep(
     return rows
 
 
+def recovery_sweep(
+    sizes: Sequence[int],
+    worlds: Sequence[int] = (8, 32, 64),
+    replicas: int = 1,
+    save_interval_steps: int = 100,
+    model: Optional[LinkCostModel] = None,
+) -> List[dict]:
+    """Deterministic durable-recovery rows — the hardware-free regression
+    artifact for replicated ZeRO-1 shards vs a checkpoint reload (``make
+    recovery-bench``, docs/RECOVERY.md §4).
+
+    One row per (world × payload) cell, priced by
+    :func:`adapcc_tpu.sim.cost_model.recovery_cost` on the calibration's
+    ICI class coefficients (the replica piggyback rides ring-neighbor
+    hops; the grid names its own worlds, so — like ``--hier-sweep`` — the
+    model's world is irrelevant and no world² state is touched):
+
+    - the per-step **replication overhead** next to the baseline step
+      comm, with ``overhead_ok`` stamping the acceptance bound (< 5 % of
+      step comm — holds from world=32 up at k=1, the default config: the
+      shard shrinks as 1/world while step comm saturates at 2·nbytes);
+    - the **repair** arm (one shard over one hop + warm plan swap, zero
+      lost steps) against the **reload** arm (full state from shared
+      storage + ``save_interval/2`` steps of re-done work), with
+      ``repair_speedup`` and the failure-rate break-even.
+
+    Deterministic: same calibration → byte-identical rows.
+    """
+    from adapcc_tpu.sim.cost_model import ICI, recovery_cost
+
+    worlds = [int(w) for w in worlds]
+    bad = [w for w in worlds if w < 2]
+    if bad:
+        raise ValueError(f"recovery sweep needs worlds >= 2, got {worlds}")
+    if replicas < 1:
+        raise ValueError(
+            f"recovery sweep needs replicas >= 1, got {replicas} "
+            "(replicas=0 prices nothing: replication is off)"
+        )
+    if model is None:
+        model = load_or_default()
+    coeffs = model.classes[ICI]
+    rows: List[dict] = []
+    for world in worlds:
+        if replicas >= world:
+            # an unreplicable cell (k >= world) is skipped LOUDLY in-band:
+            # a silent drop would read as "priced that world" when nothing
+            # was
+            rows.append({
+                "mode": "simulated",
+                "collective": "allreduce",
+                "impl": "recovery",
+                "world": world,
+                "replicas": replicas,
+                "skipped": f"replicas={replicas} needs world > replicas",
+                "calibration": model.source,
+            })
+            continue
+        for nbytes in sizes:
+            # fp32 Adam on an nbytes gradient: passed explicitly so the
+            # emitted row and the priced times can never disagree about
+            # what state size was modeled
+            state_bytes = 3 * int(nbytes)
+            cost = recovery_cost(
+                world,
+                int(nbytes),
+                coeffs,
+                state_bytes=float(state_bytes),
+                replicas=replicas,
+                save_interval_steps=save_interval_steps,
+            )
+            rows.append({
+                "mode": "simulated",
+                "collective": "allreduce",
+                "impl": "recovery",
+                "world": world,
+                "size_bytes": int(nbytes),
+                "state_bytes": state_bytes,
+                "replicas": replicas,
+                "save_interval_steps": int(save_interval_steps),
+                "baseline_step_comm_us": round(
+                    cost["baseline_step_comm_s"] * 1e6, 3
+                ),
+                "replication_overhead_us": round(
+                    cost["replication_overhead_s"] * 1e6, 3
+                ),
+                "replication_overhead_ratio": round(
+                    cost["replication_overhead_ratio"], 6
+                ),
+                # the acceptance bound: replica upkeep must stay in the
+                # piggyback window's noise, not become a second collective
+                "overhead_ok": cost["replication_overhead_ratio"] < 0.05,
+                "replica_repair_us": round(cost["replica_repair_s"] * 1e6, 3),
+                "ckpt_reload_us": round(cost["ckpt_reload_s"] * 1e6, 3),
+                "repair_speedup": round(cost["repair_speedup"], 3),
+                "overhead_break_even_steps": (
+                    round(cost["overhead_break_even_steps"], 1)
+                    if cost["overhead_break_even_steps"] != float("inf")
+                    else None
+                ),
+                "calibration": model.source,
+            })
+    if not rows:
+        raise ValueError(
+            f"recovery sweep produced no rows: worlds={worlds} "
+            f"sizes={list(sizes)}"
+        )
+    return rows
+
+
 def adapt_sweep(
     world: int,
     sizes: Sequence[int],
@@ -1456,6 +1566,26 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="chaos-sweep confirmation-count grid",
     )
     ap.add_argument(
+        "--recovery-sweep", action="store_true",
+        help="price durable elastic recovery instead of the strategy "
+        "grid: per-(world x payload) replication wire overhead vs "
+        "baseline step comm, and the in-fabric shard repair vs a "
+        "checkpoint reload (make recovery-bench; docs/RECOVERY.md)",
+    )
+    ap.add_argument(
+        "--rec-worlds", default="8,32,64",
+        help="recovery-sweep world grid",
+    )
+    ap.add_argument(
+        "--rec-replicas", type=int, default=1,
+        help="recovery-sweep shard replica count (k)",
+    )
+    ap.add_argument(
+        "--rec-save-interval", type=int, default=100,
+        help="recovery-sweep checkpoint save interval (steps) priced "
+        "into the reload arm's lost work",
+    )
+    ap.add_argument(
         "--hier-sweep", action="store_true",
         help="price the composed two-level allreduce against the flat "
         "ring over a (pods x pod_size x size) grid, with the per-row "
@@ -1534,6 +1664,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--adapt-sweep", args.adapt_sweep),
             ("--chaos-sweep", args.chaos_sweep),
             ("--fabric-sweep", args.fabric_sweep),
+            ("--recovery-sweep", args.recovery_sweep),
         ) if on
     ]
     if len(exclusive) > 1:
@@ -1598,6 +1729,39 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     f"two_level={row['pred_two_level_us']:>10.1f}us  "
                     f"flat={row['pred_flat_us']:>10.1f}us  "
                     f"crossover_pods={row['crossover_pods']}"
+                )
+        return 0
+    if args.recovery_sweep:
+        if args.hosts > 1:
+            # the grid names its own worlds and the replica piggyback is
+            # priced on the ICI class alone; silently accepting --hosts
+            # would read as "priced that host split" when nothing used it
+            ap.error("--hosts has no effect on --recovery-sweep (use "
+                     "--rec-worlds)")
+        rows = recovery_sweep(
+            sizes=[parse_size(s) for s in args.sizes.split(",")],
+            worlds=[int(w) for w in args.rec_worlds.split(",") if w],
+            replicas=args.rec_replicas,
+            save_interval_steps=args.rec_save_interval,
+            model=model,
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            elif "skipped" in row:
+                print(
+                    f"[sim] recovery world={row['world']:>3} "
+                    f"SKIP ({row['skipped']})"
+                )
+            else:
+                star = "*" if row["overhead_ok"] else "!"
+                print(
+                    f"[sim] recovery world={row['world']:>3} "
+                    f"{row['size_bytes']:>12}B k={row['replicas']}{star} "
+                    f"overhead={row['replication_overhead_ratio']*100:>6.2f}% "
+                    f"repair={row['replica_repair_us']:>10.1f}us  "
+                    f"reload={row['ckpt_reload_us']:>12.1f}us  "
+                    f"speedup={row['repair_speedup']:>8.1f}x"
                 )
         return 0
     if args.chaos_sweep:

@@ -672,3 +672,84 @@ def test_fabric_sweep_cli_emits_json(capsys):
     assert all(
         r["high_beats_uncoordinated"] for r in rows if r["mix"] == "high-low"
     )
+
+
+# ---------------------------------------------- recovery sweep (PR 13)
+
+
+def test_recovery_sweep_rows_byte_identical_and_bounds_stamped():
+    """The recovery-bench artifact (docs/RECOVERY.md §4) is deterministic
+    to the byte over the (world × payload) grid, every default-config row
+    from world=32 up stamps the acceptance bound (replication wire
+    overhead < 5 % of baseline step comm), and the in-fabric repair beats
+    the checkpoint reload on every cell — the reason the replica path
+    owns the hot path."""
+    from benchmarks.sim_collectives import recovery_sweep
+
+    sizes = [1 << 20, 64 << 20]
+    rows = recovery_sweep(sizes, worlds=(8, 32, 64))
+    again = recovery_sweep(sizes, worlds=(8, 32, 64))
+    assert [json.dumps(r, sort_keys=True) for r in rows] == [
+        json.dumps(r, sort_keys=True) for r in again
+    ]
+    assert len(rows) == 3 * len(sizes)
+    for r in rows:
+        assert r["mode"] == "simulated" and r["impl"] == "recovery"
+        assert r["replicas"] == 1
+        assert r["state_bytes"] == 3 * r["size_bytes"]
+        assert r["replication_overhead_us"] > 0
+        assert r["overhead_ok"] == (r["replication_overhead_ratio"] < 0.05)
+        if r["world"] >= 32:
+            # the acceptance pin: k=1 upkeep stays inside 5% of step comm
+            # at the default config (the shard shrinks as 1/world)
+            assert r["overhead_ok"] is True
+        # zero lost steps + one hop vs full-state read + replayed work
+        assert r["repair_speedup"] > 1.0
+        assert r["replica_repair_us"] < r["ckpt_reload_us"]
+    with pytest.raises(ValueError, match="worlds >= 2"):
+        recovery_sweep(sizes, worlds=(1,))
+    with pytest.raises(ValueError, match="replicas >= 1"):
+        recovery_sweep(sizes, replicas=0)
+    # an unreplicable cell (k >= world) is skipped loudly in-band
+    skip = [
+        r for r in recovery_sweep(sizes, worlds=(2,), replicas=2)
+        if "skipped" in r
+    ]
+    assert len(skip) == 1 and "replicas=2" in skip[0]["skipped"]
+
+
+def test_recovery_sweep_cli_mutually_exclusive_and_rejects_hosts(capsys):
+    from benchmarks.sim_collectives import main
+
+    for other in (
+        ["--ring-sweep"],
+        ["--tune-replay"],
+        ["--fused-sweep"],
+        ["--overlap-sweep"],
+        ["--fault-sweep"],
+        ["--latency-sweep"],
+        ["--adapt-sweep"],
+        ["--chaos-sweep"],
+        ["--hier-sweep"],
+        ["--fabric-sweep"],
+    ):
+        with pytest.raises(SystemExit):
+            main(["--recovery-sweep"] + other)
+    # the grid names its own worlds and prices the ICI class alone:
+    # --hosts is meaningless and silently accepting it would mislabel
+    # the artifact
+    with pytest.raises(SystemExit):
+        main(["--recovery-sweep", "--hosts", "2"])
+    capsys.readouterr()
+
+
+def test_recovery_sweep_cli_emits_json(capsys):
+    from benchmarks.sim_collectives import main
+
+    assert main([
+        "--recovery-sweep", "--sizes", "1M,64M", "--json",
+    ]) == 0
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rows and all(r["impl"] == "recovery" for r in rows)
+    assert {r["world"] for r in rows} == {8, 32, 64}
+    assert all(r["overhead_ok"] for r in rows if r["world"] >= 32)

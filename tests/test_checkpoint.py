@@ -462,3 +462,340 @@ def test_elastic_incompatible_checkpoint_friendly_error(tmp_path):
     assert out.returncode == 2, out.stdout + out.stderr
     assert "incompatible" in out.stderr
     assert "Traceback" not in out.stderr
+
+# ------------------------------------- durable / async checkpointing (PR 13)
+
+
+def test_save_checkpoint_fsyncs_file_and_directory(tmp_path, monkeypatch):
+    """Crash durability (docs/RECOVERY.md §2): the tmp payload is fsync'd
+    before the rename and the parent directory after it — rename alone
+    orders the name change but does not commit it, and an unfsynced
+    payload can commit a name pointing at unwritten blocks."""
+    import adapcc_tpu.checkpoint as ckpt_mod
+
+    synced = []
+    real_fsync = os.fsync
+    real_open = os.open
+
+    def spy_fsync(fd):
+        synced.append(("fd", fd))
+        return real_fsync(fd)
+
+    dirs = []
+
+    def spy_fsync_dir(path):
+        dirs.append(os.path.abspath(path))
+        fd = real_open(path, os.O_RDONLY)
+        try:
+            real_fsync(fd)
+        finally:
+            os.close(fd)
+
+    monkeypatch.setattr(os, "fsync", spy_fsync)
+    monkeypatch.setattr(ckpt_mod, "_fsync_dir", spy_fsync_dir)
+    path = str(tmp_path / "ck" / "c.ckpt")
+    save_checkpoint(TrainCheckpointState(params=_params(), epoch=1), path)
+    assert synced, "the payload bytes must be fsync'd before the rename"
+    assert dirs == [str(tmp_path / "ck")], (
+        "the parent directory must be fsync'd after the rename-commit"
+    )
+    # is_best commits a second rename → a second directory fsync
+    save_checkpoint(
+        TrainCheckpointState(params=_params(), epoch=2), path, is_best=True
+    )
+    assert dirs.count(str(tmp_path / "ck")) == 3
+
+
+def test_async_ckpt_env_funnel(monkeypatch):
+    from adapcc_tpu.checkpoint import async_checkpointing_enabled
+
+    monkeypatch.delenv("ADAPCC_ASYNC_CKPT", raising=False)
+    assert async_checkpointing_enabled() is False
+    assert async_checkpointing_enabled(explicit=True) is True
+    monkeypatch.setenv("ADAPCC_ASYNC_CKPT", "on")
+    assert async_checkpointing_enabled() is True
+    monkeypatch.setenv("ADAPCC_ASYNC_CKPT", "off")
+    assert async_checkpointing_enabled(explicit=True) is False
+    monkeypatch.setenv("ADAPCC_ASYNC_CKPT", "sideways")
+    with pytest.raises(ValueError, match="ADAPCC_ASYNC_CKPT"):
+        async_checkpointing_enabled()
+
+
+def _amgr_state(seed=0, scale=1.0, epoch=0, step=0):
+    return TrainCheckpointState(
+        params=_params(seed=seed, scale=scale), epoch=epoch, step=step
+    )
+
+
+def test_async_manager_save_restore_roundtrip(tmp_path):
+    from adapcc_tpu.checkpoint import AsyncCheckpointManager
+
+    mgr = AsyncCheckpointManager(str(tmp_path / "steps"), max_to_keep=2)
+    for step in (1, 2, 3):
+        mgr.save(step, _amgr_state(scale=float(step), epoch=step, step=step))
+    assert mgr.latest_step() == 3
+    # keep-last-good retention bounded to the newest 2 good steps
+    assert mgr.published_steps() == [2, 3]
+    s = _amgr_state(seed=9)
+    assert mgr.restore(s)
+    assert s.epoch == 3 and s.step == 3
+    _assert_tree_equal(s.params, _params(scale=3.0))
+    # explicit older step restores too
+    s2 = _amgr_state(seed=10)
+    assert mgr.restore(s2, step=2)
+    assert s2.epoch == 2
+    with pytest.raises(FileNotFoundError, match="step-7"):
+        mgr.restore(_amgr_state(), step=7)
+    mgr.close()
+
+
+def test_async_manager_async_pipeline_publishes_and_is_consistent(tmp_path):
+    """save_async snapshots on the caller's thread and publishes off-thread;
+    wait() makes every queued save durable.  Mutating the live state after
+    save_async must NOT leak into the published artifact (the snapshot is
+    the point-in-time capture)."""
+    from adapcc_tpu.checkpoint import AsyncCheckpointManager
+
+    mgr = AsyncCheckpointManager(str(tmp_path / "steps"), max_to_keep=8)
+    s = _amgr_state(scale=1.0, epoch=1, step=1)
+    mgr.save_async(1, s)
+    # the training loop advances immediately — the published step-1 must
+    # still carry epoch 1
+    s.epoch = 99
+    mgr.save_async(2, s)
+    mgr.wait()
+    assert mgr.published_steps() == [1, 2]
+    mgr.verify(1)
+    mgr.verify(2)
+    back = _amgr_state(seed=3)
+    assert mgr.restore(back, step=1)
+    assert back.epoch == 1, "snapshot-at-save_async must be point-in-time"
+    assert mgr.restore(back, step=2)
+    assert back.epoch == 99
+    assert mgr.torn_saves() == []
+    mgr.close()
+
+
+def test_async_manager_pipeline_error_surfaces_loudly(tmp_path):
+    """A failed background save must re-raise at the next save/wait —
+    async must not mean silently lossy."""
+    from adapcc_tpu.checkpoint import AsyncCheckpointManager
+
+    mgr = AsyncCheckpointManager(str(tmp_path / "steps"))
+    mgr.save(5, _amgr_state(epoch=5))
+    # steps are immutable once committed: re-publishing 5 fails off-thread
+    mgr.save_async(5, _amgr_state(epoch=6))
+    with pytest.raises(RuntimeError, match="does NOT exist"):
+        mgr.wait()
+    # the error is consumed: the manager keeps working afterwards
+    mgr.save(6, _amgr_state(epoch=6))
+    assert mgr.latest_step() == 6
+
+
+def test_corrupt_truncated_blob_rejects_loudly(tmp_path):
+    from adapcc_tpu.checkpoint import AsyncCheckpointManager, CheckpointCorrupt
+
+    mgr = AsyncCheckpointManager(str(tmp_path / "steps"))
+    mgr.save(1, _amgr_state(epoch=1))
+    blob = tmp_path / "steps" / "step-1" / "state.msgpack"
+    blob.write_bytes(blob.read_bytes()[:-7])
+    with pytest.raises(CheckpointCorrupt, match="truncated or torn"):
+        mgr.restore(_amgr_state(seed=2))
+    assert mgr.latest_good_step() is None
+
+
+def test_corrupt_bitflip_rejects_loudly(tmp_path):
+    from adapcc_tpu.checkpoint import AsyncCheckpointManager, CheckpointCorrupt
+
+    mgr = AsyncCheckpointManager(str(tmp_path / "steps"))
+    mgr.save(1, _amgr_state(epoch=1))
+    blob = tmp_path / "steps" / "step-1" / "state.msgpack"
+    raw = bytearray(blob.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF  # same size, flipped payload
+    blob.write_bytes(bytes(raw))
+    with pytest.raises(CheckpointCorrupt, match="sha256"):
+        mgr.restore(_amgr_state(seed=2))
+
+
+def test_corrupt_manifest_missing_shard_rejects_loudly(tmp_path):
+    from adapcc_tpu.checkpoint import AsyncCheckpointManager, CheckpointCorrupt
+
+    mgr = AsyncCheckpointManager(str(tmp_path / "steps"))
+    mgr.save(1, _amgr_state(epoch=1))
+    os.remove(tmp_path / "steps" / "step-1" / "state.msgpack")
+    with pytest.raises(CheckpointCorrupt, match="missing"):
+        mgr.restore(_amgr_state(seed=2))
+    # a published dir with no manifest at all is tampering, same loudness
+    mgr.save(2, _amgr_state(epoch=2))
+    os.remove(tmp_path / "steps" / "step-2" / "MANIFEST.json")
+    with pytest.raises(CheckpointCorrupt, match="MANIFEST"):
+        mgr.restore(_amgr_state(seed=3))
+
+
+def test_corrupt_manifest_json_is_corrupt_not_a_crash(tmp_path):
+    """A bit flip INSIDE the manifest is the same corruption class as one
+    inside a shard: verify rejects with CheckpointCorrupt (not a raw
+    JSONDecodeError), and latest_good_step falls back to the older
+    verified step instead of crashing."""
+    from adapcc_tpu.checkpoint import AsyncCheckpointManager, CheckpointCorrupt
+
+    mgr = AsyncCheckpointManager(str(tmp_path / "steps"))
+    mgr.save(1, _amgr_state(epoch=1))
+    mgr.save(2, _amgr_state(epoch=2))
+    man = tmp_path / "steps" / "step-2" / "MANIFEST.json"
+    man.write_text(man.read_text()[:-9] + "garbage")
+    with pytest.raises(CheckpointCorrupt, match="not valid JSON"):
+        mgr.verify(2)
+    assert mgr.latest_good_step() == 1
+    # a structurally-valid manifest missing its shard table is equally
+    # corrupt, equally non-fatal to the scan
+    man.write_text('{"version": 1, "step": 2}')
+    with pytest.raises(CheckpointCorrupt, match="malformed"):
+        mgr.verify(2)
+    assert mgr.latest_good_step() == 1
+
+
+def test_republish_replaces_corrupt_step_but_never_a_good_one(tmp_path):
+    """A resume that restored latest_good_step() re-runs the steps a
+    newer CORRUPT directory covers; re-publishing over the damaged
+    artifact is the recovery (replaced, loud stderr note) — while a
+    verified step stays immutable."""
+    from adapcc_tpu.checkpoint import AsyncCheckpointManager
+
+    mgr = AsyncCheckpointManager(str(tmp_path / "steps"))
+    mgr.save(1, _amgr_state(epoch=1))
+    mgr.save(2, _amgr_state(epoch=2))
+    blob = tmp_path / "steps" / "step-2" / "state.msgpack"
+    blob.write_bytes(blob.read_bytes()[:-7])
+    assert mgr.latest_good_step() == 1
+    mgr.save(2, _amgr_state(epoch=2))          # the re-run's save
+    assert mgr.latest_good_step() == 2
+    got = _amgr_state(seed=9)
+    assert mgr.restore(got) and got.epoch == 2
+    with pytest.raises(RuntimeError, match="does NOT exist"):
+        # a GOOD step stays immutable: the async re-publish fails loudly
+        mgr.save_async(2, _amgr_state(epoch=3))
+        mgr.wait()
+
+
+def test_torn_tmp_dir_tolerated_like_journal_torn_tail(tmp_path):
+    """A mid-save crash leaves only a .tmp-* directory — the one legal
+    kind of damage.  It is invisible to the published scan (the supervisor
+    journal's torn-tail rule) and restore proceeds from the newest
+    published step."""
+    from adapcc_tpu.checkpoint import AsyncCheckpointManager
+
+    mgr = AsyncCheckpointManager(str(tmp_path / "steps"))
+    mgr.save(1, _amgr_state(epoch=1))
+    # a crashed writer's debris: half-written shard, no manifest
+    torn = tmp_path / "steps" / ".tmp-step-2-12345"
+    torn.mkdir()
+    (torn / "state.msgpack").write_bytes(b"half-writ")
+    assert mgr.published_steps() == [1]
+    assert mgr.torn_saves() == [".tmp-step-2-12345"]
+    s = _amgr_state(seed=4)
+    assert mgr.restore(s)
+    assert s.epoch == 1
+
+
+def test_retention_keeps_last_good_over_newer_corrupt(tmp_path, capsys):
+    """Keep-last-good: the newest VERIFIED checkpoint is never GC'd just
+    because a newer corrupt directory exists above it — the corrupt one
+    is the casualty, with a loud stderr note."""
+    from adapcc_tpu.checkpoint import AsyncCheckpointManager, CheckpointCorrupt
+
+    mgr = AsyncCheckpointManager(str(tmp_path / "steps"), max_to_keep=2)
+    for step in (1, 2, 3):
+        mgr.save(step, _amgr_state(epoch=step))
+    assert mgr.published_steps() == [2, 3]
+    # bit-flip the newest, then save another: GC must keep good 2 and 4,
+    # collect corrupt 3
+    blob = tmp_path / "steps" / "step-3" / "state.msgpack"
+    raw = bytearray(blob.read_bytes())
+    raw[0] ^= 0xFF
+    blob.write_bytes(bytes(raw))
+    mgr.save(4, _amgr_state(epoch=4))
+    assert mgr.published_steps() == [2, 4]
+    assert "failed verification" in capsys.readouterr().err
+    # and a corrupt NEWEST step never silently falls back: restore(None)
+    # is loud, latest_good_step is the deliberate fallback
+    blob4 = tmp_path / "steps" / "step-4" / "state.msgpack"
+    raw4 = bytearray(blob4.read_bytes())
+    raw4[1] ^= 0xFF
+    blob4.write_bytes(bytes(raw4))
+    with pytest.raises(CheckpointCorrupt):
+        mgr.restore(_amgr_state(seed=5))
+    assert mgr.latest_good_step() == 2
+    s = _amgr_state(seed=6)
+    assert mgr.restore(s, step=mgr.latest_good_step())
+    assert s.epoch == 2
+
+
+def test_rendezvous_dead_peer_times_out_loudly(tmp_path, monkeypatch):
+    """The PR-10 funnel on the restore barrier: a dead peer that never
+    publishes its epoch key surfaces as CoordinatorUnavailable within the
+    ADAPCC_RPC_TIMEOUT_S budget — never an indefinite block."""
+    import time
+
+    jax.devices()
+    from jax._src import distributed
+
+    from adapcc_tpu.coordinator.service import CoordinatorUnavailable
+    from tests.test_launch import _FakeKVClient
+
+    kv = _FakeKVClient()
+    monkeypatch.setattr(distributed.global_state, "client", kv)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    monkeypatch.setenv("ADAPCC_RPC_TIMEOUT_S", "0.5")
+
+    path = str(tmp_path / "r1.ckpt")
+    save_checkpoint(TrainCheckpointState(params=_params(), epoch=3), path)
+    s = TrainCheckpointState(params=_params(seed=7))
+    t0 = time.monotonic()
+    # peer 0 is dead: its epoch key never appears
+    with pytest.raises(CoordinatorUnavailable, match="epoch of peer 0"):
+        restore_newest_across_processes(s, path)
+    assert time.monotonic() - t0 < 10.0, "must time out inside the budget"
+
+
+def test_rendezvous_gen_keys_namespace(tmp_path, monkeypatch):
+    """A rejoining worker's catch-up restore keys its rendezvous by the
+    supervisor-journaled admit generation (gen=) under a DISTINCT rejoin
+    namespace — never the dead world's ADAPCC_RESTART_GEN keys, even
+    when the admit counter collides numerically with an earlier
+    full-world restart generation."""
+    jax.devices()
+    from jax._src import distributed
+
+    from tests.test_launch import _FakeKVClient
+
+    kv = _FakeKVClient()
+    monkeypatch.setattr(distributed.global_state, "client", kv)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+    # the survivor (rank 1, has the fresh checkpoint) publishes under
+    # rejoin/g7
+    path1 = str(tmp_path / "r1.ckpt")
+    save_checkpoint(
+        TrainCheckpointState(params=_params(scale=4.0), epoch=9), path1
+    )
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    # a numerically-colliding RESTART generation 7 published stale keys:
+    # the rejoin namespace must never read them
+    kv.store["adapcc/elastic/g7/epoch/0"] = "99"
+    kv.store["adapcc/elastic/g7/epoch/1"] = "99"
+    kv.store["adapcc/elastic/rejoin/g7/epoch/0"] = "-1"
+    s1 = TrainCheckpointState(params=_params(seed=7))
+    restore_newest_across_processes(s1, path1, gen="7")
+    assert "adapcc/elastic/rejoin/g7/epoch/1" in kv.store
+    assert kv.store["adapcc/elastic/rejoin/g7/epoch/1"] == "9"
+
+    # the replacement (rank 0, empty disk) catches up through g7
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    s0 = TrainCheckpointState(params=_params(seed=8))
+    out = restore_newest_across_processes(
+        s0, str(tmp_path / "r0.ckpt"), gen="7"
+    )
+    assert out.epoch == 9
+    _assert_tree_equal(out.params, s1.params)

@@ -3,9 +3,9 @@
 Every ```python block in docs/PARALLELISM.md, docs/OPERATIONS.md,
 docs/SIMULATION.md, docs/RING.md, docs/QUANT.md, docs/TUNER.md,
 docs/OVERLAP.md, docs/LATENCY.md, docs/ELASTIC.md, docs/ADAPT.md,
-docs/SUPERVISOR.md, docs/HIERARCHY.md and docs/FABRIC.md runs verbatim
-on the virtual pod.  A snippet that stops compiling or produces wrong
-shapes fails here.
+docs/SUPERVISOR.md, docs/HIERARCHY.md, docs/FABRIC.md and
+docs/RECOVERY.md runs verbatim on the virtual pod.  A snippet that
+stops compiling or produces wrong shapes fails here.
 """
 
 import os
@@ -29,6 +29,7 @@ _ADAPT = os.path.join(_DOCS_DIR, "ADAPT.md")
 _SUPERVISOR = os.path.join(_DOCS_DIR, "SUPERVISOR.md")
 _HIERARCHY = os.path.join(_DOCS_DIR, "HIERARCHY.md")
 _FABRIC = os.path.join(_DOCS_DIR, "FABRIC.md")
+_RECOVERY = os.path.join(_DOCS_DIR, "RECOVERY.md")
 
 
 def _blocks(path):
@@ -316,3 +317,31 @@ def test_fabric_doc_covers_the_contract():
 def test_fabric_doc_snippet_runs(idx):
     code = _blocks(_FABRIC)[idx]
     exec(compile(code, f"{_FABRIC}:block{idx}", "exec"), {})
+
+
+def test_recovery_doc_has_snippets():
+    assert len(_blocks(_RECOVERY)) >= 5
+
+
+def test_recovery_doc_covers_the_contract():
+    """The durable-recovery topics the replication/checkpoint/rejoin
+    story leans on."""
+    text = open(_RECOVERY).read()
+    for needle in (
+        "ADAPCC_SHARD_REPLICAS", "ADAPCC_ASYNC_CKPT",
+        "ADAPCC_RPC_TIMEOUT_S", "replica_placement", "ShardReplicaStore",
+        "recover_zero1_trainer_state", "grow_zero1_trainer_state",
+        "restore_newest_across_processes", "AsyncCheckpointManager",
+        "CheckpointCorrupt", "MANIFEST.json", "keep-last-good",
+        "latest_good_step", "admit", "restart_generation",
+        "mark_recovered", "restore_full", "cache_hit",
+        "replication_overhead_time", "recovery_cost",
+        "make recovery-bench", "elastic_rejoin",
+    ):
+        assert needle in text, f"RECOVERY.md lost its {needle!r} coverage"
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_RECOVERY))))
+def test_recovery_doc_snippet_runs(idx):
+    code = _blocks(_RECOVERY)[idx]
+    exec(compile(code, f"{_RECOVERY}:block{idx}", "exec"), {})

@@ -786,3 +786,279 @@ def test_train_ddp_rejects_fault_plan_outside_ddp_mode(tmp_path, monkeypatch):
     monkeypatch.setenv(FAULT_PLAN_ENV, str(path))
     with pytest.raises(ValueError, match="requires --dp-mode ddp"):
         train_main(["--dp-mode", "zero1", "--steps", "1"])
+
+
+# --------------------------------------------------------------------------- #
+# redundant shard placement + durable recovery (PR 13, docs/RECOVERY.md)
+# --------------------------------------------------------------------------- #
+
+def test_replica_placement_prefers_off_host_and_balances():
+    from adapcc_tpu.elastic.redundancy import replica_placement
+
+    # 2 hosts x 4 ranks: every holder must sit on the OTHER host (a host
+    # loss must never take a shard and all its replicas together)
+    ips = {r: f"10.0.0.{r // 4}" for r in range(8)}
+    placement = replica_placement(8, ips, replicas=1)
+    for r, holders in placement.items():
+        assert len(holders) == 1
+        assert ips[holders[0]] != ips[r]
+        assert holders[0] != r
+    # balance: the 4 same-host primaries spread over 4 distinct off-host
+    # holders instead of piling onto one neighbor
+    host0_holders = [placement[r][0] for r in range(4)]
+    assert len(set(host0_holders)) == 4
+    # single-host world (the CPU rig): ring-neighbor fallback
+    flat = replica_placement(4, None, replicas=1)
+    assert flat == {0: (1,), 1: (2,), 2: (3,), 3: (0,)}
+    # k=2 keeps holders distinct and never self
+    k2 = replica_placement(4, None, replicas=2)
+    for r, holders in k2.items():
+        assert len(set(holders)) == 2 and r not in holders
+    # validation
+    with pytest.raises(ValueError, match="replicas"):
+        replica_placement(2, None, replicas=2)
+    with pytest.raises(ValueError, match="world"):
+        replica_placement(0, None, replicas=0)
+
+
+def test_shard_replicas_env_funnel(monkeypatch):
+    from adapcc_tpu.elastic.redundancy import shard_replicas
+
+    monkeypatch.delenv("ADAPCC_SHARD_REPLICAS", raising=False)
+    assert shard_replicas() == 1
+    assert shard_replicas(default=0) == 0
+    monkeypatch.setenv("ADAPCC_SHARD_REPLICAS", "2")
+    assert shard_replicas(default=0) == 2
+    monkeypatch.setenv("ADAPCC_SHARD_REPLICAS", "chatty")
+    with pytest.raises(ValueError, match="ADAPCC_SHARD_REPLICAS"):
+        shard_replicas()
+    monkeypatch.setenv("ADAPCC_SHARD_REPLICAS", "-1")
+    with pytest.raises(ValueError, match=">= 0"):
+        shard_replicas()
+
+
+def test_replica_store_capture_freshness_and_reconstruct(mesh4):
+    from adapcc_tpu.elastic.redundancy import ShardReplicaStore
+    from adapcc_tpu.parallel.fsdp import Zero1Optimizer
+
+    _, params = _tiny_params()
+    opt = Zero1Optimizer(optax.adam(1e-3), mesh4)
+    master, opt_state = opt.init(params)
+    pair = (np.asarray(master), jax.device_get(opt_state))
+
+    store = ShardReplicaStore(4, replicas=1)
+    # repair before any capture refuses loudly (replication must run
+    # before the first failure it is supposed to survive)
+    with pytest.raises(KeyError, match="no replica held"):
+        store.payload_for(2)
+    store.capture(pair, step=7)
+    assert store.captures == 1 and store.replica_step(2) == 7
+
+    # simulate rank 2's shard being lost: zero its rows, then reconstruct
+    lost_master = pair[0].copy()
+    lost_master[2] = 0.0
+    lost_opt = jax.tree_util.tree_map(
+        lambda leaf: _zero_row(leaf, 2, 4), pair[1]
+    )
+    fixed_master, fixed_opt = store.reconstruct(
+        (lost_master, lost_opt), dead=[2], step=7
+    )
+    np.testing.assert_array_equal(fixed_master, pair[0])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        fixed_opt,
+        pair[1],
+    )
+    # the freshness guard: a replica stamped with a different step refuses
+    # loudly rather than silently rewinding one shard's optimizer state
+    with pytest.raises(ValueError, match="rewind"):
+        store.reconstruct((lost_master, lost_opt), dead=[2], step=8)
+    with pytest.raises(ValueError, match="outside world"):
+        store.reconstruct((lost_master, lost_opt), dead=[9])
+    # store construction guards
+    with pytest.raises(ValueError, match="replicas >= 1"):
+        ShardReplicaStore(4, replicas=0)
+
+
+def _zero_row(leaf, rank, world):
+    arr = np.asarray(leaf)
+    if arr.ndim >= 1 and arr.shape[0] == world:
+        arr = arr.copy()
+        arr[rank] = 0
+    return arr
+
+
+def test_zero1_replica_repair_is_convergence_equivalent(mesh4):
+    """The acceptance property on the data plane: kill a rank's shard
+    mid-run, repair it from the in-fabric replica (NO checkpoint reload),
+    and training continues exactly like the uninterrupted run."""
+    from adapcc_tpu.elastic import recover_zero1_trainer_state
+
+    model, params = _tiny_params()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 5)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(8, 3)), jnp.float32)
+
+    def loss_fn(p, batch):
+        bx, by = batch
+        return jnp.mean((model.apply(p, bx) - by) ** 2)
+
+    def make():
+        return DDPTrainer(
+            loss_fn, optax.adam(1e-2), mesh4, Strategy.ring(4),
+            zero1=True, shard_replicas=1,
+        )
+
+    t = make()
+    s = t.init_state(params)
+    assert t.replica_store is not None
+    for _ in range(2):
+        s, _ = t.step(s, (x, y))
+    # the piggyback window ran every step, stamped with the completed step
+    assert t.replica_store.captures == 2
+    assert t.replica_store.replica_step(1) == 2
+
+    # branch B: rank 1's shard is lost (its HBM died with it) and is
+    # repaired from the step-2 replica; training resumes on the repaired
+    # state (repair FIRST — later captures overwrite the held rows, which
+    # is exactly what the freshness guard polices)
+    master, opt_state = np.asarray(s.opt_state[0]), jax.device_get(
+        s.opt_state[1]
+    )
+    master = master.copy()
+    master[1] = np.nan  # the dead rank's single-owner state is GONE
+    opt_state = jax.tree_util.tree_map(
+        lambda leaf: _nan_row(leaf, 1, 4), opt_state
+    )
+    broken = TrainState(
+        params=s.params, opt_state=(master, opt_state),
+        step=s.step, model_state=s.model_state,
+    )
+    sb = recover_zero1_trainer_state(t, broken, dead=[1], store=t.replica_store)
+    for _ in range(2):
+        sb, _ = t.step(sb, (x, y), step_idx=2)
+
+    # branch A: the uninterrupted twin on an identical fresh trainer
+    ta = make()
+    ta.init_state(params)
+    sa = s
+    for _ in range(2):
+        sa, _ = ta.step(sa, (x, y), step_idx=2)
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6
+        ),
+        sa.params,
+        sb.params,
+    )
+
+
+def _nan_row(leaf, rank, world):
+    arr = np.asarray(leaf)
+    if arr.ndim >= 1 and arr.shape[0] == world and np.issubdtype(
+        arr.dtype, np.floating
+    ):
+        arr = arr.copy()
+        arr[rank] = np.nan
+    return arr
+
+
+def test_grow_zero1_trainer_state_roundtrips_through_funnel(mesh8, mesh4):
+    """The rejoin path's grow-back: a world-4 ZeRO-1 state re-balances
+    onto the full world-8 mesh through the same layout-guard funnel as a
+    shrink, preserving canonical content exactly."""
+    from adapcc_tpu.elastic import grow_zero1_trainer_state
+    from adapcc_tpu.parallel.fsdp import _flatten_meta
+
+    model, params = _tiny_params()
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(8, 5)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(8, 3)), jnp.float32)
+
+    def loss_fn(p, batch):
+        bx, by = batch
+        return jnp.mean((model.apply(p, bx) - by) ** 2)
+
+    t4 = DDPTrainer(loss_fn, optax.adam(1e-2), mesh4, Strategy.ring(4), zero1=True)
+    s4 = t4.init_state(params)
+    for _ in range(2):
+        s4, _ = t4.step(s4, (x, y))
+
+    t8 = DDPTrainer(loss_fn, optax.adam(1e-2), mesh8, Strategy.ring(8), zero1=True)
+    t8.init_state(s4.params)
+    s8 = grow_zero1_trainer_state(t8, s4)
+    meta4 = _flatten_meta(params, 4, 1)
+    meta8 = _flatten_meta(params, 8, 1)
+    flat4 = np.asarray(s4.opt_state[0]).reshape(-1)[: meta4.total]
+    flat8 = np.asarray(s8.opt_state[0]).reshape(-1)[: meta8.total]
+    np.testing.assert_array_equal(flat4, flat8)
+    # and training continues on the grown world, convergence-equivalent
+    sa, sb = s4, s8
+    for _ in range(2):
+        sa, _ = t4.step(sa, (x, y), step_idx=2)
+        sb, _ = t8.step(sb, (x, y), step_idx=2)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6
+        ),
+        sa.params,
+        sb.params,
+    )
+    # direction guards: a grow that shrinks (or vice versa) is refused
+    with pytest.raises(ValueError, match="grow_zero1_trainer_state"):
+        grow_zero1_trainer_state(t4, s8)
+    with pytest.raises(ValueError, match="shrink_zero1_trainer_state"):
+        shrink_zero1_trainer_state(t8, s4)
+
+
+def test_trainer_shard_replicas_validation(mesh4, monkeypatch):
+    def loss_fn(p, batch):
+        return jnp.mean(p["w"] ** 2)
+
+    with pytest.raises(ValueError, match="requires zero1=True"):
+        DDPTrainer(
+            loss_fn, optax.adam(1e-2), mesh4, Strategy.ring(4),
+            shard_replicas=1,
+        )
+    # malformed env dies at construction, not at the first capture
+    monkeypatch.setenv("ADAPCC_SHARD_REPLICAS", "many")
+    with pytest.raises(ValueError, match="ADAPCC_SHARD_REPLICAS"):
+        DDPTrainer(
+            loss_fn, optax.adam(1e-2), mesh4, Strategy.ring(4), zero1=True,
+        )
+
+
+def test_replication_overhead_pricing_bounds():
+    """The sim terms behind make recovery-bench: k=1 upkeep under 5% of
+    step comm at the default config, repair strictly cheaper than a
+    checkpoint reload, replication off exactly free."""
+    from adapcc_tpu.sim.cost_model import (
+        DEFAULT_COEFFS,
+        ICI,
+        LinkCoeffs,
+        recovery_cost,
+        replica_repair_time,
+        replication_overhead_time,
+    )
+
+    coeffs = LinkCoeffs(*DEFAULT_COEFFS[ICI])
+    nbytes = 64 << 20
+    assert replication_overhead_time(8, 3 * nbytes, coeffs, replicas=0) == 0.0
+    one = replication_overhead_time(8, 3 * nbytes, coeffs, replicas=1)
+    two = replication_overhead_time(8, 3 * nbytes, coeffs, replicas=2)
+    assert 0.0 < one < two
+    cost = recovery_cost(32, nbytes, coeffs)
+    assert cost["replication_overhead_ratio"] < 0.05
+    assert cost["replica_repair_s"] < cost["ckpt_reload_s"]
+    assert cost["repair_speedup"] > 1.0
+    # warm swap is the point: a cold repair pays the compile on top
+    assert replica_repair_time(8, nbytes, coeffs, standby_cached=False) > (
+        replica_repair_time(8, nbytes, coeffs, standby_cached=True)
+    )
+    with pytest.raises(ValueError, match="replicas"):
+        replication_overhead_time(2, nbytes, coeffs, replicas=2)
+    with pytest.raises(ValueError, match="save_interval"):
+        recovery_cost(8, nbytes, coeffs, save_interval_steps=0)

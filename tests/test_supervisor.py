@@ -320,7 +320,9 @@ def test_supervisor_recovery_restores_base_plan(mesh4, tmp_path):
             logic.heartbeat_arrive(r, now=t)
         sup.poll(t)
     assert sorted(sup.worldview().alive) == [0, 1, 3]
-    # rank 2 comes back (the restarted process leases again)
+    # rank 2 comes back (a restarted/replacement process leases again):
+    # the rejoin protocol journals an ADMIT carrying the restart
+    # generation the newcomer's catch-up restore keys its rendezvous by
     logic.heartbeat_arrive(2, now=2.4)
     for r in (0, 1, 3):
         logic.heartbeat_arrive(r, now=2.4)
@@ -328,10 +330,31 @@ def test_supervisor_recovery_restores_base_plan(mesh4, tmp_path):
     wv = sup.worldview()
     assert sorted(wv.alive) == [0, 1, 2, 3] and wv.epoch == 2
     kinds = [d.kind for d in sup.journal.replay().decisions]
-    assert kinds[-3:] == ["recover", "epoch", "swap"]
+    assert kinds[-3:] == ["admit", "epoch", "swap"]
+    admit = next(
+        d for d in sup.journal.replay().decisions if d.kind == "admit"
+    )
+    assert admit.payload["rank"] == 2
+    assert admit.payload["origin"] == "heartbeat"
+    assert admit.payload["gen"] == logic.restart_generation == 1
     # the recovery swap is the base plan, warm by construction
     swap = sup.journal.replay().decisions[-1]
     assert swap.payload["label"] == "base" and swap.payload["warmed"]
+
+    # a supervisor restart replays the journaled admit and RE-SEEDS the
+    # admit counter into a fresh logic: without this, the next rejoin
+    # would reuse generation 1's rendezvous namespace and read the
+    # earlier rejoin's stale keys as its own
+    logic2 = CoordinatorLogic(4)
+    assert logic2.restart_generation == 0
+    Supervisor(
+        logic2,
+        engine,
+        cache=cache,
+        journal_path=sup.journal.path,
+        config=LivenessConfig(timeout_s=1.0, period_s=0.5, grace=2),
+    )
+    assert logic2.restart_generation == 1
     out = engine.all_reduce(x, epoch=sup.engine_epoch)
     assert float(np.asarray(out)[0, 0]) == 4.0
 
