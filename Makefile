@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench hier-bench elastic-bench adapt-bench chaos-bench fabric-bench recovery-bench trace-export clean
+.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench hier-bench elastic-bench adapt-bench chaos-bench fabric-bench recovery-bench serve-bench trace-export clean
 
 all: native
 
@@ -138,6 +138,19 @@ fabric-bench:
 recovery-bench:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--sizes 1M,64M --recovery-sweep --json
+
+# Latency-SLO serving frontier on the same simulator (docs/SERVING.md):
+# deterministic "mode": "simulated" rows over (arrival rate x decode
+# slots) — one seeded Poisson trace per rate replayed through the
+# continuous batcher's queueing twin, each cell priced by the decode-step
+# service time (per-layer small-message allreduce on the calibrated
+# coefficients + compute), with p50/p99 sojourn, throughput, utilization,
+# and SLO attainment stamped per row.  The frontier an admission policy
+# trades along, as a regression artifact.
+serve-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--world 8 --serve-sweep --rates 0.05,0.1,0.25 \
+		--serve-slots 1,2,4,8 --slo-ms 2 --json
 
 # Perfetto/chrome://tracing export of a recorded dispatch trace: run a
 # short virtual-pod collective session under ADAPCC_TUNER=record and emit

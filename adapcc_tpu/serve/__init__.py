@@ -1,0 +1,130 @@
+"""Latency-SLO inference serving plane (docs/SERVING.md).
+
+Every subsystem so far optimizes training throughput; the north-star
+traffic ("millions of users", ROADMAP) is *decode*: one token per step,
+per-token collectives far below the ~100 KB ring ↔ recursive-doubling
+crossover — exactly the regime the small-message plane
+(:mod:`adapcc_tpu.comm.latency`) was built for.  This package serves a
+tensor-parallel GPT-2 end to end through the adaptive-CC stack:
+
+- :mod:`adapcc_tpu.serve.trace` — deterministic synthetic request
+  traffic: seeded Poisson arrivals via ``jax.random``, replayable as a
+  JSON artifact through the one env→artifact funnel
+  (``ADAPCC_SERVE_TRACE``), so every latency claim is reproducible;
+- :mod:`adapcc_tpu.serve.kv_cache` — a slot-paged fixed-shape KV cache
+  laid out on the TP mesh (heads axis): admission claims a slot,
+  evict-on-EOS frees it for the next request **without retracing** (all
+  shapes static);
+- :mod:`adapcc_tpu.serve.model` — the head-sharded decode forward whose
+  per-token combine is ONE :meth:`CollectiveEngine.all_reduce` per layer,
+  so size-adaptive algorithm selection and dispatch tracing apply to
+  decode-step collectives — and whose token streams are **bit-identical**
+  to :func:`adapcc_tpu.models.gpt2_generate.generate` (each rank
+  contributes its head block into a zero-padded partial; the sum
+  re-associates nothing — fp32 exactness is what buys the parity, which
+  is why the quantized wire is NOT yet fused into the decode combine:
+  a lossy plane needs its own acceptance bar, ROADMAP item 3);
+- :mod:`adapcc_tpu.serve.scheduler` — the continuous batcher: per-request
+  admission into fixed decode slots, prefill/decode interleave (a newly
+  admitted request force-feeds prompt tokens while its neighbors decode),
+  per-request RNG streams, p50/p99 sojourn through the
+  :class:`~adapcc_tpu.utils.observability.MetricsRegistry` reservoir.
+
+Offline pricing lives in :mod:`adapcc_tpu.sim.cost_model` (the queueing
+extension: arrival rate × slots × per-token step time → the
+latency/throughput frontier ``make serve-bench`` emits), and the
+tail-aware tuner objective (``ADAPCC_TUNER_OBJECTIVE=p99``) lives in
+:mod:`adapcc_tpu.tuner.policy`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+#: fixed decode-slot count of the continuous batcher (env > arg > default)
+SERVE_SLOTS_ENV = "ADAPCC_SERVE_SLOTS"
+
+DEFAULT_SERVE_SLOTS = 4
+
+#: per-request sojourn SLO in milliseconds (env > arg > None = no SLO)
+SERVE_SLO_ENV = "ADAPCC_SERVE_SLO_MS"
+
+
+def resolve_serve_slots(explicit: Optional[int] = None) -> int:
+    """Decode-slot count in force: ``ADAPCC_SERVE_SLOTS`` env > the
+    caller's explicit value > :data:`DEFAULT_SERVE_SLOTS`.  Malformed or
+    non-positive values raise — a typo'd slot count silently serving a
+    different batch geometry would invalidate the latency numbers the
+    run was meant to produce (the ADAPCC_MERGE_ROUNDS policy)."""
+    env = os.environ.get(SERVE_SLOTS_ENV)
+    value: object = env if env is not None and env.strip() else explicit
+    if value is None:
+        return DEFAULT_SERVE_SLOTS
+    try:
+        slots = int(str(value).strip())
+    except ValueError as e:
+        raise ValueError(
+            f"{SERVE_SLOTS_ENV}={value!r}: expected a positive integer"
+        ) from e
+    if slots < 1:
+        raise ValueError(
+            f"{SERVE_SLOTS_ENV}={value!r}: slot count must be >= 1"
+        )
+    return slots
+
+
+def resolve_serve_slo_ms(explicit: Optional[float] = None) -> Optional[float]:
+    """Sojourn SLO in force: ``ADAPCC_SERVE_SLO_MS`` env > the caller's
+    explicit value > None (no SLO tracked).  Malformed / non-positive
+    values raise loudly (same policy as :func:`resolve_serve_slots`)."""
+    env = os.environ.get(SERVE_SLO_ENV)
+    value: object = env if env is not None and env.strip() else explicit
+    if value is None:
+        return None
+    try:
+        slo = float(str(value).strip())
+    except ValueError as e:
+        raise ValueError(
+            f"{SERVE_SLO_ENV}={value!r}: expected a positive number of "
+            "milliseconds"
+        ) from e
+    if slo <= 0:
+        raise ValueError(
+            f"{SERVE_SLO_ENV}={value!r}: the SLO must be > 0 ms"
+        )
+    return slo
+
+
+from adapcc_tpu.serve.kv_cache import SlotKVCache  # noqa: E402
+from adapcc_tpu.serve.model import TPDecodeModel  # noqa: E402
+from adapcc_tpu.serve.scheduler import (  # noqa: E402
+    GPT2Server,
+    Request,
+    RequestResult,
+)
+from adapcc_tpu.serve.trace import (  # noqa: E402
+    SERVE_TRACE_ENV,
+    ArrivalTrace,
+    RequestSpec,
+    load_serve_trace,
+    synthesize_arrival_trace,
+)
+
+__all__ = [
+    "ArrivalTrace",
+    "DEFAULT_SERVE_SLOTS",
+    "GPT2Server",
+    "Request",
+    "RequestResult",
+    "RequestSpec",
+    "SERVE_SLO_ENV",
+    "SERVE_SLOTS_ENV",
+    "SERVE_TRACE_ENV",
+    "SlotKVCache",
+    "TPDecodeModel",
+    "load_serve_trace",
+    "resolve_serve_slo_ms",
+    "resolve_serve_slots",
+    "synthesize_arrival_trace",
+]

@@ -1,0 +1,134 @@
+"""Slot-paged KV cache, sharded over the TP mesh by attention heads.
+
+The serving-time memory bottleneck is the KV cache, not the weights: one
+decode slot holds ``2 · n_layer · max_seq · d_model`` cache entries, and a
+fixed-slot continuous batcher keeps ``slots`` of them alive at once.  This
+module lays that state out as fixed-shape arrays
+
+    ``[world, slots, max_seq, n_head/world, head_dim]``  (per layer, K and V)
+
+with the leading axis sharded over the TP mesh — each rank materializes
+only its own heads' pages, which is exactly the Megatron head split the
+decode forward (:mod:`adapcc_tpu.serve.model`) computes attention over.
+
+Slot lifecycle is the whole point:
+
+- **admission** claims a free slot and zeroes its pages (one sliced
+  ``.set(0)`` per layer — a freed slot's stale keys are masked out of
+  attention anyway, but zeroed pages keep the cache state bit-identical
+  to a fresh ``generate`` cache, which the parity drill pins);
+- **evict-on-EOS** frees the slot immediately — the remaining tokens of a
+  finished stream are all EOS by the generate loop's own latch, so no
+  model step is owed — and the next admission **reuses the slot without
+  retracing**: every shape is static, so the compiled step programs are
+  cache hits for the entire life of the server.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from adapcc_tpu.models.gpt2 import GPT2Config
+
+
+class SlotKVCache:
+    """Per-layer (K, V) slot pages on the TP mesh.
+
+    The arrays are owned functionally: the decode step consumes and
+    returns them (`layers` is replaced wholesale each step), so the cache
+    object is a layout + lifecycle manager, not a mutable device buffer.
+    """
+
+    def __init__(
+        self,
+        cfg: GPT2Config,
+        world: int,
+        slots: int,
+        mesh=None,
+        axis_name: str = "ranks",
+    ) -> None:
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if world < 1:
+            raise ValueError(f"world must be >= 1, got {world}")
+        if cfg.n_head % world:
+            raise ValueError(
+                f"n_head={cfg.n_head} must divide over the TP world "
+                f"{world} (head-sharded cache pages)"
+            )
+        self.cfg = cfg
+        self.world = int(world)
+        self.slots = int(slots)
+        self.heads_local = cfg.n_head // world
+        self.head_dim = cfg.d_model // cfg.n_head
+        shape = (
+            self.world, self.slots, cfg.max_seq, self.heads_local,
+            self.head_dim,
+        )
+        self._sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._sharding = NamedSharding(mesh, P(axis_name))
+        #: per layer: (k_pages, v_pages), each [world, slots, max_seq, Hl, hd]
+        self.layers: List[Tuple[jnp.ndarray, jnp.ndarray]] = [
+            (self._place(jnp.zeros(shape, cfg.dtype)),
+             self._place(jnp.zeros(shape, cfg.dtype)))
+            for _ in range(cfg.n_layer)
+        ]
+
+    def _place(self, arr: jnp.ndarray) -> jnp.ndarray:
+        if self._sharding is not None:
+            return jax.device_put(arr, self._sharding)
+        return arr
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def clear_slot(self, slot: int) -> None:
+        """Zero one slot's pages across all layers (admission hygiene:
+        the fresh-cache state ``generate`` starts from)."""
+        if not 0 <= slot < self.slots:
+            raise ValueError(f"slot {slot} outside [0, {self.slots})")
+        self.layers = [
+            (k.at[:, slot].set(0), v.at[:, slot].set(0))
+            for k, v in self.layers
+        ]
+
+    def update(
+        self, layer: int, k_pages: jnp.ndarray, v_pages: jnp.ndarray
+    ) -> None:
+        """Adopt one layer's post-step pages (the decode step's output)."""
+        self.layers[layer] = (k_pages, v_pages)
+
+    # -- layout ----------------------------------------------------------------
+
+    @property
+    def nbytes_per_rank(self) -> int:
+        """One rank's cache footprint — the number that scales as
+        ``1/world`` and makes head sharding worth it."""
+        k, _ = self.layers[0]
+        per_layer = 2 * k.nbytes // self.world
+        return per_layer * self.cfg.n_layer
+
+    def layout(self) -> dict:
+        """Artifact row describing the paging geometry."""
+        return {
+            "layers": self.cfg.n_layer,
+            "world": self.world,
+            "slots": self.slots,
+            "max_seq": self.cfg.max_seq,
+            "heads_local": self.heads_local,
+            "head_dim": self.head_dim,
+            "dtype": jnp.dtype(self.cfg.dtype).name,
+            "nbytes_per_rank": self.nbytes_per_rank,
+        }
+
+    def __repr__(self) -> str:
+        return (
+            f"SlotKVCache(layers={self.cfg.n_layer}, world={self.world}, "
+            f"slots={self.slots}, max_seq={self.cfg.max_seq}, "
+            f"heads_local={self.heads_local}, head_dim={self.head_dim})"
+        )

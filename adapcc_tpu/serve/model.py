@@ -1,0 +1,276 @@
+"""Tensor-parallel GPT-2 decode forward with bit-exact batching.
+
+The serving plane's correctness bar (ISSUE 14) is brutal: a batched,
+head-sharded decode step must emit **the same bits** as the same request
+run alone through :func:`adapcc_tpu.models.gpt2_generate.generate`.  Two
+construction rules buy that:
+
+1. **Slot independence.**  Every op outside attention's head split is
+   row-wise in the slot axis (embeds, LayerNorms, Dense matmuls contract
+   over features only, softmax is per-row), and the flax modules applied
+   here are the *same module classes with the same params* the training
+   model uses — not a reimplementation — so slot ``s`` of a batched step
+   computes exactly what a ``B=1`` step computes.
+
+2. **A re-association-free collective.**  The Megatron row-parallel psum
+   would split the ``d_model`` contraction across ranks and re-associate
+   the sum — goodbye bit parity.  Instead attention is **head-sharded**:
+   rank ``r`` owns heads ``[r·Hl, (r+1)·Hl)`` and its slice of the KV
+   cache, computes its heads' attention outputs (einsums are elementwise
+   in the head axis, so each slice is bitwise the reference's), and
+   scatters them into a zero-padded ``[world, S, 1, d_model]`` partial.
+   The per-token collective is then ONE
+   :meth:`~adapcc_tpu.comm.engine.CollectiveEngine.all_reduce` per layer
+   whose sum touches each element exactly once (``x + 0 = x``) — the
+   combine is a concatenation wearing an allreduce's clothes, so the
+   size-adaptive algorithm selection (ring vs recursive doubling vs
+   tree) and the dispatch tracing of the engine apply to decode-step
+   traffic, and the math stays exact.  (The quantized wire is
+   deliberately NOT part of this combine: fp32 exactness is what buys
+   the bit parity — a lossy decode plane needs its own acceptance bar,
+   ROADMAP item 3.)
+
+The payload per dispatch is ``slots · d_model`` elements — hundreds of
+bytes to a few KB, far below the ~100 KB crossover — so under
+``algo="auto"`` a power-of-two world rides the recursive-doubling plane
+(docs/LATENCY.md), which is the whole reason the serving plane exists as
+a workload for the adaptive-CC stack.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from adapcc_tpu.models.gpt2 import GPT2Config
+from adapcc_tpu.models.gpt2_generate import sample_token
+
+
+class TPDecodeModel:
+    """Head-sharded one-token-per-step decode programs for one config.
+
+    All entry points are jitted once per shape (slots is fixed by the
+    batcher), layer params are *arguments* so one compiled program serves
+    every layer, and nothing here retraces across the server's lifetime —
+    slot reuse is free.
+    """
+
+    def __init__(
+        self,
+        cfg: GPT2Config,
+        world: int,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        top_p: float = 0.0,
+    ) -> None:
+        if cfg.n_head % world:
+            raise ValueError(
+                f"n_head={cfg.n_head} must divide over the TP world {world}"
+            )
+        if cfg.d_model % cfg.n_head:
+            raise ValueError(
+                f"d_model={cfg.d_model} must divide over n_head={cfg.n_head}"
+            )
+        self.cfg = cfg
+        self.world = int(world)
+        self.heads_local = cfg.n_head // world
+        self.head_dim = cfg.d_model // cfg.n_head
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.embed = jax.jit(self._embed)
+        self.attn_partial = jax.jit(self._attn_partial)
+        self.post_attn = jax.jit(self._post_attn)
+        self.logits = jax.jit(self._logits)
+        self.sample = jax.jit(self._sample)
+
+    # -- per-step programs -----------------------------------------------------
+
+    def _embed(
+        self, params: Any, tok: jnp.ndarray, pos: jnp.ndarray
+    ) -> jnp.ndarray:
+        """``tok [S, 1] int32``, ``pos [S] int32`` → ``x [S, 1, C]``.
+
+        Same modules + params as ``GPT2.__call__``: token and (per-slot)
+        position embeddings added elementwise, dropout is identity at
+        serving time (deterministic)."""
+        cfg = self.cfg
+        wte = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype)
+        wpe = nn.Embed(cfg.max_seq, cfg.d_model, dtype=cfg.dtype)
+        return (
+            wte.apply({"params": params["wte"]}, tok)
+            + wpe.apply({"params": params["wpe"]}, pos[:, None])
+        )
+
+    def _attn_partial(
+        self,
+        layer_params: Any,
+        x: jnp.ndarray,
+        k_pages: jnp.ndarray,
+        v_pages: jnp.ndarray,
+        pos: jnp.ndarray,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """One layer's pre-collective half: ln1 + qkv (replicated), the
+        per-slot cache write at each slot's own position, and the
+        head-sharded attention — returning the zero-padded stacked
+        partial ``[world, S, 1, C]`` ready for ``engine.all_reduce``.
+
+        Mirrors ``CausalSelfAttention.__call__``'s decode branch op for
+        op (same einsum strings, the same fp32 cast + ``-1e30`` mask +
+        softmax dtype round-trip), with the scalar ``cache_index``
+        generalized to a per-slot position.
+        """
+        cfg = self.cfg
+        world, Hl, hd = self.world, self.heads_local, self.head_dim
+        S = x.shape[0]
+        h = nn.LayerNorm(dtype=jnp.float32).apply(
+            {"params": layer_params["ln1"]}, x
+        )
+        qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.dtype).apply(
+            {"params": layer_params["attn"]["qkv"]}, h
+        )
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def shard_heads(t: jnp.ndarray) -> jnp.ndarray:
+            # [S, 1, C] → [world, S, 1, Hl, hd]: rank w's contiguous heads
+            return jnp.moveaxis(t.reshape(S, 1, world, Hl, hd), 2, 0)
+
+        q_s = shard_heads(q)
+        k_s = shard_heads(k).astype(cfg.dtype)
+        v_s = shard_heads(v).astype(cfg.dtype)
+
+        def write_slot(pages, new, p):
+            # pages [max_seq, Hl, hd] ← new [1, Hl, hd] at row p
+            return jax.lax.dynamic_update_slice(pages, new, (p, 0, 0))
+
+        write = jax.vmap(  # over world (pos shared)
+            jax.vmap(write_slot, in_axes=(0, 0, 0)), in_axes=(0, 0, None)
+        )
+        k_pages = write(k_pages, k_s, pos)
+        v_pages = write(v_pages, v_s, pos)
+
+        scale = 1.0 / np.sqrt(hd)
+        att = (
+            jnp.einsum("wsqhd,wskhd->wshqk", q_s, k_pages).astype(jnp.float32)
+            * scale
+        )
+        valid = jnp.arange(cfg.max_seq) <= pos[:, None]  # [S, max_seq]
+        att = jnp.where(valid[None, :, None, None, :], att, -1e30)
+        att = jax.nn.softmax(att, axis=-1).astype(cfg.dtype)
+        out = jnp.einsum("wshqk,wskhd->wsqhd", att, v_pages)
+        out = out.reshape(world, S, 1, Hl * hd)
+        # rank w's heads land at their concat offset; every other element
+        # is an exact zero, so the allreduce's sum is a concatenation
+        partial = jnp.zeros((world, S, 1, cfg.d_model), cfg.dtype)
+        for w in range(world):
+            partial = partial.at[
+                w, :, :, w * Hl * hd : (w + 1) * Hl * hd
+            ].set(out[w])
+        return partial, k_pages, v_pages
+
+    def _post_attn(
+        self, layer_params: Any, x: jnp.ndarray, attn_full: jnp.ndarray
+    ) -> jnp.ndarray:
+        """One layer's post-collective half (replicated): the residual
+        projection of the gathered head concat, then the MLP — the same
+        module stack as ``Block.__call__`` after attention."""
+        cfg = self.cfg
+        proj = nn.Dense(cfg.d_model, dtype=cfg.dtype).apply(
+            {"params": layer_params["attn"]["proj"]}, attn_full
+        )
+        x = x + proj
+        h = nn.LayerNorm(dtype=jnp.float32).apply(
+            {"params": layer_params["ln2"]}, x
+        )
+        h = nn.Dense(4 * cfg.d_model, dtype=cfg.dtype).apply(
+            {"params": layer_params["fc"]}, h
+        )
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, dtype=cfg.dtype).apply(
+            {"params": layer_params["proj"]}, h
+        )
+        return x + h
+
+    def _logits(self, params: Any, x: jnp.ndarray) -> jnp.ndarray:
+        """Final LayerNorm + the weight-tied LM head (``GPT2.__call__``'s
+        closing lines, same cast order)."""
+        cfg = self.cfg
+        x = nn.LayerNorm(dtype=jnp.float32).apply(
+            {"params": params["ln_f"]}, x
+        )
+        wte = params["wte"]["embedding"]
+        logits = x.astype(cfg.dtype) @ wte.T.astype(cfg.dtype)
+        return logits.astype(jnp.float32)
+
+    def _sample(
+        self, rng: jnp.ndarray, logits: jnp.ndarray
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Per-slot RNG split + sample: ``rng [S, 2]``, ``logits
+        [S, 1, V]`` → ``(rng', sampled [S])``.
+
+        Each slot advances **its own** key exactly the way the generate
+        scan advances its single key (`split` then sample with the
+        subkey), and samples over its own ``[1, V]`` row — under ``vmap``
+        both the threefry bits and the filtered categorical are
+        elementwise in the slot axis, so slot ``s`` draws the same token
+        the one-at-a-time reference draws at the same position.
+        """
+        sample = functools.partial(
+            sample_token,
+            temperature=self.temperature,
+            top_k=self.top_k,
+            top_p=self.top_p,
+        )
+
+        def one(key: jnp.ndarray, lg: jnp.ndarray):
+            key, sub = jax.random.split(key)
+            return key, sample(sub, lg)[0]
+
+        return jax.vmap(one)(rng, logits)
+
+    # -- one full decode step --------------------------------------------------
+
+    def decode_step(
+        self,
+        params: Any,
+        engine,
+        cache_layers: List[Tuple[jnp.ndarray, jnp.ndarray]],
+        tok: jnp.ndarray,
+        pos: jnp.ndarray,
+        rng: jnp.ndarray,
+        algo: Optional[str] = "auto",
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, List[Tuple[jnp.ndarray, jnp.ndarray]]]:
+        """One token for every slot: embed → per layer (attention partial
+        → ``engine.all_reduce`` → MLP) → logits → per-slot sample.
+
+        Returns ``(rng', sampled [S], new_cache_layers)``.  The per-layer
+        allreduce is the ONLY cross-rank exchange; its executed algorithm
+        (and wire dtype, and tuner provenance) lands in the engine's
+        dispatch trace like any training collective.
+        """
+        x = self.embed(params, tok, pos)
+        new_layers: List[Tuple[jnp.ndarray, jnp.ndarray]] = []
+        for layer in range(self.cfg.n_layer):
+            lp = params[f"h{layer}"]
+            k_pages, v_pages = cache_layers[layer]
+            partial, k_pages, v_pages = self.attn_partial(
+                lp, x, k_pages, v_pages, pos
+            )
+            new_layers.append((k_pages, v_pages))
+            full = engine.all_reduce(partial, algo=algo)
+            x = self.post_attn(lp, x, full[0])
+        logits = self.logits(params, x)
+        rng, sampled = self.sample(rng, logits)
+        return rng, sampled, new_layers
+
+    @property
+    def collective_bytes(self) -> int:
+        """Per-rank payload of one decode-step allreduce, for one slot —
+        multiply by the batcher's slot count for the dispatch size the
+        tuner/selector sees."""
+        return self.cfg.d_model * jnp.dtype(self.cfg.dtype).itemsize

@@ -1,0 +1,400 @@
+"""The continuous batcher: per-request admission into fixed decode slots.
+
+One :class:`GPT2Server` owns ``slots`` decode lanes over a TP mesh.  Each
+engine step advances **every occupied lane by one token**: lanes still
+inside their prompt force-feed the next prompt token (prefill), lanes
+past it sample (decode) — so prefill and decode interleave in one fixed-
+shape compiled step and admission never waits for a batch boundary
+(continuous batching, Orca-style, at token granularity).
+
+The step semantics are a transliteration of the ``generate`` scan body
+(:mod:`adapcc_tpu.models.gpt2_generate`) with the scan index generalized
+to a per-slot position and the EOS latch moved to the host:
+
+- every occupied lane splits its own RNG every step (prefill steps too —
+  that is what keeps lane streams bit-identical to a one-at-a-time
+  ``generate`` run with the same per-request key);
+- a sampled EOS at a generated position latches the stream: every later
+  position is EOS by construction, so the lane is **evicted immediately**
+  and its remaining tokens filled host-side — zero model steps owed, and
+  the freed slot admits the next queued request without retracing;
+- completion (position ``total − 1`` written) frees the slot at end of
+  step; admission happens at start of step — a freed slot serves new
+  traffic on the next step, exactly the discipline the queueing model in
+  :mod:`adapcc_tpu.sim.cost_model` prices offline.
+
+Latency accounting runs on two clocks: the deterministic **step clock**
+(sojourn/TTFT in decode steps — byte-reproducible, what tests pin) and
+the wall clock (per-step and per-request seconds through the
+:class:`~adapcc_tpu.utils.observability.MetricsRegistry` reservoir, what
+the SLO attainment and the p99 tuner objective consume).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from adapcc_tpu.models.gpt2 import GPT2Config
+from adapcc_tpu.serve import resolve_serve_slo_ms, resolve_serve_slots
+from adapcc_tpu.serve.kv_cache import SlotKVCache
+from adapcc_tpu.serve.model import TPDecodeModel
+from adapcc_tpu.serve.trace import ArrivalTrace, RequestSpec
+from adapcc_tpu.utils.observability import (
+    MetricsRegistry,
+    nearest_rank_percentile,
+)
+
+
+@dataclass
+class Request:
+    """A live request (the scheduler-side spelling of a RequestSpec)."""
+
+    req_id: int
+    prompt: List[int]
+    max_new_tokens: int
+    seed: int
+    arrival_step: int = 0
+
+    @classmethod
+    def from_spec(cls, spec: RequestSpec) -> "Request":
+        return cls(
+            req_id=spec.req_id,
+            prompt=list(spec.prompt),
+            max_new_tokens=spec.max_new_tokens,
+            seed=spec.seed,
+            arrival_step=spec.arrival_step,
+        )
+
+    @property
+    def total(self) -> int:
+        return len(self.prompt) + self.max_new_tokens
+
+
+@dataclass
+class RequestResult:
+    """One served request: the token stream plus its latency ledger."""
+
+    req_id: int
+    tokens: List[int]
+    prompt_len: int
+    arrival_step: int
+    admitted_step: int
+    #: step at which the first *generated* token was written
+    first_token_step: int = -1
+    completed_step: int = -1
+    #: True when the stream ended on a latched EOS before max_new_tokens
+    eos_evicted: bool = False
+    #: wall seconds from ARRIVAL to completion (the SLO clock — queue
+    #: wait included, matching the step-clock sojourn convention and the
+    #: sim twin's attainment)
+    wall_s: float = 0.0
+
+    @property
+    def sojourn_steps(self) -> int:
+        """Arrival → completion in decode steps (queue wait included)."""
+        return self.completed_step - self.arrival_step
+
+    @property
+    def ttft_steps(self) -> int:
+        """Arrival → first generated token, in decode steps."""
+        return self.first_token_step - self.arrival_step
+
+    @property
+    def generated(self) -> List[int]:
+        return self.tokens[self.prompt_len:]
+
+
+@dataclass
+class _Lane:
+    """One occupied decode slot's host state."""
+
+    req: Request
+    admitted_step: int
+    #: tokens written so far (prompt pre-filled); grows to req.total
+    tokens: np.ndarray = field(default=None)  # type: ignore[assignment]
+    #: scan position: index of the token the NEXT step feeds
+    pos: int = 0
+    first_token_step: int = -1
+    wall_t0: float = 0.0
+
+
+class GPT2Server:
+    """Continuous-batching GPT-2 server on one TP mesh.
+
+    ``algo`` is handed to every decode-step ``engine.all_reduce`` —
+    ``"auto"`` (default) lets the calibrated crossover / tuner pick the
+    small-message plane; ``ADAPCC_COLL_ALGO`` still outranks it (the
+    engine's standing precedence).  Sampling parameters are server-wide
+    and static, mirroring ``generate``'s static arguments.
+    """
+
+    def __init__(
+        self,
+        cfg: GPT2Config,
+        params: Any,
+        mesh,
+        slots: Optional[int] = None,
+        temperature: float = 1.0,
+        top_k: int = 0,
+        top_p: float = 0.0,
+        eos_id: Optional[int] = None,
+        algo: Optional[str] = "auto",
+        engine=None,
+        trace=None,
+        metrics: Optional[MetricsRegistry] = None,
+        slo_ms: Optional[float] = None,
+    ) -> None:
+        self.cfg = cfg
+        self.params = params
+        self.mesh = mesh
+        self.world = int(mesh.devices.size)
+        self.slots = resolve_serve_slots(slots)
+        self.eos_id = eos_id
+        self.algo = algo
+        self.slo_ms = resolve_serve_slo_ms(slo_ms)
+        if engine is None:
+            from adapcc_tpu.comm.engine import CollectiveEngine
+            from adapcc_tpu.strategy.ir import Strategy
+
+            engine = CollectiveEngine(
+                mesh, Strategy.ring(self.world), trace=trace
+            )
+        self.engine = engine
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        self.tp = TPDecodeModel(
+            cfg, self.world, temperature=temperature, top_k=top_k, top_p=top_p
+        )
+        self.cache = SlotKVCache(cfg, self.world, self.slots, mesh=mesh)
+        self.clock = 0
+        self._pending: Deque[Request] = deque()
+        self._lanes: Dict[int, _Lane] = {}
+        self._free: List[int] = list(range(self.slots))
+        self._results: Dict[int, RequestResult] = {}
+        #: req_id → wall time its arrival step was first reached: the SLO
+        #: clock starts at ARRIVAL, not admission, or queue wait would be
+        #: invisible to attainment exactly in the overload regime the SLO
+        #: exists for (the sim twin's sojourn convention)
+        self._arrival_wall: Dict[int, float] = {}
+        #: per-slot RNG keys, advanced only for occupied lanes
+        self._rng = jnp.zeros((self.slots, 2), jnp.uint32)
+
+    # -- admission -------------------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        if req.total > self.cfg.max_seq:
+            raise ValueError(
+                f"request {req.req_id}: {req.total} tokens > "
+                f"max_seq={self.cfg.max_seq} cache slots"
+            )
+        if req.max_new_tokens < 1:
+            raise ValueError(
+                f"request {req.req_id}: max_new_tokens must be >= 1"
+            )
+        if not req.prompt:
+            raise ValueError(f"request {req.req_id}: empty prompt")
+        bad = [t for t in req.prompt if not 0 <= t < self.cfg.vocab_size]
+        if bad:
+            # nn.Embed's gather would silently clamp an out-of-range id
+            # under jit — the server would serve different traffic than
+            # the trace claims (the set-but-broken → loud artifact policy)
+            raise ValueError(
+                f"request {req.req_id}: prompt token(s) {bad[:3]} outside "
+                f"vocab_size={self.cfg.vocab_size}"
+            )
+        self._pending.append(req)
+
+    def submit_trace(self, trace: ArrivalTrace) -> None:
+        if trace.world != self.world:
+            raise ValueError(
+                f"arrival trace was authored for world={trace.world} but "
+                f"this server runs world={self.world}"
+            )
+        for spec in trace.requests:
+            self.submit(Request.from_spec(spec))
+
+    def _admit(self) -> None:
+        while self._free and self._pending and (
+            self._pending[0].arrival_step <= self.clock
+        ):
+            req = self._pending.popleft()
+            slot = self._free.pop(0)
+            lane = _Lane(req=req, admitted_step=self.clock)
+            lane.tokens = np.zeros((req.total,), np.int32)
+            lane.tokens[: len(req.prompt)] = np.asarray(req.prompt, np.int32)
+            lane.wall_t0 = time.perf_counter()
+            self._lanes[slot] = lane
+            self.cache.clear_slot(slot)
+            self._rng = self._rng.at[slot].set(
+                jax.random.PRNGKey(req.seed)
+            )
+            self.metrics.incr("serve.admitted")
+
+    # -- the decode step -------------------------------------------------------
+
+    def step(self) -> int:
+        """Admit, then advance every occupied lane by one token.  Returns
+        the number of lanes that made progress (0 = idle tick: queue
+        empty or all arrivals in the future)."""
+        now = time.perf_counter()
+        for req in self._pending:
+            # the SLO clock starts when the arrival step is reached, even
+            # if no slot is free yet — queue wait is sojourn, not overhead
+            if req.arrival_step > self.clock:
+                break  # arrival-sorted FIFO (the discipline _admit assumes)
+            self._arrival_wall.setdefault(req.req_id, now)
+        self._admit()
+        active = sorted(self._lanes)
+        if not active:
+            self.clock += 1
+            return 0
+        t0 = time.perf_counter()
+        tok = np.zeros((self.slots, 1), np.int32)
+        pos = np.zeros((self.slots,), np.int32)
+        for s in active:
+            lane = self._lanes[s]
+            tok[s, 0] = lane.tokens[lane.pos]
+            pos[s] = lane.pos
+        self._rng, sampled, new_layers = self.tp.decode_step(
+            self.params,
+            self.engine,
+            self.cache.layers,
+            jnp.asarray(tok),
+            jnp.asarray(pos),
+            self._rng,
+            algo=self.algo,
+        )
+        for layer, (k_pages, v_pages) in enumerate(new_layers):
+            self.cache.update(layer, k_pages, v_pages)
+        sampled_host = np.asarray(sampled)
+        self.metrics.observe("serve.step_s", time.perf_counter() - t0)
+        self.metrics.gauge("serve.slots_busy", len(active))
+        self.metrics.gauge("serve.queue_depth", len(self._pending))
+        for s in active:
+            self._advance_lane(s, int(sampled_host[s]))
+        self.clock += 1
+        return len(active)
+
+    def _advance_lane(self, slot: int, sampled: int) -> None:
+        """The generate scan body's host half for one lane: forced prompt
+        vs sampled write, EOS eviction.  The scan's carried ``done`` latch
+        has no host-side twin on purpose: it exists only because a scan
+        cannot stop early — here the step that WRITES an EOS at a
+        generated position evicts (or completes) the lane below, so no
+        lane ever survives to feed an EOS back in."""
+        lane = self._lanes[slot]
+        req = lane.req
+        t = lane.pos
+        prompt_len = len(req.prompt)
+        if t + 1 >= prompt_len:
+            lane.tokens[t + 1] = sampled
+            if t + 1 == prompt_len:
+                # the step that wrote the token ends at clock+1 — the same
+                # convention completed_step uses, so TTFT and sojourn
+                # percentiles count engine steps identically
+                lane.first_token_step = self.clock + 1
+        # else: position t+1 is a forced prompt token, already in place
+        lane.pos = t + 1
+        wrote_eos = (
+            self.eos_id is not None
+            and t + 1 >= prompt_len
+            and int(lane.tokens[t + 1]) == self.eos_id
+        )
+        if wrote_eos and lane.pos < req.total - 1:
+            # the latch makes every later position EOS: fill host-side and
+            # evict — the freed slot serves the queue next step, and no
+            # compiled program is owed for the tail
+            lane.tokens[lane.pos + 1:] = self.eos_id
+            self.metrics.incr("serve.evicted_eos")
+            self._complete(slot, eos_evicted=True)
+            return
+        if lane.pos == req.total - 1:
+            self._complete(slot, eos_evicted=False)
+
+    def _complete(self, slot: int, eos_evicted: bool) -> None:
+        lane = self._lanes.pop(slot)
+        self._free.append(slot)
+        self._free.sort()
+        req = lane.req
+        wall = time.perf_counter() - self._arrival_wall.pop(
+            req.req_id, lane.wall_t0
+        )
+        result = RequestResult(
+            req_id=req.req_id,
+            tokens=[int(x) for x in lane.tokens],
+            prompt_len=len(req.prompt),
+            arrival_step=req.arrival_step,
+            admitted_step=lane.admitted_step,
+            first_token_step=lane.first_token_step,
+            completed_step=self.clock + 1,
+            eos_evicted=eos_evicted,
+            wall_s=wall,
+        )
+        self._results[req.req_id] = result
+        self.metrics.incr("serve.completed")
+        self.metrics.observe("serve.sojourn_steps", result.sojourn_steps)
+        if result.first_token_step >= 0:
+            self.metrics.observe("serve.ttft_steps", result.ttft_steps)
+        self.metrics.observe("serve.sojourn_s", wall)
+
+    # -- the drive loop --------------------------------------------------------
+
+    def run(self, max_steps: Optional[int] = None) -> List[RequestResult]:
+        """Step until every submitted request completes (or ``max_steps``
+        elapses — loudly: an under-budgeted drive must not return a
+        partial ledger as if it were the full one)."""
+        budget = max_steps if max_steps is not None else 1_000_000
+        steps = 0
+        while self._pending or self._lanes:
+            if steps >= budget:
+                raise RuntimeError(
+                    f"serve run exceeded max_steps={budget} with "
+                    f"{len(self._pending)} queued / {len(self._lanes)} "
+                    "in-flight requests"
+                )
+            self.step()
+            steps += 1
+        return self.results()
+
+    def results(self) -> List[RequestResult]:
+        return [self._results[k] for k in sorted(self._results)]
+
+    def summary(self) -> dict:
+        """The serving ledger: deterministic step-clock percentiles plus
+        the wall-clock SLO attainment."""
+        res = self.results()
+        snap = self.metrics.snapshot()
+        out: dict = {
+            "requests": len(res),
+            "slots": self.slots,
+            "world": self.world,
+            "steps": self.clock,
+            "kv_cache": self.cache.layout(),
+        }
+        if res:
+            sojourns = sorted(r.sojourn_steps for r in res)
+            ttfts = sorted(r.ttft_steps for r in res if r.first_token_step >= 0)
+
+            def pct(xs, q):
+                return int(nearest_rank_percentile(xs, q))
+
+            out["p50_sojourn_steps"] = pct(sojourns, 0.50)
+            out["p99_sojourn_steps"] = pct(sojourns, 0.99)
+            if ttfts:
+                out["p50_ttft_steps"] = pct(ttfts, 0.50)
+                out["p99_ttft_steps"] = pct(ttfts, 0.99)
+        step_t = snap["timings"].get("serve.step_s")
+        if step_t:
+            out["p50_step_ms"] = step_t["p50_s"] * 1e3
+            out["p99_step_ms"] = step_t["p99_s"] * 1e3
+        if self.slo_ms is not None and res:
+            within = sum(1 for r in res if r.wall_s * 1e3 <= self.slo_ms)
+            out["slo_ms"] = self.slo_ms
+            out["slo_attainment"] = within / len(res)
+        return out

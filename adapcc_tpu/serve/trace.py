@@ -1,0 +1,239 @@
+"""Deterministic synthetic request traffic for the serving plane.
+
+The Big Send-off's lesson (PAPERS.md) is that collectives must be priced
+against tail latency *under real traffic*, not medians under a benchmark
+loop — so every serving claim in this repo is driven by an explicit
+arrival trace: seeded Poisson inter-arrival gaps (``jax.random``, so two
+runs of the same seed produce the same trace on any backend), per-request
+prompts/lengths/RNG seeds, all replayable from a JSON artifact through
+the one env→artifact funnel (:mod:`adapcc_tpu.utils.artifacts`,
+``ADAPCC_SERVE_TRACE``) exactly like fault plans and congestion profiles.
+
+Arrival times are measured in **decode steps** (the scheduler's virtual
+clock), not wall seconds: the continuous batcher admits at step
+boundaries, so step-granular arrivals are what it can actually observe,
+and they keep the trace — and every latency percentile derived from it —
+byte-reproducible.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import List, Mapping, Optional, Sequence, Tuple
+
+from adapcc_tpu.utils.artifacts import load_env_json_artifact
+
+#: env var naming a JSON arrival-trace artifact to replay
+SERVE_TRACE_ENV = "ADAPCC_SERVE_TRACE"
+
+
+@dataclass(frozen=True)
+class RequestSpec:
+    """One request of an arrival trace."""
+
+    req_id: int
+    #: decode step (virtual clock) at which the request becomes admissible
+    arrival_step: int
+    prompt: Tuple[int, ...]
+    max_new_tokens: int
+    #: per-request RNG seed: the serving plane samples this request with
+    #: ``jax.random.PRNGKey(seed)``, the same key a one-at-a-time
+    #: ``gpt2_generate.generate`` reference run would use — the handle the
+    #: bit-identity drill holds on to
+    seed: int
+
+    def __post_init__(self) -> None:
+        if self.arrival_step < 0:
+            raise ValueError(
+                f"request {self.req_id}: arrival_step must be >= 0, got "
+                f"{self.arrival_step}"
+            )
+        if not self.prompt:
+            raise ValueError(f"request {self.req_id}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"request {self.req_id}: max_new_tokens must be >= 1, got "
+                f"{self.max_new_tokens} (a request that decodes nothing is "
+                "not serving traffic)"
+            )
+
+    @property
+    def total_tokens(self) -> int:
+        return len(self.prompt) + self.max_new_tokens
+
+    @property
+    def service_steps(self) -> int:
+        """Engine steps the request occupies a decode slot: the scan
+        length of the equivalent ``generate`` call (``total − 1``)."""
+        return self.total_tokens - 1
+
+    def to_dict(self) -> dict:
+        return {
+            "req_id": self.req_id,
+            "arrival_step": self.arrival_step,
+            "prompt": list(self.prompt),
+            "max_new_tokens": self.max_new_tokens,
+            "seed": self.seed,
+        }
+
+    @classmethod
+    def from_dict(cls, obj: Mapping) -> "RequestSpec":
+        return cls(
+            req_id=int(obj["req_id"]),
+            arrival_step=int(obj["arrival_step"]),
+            prompt=tuple(int(t) for t in obj["prompt"]),
+            max_new_tokens=int(obj["max_new_tokens"]),
+            seed=int(obj["seed"]),
+        )
+
+
+@dataclass
+class ArrivalTrace:
+    """A replayable arrival schedule (the serving analog of a FaultPlan).
+
+    ``world`` is the TP world the trace was authored for — validated by
+    the env funnel so a trace authored for one mesh can never silently
+    drive another (prompt vocab / head split assumptions ride on it).
+    """
+
+    world: int
+    seed: int
+    requests: List[RequestSpec] = field(default_factory=list)
+    label: str = ""
+
+    def __post_init__(self) -> None:
+        if self.world < 1:
+            raise ValueError(f"world must be >= 1, got {self.world}")
+        steps = [r.arrival_step for r in self.requests]
+        if steps != sorted(steps):
+            raise ValueError(
+                "arrival trace requests must be sorted by arrival_step "
+                "(the batcher admits FIFO)"
+            )
+
+    def to_dict(self) -> dict:
+        return {
+            "world": self.world,
+            "seed": self.seed,
+            "label": self.label,
+            "requests": [r.to_dict() for r in self.requests],
+        }
+
+    @classmethod
+    def from_dict(cls, obj: Mapping) -> "ArrivalTrace":
+        return cls(
+            world=int(obj["world"]),
+            seed=int(obj["seed"]),
+            label=str(obj.get("label", "")),
+            requests=[RequestSpec.from_dict(r) for r in obj["requests"]],
+        )
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, sort_keys=True, indent=1)
+        return path
+
+    def __len__(self) -> int:
+        return len(self.requests)
+
+
+def synthesize_arrival_trace(
+    world: int,
+    num_requests: int,
+    rate: float,
+    seed: int = 0,
+    prompt_len: Tuple[int, int] = (4, 12),
+    max_new_tokens: Tuple[int, int] = (8, 16),
+    vocab_size: int = 256,
+    eos_id: Optional[int] = None,
+    label: str = "synthetic-poisson",
+) -> ArrivalTrace:
+    """Seeded Poisson traffic: exponential inter-arrival gaps at ``rate``
+    requests per decode step (``jax.random``, deterministic per seed),
+    uniform prompt lengths / generation budgets in the given inclusive
+    ranges, uniform prompt tokens below ``vocab_size``.
+
+    ``eos_id`` (when given) is excluded from prompt bodies so an injected
+    separator can't end a request at its first sampled comparison —
+    traces that *want* EOS-in-prompt coverage author it by hand.
+    """
+    import jax
+    import numpy as np
+
+    if num_requests < 1:
+        raise ValueError(f"num_requests must be >= 1, got {num_requests}")
+    if rate <= 0:
+        raise ValueError(f"rate must be > 0 requests/step, got {rate}")
+    if prompt_len[0] < 1 or prompt_len[0] > prompt_len[1]:
+        raise ValueError(f"bad prompt_len range {prompt_len}")
+    if max_new_tokens[0] < 1 or max_new_tokens[0] > max_new_tokens[1]:
+        raise ValueError(f"bad max_new_tokens range {max_new_tokens}")
+    if vocab_size < 2:
+        raise ValueError(f"vocab_size must be >= 2, got {vocab_size}")
+    key = jax.random.PRNGKey(seed)
+    k_gap, k_plen, k_new, k_tok, k_seed = jax.random.split(key, 5)
+    gaps = np.asarray(
+        jax.random.exponential(k_gap, (num_requests,)) / rate
+    )
+    arrivals = np.floor(np.cumsum(gaps)).astype(int)
+    plens = np.asarray(
+        jax.random.randint(
+            k_plen, (num_requests,), prompt_len[0], prompt_len[1] + 1
+        )
+    )
+    news = np.asarray(
+        jax.random.randint(
+            k_new, (num_requests,), max_new_tokens[0], max_new_tokens[1] + 1
+        )
+    )
+    toks = np.asarray(
+        jax.random.randint(
+            k_tok, (num_requests, int(prompt_len[1])), 0, vocab_size
+        )
+    )
+    if eos_id is not None:
+        # deterministic re-map of any sampled eos to its neighbor token
+        toks = np.where(
+            toks == int(eos_id), (toks + 1) % vocab_size, toks
+        )
+    seeds = np.asarray(
+        jax.random.randint(k_seed, (num_requests,), 0, 1 << 30)
+    )
+    requests = [
+        RequestSpec(
+            req_id=i,
+            arrival_step=int(arrivals[i]),
+            prompt=tuple(int(t) for t in toks[i, : int(plens[i])]),
+            max_new_tokens=int(news[i]),
+            seed=int(seeds[i]),
+        )
+        for i in range(num_requests)
+    ]
+    return ArrivalTrace(world=world, seed=seed, requests=requests, label=label)
+
+
+def load_serve_trace(
+    world: Optional[int] = None, env: Optional[Mapping[str, str]] = None
+) -> Optional[ArrivalTrace]:
+    """The ``ADAPCC_SERVE_TRACE`` env funnel: None when unset, the parsed
+    artifact otherwise — missing file / non-trace JSON / world mismatch
+    all raise loudly (:func:`adapcc_tpu.utils.artifacts
+    .load_env_json_artifact`'s shared policy)."""
+    return load_env_json_artifact(
+        SERVE_TRACE_ENV,
+        ArrivalTrace.from_dict,
+        "serve arrival-trace",
+        world=world,
+        env=env,
+        mismatch_hint=(
+            "its prompts and head split were authored for that mesh — "
+            "replaying it as-is would serve different traffic than the "
+            "trace claims"
+        ),
+    )
+
+
+def arrival_steps(trace: ArrivalTrace) -> Sequence[int]:
+    """The trace's arrival clock, for the queueing model."""
+    return [r.arrival_step for r in trace.requests]

@@ -1515,3 +1515,211 @@ def recovery_cost(
             saved / overhead if overhead > 0 and saved > 0 else float("inf")
         ),
     }
+
+
+# --------------------------------------------------------------------------- #
+# serving-plane queueing (adapcc_tpu/serve): arrival rate × decode slots ×
+# per-token step time → the latency/throughput frontier — the serve sweep's
+# rows (docs/SERVING.md §5)
+# --------------------------------------------------------------------------- #
+
+#: per-layer on-chip compute of one decode step (qkv + attention over the
+#: cached pages + MLP for a handful of slots) when no measured figure
+#: exists — a deliberately round number of the right order for a small TP
+#: shard on a v5e-class core, replaced by any calibration the operator
+#: provides.  It exists so the frontier prices a *step*, not a bare
+#: collective: at serving sizes the per-layer allreduce and the per-layer
+#: compute are the same order, which is why the small-message plane
+#: matters at all
+DEFAULT_DECODE_COMPUTE_S_PER_LAYER = 5e-6
+
+
+def decode_step_time(
+    world: int,
+    slots: int,
+    n_layer: int,
+    d_model: int,
+    coeffs: LinkCoeffs,
+    itemsize: int = 4,
+    algo: Optional[str] = None,
+    compute_s_per_layer: float = DEFAULT_DECODE_COMPUTE_S_PER_LAYER,
+) -> Dict[str, object]:
+    """Price ONE continuous-batching decode step (docs/SERVING.md §3): per
+    layer, the head-sharded attention's compute plus the per-token combine
+    — a ``slots × d_model`` allreduce whose payload sits far below the
+    ring ↔ recursive-doubling crossover, so under ``algo=None`` ("auto")
+    the selector's own :func:`choose_allreduce_algo` prices the algorithm
+    the engine would execute.
+
+    Returns the step ledger: ``step_time_s``, the per-dispatch
+    ``collective_bytes`` (``slots · d_model · itemsize`` — the number the
+    tuner's size bucket sees; ``itemsize`` defaults to 4 because the
+    shipped decode plane is fp32 — exactness is what buys bit parity —
+    so a sim row and a live dispatch land in the same bucket), the
+    chosen/priced ``algo``, and the comm/compute split.  ``world < 2``
+    serves without a fabric: the collective term is zero and ``algo`` is
+    ``"none"``.
+    """
+    if slots < 1:
+        raise ValueError(f"slots must be >= 1, got {slots}")
+    if n_layer < 1 or d_model < 1:
+        raise ValueError(
+            f"n_layer={n_layer} / d_model={d_model} must be >= 1"
+        )
+    if itemsize < 1:
+        raise ValueError(f"itemsize must be >= 1, got {itemsize}")
+    if compute_s_per_layer < 0:
+        raise ValueError(
+            f"compute_s_per_layer must be >= 0, got {compute_s_per_layer}"
+        )
+    nbytes = float(slots * d_model * itemsize)
+    if int(world) < 2:
+        chosen, coll = "none", 0.0
+    elif algo is None:
+        chosen, times = choose_allreduce_algo(world, nbytes, coeffs)
+        coll = times[chosen]
+    else:
+        chosen = algo
+        _, times = choose_allreduce_algo(world, nbytes, coeffs, (algo,))
+        coll = times[algo]
+    comm_s = n_layer * coll
+    compute_s = n_layer * compute_s_per_layer
+    return {
+        "step_time_s": comm_s + compute_s,
+        "collective_bytes": int(nbytes),
+        "algo": chosen,
+        "comm_s": comm_s,
+        "compute_s": compute_s,
+    }
+
+
+def simulate_serve_queue(
+    arrival_steps: Sequence[int],
+    service_steps: Sequence[int],
+    slots: int,
+) -> list:
+    """Replay the continuous batcher's admission discipline on the integer
+    step clock — the queueing twin of
+    :meth:`adapcc_tpu.serve.scheduler.GPT2Server.step`:
+
+    - FIFO admission at step start: a request is admitted at
+      ``max(arrival, earliest slot-free step)``;
+    - a lane occupies its slot for ``service_steps`` engine steps (the
+      equivalent ``generate`` scan length, ``total − 1``) and completes at
+      ``admitted + service``;
+    - a completed lane's slot admits new traffic from the completion step
+      itself (completion is end-of-step, admission start-of-next — the
+      same step index).
+
+    Returns one ``(arrival, admitted, completed)`` triple per request, in
+    input order.  EOS eviction is not modeled: the triples price the
+    no-early-exit worst case, an upper bound on every sojourn.
+    Deterministic, analytic — no RNG, no wall clock.
+    """
+    import heapq
+
+    if slots < 1:
+        raise ValueError(f"slots must be >= 1, got {slots}")
+    if len(arrival_steps) != len(service_steps):
+        raise ValueError(
+            f"{len(arrival_steps)} arrivals vs {len(service_steps)} service "
+            "times: every request needs exactly one of each"
+        )
+    if any(a < 0 for a in arrival_steps):
+        raise ValueError("arrival steps must be >= 0")
+    if any(s < 1 for s in service_steps):
+        raise ValueError(
+            "service steps must be >= 1 (a request that decodes nothing is "
+            "not serving traffic)"
+        )
+    if list(arrival_steps) != sorted(arrival_steps):
+        raise ValueError(
+            "arrival steps must be sorted (the batcher admits FIFO)"
+        )
+    free = [0] * int(slots)
+    heapq.heapify(free)
+    out = []
+    for arrival, service in zip(arrival_steps, service_steps):
+        admitted = max(int(arrival), heapq.heappop(free))
+        completed = admitted + int(service)
+        heapq.heappush(free, completed)
+        out.append((int(arrival), admitted, completed))
+    return out
+
+
+def serve_queue_metrics(
+    arrival_steps: Sequence[int],
+    service_steps: Sequence[int],
+    slots: int,
+    step_time_s: float,
+    slo_ms: Optional[float] = None,
+    generated_steps: Optional[Sequence[int]] = None,
+) -> Dict[str, float]:
+    """The latency/throughput ledger of one (trace × slots × step-time)
+    cell — the row body ``sim_collectives --serve-sweep`` emits:
+
+    - ``p50_sojourn_steps`` / ``p99_sojourn_steps`` — arrival → completion
+      on the deterministic step clock (queue wait included), nearest-rank;
+    - ``p50_sojourn_ms`` / ``p99_sojourn_ms`` — the same scaled by the
+      priced decode step time;
+    - ``p99_queue_steps`` — arrival → admission: the congestion-collapse
+      signal (it explodes first when the arrival rate crosses the service
+      capacity ``slots / mean_service``);
+    - ``throughput_tok_s`` — GENERATED tokens per second of makespan when
+      ``generated_steps`` (per-request decode budgets) is given; without
+      it, engine token-steps per second (prefill force-feeds included —
+      an upper bound on the generated rate);
+    - ``utilization`` — occupied-lane steps over ``slots × makespan``;
+    - ``slo_attainment`` (with ``slo_ms``) — fraction of requests whose
+      priced sojourn meets the SLO, the number the frontier trades
+      against throughput.
+
+    Deterministic: same trace, same slots, same step time → the same
+    bytes.
+    """
+    from adapcc_tpu.utils.observability import nearest_rank_percentile
+
+    if step_time_s <= 0:
+        raise ValueError(f"step_time_s must be > 0, got {step_time_s}")
+    if generated_steps is not None:
+        if len(generated_steps) != len(service_steps):
+            raise ValueError(
+                f"{len(generated_steps)} generated budgets vs "
+                f"{len(service_steps)} service times"
+            )
+        if any(g < 1 or g > s for g, s in
+               zip(generated_steps, service_steps)):
+            raise ValueError(
+                "each generated budget must be in [1, service_steps]"
+            )
+    triples = simulate_serve_queue(arrival_steps, service_steps, slots)
+    sojourns = sorted(c - a for a, _, c in triples)
+    queues = sorted(adm - a for a, adm, _ in triples)
+
+    def pct(xs, q: float) -> int:
+        # nearest-rank, the shared convention (one spelling repo-wide)
+        return int(nearest_rank_percentile(xs, q))
+
+    makespan = max(c for _, _, c in triples)
+    busy = sum(service_steps)
+    tokens = sum(generated_steps) if generated_steps is not None else busy
+    out: Dict[str, float] = {
+        "requests": float(len(triples)),
+        "makespan_steps": float(makespan),
+        "p50_sojourn_steps": float(pct(sojourns, 0.50)),
+        "p99_sojourn_steps": float(pct(sojourns, 0.99)),
+        "p50_sojourn_ms": pct(sojourns, 0.50) * step_time_s * 1e3,
+        "p99_sojourn_ms": pct(sojourns, 0.99) * step_time_s * 1e3,
+        "p99_queue_steps": float(pct(queues, 0.99)),
+        "throughput_tok_s": tokens / (makespan * step_time_s),
+        "utilization": busy / float(makespan * slots),
+    }
+    if slo_ms is not None:
+        if slo_ms <= 0:
+            raise ValueError(f"slo_ms must be > 0, got {slo_ms}")
+        within = sum(
+            1 for s in sojourns if s * step_time_s * 1e3 <= slo_ms
+        )
+        out["slo_ms"] = float(slo_ms)
+        out["slo_attainment"] = within / len(sojourns)
+    return out

@@ -41,8 +41,11 @@ from adapcc_tpu.tuner.db import (
 from adapcc_tpu.tuner.measure import DispatchTimer, replay_trace, timed_call
 from adapcc_tpu.tuner.policy import (
     DEFAULT_CHUNK_GRID,
+    TUNER_OBJECTIVE_ENV,
+    TUNER_OBJECTIVES,
     TunedPlan,
     TuningPolicy,
+    resolve_tuner_objective,
 )
 
 #: global tuner mode env: off (default) | record | choose
@@ -242,6 +245,8 @@ __all__ = [
     "TUNER_DB_ENV",
     "TUNER_MODE_ENV",
     "TUNER_MODES",
+    "TUNER_OBJECTIVE_ENV",
+    "TUNER_OBJECTIVES",
     "TunedPlan",
     "TuningDatabase",
     "TuningKey",
@@ -250,6 +255,7 @@ __all__ = [
     "mesh_fingerprint",
     "replay_trace",
     "resolve_db_path",
+    "resolve_tuner_objective",
     "size_bucket",
     "timed_call",
     "topology_fingerprint",

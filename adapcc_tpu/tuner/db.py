@@ -125,33 +125,41 @@ class TuningKey:
 @dataclass(frozen=True)
 class TuningStats:
     """Robust summary of one cell's samples: median + IQR, not mean + max —
-    a single straggler-polluted dispatch must not poison the cell."""
+    a single straggler-polluted dispatch must not poison the cell.
+
+    ``p99_s`` is the nearest-rank 99th percentile over the cell's bounded
+    sample window (the tuner-side reservoir, newest
+    :data:`MAX_SAMPLES_PER_KEY`): the number the tail-aware objective
+    (``ADAPCC_TUNER_OBJECTIVE=p99``, docs/TUNER.md §6) ranks cells by —
+    a plan that wins the median but fattens the tail must lose there.
+    """
 
     count: int
     median_s: float
     iqr_s: float
     min_s: float
     max_s: float
+    p99_s: float
 
     def to_dict(self) -> Dict[str, Any]:
         return asdict(self)
 
 
 def _robust_stats(samples: List[float]) -> TuningStats:
+    from adapcc_tpu.utils.observability import nearest_rank_percentile
+
     xs = sorted(samples)
-    n = len(xs)
 
     def q(frac: float) -> float:
-        # nearest-rank quantile (same convention as MetricsRegistry)
-        rank = max(0, int(-(-frac * n // 1)) - 1)
-        return xs[min(rank, n - 1)]
+        return nearest_rank_percentile(xs, frac)
 
     return TuningStats(
-        count=n,
+        count=len(xs),
         median_s=q(0.5),
         iqr_s=q(0.75) - q(0.25),
         min_s=xs[0],
         max_s=xs[-1],
+        p99_s=q(0.99),
     )
 
 

@@ -30,11 +30,43 @@ import random
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from adapcc_tpu.tuner.db import TuningDatabase, TuningKey, size_bucket
+from adapcc_tpu.tuner.db import (
+    TuningDatabase,
+    TuningKey,
+    TuningStats,
+    size_bucket,
+)
 
 #: default ring staging grid: the spread `make ring-sweep` covers, from
 #: latency-bound small tiles to near-whole-payload staging
 DEFAULT_CHUNK_GRID = (256 << 10, 1 << 20, 4 << 20, 16 << 20)
+
+#: tail-aware scoring (docs/TUNER.md §6): "median" ranks measured cells by
+#: their robust median (the historical default), "p99" by the nearest-rank
+#: 99th percentile over the cell's bounded sample window — the objective
+#: the serving plane keys on, where a strategy that wins the median but
+#: fattens the tail loses (The Big Send-off, PAPERS.md)
+TUNER_OBJECTIVE_ENV = "ADAPCC_TUNER_OBJECTIVE"
+
+TUNER_OBJECTIVES = ("median", "p99")
+
+
+def resolve_tuner_objective(explicit: Optional[str] = None) -> str:
+    """The scoring objective in force: ``ADAPCC_TUNER_OBJECTIVE`` env >
+    the caller's explicit value > "median".  Malformed values raise — a
+    typo'd ``p95`` silently ranking by medians would invalidate the tail
+    claim the run was meant to make (the ADAPCC_MERGE_ROUNDS policy)."""
+    env = os.environ.get(TUNER_OBJECTIVE_ENV)
+    value = env if env is not None and env.strip() else explicit
+    if value is None:
+        return "median"
+    objective = value.strip().lower()
+    if objective not in TUNER_OBJECTIVES:
+        raise ValueError(
+            f"{TUNER_OBJECTIVE_ENV}={value!r}: expected one of "
+            f"{'|'.join(TUNER_OBJECTIVES)}"
+        )
+    return objective
 
 #: cells with fewer samples than this rank by the prior, not their median
 DEFAULT_MIN_SAMPLES = 2
@@ -143,7 +175,11 @@ class TunedPlan:
 
     key: TuningKey
     source: str                    #: "measured" | "prior" | "explore"
-    expected_s: float              #: the score that won (median or prior)
+    expected_s: float              #: the score that won (objective or prior)
+    #: scoring objective the decision ranked measured cells by
+    #: (:data:`TUNER_OBJECTIVES`) — part of the trace payload so a tail
+    #: claim can be audited against the mode that actually decided
+    objective: str = "median"
     #: execution hint for cells whose persistent key carries no chunk: a
     #: vmem cell is keyed chunk_bytes=0 (the knob is inert there — every
     #: budget ≥ the payload runs the identical program), but the engine
@@ -173,6 +209,7 @@ class TunedPlan:
                 "path": self.key.path,
             },
             "source": self.source,
+            "objective": self.objective,
             "applied": bool(applied),
         }
 
@@ -195,6 +232,7 @@ class TuningPolicy:
         cost_model=None,
         seed: int = 0,
         fused_paths: Optional[bool] = None,
+        objective: Optional[str] = None,
     ) -> None:
         if not 0.0 <= epsilon <= 1.0:
             raise ValueError(f"epsilon must be in [0, 1], got {epsilon}")
@@ -220,6 +258,12 @@ class TuningPolicy:
         self.min_samples = int(min_samples)
         self.hysteresis_margin = float(hysteresis_margin)
         self.hysteresis_min_samples = int(hysteresis_min_samples)
+        #: scoring objective for MEASURED cells (env > explicit > median,
+        #: resolved once at construction — an engine rebuild picks up a
+        #: changed env, a running policy never flips mid-decision).  The
+        #: prior is untouched: the α-β model predicts one deterministic
+        #: time, so objectives only diverge once samples exist.
+        self.objective = resolve_tuner_objective(objective)
         self._cost_model = cost_model
         #: whether fused wire cells (codec inside the Pallas kernels) join
         #: the grid: None = probe the data plane (a cell must never claim a
@@ -609,12 +653,21 @@ class TuningPolicy:
 
     # -- selection -------------------------------------------------------------
 
+    def _stat_score(self, stats: TuningStats) -> float:
+        """The measured scalar the objective ranks cells by: the robust
+        median (default) or the tail percentile (``p99``, docs/TUNER.md
+        §6) — where a cell that wins the median but fattens the tail
+        loses.  One spelling for exploit, hysteresis, and rank_only, so
+        the adoption gate and the ranking can never judge by different
+        numbers."""
+        return stats.p99_s if self.objective == "p99" else stats.median_s
+
     def _score(self, key: TuningKey, nbytes: int) -> Tuple[float, bool]:
-        """(seconds, measured?) — median when the cell has enough samples,
-        the model prior otherwise."""
+        """(seconds, measured?) — the objective score when the cell has
+        enough samples, the model prior otherwise."""
         stats = self.db.stats(key)
         if stats is not None and stats.count >= self.min_samples:
-            return stats.median_s, True
+            return self._stat_score(stats), True
         return self.prior_time(key, nbytes), False
 
     def _exec_chunk(self, key: TuningKey, nbytes: int, dtype: str) -> Optional[int]:
@@ -638,6 +691,7 @@ class TuningPolicy:
     ) -> TunedPlan:
         return TunedPlan(
             key=key, source=source, expected_s=expected_s,
+            objective=self.objective,
             exec_chunk_bytes=self._exec_chunk(key, nbytes, dtype),
         )
 
@@ -645,8 +699,9 @@ class TuningPolicy:
         self, cells: Sequence[TuningKey], nbytes: int
     ) -> Tuple[TuningKey, float, str]:
         """Exploitation ranking shared by :meth:`choose` and
-        :meth:`rank_only`: measured cells by database median; with nothing
-        measured, the sim prior over the whole grid."""
+        :meth:`rank_only`: measured cells by the objective score (median
+        or p99); with nothing measured, the sim prior over the whole
+        grid."""
         measured = {
             c: self.db.stats(c)
             for c in cells
@@ -655,9 +710,9 @@ class TuningPolicy:
         if measured:
             best = min(
                 measured,
-                key=lambda c: (measured[c].median_s, cells.index(c)),
+                key=lambda c: (self._stat_score(measured[c]), cells.index(c)),
             )
-            return best, measured[best].median_s, "measured"
+            return best, self._stat_score(measured[best]), "measured"
         priors = {c: self.prior_time(c, nbytes) for c in cells}
         best = min(cells, key=lambda c: (priors[c], cells.index(c)))
         return best, priors[best], "prior"

@@ -73,6 +73,17 @@ class ProgressMeter:
 # --- metrics registry ---------------------------------------------------------
 
 
+def nearest_rank_percentile(sorted_samples: Sequence[float], q: float) -> float:
+    """THE nearest-rank percentile convention, repo-wide: every consumer
+    (the metrics reservoir, the dispatch-trace summary, the tuner
+    database, the serving ledger, the queueing model) quotes percentiles
+    through this one spelling, so a p99 from any artifact is comparable
+    with a p99 from any other.  ``sorted_samples`` must be sorted
+    ascending and non-empty."""
+    rank = max(0, int(-(-q * len(sorted_samples) // 1)) - 1)
+    return sorted_samples[min(rank, len(sorted_samples) - 1)]
+
+
 class MetricsRegistry:
     """Named counters/gauges/timers with JSON export; thread-safe.
 
@@ -136,8 +147,7 @@ class MetricsRegistry:
     @staticmethod
     def _percentile(sorted_samples: List[float], q: float) -> float:
         """Nearest-rank percentile over the (sorted) reservoir."""
-        rank = max(0, int(-(-q * len(sorted_samples) // 1)) - 1)
-        return sorted_samples[min(rank, len(sorted_samples) - 1)]
+        return nearest_rank_percentile(sorted_samples, q)
 
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
@@ -231,13 +241,51 @@ class CollectiveTrace:
                     f"{-1 if e.step is None else e.step} {json.dumps(e.extra)}\n"
                 )
 
-    def dump_chrome_trace(self, path: str) -> str:
+    def impl_summary(self) -> Dict[str, Dict[str, Any]]:
+        """Per-impl dispatch statistics over the buffered events: count,
+        how many carried a measured ``duration_s``, and nearest-rank
+        p50/p99 over those durations (None with nothing timed).  The
+        aggregation a tail claim needs from a trace — e.g. decode-step
+        allreduces under ``rd`` vs ``ring`` — without hand-scraping the
+        event list."""
+        grouped: Dict[str, List[float]] = {}
+        counts: Dict[str, int] = {}
+        for e in self.events():
+            counts[e.impl] = counts.get(e.impl, 0) + 1
+            if "duration_s" in e.extra:
+                grouped.setdefault(e.impl, []).append(
+                    float(e.extra["duration_s"])
+                )
+        out: Dict[str, Dict[str, Any]] = {}
+        for impl, count in sorted(counts.items()):
+            timed = sorted(grouped.get(impl, []))
+
+            def pct(q: float) -> Optional[float]:
+                if not timed:
+                    return None
+                return nearest_rank_percentile(timed, q)
+
+            out[impl] = {
+                "count": count,
+                "timed": len(timed),
+                "p50_s": pct(0.50),
+                "p99_s": pct(0.99),
+            }
+        return out
+
+    def dump_chrome_trace(self, path: str, impl_summary: bool = True) -> str:
         """``chrome://tracing`` / Perfetto JSON: one complete ("X") event
         per dispatch.  Events that carry a measured ``duration_s`` (the
         tuner's record mode) render with real extent; untimed dispatches
         render as instants.  Args carry the plan provenance — impl, bytes,
         wire dtype, and the tuner decision — so a timeline click answers
         "what ran here and who chose it".
+
+        With ``impl_summary`` (default on), one extra slice per impl lands
+        on a dedicated ``summary`` track (tid 1), spanning that impl's
+        first→last dispatch, with :meth:`impl_summary`'s count/p50/p99 in
+        its args — so per-impl tail behavior (the decode-step p99 a
+        serving claim keys on) is one timeline click, no hand-aggregation.
         """
         trace_events = []
         for e in self.events():
@@ -266,6 +314,44 @@ class CollectiveTrace:
                     "pid": 0,
                     "tid": 0,
                     "args": args,
+                }
+            )
+        if impl_summary:
+            spans: Dict[str, List[float]] = {}
+            for e in self.events():
+                dur_us = float(e.extra.get("duration_s", 0.0)) * 1e6
+                start = e.ts * 1e6 - dur_us
+                span = spans.setdefault(e.impl, [start, e.ts * 1e6])
+                span[0] = min(span[0], start)
+                span[1] = max(span[1], e.ts * 1e6)
+            for impl, stats in self.impl_summary().items():
+                lo, hi = spans[impl]
+                args = {
+                    "count": stats["count"],
+                    "timed": stats["timed"],
+                }
+                if stats["p50_s"] is not None:
+                    args["p50_us"] = stats["p50_s"] * 1e6
+                    args["p99_us"] = stats["p99_s"] * 1e6
+                trace_events.append(
+                    {
+                        "name": f"summary:{impl}",
+                        "cat": "summary",
+                        "ph": "X",
+                        "ts": lo,
+                        "dur": max(hi - lo, 1.0),
+                        "pid": 0,
+                        "tid": 1,
+                        "args": args,
+                    }
+                )
+            trace_events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": 0,
+                    "tid": 1,
+                    "args": {"name": "per-impl summary (p50/p99)"},
                 }
             )
         with open(path, "w") as f:

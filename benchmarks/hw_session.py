@@ -207,6 +207,14 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
       deployment pays only the k·state/world ring-neighbor wire transfer
       (the rejoin protocol itself is process-level and drilled by
       tests/test_chaos_drill.py).
+    - ``decode_slo`` — the serving-plane tail A/B (the hardware twin of
+      ``make serve-bench``, docs/SERVING.md): the continuous batcher
+      serving one seeded Poisson trace with the per-token decode
+      allreduce under ``--algo ring`` vs ``rd`` vs ``auto`` — serving
+      payloads sit far below the ring ↔ recursive-doubling crossover, so
+      the arms measure what the small-message plane buys the p50/p99
+      decode-step tail and SLO attainment on real ICI, and the ``auto``
+      arm records which plane the size-adaptive selector picks live.
     """
     gate = f"world={world} (needs multi-chip ICI)"
     if world < 2:
@@ -215,7 +223,7 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
             "busbw_wire_dtype", "busbw_fused_wire", "tuner_convergence",
             "overlap_ab", "small_msg_crossover", "two_level_synth",
             "elastic_failover", "online_adaptation", "supervised_failover",
-            "fabric_contention", "elastic_rejoin",
+            "fabric_contention", "elastic_rejoin", "decode_slo",
         ):
             _skip(name, gate, out_path)
         return
@@ -514,6 +522,26 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
             900, out_path,
             extra_env={"ADAPCC_SHARD_REPLICAS": k},
             rec_extra={"shard_replicas": int(k)},
+        )
+    # decode-SLO serving A/B on real chips (the hardware twin of `make
+    # serve-bench`, docs/SERVING.md): the continuous batcher serving one
+    # seeded Poisson trace with the decode-step allreduce pinned to the
+    # ring plane vs the small-message rd plane — per-token payloads sit
+    # far below the crossover, so the A/B measures what the latency plane
+    # buys the serving tail (p50/p99 step ms + SLO attainment in the
+    # printed summary).  One head per rank; the final auto arm records
+    # which plane the size-adaptive selector picks live.
+    for algo in ("ring", "rd", "auto"):
+        _run(
+            "decode_slo",
+            [py, "-m", "adapcc_tpu.workloads.serve_gpt2",
+             "--requests", "16", "--rate", "0.25", "--slots", "4",
+             "--world", str(world), "--heads", str(world),
+             "--dmodel", str(64 * world), "--seq", "64",
+             "--max-new-tokens", "16", "--algo", algo,
+             "--slo-ms", "2000", "--json"],
+            900, out_path,
+            rec_extra={"algo": algo, "serve": True},
         )
 
 

@@ -1391,6 +1391,118 @@ def fabric_sweep(
     return rows
 
 
+def serve_sweep(
+    world: int,
+    rates: Sequence[float] = (0.05, 0.1, 0.25),
+    slots_grid: Sequence[int] = (1, 2, 4, 8),
+    num_requests: int = 64,
+    n_layer: int = 2,
+    d_model: int = 128,
+    seed: int = 0,
+    slo_ms: Optional[float] = None,
+    model: Optional[LinkCostModel] = None,
+) -> List[dict]:
+    """Deterministic latency/throughput frontier rows for the serving
+    plane — the hardware-free regression artifact for the continuous
+    batcher (``make serve-bench``, docs/SERVING.md §5).
+
+    The grid is (arrival rate × decode slots) on one seeded Poisson
+    trace per rate (:func:`adapcc_tpu.serve.trace
+    .synthesize_arrival_trace` — the SAME module the live server
+    replays, so the sweep and the workload can never price different
+    traffic).  Each cell:
+
+    - prices the decode step with :func:`adapcc_tpu.sim.cost_model
+      .decode_step_time` — per layer, a ``slots × d_model`` allreduce on
+      the calibrated coefficients, the algorithm chosen by the selector's
+      own crossover (at serving sizes: the small-message plane);
+    - replays the trace through :func:`adapcc_tpu.sim.cost_model
+      .simulate_serve_queue`, the queueing twin of the batcher's
+      admission discipline, for p50/p99 sojourn on the step clock;
+    - stamps throughput, utilization, and (with ``slo_ms``) SLO
+      attainment — the frontier an admission policy trades along.
+
+    Deterministic: the trace is seeded ``jax.random``, the replay is
+    analytic — same calibration, same seed → byte-identical rows.
+    """
+    from adapcc_tpu.serve.trace import synthesize_arrival_trace
+    from adapcc_tpu.sim.cost_model import (
+        bottleneck_ring_coeffs,
+        decode_step_time,
+        serve_queue_metrics,
+    )
+
+    if world < 1:
+        raise ValueError(f"world must be >= 1, got {world}")
+    if num_requests < 1:
+        raise ValueError(f"num_requests must be >= 1, got {num_requests}")
+    if any(r <= 0 for r in rates):
+        raise ValueError(
+            f"arrival rates must be > 0 requests/step, got {list(rates)}"
+        )
+    if any(s < 1 for s in slots_grid):
+        raise ValueError(f"slot counts must be >= 1, got {list(slots_grid)}")
+    if model is None:
+        model = load_or_default(world=world)
+    elif model.world != world:
+        raise ValueError(f"model world {model.world} != sweep world {world}")
+    coeffs = bottleneck_ring_coeffs(model, max(2, world))
+    rows: List[dict] = []
+    for rate in rates:
+        rate = float(rate)
+        trace = synthesize_arrival_trace(
+            world, num_requests, rate, seed=seed,
+            label=f"serve-sweep-r{rate:g}",
+        )
+        arrivals = [r.arrival_step for r in trace.requests]
+        services = [r.service_steps for r in trace.requests]
+        generated = [r.max_new_tokens for r in trace.requests]
+        for slots in slots_grid:
+            slots = int(slots)
+            step = decode_step_time(
+                world, slots, n_layer, d_model, coeffs
+            )
+            metrics = serve_queue_metrics(
+                arrivals, services, slots,
+                float(step["step_time_s"]), slo_ms=slo_ms,
+                generated_steps=generated,
+            )
+            row = {
+                "mode": "simulated",
+                "collective": "allreduce",
+                "impl": "serve",
+                "world": world,
+                "slots": slots,
+                "rate_req_per_step": rate,
+                "requests": num_requests,
+                "trace_seed": seed,
+                "n_layer": n_layer,
+                "d_model": d_model,
+                "algo": step["algo"],
+                "collective_bytes": step["collective_bytes"],
+                "pred_step_us": round(float(step["step_time_s"]) * 1e6, 3),
+                "pred_comm_us": round(float(step["comm_s"]) * 1e6, 3),
+                "p50_sojourn_steps": int(metrics["p50_sojourn_steps"]),
+                "p99_sojourn_steps": int(metrics["p99_sojourn_steps"]),
+                "p50_sojourn_ms": round(metrics["p50_sojourn_ms"], 6),
+                "p99_sojourn_ms": round(metrics["p99_sojourn_ms"], 6),
+                "p99_queue_steps": int(metrics["p99_queue_steps"]),
+                "throughput_tok_s": round(metrics["throughput_tok_s"], 3),
+                "utilization": round(metrics["utilization"], 6),
+                "calibration": model.source,
+            }
+            if slo_ms is not None:
+                row["slo_ms"] = float(slo_ms)
+                row["slo_attainment"] = round(metrics["slo_attainment"], 6)
+            rows.append(row)
+    if not rows:
+        raise ValueError(
+            f"serve sweep produced no rows: rates={list(rates)} "
+            f"slots={list(slots_grid)}"
+        )
+    return rows
+
+
 def tune_replay_sweep(
     world: int,
     sizes: Sequence[int],
@@ -1635,6 +1747,35 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="fabric-sweep background DCN congestion factor grid",
     )
     ap.add_argument(
+        "--serve-sweep", action="store_true",
+        help="price the serving plane's latency/throughput frontier "
+        "instead of the strategy grid: a seeded Poisson arrival trace "
+        "replayed through the continuous batcher's queueing twin over "
+        "(--rates x --serve-slots), each cell priced by the decode-step "
+        "service time on the calibrated coefficients, p50/p99 sojourn "
+        "and SLO attainment stamped per row (make serve-bench; "
+        "docs/SERVING.md)",
+    )
+    ap.add_argument(
+        "--rates", default="0.05,0.1,0.25",
+        help="serve-sweep Poisson arrival-rate grid (requests per decode "
+        "step)",
+    )
+    ap.add_argument(
+        "--serve-slots", default="1,2,4,8",
+        help="serve-sweep decode-slot grid (the continuous batcher's "
+        "fixed lane count)",
+    )
+    ap.add_argument(
+        "--serve-requests", type=int, default=64,
+        help="serve-sweep requests per synthesized trace",
+    )
+    ap.add_argument(
+        "--slo-ms", type=float, default=0.0,
+        help="serve-sweep per-request sojourn SLO in milliseconds "
+        "(0 = no SLO-attainment column)",
+    )
+    ap.add_argument(
         "--overlap-sweep", action="store_true",
         help="price the overlapped DDP gradient sync over (accum x "
         "bucket cap x overlap schedule) with overlapped_step_time instead "
@@ -1665,6 +1806,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--chaos-sweep", args.chaos_sweep),
             ("--fabric-sweep", args.fabric_sweep),
             ("--recovery-sweep", args.recovery_sweep),
+            ("--serve-sweep", args.serve_sweep),
         ) if on
     ]
     if len(exclusive) > 1:
@@ -1673,6 +1815,39 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ap.error(f"{' and '.join(exclusive)} are mutually exclusive; "
                  "run one sweep per invocation")
     model = load_or_default(args.calibration, world=args.world)
+    if args.serve_sweep:
+        if args.hosts > 1:
+            # the frontier prices the TP decode mesh of --world; silently
+            # accepting --hosts would read as "priced that host split"
+            # when nothing used it (the --hier-sweep precedent)
+            ap.error("--hosts has no effect on --serve-sweep (the decode "
+                     "mesh is --world)")
+        if args.slo_ms < 0:
+            ap.error(f"--slo-ms must be >= 0, got {args.slo_ms}")
+        rows = serve_sweep(
+            world=args.world,
+            rates=[float(r) for r in args.rates.split(",") if r],
+            slots_grid=[int(s) for s in args.serve_slots.split(",") if s],
+            num_requests=args.serve_requests,
+            slo_ms=args.slo_ms if args.slo_ms > 0 else None,
+            model=model,
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            else:
+                att = row.get("slo_attainment")
+                print(
+                    f"[sim] serve rate={row['rate_req_per_step']:>5g} "
+                    f"slots={row['slots']:>2} algo={row['algo']:<4} "
+                    f"step={row['pred_step_us']:>8.1f}us  "
+                    f"p50={row['p50_sojourn_ms']:>9.3f}ms "
+                    f"p99={row['p99_sojourn_ms']:>9.3f}ms  "
+                    f"tok/s={row['throughput_tok_s']:>11.1f}  "
+                    f"util={row['utilization']:.3f}"
+                    + (f"  slo={att:.3f}" if att is not None else "")
+                )
+        return 0
     if args.fabric_sweep:
         if args.hosts > 1:
             # the sweep fixes its own two-pod split of --world; silently

@@ -20,6 +20,11 @@ import sys
 
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    # --no-summary drops the per-impl p50/p99 summary track from the
+    # export (the raw-events-only view); default keeps it, so decode-step
+    # tail behavior is one Perfetto click, no hand-aggregation
+    summary = "--no-summary" not in argv
+    argv = [a for a in argv if a != "--no-summary"]
     out = argv[0] if argv else os.path.join(
         "benchmarks", "results", "trace_export.json"
     )
@@ -60,11 +65,22 @@ def main(argv=None) -> int:
     d = os.path.dirname(out)
     if d:
         os.makedirs(d, exist_ok=True)
-    engine.trace.dump_chrome_trace(out)
+    engine.trace.dump_chrome_trace(out, impl_summary=summary)
     timed = sum(1 for e in trace.events() if "duration_s" in e.extra)
     print(
         f"[trace-export] {len(trace.events())} events ({timed} timed) -> {out}"
     )
+    for impl, stats in trace.impl_summary().items():
+        p50 = stats["p50_s"]
+        p99 = stats["p99_s"]
+        print(
+            f"[trace-export]   {impl:<14} n={stats['count']:>4} "
+            f"timed={stats['timed']:>4}"
+            + (
+                f"  p50={p50 * 1e6:>10.1f}us  p99={p99 * 1e6:>10.1f}us"
+                if p50 is not None else ""
+            )
+        )
     return 0
 
 

@@ -753,3 +753,94 @@ def test_recovery_sweep_cli_emits_json(capsys):
     assert rows and all(r["impl"] == "recovery" for r in rows)
     assert {r["world"] for r in rows} == {8, 32, 64}
     assert all(r["overhead_ok"] for r in rows if r["world"] >= 32)
+
+
+# ------------------------------------------------- serve sweep (PR 14)
+
+
+def test_serve_sweep_rows_byte_identical_and_frontier_shaped():
+    """The serve-bench artifact (docs/SERVING.md §5) is deterministic to
+    the byte over the (arrival rate × decode slots) grid, every cell runs
+    the small-message algorithm the selector's crossover picks at serving
+    payloads, and the frontier has its load-bearing shape: more slots
+    never fatten the p99 sojourn at a fixed rate."""
+    from benchmarks.sim_collectives import serve_sweep
+
+    rows = serve_sweep(8, rates=(0.1, 0.25), slots_grid=(1, 2, 4),
+                       slo_ms=2.0)
+    again = serve_sweep(8, rates=(0.1, 0.25), slots_grid=(1, 2, 4),
+                        slo_ms=2.0)
+    assert [json.dumps(r, sort_keys=True) for r in rows] == [
+        json.dumps(r, sort_keys=True) for r in again
+    ]
+    assert len(rows) == 2 * 3
+    for r in rows:
+        assert r["mode"] == "simulated" and r["impl"] == "serve"
+        assert r["world"] == 8 and r["requests"] == 64
+        # slots x d_model fp32 sits far below the crossover: rd wins
+        assert r["algo"] == "rd"
+        assert r["collective_bytes"] == r["slots"] * r["d_model"] * 4
+        assert r["pred_step_us"] > 0
+        assert r["p99_sojourn_steps"] >= r["p50_sojourn_steps"]
+        assert 0.0 < r["utilization"] <= 1.0
+        assert 0.0 <= r["slo_attainment"] <= 1.0
+    for rate in (0.1, 0.25):
+        tails = [
+            r["p99_sojourn_steps"] for r in rows
+            if r["rate_req_per_step"] == rate
+        ]
+        assert tails == sorted(tails, reverse=True)
+    with pytest.raises(ValueError, match="rates"):
+        serve_sweep(8, rates=(0.0,))
+    with pytest.raises(ValueError, match="slot"):
+        serve_sweep(8, slots_grid=(0,))
+    with pytest.raises(ValueError, match="num_requests"):
+        serve_sweep(8, num_requests=0)
+
+
+def test_serve_sweep_cli_mutually_exclusive_and_rejects_hosts(capsys):
+    from benchmarks.sim_collectives import main
+
+    for other in (
+        ["--ring-sweep"],
+        ["--tune-replay"],
+        ["--fused-sweep"],
+        ["--overlap-sweep"],
+        ["--fault-sweep"],
+        ["--latency-sweep"],
+        ["--adapt-sweep"],
+        ["--chaos-sweep"],
+        ["--hier-sweep"],
+        ["--fabric-sweep"],
+        ["--recovery-sweep"],
+    ):
+        with pytest.raises(SystemExit):
+            main(["--serve-sweep"] + other)
+    # the frontier prices the TP decode mesh of --world: --hosts is
+    # meaningless and silently accepting it would mislabel the artifact
+    with pytest.raises(SystemExit):
+        main(["--serve-sweep", "--hosts", "2"])
+    with pytest.raises(SystemExit):
+        main(["--serve-sweep", "--slo-ms", "-1"])
+    capsys.readouterr()
+
+
+def test_serve_sweep_cli_emits_json(capsys):
+    from benchmarks.sim_collectives import main
+
+    assert main([
+        "--serve-sweep", "--world", "8", "--rates", "0.1,0.25",
+        "--serve-slots", "1,4", "--slo-ms", "2", "--json",
+    ]) == 0
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rows and all(r["impl"] == "serve" for r in rows)
+    assert {r["rate_req_per_step"] for r in rows} == {0.1, 0.25}
+    assert {r["slots"] for r in rows} == {1, 4}
+    assert all("slo_attainment" in r for r in rows)
+    # --slo-ms 0 drops the attainment column instead of faking a bound
+    assert main([
+        "--serve-sweep", "--world", "8", "--rates", "0.1",
+        "--serve-slots", "2", "--json",
+    ]) == 0
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rows and all("slo_attainment" not in r for r in rows)

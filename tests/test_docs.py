@@ -3,8 +3,8 @@
 Every ```python block in docs/PARALLELISM.md, docs/OPERATIONS.md,
 docs/SIMULATION.md, docs/RING.md, docs/QUANT.md, docs/TUNER.md,
 docs/OVERLAP.md, docs/LATENCY.md, docs/ELASTIC.md, docs/ADAPT.md,
-docs/SUPERVISOR.md, docs/HIERARCHY.md, docs/FABRIC.md and
-docs/RECOVERY.md runs verbatim on the virtual pod.  A snippet that
+docs/SUPERVISOR.md, docs/HIERARCHY.md, docs/FABRIC.md, docs/RECOVERY.md
+and docs/SERVING.md runs verbatim on the virtual pod.  A snippet that
 stops compiling or produces wrong shapes fails here.
 """
 
@@ -30,6 +30,7 @@ _SUPERVISOR = os.path.join(_DOCS_DIR, "SUPERVISOR.md")
 _HIERARCHY = os.path.join(_DOCS_DIR, "HIERARCHY.md")
 _FABRIC = os.path.join(_DOCS_DIR, "FABRIC.md")
 _RECOVERY = os.path.join(_DOCS_DIR, "RECOVERY.md")
+_SERVING = os.path.join(_DOCS_DIR, "SERVING.md")
 
 
 def _blocks(path):
@@ -345,3 +346,27 @@ def test_recovery_doc_covers_the_contract():
 def test_recovery_doc_snippet_runs(idx):
     code = _blocks(_RECOVERY)[idx]
     exec(compile(code, f"{_RECOVERY}:block{idx}", "exec"), {})
+
+
+def test_serving_doc_has_snippets():
+    assert len(_blocks(_SERVING)) >= 5
+
+
+def test_serving_doc_covers_the_contract():
+    """The serving-plane topics the latency-SLO story leans on."""
+    text = open(_SERVING).read()
+    for needle in (
+        "ADAPCC_SERVE_TRACE", "ADAPCC_SERVE_SLOTS", "ADAPCC_SERVE_SLO_MS",
+        "ADAPCC_TUNER_OBJECTIVE", "synthesize_arrival_trace",
+        "SlotKVCache", "GPT2Server", "continuous batch", "evict-on-EOS",
+        "bit-identical", "head-sharded", "simulate_serve_queue",
+        "serve_queue_metrics", "decode_step_time", "make serve-bench",
+        "decode_slo", "small-message", "p99", "without retracing",
+    ):
+        assert needle in text, f"SERVING.md lost its {needle!r} coverage"
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_SERVING))))
+def test_serving_doc_snippet_runs(idx):
+    code = _blocks(_SERVING)[idx]
+    exec(compile(code, f"{_SERVING}:block{idx}", "exec"), {})

@@ -113,6 +113,56 @@ def test_filter_top_p_keeps_minimal_nucleus():
     assert not np.isneginf(np.asarray(filter_top_p(logits, 1.0))).any()
 
 
+def test_filter_top_k_boundaries():
+    """k = 1 keeps only the argmax; k ≥ vocab masks nothing — the serving
+    plane's sampling path at the knob's extremes."""
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0]])
+    k1 = np.asarray(filter_top_k(logits, 1))
+    assert k1[0, 1] == 5.0
+    assert np.isneginf(np.delete(k1[0], 1)).all()
+    for k in (4, 7):  # k == vocab and k > vocab behave identically
+        assert np.array_equal(
+            np.asarray(filter_top_k(logits, k)), np.asarray(logits)
+        )
+
+
+def test_filter_top_p_one_hot_distribution():
+    """A (numerically) one-hot distribution survives nucleus filtering at
+    any p: the top token alone already covers the mass, and the first
+    sorted position is never cut."""
+    logits = jnp.asarray([[100.0, 0.0, 0.0, 0.0]])
+    for p in (0.1, 0.9, 1.0):
+        out = np.asarray(filter_top_p(logits, p))
+        assert out[0, 0] == 100.0
+        if p < 1.0:
+            assert np.isneginf(out[0, 1:]).all()
+    # p = 1.0 keeps everything even when the mass is spread
+    spread = jnp.asarray([[3.0, 2.0, 1.0, 0.0]])
+    assert not np.isneginf(np.asarray(filter_top_p(spread, 1.0))).any()
+
+
+def test_sample_token_temperature_zero_is_greedy():
+    """T = 0 is argmax regardless of the RNG key and regardless of the
+    filter knobs (the greedy path short-circuits before filtering) — the
+    invariant the serving plane's compiled greedy-parity drill leans on."""
+    logits = jnp.asarray([[0.5, 2.0, 1.0]])
+    draws = {
+        int(sample_token(
+            jax.random.PRNGKey(i), logits,
+            temperature=0.0, top_k=2, top_p=0.5,
+        )[0])
+        for i in range(4)
+    }
+    assert draws == {1}
+    # ... and a categorical draw at T > 0 from the same logits uses the
+    # key (two keys that disagree somewhere exist in any 16-draw window)
+    varied = {
+        int(sample_token(jax.random.PRNGKey(i), logits, temperature=2.0)[0])
+        for i in range(16)
+    }
+    assert len(varied) > 1
+
+
 def test_sample_token_greedy_and_categorical():
     logits = jnp.asarray([[0.0, 10.0, 0.0]])
     assert int(sample_token(jax.random.PRNGKey(0), logits, temperature=0.0)[0]) == 1

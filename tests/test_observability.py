@@ -149,7 +149,7 @@ def test_dump_chrome_trace(tmp_path):
     path = str(tmp_path / "trace.json")
     assert tr.dump_chrome_trace(path) == path
     doc = json.loads(open(path).read())
-    evs = doc["traceEvents"]
+    evs = [e for e in doc["traceEvents"] if e.get("cat") == "collective"]
     assert len(evs) == 2 and all(e["ph"] == "X" for e in evs)
     timed = evs[0]
     assert timed["name"] == "allreduce"
@@ -160,6 +160,39 @@ def test_dump_chrome_trace(tmp_path):
     assert timed["args"]["tuner_source"] == "measured"
     assert timed["args"]["tuner_applied"] is True
     assert evs[1]["dur"] == 0.0
+
+
+def test_chrome_trace_per_impl_summary(tmp_path):
+    """The export aggregates per-impl p50/p99 onto a dedicated summary
+    track (ISSUE 14 satellite): decode-step tail behavior is one Perfetto
+    click, no hand-scraping — and ``impl_summary=False`` drops the track
+    for the raw view."""
+    tr = CollectiveTrace()
+    for i in range(10):
+        tr.record(
+            "allreduce", "rd", 1024,
+            duration_s=(0.001 if i % 9 else 0.010),
+        )
+    tr.record("allreduce", "ring", 1024)  # untimed: counted, no percentiles
+    stats = tr.impl_summary()
+    assert stats["rd"]["count"] == 10 and stats["rd"]["timed"] == 10
+    assert stats["rd"]["p50_s"] == pytest.approx(0.001)
+    assert stats["rd"]["p99_s"] == pytest.approx(0.010)
+    assert stats["ring"]["timed"] == 0 and stats["ring"]["p50_s"] is None
+    path = str(tmp_path / "trace.json")
+    tr.dump_chrome_trace(path)
+    doc = json.loads(open(path).read())
+    summ = {
+        e["name"]: e for e in doc["traceEvents"]
+        if e.get("cat") == "summary"
+    }
+    assert set(summ) == {"summary:rd", "summary:ring"}
+    assert summ["summary:rd"]["args"]["p99_us"] == pytest.approx(10_000.0)
+    assert summ["summary:rd"]["tid"] == 1  # its own track, off the dispatches
+    assert "p50_us" not in summ["summary:ring"]["args"]
+    tr.dump_chrome_trace(path, impl_summary=False)
+    doc = json.loads(open(path).read())
+    assert not [e for e in doc["traceEvents"] if e.get("cat") == "summary"]
 
 
 def test_engine_records_dispatches(mesh4):
