@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench hier-bench elastic-bench adapt-bench chaos-bench fabric-bench recovery-bench serve-bench trace-export clean
+.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench compiler-bench hier-bench elastic-bench adapt-bench chaos-bench fabric-bench recovery-bench serve-bench trace-export clean
 
 all: native
 
@@ -77,6 +77,18 @@ overlap-bench:
 latency-bench:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--world 8 --sizes 1K,16K,64K,256K,1M,16M --latency-sweep --json
+
+# Schedule-compiler sweep on the same simulator (docs/COMPILER.md):
+# deterministic "mode": "simulated" rows over a size grid pricing the
+# IR-lowered programs — ring / recursive-doubling / binomial tree
+# re-emitted as compiler.ScheduleProgram, plus the pipelined
+# bidirectional schedule no hand-written plane expresses — each verified
+# by compiler.verify_program then priced by schedule_program_time next
+# to its legacy plane's own term, with the pipelined program's
+# beats-lockstep-ring acceptance flag stamped per row.
+compiler-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--world 8 --sizes 64K,1M,16M,128M --schedule-sweep --json
 
 # Hierarchical (DCN x ICI) two-level-vs-flat sweep on the same simulator
 # (docs/HIERARCHY.md): deterministic "mode": "simulated" rows over the

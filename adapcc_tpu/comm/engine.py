@@ -711,6 +711,15 @@ class CollectiveEngine:
         #: lazily computed sim crossover (ring vs recursive doubling) the
         #: `auto` algorithm selector consults; None = not yet computed
         self._algo_crossover: Optional[float] = None
+        #: the ScheduleProgram executed by ``algo="ir"`` dispatches; None =
+        #: derive from the strategy on first use (docs/COMPILER.md).  An
+        #: explicit :meth:`set_schedule_program` pin survives strategy
+        #: hot-swaps; a derived program is re-derived after one.
+        self._ir_program: Optional[Any] = None
+        self._ir_program_explicit = False
+        #: program fingerprints already certified by compiler.verify — a
+        #: program is verified once, not per compiled shape
+        self._ir_verified: set = set()
 
     # -- elastic plan failover -------------------------------------------------
 
@@ -734,6 +743,10 @@ class CollectiveEngine:
                     "shrink the device set"
                 )
             self.strategy = strategy
+            # a strategy-derived IR program belongs to the old strategy;
+            # re-derive lazily (an explicit set_schedule_program pin stays)
+            if not self._ir_program_explicit:
+                self._ir_program = None
         self.epoch += 1
         return self.epoch
 
@@ -936,6 +949,122 @@ class CollectiveEngine:
         cache_hit = key in self._cache
         return self._shard_mapped(key, per_shard, 2)(stacked, mask), key, cache_hit
 
+    # -- IR plane (adapcc_tpu/compiler): the compiled ScheduleProgram executor -
+
+    def _certify_program(self, program) -> None:
+        """Verify a ScheduleProgram once per fingerprint (the verifier is
+        pure; re-running it per compiled shape would be dispatch noise)."""
+        from adapcc_tpu.compiler.verify import verify_program
+
+        fp = program.fingerprint()
+        if fp not in self._ir_verified:
+            verify_program(program)
+            self._ir_verified.add(fp)
+
+    def set_schedule_program(self, program) -> None:
+        """Pin the :class:`~adapcc_tpu.compiler.ir.ScheduleProgram` that
+        ``algo="ir"`` dispatches execute — the entry point for synthesized
+        schedules with no Strategy spelling (docs/COMPILER.md).  The
+        program is verified here, before anything compiles; a bad program
+        dies at the pin, not at the first traced collective."""
+        if program.world != self.world_size:
+            raise ValueError(
+                f"schedule program {program.name!r} is for world "
+                f"{program.world}, engine world is {self.world_size}"
+            )
+        self._certify_program(program)
+        self._ir_program = program
+        self._ir_program_explicit = True
+
+    def schedule_program(self):
+        """The exact ScheduleProgram object ``algo="ir"`` executes: the
+        pinned one, else a verified program derived from the engine's
+        strategy.  ``sim/replay.simulate_program`` takes this same object
+        — pricing and execution share the schedule by construction."""
+        if self._ir_program is None:
+            from adapcc_tpu.compiler.builders import program_from_strategy
+
+            program = program_from_strategy(self.strategy)
+            self._certify_program(program)
+            self._ir_program = program
+        return self._ir_program
+
+    def _ir_allreduce(
+        self,
+        stacked: jnp.ndarray,
+        op: ReduceOp,
+        per_rank_bytes: int,
+        active_gpus: Optional[Sequence[int]],
+    ) -> jnp.ndarray:
+        """Dispatch one allreduce through the compiled ScheduleProgram
+        executor (``compiler/lower.py``), with the executed program's
+        fingerprint in the dispatch trace and record-mode timings under
+        the tuner's ``IR_PATH`` cells."""
+        from adapcc_tpu.compiler import lower as ir_lower
+        from adapcc_tpu.tuner.policy import IR_PATH, NO_CHUNK
+
+        if self.two_level:
+            raise ValueError(
+                "algo='ir' has no two-level lowering yet: ScheduleProgram "
+                "execution needs the flat ranks axis (the composed plan's "
+                "IR ride is a ROADMAP REMAINING item); run the composed "
+                "plane or a flat mesh"
+            )
+        if active_gpus is not None:
+            raise ValueError(
+                "algo='ir' executes the program's own relay masks; "
+                "active_gpus subsets are not expressible on this path — "
+                "build a program with relays= and set_schedule_program it"
+            )
+        program = self.schedule_program()
+        # two explicit pins in conflict reject loudly (the rd/tree wire
+        # policy): on the IR path the wire codec is a PROGRAM property,
+        # so an env/argument pin that disagrees with the program's
+        # first-class annotation cannot be honored silently
+        if self._wire_pinned_non_off(None):
+            from adapcc_tpu.quant import resolve_wire_dtype
+
+            pinned = resolve_wire_dtype(None)
+            if pinned != program.wire_dtype:
+                raise ValueError(
+                    f"algo='ir' program {program.name!r} carries "
+                    f"wire_dtype={program.wire_dtype!r} but {pinned!r} is "
+                    "pinned; IR wire codecs are program properties — "
+                    "rebuild the program with that codec or drop the pin"
+                )
+        tuner = self.tuner
+        key = (
+            "ir_allreduce", program.fingerprint(), stacked.shape,
+            stacked.dtype.name, op,
+        )
+        per_shard = ir_lower.allreduce_per_shard(program, self.axis_name, op)
+        cache_hit = key in self._cache
+        timing = tuner is not None and tuner.recording
+        t0 = time.perf_counter()
+        out = self._shard_mapped(key, per_shard, 1)(stacked)
+        extras: Dict[str, Any] = {
+            "algo": "ir",
+            "program": program.name,
+            "program_fingerprint": program.fingerprint(),
+            "wire_dtype": program.wire_dtype,
+        }
+        if timing:
+            jax.block_until_ready(out)
+            duration = time.perf_counter() - t0
+            extras["duration_s"] = duration
+            tuner.observe_dispatch(
+                tuner.key_for(
+                    "allreduce", per_rank_bytes, IR_PATH, NO_CHUNK,
+                    program.wire_dtype,
+                ),
+                key,
+                duration,
+            )
+        self._record(
+            "allreduce", "ir", stacked, cache_hit=cache_hit, **extras
+        )
+        return out
+
     def all_reduce(
         self,
         stacked: jnp.ndarray,
@@ -947,7 +1076,7 @@ class CollectiveEngine:
     ) -> jnp.ndarray:
         """Allreduce with subset semantics and a size-adaptive algorithm
         selector (docs/LATENCY.md): ``algo`` is one of
-        ``auto|ring|rd|tree`` under the precedence **env > explicit arg >
+        ``auto|ring|rd|tree|ir`` under the precedence **env > explicit arg >
         tuner > sim-crossover** — ``ADAPCC_COLL_ALGO`` wins, then the
         argument, then (for ``auto``/unset with a choosing tuner) a
         measured algorithm cell, then the calibrated crossover decides
@@ -966,6 +1095,8 @@ class CollectiveEngine:
         per_rank_bytes = (
             int(np.prod(stacked.shape[1:])) * stacked.dtype.itemsize
         )
+        if algo_req == "ir":
+            return self._ir_allreduce(stacked, op, per_rank_bytes, active_gpus)
         mask = self._active_to_mask(active_gpus)
         tuner = self.tuner
         tplan = None
@@ -1713,6 +1844,22 @@ class CollectiveEngine:
         # the legacy contract of this entry point
         algo_req = resolve_coll_algo(algo)
         wire_arg = wire_dtype  # the caller's pin, before tuner adoption
+        if algo_req == "ir":
+            # the IR pin owns every allreduce entry point: rerouting here
+            # (not silently running the ring under the pinned label) is
+            # the same honesty rule as the rd/tree pins.  Ring-plane
+            # knobs have no IR meaning — the program carries its own
+            # chunking and codec — so explicit ones conflict loudly.
+            if wire_arg is not None or chunk_bytes is not None:
+                raise ValueError(
+                    "algo='ir' executes a ScheduleProgram whose chunking "
+                    "and wire codec are program properties; drop the "
+                    "chunk_bytes/wire_dtype arguments or the ir pin"
+                )
+            per_rank_bytes = (
+                int(np.prod(stacked.shape[1:])) * stacked.dtype.itemsize
+            )
+            return self._ir_allreduce(stacked, ReduceOp.SUM, per_rank_bytes, None)
         if algo_req in ("rd", "tree"):
             # double-pin conflict BEFORE the tuner consult: under both
             # pins the candidate grid is legitimately empty (neither the

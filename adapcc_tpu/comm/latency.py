@@ -53,8 +53,11 @@ from adapcc_tpu.comm.mesh import RANKS_AXIS
 from adapcc_tpu.primitives import ReduceOp
 
 #: algorithm selector vocabulary: ``auto`` = size-adaptive (tuner, then the
-#: sim crossover), the rest pin one data plane
-COLL_ALGOS = ("auto", "ring", "rd", "tree")
+#: sim crossover), the rest pin one data plane.  ``ir`` pins the compiled
+#: ScheduleProgram executor (``adapcc_tpu/compiler``, docs/COMPILER.md) —
+#: allreduce only today; RS/AG dispatches under a global ``ir`` pin keep
+#: their legacy planes, exactly like a ``tree`` pin does
+COLL_ALGOS = ("auto", "ring", "rd", "tree", "ir")
 
 #: env override for the collective algorithm (docs/LATENCY.md §3); the top
 #: of the precedence ladder env > arg > tuner > sim-crossover

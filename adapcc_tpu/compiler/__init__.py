@@ -1,0 +1,48 @@
+"""Collective schedule compiler: one chunk-granular IR, verified and
+lowered to every data plane (docs/COMPILER.md).
+
+- :mod:`adapcc_tpu.compiler.ir` — ``ScheduleProgram``/``Step``, the one
+  program form synthesizer, simulator, verifier and executor share;
+- :mod:`adapcc_tpu.compiler.builders` — today's ring / recursive-doubling
+  / binomial-tree / two-level planes re-emitted as IR programs;
+- :mod:`adapcc_tpu.compiler.synthesize` — schedules only the IR can
+  express (the bidirectional pipelined ring);
+- :mod:`adapcc_tpu.compiler.verify` — static certification before
+  lowering, loud rejection with the offending (rank, round, chunk);
+- :mod:`adapcc_tpu.compiler.lower` — the ONE shard_map/ppermute lowering
+  behind ``engine.all_reduce(algo="ir")``.
+"""
+
+from adapcc_tpu.compiler.builders import (
+    program_from_strategy,
+    rd_allreduce_program,
+    ring_allreduce_program,
+    tree_allreduce_program,
+    two_level_allreduce_program,
+)
+from adapcc_tpu.compiler.ir import (
+    PROGRAM_COLLECTIVES,
+    STEP_KINDS,
+    ScheduleProgram,
+    Step,
+)
+from adapcc_tpu.compiler.lower import allreduce_per_shard, execute_program_shard
+from adapcc_tpu.compiler.synthesize import pipelined_allreduce_program
+from adapcc_tpu.compiler.verify import ScheduleVerificationError, verify_program
+
+__all__ = [
+    "PROGRAM_COLLECTIVES",
+    "STEP_KINDS",
+    "ScheduleProgram",
+    "ScheduleVerificationError",
+    "Step",
+    "allreduce_per_shard",
+    "execute_program_shard",
+    "pipelined_allreduce_program",
+    "program_from_strategy",
+    "rd_allreduce_program",
+    "ring_allreduce_program",
+    "tree_allreduce_program",
+    "two_level_allreduce_program",
+    "verify_program",
+]

@@ -1,0 +1,294 @@
+"""Builders re-emitting today's hand-written planes as ScheduleProgram IR.
+
+Each builder mirrors the **exact combine structure** of the plane it
+replaces, so the parity tests can pin IR-lowered execution against the
+legacy executor at the tightest tolerance the plane admits:
+
+- :func:`program_from_strategy` — the generic strategy-tree lowering
+  behind ``Strategy.schedule_program()``: one chunk per tree, reduce
+  rounds aligned by index across trees, then broadcast rounds.  For
+  ``Strategy.ring(w, num_trans=w)`` this *is* the segmented
+  bandwidth-optimal ring.
+- :func:`ring_allreduce_program` — that segmented ring by name.
+- :func:`rd_allreduce_program` — recursive halving/doubling at
+  world-chunk granularity, mirroring ``comm/latency.py``'s
+  ``_halving_rounds``/``_doubling_rounds`` bit arithmetic (same keep-half
+  convention, same ``combine(keep, recvd)`` operand order).
+- :func:`tree_allreduce_program` — the binomial tree, edges taken from
+  the same ``_binomial_rounds`` tables ``binomial_reduce_shard`` runs.
+- :func:`two_level_allreduce_program` — the composed hierarchical plan:
+  ring reduce-scatter inside each pod, a per-chunk cross-pod binomial
+  allreduce on the DCN axis, ring all-gather back inside the pod
+  (``comm/two_level.allreduce_two_level_composed_shard``'s phase
+  structure; parity is ulp-bounded because that plane's pod phase is an
+  XLA ``psum_scatter`` with its own reduction order).
+
+Programs with ``wire_dtype != "off"`` carry explicit encode/decode steps
+on every reduce-phase message (broadcast-phase copies ship the already
+combined value; quantizing them would double-apply the codec error
+relative to the engine's ring plane, which encodes contributions once).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from adapcc_tpu.compiler.ir import ScheduleProgram, Step
+
+
+def _message(
+    src: int,
+    dst: int,
+    chunk: int,
+    action: str,
+    codec: Optional[str] = None,
+) -> Tuple[Step, ...]:
+    """The step group for one message: send/recv plus the consumer, with
+    the encode/decode pair when a codec rides the wire."""
+    steps: List[Step] = []
+    if codec is not None:
+        steps.append(Step("encode", src, chunk, codec=codec))
+    steps.append(Step("send", src, chunk, peer=dst))
+    steps.append(Step("recv", dst, chunk, peer=src))
+    if codec is not None:
+        steps.append(Step("decode", dst, chunk, codec=codec))
+    steps.append(Step(action, dst, chunk))
+    return tuple(steps)
+
+
+def program_from_strategy(strategy, name: Optional[str] = None) -> ScheduleProgram:
+    """Lower a ``strategy.ir.Strategy`` to the chunk-granular program form.
+
+    Chunk ``t`` is tree ``t``'s segment (the strategy's parallel-
+    transmission sharding, one chunk per tree).  Reduce rounds of all
+    trees are aligned by round index — the merged-executor alignment the
+    schedule plane already runs — followed by the broadcast rounds.  The
+    IR round has no partial-permutation constraint, so the alignment is
+    always legal; the lowering re-colors as needed.
+    """
+    wire = strategy.wire_dtype if strategy.wire_dtype != "off" else None
+    reduce_rounds = [t.reduce_rounds() for t in strategy.trees]
+    broadcast_rounds = [t.broadcast_rounds() for t in strategy.trees]
+    rounds: List[Tuple[Step, ...]] = []
+    for per_tree, action, codec in (
+        (reduce_rounds, "reduce", wire),
+        (broadcast_rounds, "copy", None),
+    ):
+        depth = max((len(r) for r in per_tree), default=0)
+        for i in range(depth):
+            steps: List[Step] = []
+            for t, tree_rounds in enumerate(per_tree):
+                if i < len(tree_rounds):
+                    for src, dst in tree_rounds[i].edges:
+                        steps.extend(_message(src, dst, t, action, codec))
+            if steps:
+                rounds.append(tuple(steps))
+    return ScheduleProgram(
+        name=name or f"strategy-{strategy.synthesis or 'custom'}-w{strategy.world_size}",
+        world=strategy.world_size,
+        chunks=len(strategy.trees),
+        rounds=tuple(rounds),
+        wire_dtype=strategy.wire_dtype,
+    )
+
+
+def ring_allreduce_program(world: int, wire_dtype: str = "off") -> ScheduleProgram:
+    """The segmented ring: ``Strategy.ring(world, num_trans=world)``
+    through the generic lowering — w rotated chains, one chunk each, so
+    every round is a full ring permutation and the program prices at the
+    bandwidth-optimal ``2(w−1)·(α + β·n/w)``."""
+    from adapcc_tpu.strategy.ir import Strategy
+
+    strategy = Strategy.ring(world, num_trans=max(1, world))
+    strategy.wire_dtype = wire_dtype
+    prog = program_from_strategy(strategy, name=f"ring-seg-w{world}")
+    return prog
+
+
+def rd_allreduce_program(world: int, wire_dtype: str = "off") -> ScheduleProgram:
+    """Recursive halving/doubling at world-chunk granularity.
+
+    Power-of-two worlds only, like the plane it mirrors.  Chunk ``c`` is
+    the c-th of ``world`` equal segments; at distance ``d`` rank ``me``
+    (bit ``(me//d) % 2``) keeps its bit-half of its active range and
+    ships the other half to ``me ^ d`` — exactly
+    ``comm/latency.py:_halving_rounds``'s convention, so the receiver's
+    ``reduce`` lands combine(keep, recvd) in the same operand order and
+    the parity is bit-identical.  Doubling reverses the walk with copies.
+    """
+    if world < 1 or world & (world - 1):
+        raise ValueError(f"rd program needs a power-of-two world, got {world}")
+    codec = wire_dtype if wire_dtype != "off" else None
+    rounds: List[Tuple[Step, ...]] = []
+    # active chunk range per rank, narrowed by the rank's own bits
+    ranges = [(0, world) for _ in range(world)]
+    d = world // 2
+    while d >= 1:
+        steps: List[Step] = []
+        new_ranges = list(ranges)
+        for me in range(world):
+            lo, hi = ranges[me]
+            mid = (lo + hi) // 2
+            partner = me ^ d
+            if (me // d) % 2 == 0:
+                keep, ship = (lo, mid), (mid, hi)
+            else:
+                keep, ship = (mid, hi), (lo, mid)
+            for c in range(*ship):
+                steps.extend(_message(me, partner, c, "reduce", codec))
+            new_ranges[me] = keep
+        ranges = new_ranges
+        rounds.append(tuple(steps))
+        d //= 2
+    d = 1
+    while d < world:
+        steps = []
+        new_ranges = list(ranges)
+        for me in range(world):
+            lo, hi = ranges[me]
+            partner = me ^ d
+            for c in range(lo, hi):
+                steps.extend(_message(me, partner, c, "copy"))
+            plo, phi = ranges[partner]
+            new_ranges[me] = (min(lo, plo), max(hi, phi))
+        ranges = new_ranges
+        rounds.append(tuple(steps))
+        d *= 2
+    return ScheduleProgram(
+        name=f"rd-w{world}",
+        world=world,
+        chunks=max(1, world),
+        rounds=tuple(rounds),
+        wire_dtype=wire_dtype,
+    )
+
+
+def tree_allreduce_program(world: int, wire_dtype: str = "off") -> ScheduleProgram:
+    """The binomial tree rooted at 0: one chunk, reduce up then broadcast
+    down, edges from the same ``_binomial_rounds`` tables the legacy
+    ``binomial_reduce_shard``/``binomial_broadcast_shard`` pair executes
+    (same edge order ⇒ same combine order ⇒ bit-identical parity)."""
+    from adapcc_tpu.comm.latency import _binomial_rounds, _tree_round_tables
+
+    codec = wire_dtype if wire_dtype != "off" else None
+    rounds: List[Tuple[Step, ...]] = []
+    distances = _binomial_rounds(world)
+    for d in distances:
+        perm, _ = _tree_round_tables(world, d, 0, up=True)
+        steps: List[Step] = []
+        for src, dst in perm:
+            steps.extend(_message(src, dst, 0, "reduce", codec))
+        if steps:
+            rounds.append(tuple(steps))
+    for d in reversed(distances):
+        perm, _ = _tree_round_tables(world, d, 0, up=False)
+        steps = []
+        for src, dst in perm:
+            steps.extend(_message(src, dst, 0, "copy"))
+        if steps:
+            rounds.append(tuple(steps))
+    return ScheduleProgram(
+        name=f"tree-binomial-w{world}",
+        world=world,
+        chunks=1,
+        rounds=tuple(rounds),
+        wire_dtype=wire_dtype,
+    )
+
+
+def two_level_allreduce_program(
+    pods: int, pod_size: int, wire_dtype: str = "off"
+) -> ScheduleProgram:
+    """The composed hierarchical plan as one flat-world program.
+
+    Rank ``p·S + i`` is member ``i`` of pod ``p``; the payload splits
+    into ``S = pod_size`` chunks.  Three phases, matching
+    ``allreduce_two_level_composed_shard``'s structure:
+
+    1. ring reduce-scatter inside each pod (S−1 rounds) — member ``i``
+       ends holding the pod-partial chunk ``i``;
+    2. per-chunk cross-pod allreduce among the member-``i`` ranks
+       (binomial reduce to pod 0's member, then broadcast back — the
+       ``leader_algo="tree"`` spelling, general in ``pods``);
+    3. ring all-gather inside each pod (S−1 rounds).
+
+    DCN-phase volume is 1/S of the payload per member — the composed
+    plane's whole point — and the program prices that way through
+    ``schedule_program_time``.
+    """
+    from adapcc_tpu.comm.latency import _binomial_rounds, _tree_round_tables
+
+    if pods < 1 or pod_size < 1:
+        raise ValueError(f"need pods >= 1 and pod_size >= 1, got {pods}x{pod_size}")
+    world = pods * pod_size
+    S = pod_size
+    codec = wire_dtype if wire_dtype != "off" else None
+    rounds: List[Tuple[Step, ...]] = []
+
+    def member(p: int, i: int) -> int:
+        return p * S + i
+
+    # phase 1: ring reduce-scatter within each pod over member index.
+    # Round r: member i ships chunk (i - r) mod S to member (i+1) mod S;
+    # chunk c travels i = c+r → c+r+1, so after S-1 rounds it sits fully
+    # pod-reduced at member (c-1) mod S — member i owns chunk (i+1) mod S
+    for r in range(S - 1):
+        steps: List[Step] = []
+        for p in range(pods):
+            for i in range(S):
+                c = (i - r) % S
+                steps.extend(
+                    _message(member(p, i), member(p, (i + 1) % S), c, "reduce", codec)
+                )
+        if steps:
+            rounds.append(tuple(steps))
+    # after the RS walk, chunk c sits fully pod-reduced at member (c-1)%S
+    owner = {c: (c - 1) % S for c in range(S)}
+    # phase 2: cross-pod binomial allreduce per chunk among its owners
+    distances = _binomial_rounds(pods)
+    for d in distances:
+        perm, _ = _tree_round_tables(pods, d, 0, up=True)
+        steps = []
+        for src_pod, dst_pod in perm:
+            for c in range(S):
+                steps.extend(
+                    _message(
+                        member(src_pod, owner[c]), member(dst_pod, owner[c]),
+                        c, "reduce", codec,
+                    )
+                )
+        if steps:
+            rounds.append(tuple(steps))
+    for d in reversed(distances):
+        perm, _ = _tree_round_tables(pods, d, 0, up=False)
+        steps = []
+        for src_pod, dst_pod in perm:
+            for c in range(S):
+                steps.extend(
+                    _message(
+                        member(src_pod, owner[c]), member(dst_pod, owner[c]),
+                        c, "copy",
+                    )
+                )
+        if steps:
+            rounds.append(tuple(steps))
+    # phase 3: ring all-gather within each pod.  Member i owns chunk
+    # (i+1) mod S; at round r it forwards the newest chunk it holds,
+    # (i + 1 - r) mod S, to member (i+1) mod S, who copies it in
+    for r in range(S - 1):
+        steps = []
+        for p in range(pods):
+            for i in range(S):
+                c = (i + 1 - r) % S
+                steps.extend(
+                    _message(member(p, i), member(p, (i + 1) % S), c, "copy")
+                )
+        if steps:
+            rounds.append(tuple(steps))
+    return ScheduleProgram(
+        name=f"two-level-{pods}x{S}",
+        world=world,
+        chunks=max(1, S),
+        rounds=tuple(rounds),
+        wire_dtype=wire_dtype,
+    )

@@ -1,0 +1,192 @@
+"""Chunk-granular collective schedule IR.
+
+The repo grew six hand-written execution planes (XLA psum, the quantized
+ppermute ring, two Pallas ring kernels ± fused codec, recursive-doubling
+and binomial-tree in ``comm/latency.py``, the hierarchical composed plane
+in ``comm/two_level.py``) — each re-implementing chunking, masking and
+codec plumbing, so only strategies with a hand-written twin were
+executable.  Following GC3/MSCCLang's chunk-oriented DSL and SCCL/TACCL's
+synthesized-algorithm model (PAPERS.md), this module is the one program
+form all of them share:
+
+- a :class:`ScheduleProgram` is a list of **rounds**; a round is a list of
+  typed :class:`Step`\\ s (``send``/``recv``/``reduce``/``copy``/``encode``/
+  ``decode``) over ``chunks`` named chunk buffers replicated on every rank;
+- rounds are barriers: every ``send`` reads its rank's *round-entry* buffer
+  state, and its matching ``recv`` must sit in the same round (a recv whose
+  send lands later is a deadlock — the verifier rejects it);
+- each ``recv`` is consumed by exactly one same-round ``reduce`` (combine
+  into the local chunk) or ``copy`` (overwrite the local chunk);
+- ``encode``/``decode`` mark a send/recv pair whose wire value takes the
+  named codec's quantize→dequantize round trip (``quant/codec.py``) — the
+  wire-dtype annotation is first-class, not an engine-side reroute;
+- ``relays`` names ranks that forward traffic without contributing input
+  or requiring delivery (the AdapCC relay mask, here a program property).
+
+Unlike :class:`adapcc_tpu.strategy.ir.CommRound`, a round is **not**
+constrained to a partial permutation — a rank may send several chunks to
+several peers in one round.  The lowering (``compiler/lower.py``) colors a
+round's messages into ppermute-able partial permutations; the IR itself
+stays at the algorithm's natural granularity, which is what lets it
+express schedules (e.g. the bidirectional pipelined ring in
+``compiler/synthesize.py``) that no ``CommRound``-shaped plane can.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Iterator, Optional, Tuple
+
+#: the closed set of step kinds; anything else is a construction error
+STEP_KINDS = ("send", "recv", "reduce", "copy", "encode", "decode")
+
+#: collectives a program may declare; today only allreduce has a lowering
+PROGRAM_COLLECTIVES = ("allreduce",)
+
+
+@dataclass(frozen=True)
+class Step:
+    """One typed step of one rank in one round.
+
+    ``peer`` is the destination rank for ``send`` and the source rank for
+    ``recv`` (required for both, meaningless elsewhere); ``codec`` names
+    the registered wire codec for ``encode``/``decode`` steps.
+    """
+
+    kind: str
+    rank: int
+    chunk: int
+    peer: Optional[int] = None
+    codec: Optional[str] = None
+
+    def __post_init__(self) -> None:
+        if self.kind not in STEP_KINDS:
+            raise ValueError(
+                f"unknown step kind {self.kind!r}; expected one of {STEP_KINDS}"
+            )
+        if self.kind in ("send", "recv") and self.peer is None:
+            raise ValueError(f"{self.kind} step at rank {self.rank} needs a peer")
+        if self.kind in ("encode", "decode") and not self.codec:
+            raise ValueError(f"{self.kind} step at rank {self.rank} needs a codec")
+
+    def describe(self) -> str:
+        """Human-readable spelling used by verifier rejections."""
+        bits = f"{self.kind}(rank={self.rank}, chunk={self.chunk}"
+        if self.peer is not None:
+            bits += f", peer={self.peer}"
+        if self.codec is not None:
+            bits += f", codec={self.codec}"
+        return bits + ")"
+
+
+@dataclass(frozen=True)
+class ScheduleProgram:
+    """One verified-lowerable collective schedule.
+
+    ``rounds`` is a tuple of rounds, each a tuple of :class:`Step`.  The
+    program is the single object the builders emit, the verifier certifies
+    (``compiler/verify.py``), the cost model prices
+    (``sim/cost_model.schedule_program_time``), the replay layer simulates
+    (``sim/replay.simulate_program``) and the lowering executes
+    (``compiler/lower.py``) — pricing and execution share the schedule by
+    construction because they share this object.
+    """
+
+    name: str
+    world: int
+    chunks: int
+    rounds: Tuple[Tuple[Step, ...], ...]
+    collective: str = "allreduce"
+    #: wire codec annotation; "off" = payload dtype end to end.  Programs
+    #: carrying encode/decode steps name their codec here so dispatch-time
+    #: pin-conflict checks and tuner keys see it without walking steps.
+    wire_dtype: str = "off"
+    #: ranks that forward without contributing input or needing delivery
+    relays: Tuple[int, ...] = ()
+
+    def __post_init__(self) -> None:
+        if self.world < 1:
+            raise ValueError(f"world must be >= 1, got {self.world}")
+        if self.chunks < 1:
+            raise ValueError(f"chunks must be >= 1, got {self.chunks}")
+        if self.collective not in PROGRAM_COLLECTIVES:
+            raise ValueError(
+                f"unknown collective {self.collective!r}; expected one of "
+                f"{PROGRAM_COLLECTIVES}"
+            )
+        object.__setattr__(
+            self, "rounds", tuple(tuple(rnd) for rnd in self.rounds)
+        )
+        object.__setattr__(self, "relays", tuple(sorted(set(self.relays))))
+        for r in self.relays:
+            if not (0 <= r < self.world):
+                raise ValueError(f"relay rank {r} out of range [0, {self.world})")
+        if len(self.relays) >= self.world:
+            raise ValueError("every rank is a relay: nothing contributes")
+        for i, rnd in enumerate(self.rounds):
+            for step in rnd:
+                if not (0 <= step.rank < self.world):
+                    raise ValueError(
+                        f"round {i}: {step.describe()} rank out of range "
+                        f"[0, {self.world})"
+                    )
+                if step.peer is not None and not (0 <= step.peer < self.world):
+                    raise ValueError(
+                        f"round {i}: {step.describe()} peer out of range "
+                        f"[0, {self.world})"
+                    )
+                if not (0 <= step.chunk < self.chunks):
+                    raise ValueError(
+                        f"round {i}: {step.describe()} chunk out of range "
+                        f"[0, {self.chunks})"
+                    )
+
+    # -- queries ---------------------------------------------------------------
+
+    @property
+    def num_rounds(self) -> int:
+        return len(self.rounds)
+
+    def contributors(self) -> Tuple[int, ...]:
+        """Ranks that contribute input and require delivery (non-relays)."""
+        relay = set(self.relays)
+        return tuple(r for r in range(self.world) if r not in relay)
+
+    def steps(self) -> Iterator[Tuple[int, Step]]:
+        for i, rnd in enumerate(self.rounds):
+            for step in rnd:
+                yield i, step
+
+    def total_sends(self) -> int:
+        return sum(1 for _, s in self.steps() if s.kind == "send")
+
+    def fingerprint(self) -> str:
+        """Stable structural hash — the compiled-executor cache key
+        component and the dispatch-trace provenance stamp.  Memoized:
+        the program is immutable and hot dispatch paths consult this per
+        collective call (the ``Strategy.fingerprint`` pattern)."""
+        cached = self.__dict__.get("_fingerprint")
+        if cached is not None:
+            return cached
+        h = hashlib.sha256()
+        h.update(
+            f"{self.name}|{self.world}|{self.chunks}|{self.collective}|"
+            f"{self.wire_dtype}|{self.relays}".encode()
+        )
+        for i, rnd in enumerate(self.rounds):
+            h.update(f"r{i}".encode())
+            for s in rnd:
+                h.update(
+                    f"{s.kind},{s.rank},{s.chunk},{s.peer},{s.codec};".encode()
+                )
+        fp = h.hexdigest()[:16]
+        self.__dict__["_fingerprint"] = fp
+        return fp
+
+    def __repr__(self) -> str:
+        return (
+            f"ScheduleProgram(name={self.name!r}, world={self.world}, "
+            f"chunks={self.chunks}, rounds={self.num_rounds}, "
+            f"wire_dtype={self.wire_dtype!r}, fingerprint={self.fingerprint()})"
+        )

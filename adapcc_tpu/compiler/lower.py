@@ -1,0 +1,192 @@
+"""ONE lowering: ScheduleProgram → compiled shard_map/ppermute executor.
+
+Every program that passes ``compiler/verify.py`` executes through this
+module — ring, recursive doubling, binomial tree, the composed two-level
+plan and any synthesized schedule alike.  The engine dispatches it via
+``engine.all_reduce(algo="ir")`` and stamps the executed program's
+fingerprint into the dispatch trace.
+
+Execution model (mirrors the IR's barrier-round semantics exactly):
+
+- the payload flattens and zero-pads to ``chunks × seg`` rows, one row
+  per named chunk buffer, identically on every rank;
+- each round snapshots its entry state; all sends read the snapshot, so
+  a chunk that is both shipped and overwritten in one round behaves as
+  the verifier's abstract interpretation says it does;
+- a round's messages are **colored** into partial permutations (distinct
+  sources, distinct destinations per color) — each color is one
+  ``lax.ppermute``.  The IR places no per-round fan-out limit; the
+  coloring is where the free-form schedule meets the ppermute contract,
+  which is exactly what lets one executor run schedules (two sends per
+  rank per round, say) that the CommRound-shaped planes cannot;
+- ``reduce`` consumers combine ``(local, received)`` in that operand
+  order — the same order ``comm/latency.py`` uses, which is what makes
+  the rd/tree parity bit-identical; ``copy`` consumers overwrite;
+- ``encode``/``decode`` pairs execute as the named codec's jittable
+  quantize→dequantize round trip (``WireCodec.apply``) on the wire value
+  — numerically identical to encode/ship/decode, with XLA free to fuse;
+- relays enter with the reduction identity and are excluded from the
+  ``AVG`` normalization count.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from adapcc_tpu.compiler.ir import ScheduleProgram
+from adapcc_tpu.primitives import ReduceOp
+
+
+def _combine(a: jnp.ndarray, b: jnp.ndarray, op: ReduceOp) -> jnp.ndarray:
+    if op is ReduceOp.MAX:
+        return jnp.maximum(a, b)
+    return a + b  # SUM; AVG normalizes once at the end
+
+
+def _identity_value(op: ReduceOp, dtype) -> float:
+    if op is ReduceOp.MAX:
+        if jnp.issubdtype(dtype, jnp.floating):
+            return float("-inf")
+        return int(jnp.iinfo(dtype).min)
+    return 0
+
+
+class _Color:
+    """One partial permutation of one round: the per-rank constant tables
+    a single ppermute + masked commit needs."""
+
+    __slots__ = (
+        "perm", "send_chunk", "is_src", "dst_chunk", "is_dst", "is_copy",
+        "encoded", "any_encoded",
+    )
+
+    def __init__(self, world: int) -> None:
+        self.perm: List[Tuple[int, int]] = []
+        self.send_chunk = np.zeros(world, dtype=np.int32)
+        self.is_src = np.zeros(world, dtype=bool)
+        self.dst_chunk = np.zeros(world, dtype=np.int32)
+        self.is_dst = np.zeros(world, dtype=bool)
+        self.is_copy = np.zeros(world, dtype=bool)
+        self.encoded = np.zeros(world, dtype=bool)
+        self.any_encoded = False
+
+    def can_take(self, src: int, dst: int) -> bool:
+        return not self.is_src[src] and not self.is_dst[dst]
+
+    def take(
+        self, src: int, dst: int, chunk: int, copy: bool, encoded: bool
+    ) -> None:
+        self.perm.append((src, dst))
+        self.send_chunk[src] = chunk
+        self.is_src[src] = True
+        self.dst_chunk[dst] = chunk
+        self.is_dst[dst] = True
+        self.is_copy[dst] = copy
+        self.encoded[src] = encoded
+        self.any_encoded = self.any_encoded or encoded
+
+
+def _color_rounds(program: ScheduleProgram) -> List[List[_Color]]:
+    """Greedy-color every round's messages into ppermute-able partial
+    permutations, in deterministic step order.  Memoized on the program —
+    it is immutable and the executor cache may rebuild per shape."""
+    cached = program.__dict__.get("_lowering_colors")
+    if cached is not None:
+        return cached
+    plan: List[List[_Color]] = []
+    for rnd in program.rounds:
+        sends = []
+        consumers = {}
+        encodes = set()
+        for step in rnd:
+            if step.kind == "send":
+                sends.append((step.rank, step.peer, step.chunk))
+            elif step.kind in ("reduce", "copy"):
+                consumers[(step.rank, step.chunk)] = step.kind
+            elif step.kind == "encode":
+                encodes.add((step.rank, step.chunk))
+        colors: List[_Color] = []
+        for src, dst, chunk in sends:
+            copy = consumers.get((dst, chunk)) == "copy"
+            encoded = (src, chunk) in encodes
+            for col in colors:
+                if col.can_take(src, dst):
+                    col.take(src, dst, chunk, copy, encoded)
+                    break
+            else:
+                col = _Color(program.world)
+                col.take(src, dst, chunk, copy, encoded)
+                colors.append(col)
+        plan.append(colors)
+    program.__dict__["_lowering_colors"] = plan
+    return plan
+
+
+def execute_program_shard(
+    x: jnp.ndarray,
+    program: ScheduleProgram,
+    axis_name: str,
+    op: ReduceOp = ReduceOp.SUM,
+) -> jnp.ndarray:
+    """Run ``program`` on this rank's payload inside a shard_map body.
+
+    ``x`` is the rank's full (replicated-shape) contribution; the result
+    is the completed collective in ``x``'s shape.  Callers are expected
+    to have verified the program (the engine verifies once per
+    fingerprint before compiling).
+    """
+    k = program.chunks
+    flat = x.reshape(-1)
+    n = flat.size
+    seg = -(-n // k)
+    pad = k * seg - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    acc = flat.reshape(k, seg)
+    me = lax.axis_index(axis_name)
+    if program.relays:
+        relay = np.zeros(program.world, dtype=bool)
+        relay[list(program.relays)] = True
+        ident = jnp.full_like(acc, _identity_value(op, acc.dtype))
+        acc = jnp.where(jnp.asarray(relay)[me], ident, acc)
+    codec = None
+    if program.wire_dtype != "off":
+        from adapcc_tpu.quant.codec import get_codec
+
+        codec = get_codec(program.wire_dtype)
+    for colors in _color_rounds(program):
+        entry = acc
+        for col in colors:
+            wire = entry[jnp.asarray(col.send_chunk)[me]]
+            if col.any_encoded and codec is not None:
+                wire = jnp.where(
+                    jnp.asarray(col.encoded)[me], codec.apply(wire), wire
+                )
+            recvd = lax.ppermute(wire, axis_name, col.perm)
+            dst_chunk = jnp.asarray(col.dst_chunk)[me]
+            cur = acc[dst_chunk]
+            new = jnp.where(
+                jnp.asarray(col.is_copy)[me], recvd, _combine(cur, recvd, op)
+            )
+            acc = acc.at[dst_chunk].set(
+                jnp.where(jnp.asarray(col.is_dst)[me], new, cur)
+            )
+    if op is ReduceOp.AVG:
+        acc = acc / len(program.contributors())
+    return acc.reshape(-1)[:n].reshape(x.shape)
+
+
+def allreduce_per_shard(
+    program: ScheduleProgram, axis_name: str, op: ReduceOp = ReduceOp.SUM
+):
+    """The engine-facing per-shard callable (stacked ``[1, *payload]``
+    convention, matching ``CollectiveEngine._shard_mapped``)."""
+
+    def per_shard(x: jnp.ndarray) -> jnp.ndarray:
+        return execute_program_shard(x[0], program, axis_name, op)[None]
+
+    return per_shard

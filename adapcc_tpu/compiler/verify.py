@@ -1,0 +1,188 @@
+"""Static verifier for :class:`~adapcc_tpu.compiler.ir.ScheduleProgram`.
+
+Every program is certified **before** lowering (the engine verifies once
+per fingerprint).  Verification is an abstract interpretation over
+contribution sets: chunk ``c`` on rank ``r`` carries the frozenset of
+ranks whose input has been folded into it.  The checks, each rejecting
+loudly with the offending ``(rank, round, chunk)`` named:
+
+1. **Matching** — every ``recv`` has exactly one same-round ``send`` with
+   mirrored endpoints (rounds are barriers, so a send in a later round
+   could never satisfy it: that is a deadlock, and the rejection says so);
+   every ``send`` has a matching ``recv`` (an unreceived send is lost
+   contribution); duplicate messages on one (src, dst, chunk) edge in one
+   round are ambiguous and rejected.
+2. **Consumption** — each recv is consumed by exactly one same-round
+   ``reduce`` or ``copy`` on its (rank, chunk); a reduce/copy with no recv
+   feeding it has nothing to combine; at most one recv lands per
+   (rank, chunk) per round so the combine order is well-defined.
+3. **No double-reduce** — a ``reduce`` whose incoming contribution set
+   intersects the local one would fold some rank's input in twice; the
+   duplicated contributors are named.
+4. **Codec pairing** — an ``encode`` must wrap a same-round send whose
+   receiver ``decode``\\ s with the same codec (an orphaned encode means
+   the receiver would combine quantized wire values as if exact); a
+   ``decode`` with no encoded incoming message decodes nothing.
+5. **Delivery** — after the last round every non-relay rank holds, for
+   every chunk, exactly the full contributor set (all non-relay ranks).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, List, Tuple
+
+from adapcc_tpu.compiler.ir import ScheduleProgram, Step
+
+
+class ScheduleVerificationError(ValueError):
+    """A program failed static verification; the message names the
+    offending step as ``(rank=…, round=…, chunk=…)``."""
+
+
+def _fail(round_idx: int, step: Step, why: str) -> None:
+    raise ScheduleVerificationError(
+        f"invalid schedule step at (rank={step.rank}, round={round_idx}, "
+        f"chunk={step.chunk}): {step.describe()}: {why}"
+    )
+
+
+def verify_program(program: ScheduleProgram) -> None:
+    """Certify ``program`` or raise :class:`ScheduleVerificationError`."""
+    contributors = frozenset(program.contributors())
+    # contribution state: state[rank][chunk] -> frozenset of folded ranks;
+    # relays start empty (they forward, they do not contribute)
+    state: List[List[FrozenSet[int]]] = [
+        [frozenset((r,)) if r in contributors else frozenset()
+         for _ in range(program.chunks)]
+        for r in range(program.world)
+    ]
+
+    for i, rnd in enumerate(program.rounds):
+        sends: Dict[Tuple[int, int, int], Step] = {}  # (src, dst, chunk)
+        recvs: Dict[Tuple[int, int, int], Step] = {}
+        consumers: Dict[Tuple[int, int], List[Step]] = {}  # (rank, chunk)
+        encodes: Dict[Tuple[int, int], Step] = {}  # (rank, chunk)
+        decodes: Dict[Tuple[int, int], Step] = {}
+        for step in rnd:
+            if step.kind == "send":
+                edge = (step.rank, step.peer, step.chunk)
+                if edge in sends:
+                    _fail(i, step, "duplicate send on this (src, dst, chunk) edge")
+                sends[edge] = step
+            elif step.kind == "recv":
+                edge = (step.peer, step.rank, step.chunk)
+                if edge in recvs:
+                    _fail(i, step, "duplicate recv on this (src, dst, chunk) edge")
+                recvs[edge] = step
+            elif step.kind in ("reduce", "copy"):
+                consumers.setdefault((step.rank, step.chunk), []).append(step)
+            elif step.kind == "encode":
+                if (step.rank, step.chunk) in encodes:
+                    _fail(i, step, "duplicate encode for this (rank, chunk)")
+                encodes[(step.rank, step.chunk)] = step
+            elif step.kind == "decode":
+                if (step.rank, step.chunk) in decodes:
+                    _fail(i, step, "duplicate decode for this (rank, chunk)")
+                decodes[(step.rank, step.chunk)] = step
+
+        # 1. send <-> recv bijection inside the barrier round
+        for edge, step in recvs.items():
+            if edge not in sends:
+                _fail(
+                    i, step,
+                    f"no matching send from rank {step.peer} in round {i} — "
+                    "rounds are barriers, so this recv can never be "
+                    "satisfied (deadlock)",
+                )
+        for edge, step in sends.items():
+            if edge not in recvs:
+                _fail(
+                    i, step,
+                    f"no matching recv at rank {step.peer} in round {i} — "
+                    "the sent contribution would be dropped",
+                )
+
+        # 2. one recv per (rank, chunk), consumed exactly once
+        landing: Dict[Tuple[int, int], Tuple[int, Step]] = {}
+        for (src, dst, chunk), step in recvs.items():
+            if (dst, chunk) in landing:
+                _fail(
+                    i, step,
+                    "a second recv lands on this (rank, chunk) in one round; "
+                    "the combine order would be ambiguous",
+                )
+            landing[(dst, chunk)] = (src, step)
+        for key, steps in consumers.items():
+            if len(steps) > 1:
+                _fail(
+                    i, steps[1],
+                    "chunk consumed twice in one round (double-reduce)",
+                )
+            if key not in landing:
+                _fail(i, steps[0], "consumes no received value (no recv feeds it)")
+        for key, (src, step) in landing.items():
+            if key not in consumers:
+                _fail(
+                    i, step,
+                    "received value is never consumed (missing reduce/copy)",
+                )
+
+        # 4. codec pairing rides the matched messages
+        for (rank, chunk), step in encodes.items():
+            edge = next(
+                (e for e in sends if e[0] == rank and e[2] == chunk), None
+            )
+            if edge is None:
+                _fail(i, step, "encode wraps no same-round send")
+            send = sends[edge]
+            dec = decodes.get((send.peer, chunk))
+            if dec is None:
+                _fail(
+                    i, step,
+                    f"orphaned encode: receiver rank {send.peer} has no "
+                    f"matching decode in round {i}",
+                )
+            if dec.codec != step.codec:
+                _fail(
+                    i, dec,
+                    f"decode codec {dec.codec!r} does not match encode "
+                    f"codec {step.codec!r}",
+                )
+        for (rank, chunk), step in decodes.items():
+            if (rank, chunk) not in landing:
+                _fail(i, step, "decode with no incoming message")
+            src, _ = landing[(rank, chunk)]
+            if (src, chunk) not in encodes:
+                _fail(
+                    i, step,
+                    f"decode of an unencoded message from rank {src}",
+                )
+
+        # 3. dataflow: sends read round-entry state; reduce unions
+        # disjoint contribution sets; copy overwrites
+        entry = [list(row) for row in state]
+        for (dst, chunk), (src, _step) in landing.items():
+            incoming = entry[src][chunk]
+            consumer = consumers[(dst, chunk)][0]
+            if consumer.kind == "copy":
+                state[dst][chunk] = incoming
+            else:  # reduce
+                dup = state[dst][chunk] & incoming
+                if dup:
+                    _fail(
+                        i, consumer,
+                        f"double-reduce: contributions {sorted(dup)} are "
+                        "already folded into this chunk",
+                    )
+                state[dst][chunk] = state[dst][chunk] | incoming
+
+    # 5. delivery: every non-relay rank holds the full contributor set
+    for r in program.contributors():
+        for c in range(program.chunks):
+            if state[r][c] != contributors:
+                missing = sorted(contributors - state[r][c])
+                raise ScheduleVerificationError(
+                    f"undelivered chunk at (rank={r}, round={program.num_rounds - 1}, "
+                    f"chunk={c}): final contributions {sorted(state[r][c])} "
+                    f"are missing ranks {missing}"
+                )

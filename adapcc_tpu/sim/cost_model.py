@@ -983,8 +983,10 @@ def adaptation_cost(
 
 #: algorithm candidates the size-adaptive selector prices, safest first
 #: ("ring" leads so a predicted tie keeps the bandwidth-optimal plane);
-#: mirrors ``adapcc_tpu.comm.latency.COLL_ALGOS`` minus "auto" (drift
-#: pinned by a test)
+#: mirrors ``adapcc_tpu.comm.latency.COLL_ALGOS`` minus "auto" (the
+#: selector mode) and "ir" (priced per-program by
+#: :func:`schedule_program_time`, not by a sized closed form) — drift
+#: pinned by a test
 COLL_ALGO_CANDIDATES = ("ring", "rd", "tree")
 
 
@@ -1366,6 +1368,38 @@ def ring_allreduce_time(
         return 0.0
     per_hop = coeffs.time(nbytes / chunks)
     return (2 * (world - 1) + chunks - 1) * per_hop
+
+
+def schedule_program_time(program, nbytes: float, coeffs: LinkCoeffs) -> float:
+    """Analytical latency of a ``compiler.ScheduleProgram``.
+
+    The IR's rounds are barriers, so the program's makespan is the sum over
+    rounds of the slowest link in that round.  Within a round, sends on the
+    same directed (src, dst) link serialize — their bytes coalesce onto one
+    α + β·bytes transfer — while distinct links run concurrently
+    (full-duplex, fully-connected: the same abstraction
+    :func:`ring_allreduce_time` and the recursive-doubling/tree terms price
+    against, so cross-plane rankings compare like with like).  Each send
+    carries one chunk of ``nbytes / program.chunks``.
+
+    For the builders this reproduces the closed forms exactly: the
+    segmented ring prices at ``2(w−1)·(α + β·n/w)``, and the bidirectional
+    pipelined program at ``2(w−1)·(α + β·n/(2w))`` — half the β term, the
+    novel schedule's whole point (docs/COMPILER.md §5).
+    """
+    if program.world < 2:
+        return 0.0
+    seg = float(nbytes) / max(1, program.chunks)
+    total = 0.0
+    for round_steps in program.rounds:
+        link_bytes: Dict[Tuple[int, int], float] = {}
+        for step in round_steps:
+            if step.kind == "send":
+                link = (step.rank, step.peer)
+                link_bytes[link] = link_bytes.get(link, 0.0) + seg
+        if link_bytes:
+            total += max(coeffs.time(b) for b in link_bytes.values())
+    return total
 
 
 # --------------------------------------------------------------------------- #

@@ -15,7 +15,7 @@ from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Tuple
 
 from adapcc_tpu.comm.relay import prune_broadcast_rounds, prune_reduce_rounds
 from adapcc_tpu.sim.cost_model import Link, LinkCostModel
-from adapcc_tpu.sim.events import EventSimulator, SimReport, TreeSchedule
+from adapcc_tpu.sim.events import EventSimulator, SimReport, Transfer, TreeSchedule
 from adapcc_tpu.strategy.ir import CommRound, Strategy, Tree
 
 #: collectives the replay layer knows how to lower from a tree strategy
@@ -132,6 +132,68 @@ def simulate_strategy(
         world=strategy.world_size,
         report=report,
         strategy_label=f"{strategy.synthesis or 'unnamed'} x{strategy.num_trans}",
+    )
+
+
+def simulate_program(
+    program,
+    cost_model: LinkCostModel,
+    nbytes: float,
+    keep_transfers: bool = True,
+) -> SimTimeline:
+    """Replay a ``compiler.ScheduleProgram`` — the SAME object the engine's
+    ``algo="ir"`` dispatch lowers and ``engine.schedule_program()`` returns,
+    not a parallel description that can drift from it.
+
+    The IR's rounds are barriers, so the replay is exact, not heuristic:
+    per round, sends sharing a directed link serialize (their chunk bytes
+    coalesce onto one transfer priced by ``cost_model.time_for``), distinct
+    links run concurrently, and the round completes at its slowest link.
+    Under a uniform cost model this reproduces
+    :func:`~adapcc_tpu.sim.cost_model.schedule_program_time` to the float —
+    the cross-check ``tests/test_compiler.py`` pins — while a heterogeneous
+    model (degraded links, two-level classes) prices each link at its own
+    α/β.
+    """
+    seg = float(nbytes) / max(1, program.chunks)
+    transfers: List[Transfer] = []
+    link_busy: Dict[Link, float] = {}
+    clock = 0.0
+    for round_idx, round_steps in enumerate(program.rounds):
+        link_chunks: Dict[Link, List[int]] = {}
+        for step in round_steps:
+            if step.kind == "send":
+                link_chunks.setdefault((step.rank, step.peer), []).append(step.chunk)
+        if not link_chunks:
+            continue
+        round_end = clock
+        for (src, dst), chunks in link_chunks.items():
+            dur = cost_model.time_for(src, dst, seg * len(chunks))
+            link_busy[(src, dst)] = link_busy.get((src, dst), 0.0) + dur
+            round_end = max(round_end, clock + dur)
+            if keep_transfers:
+                for chunk in chunks:
+                    transfers.append(
+                        Transfer(
+                            tree=0,
+                            round_idx=round_idx,
+                            chunk=chunk,
+                            src=src,
+                            dst=dst,
+                            nbytes=seg,
+                            start=clock,
+                            finish=clock + dur,
+                        )
+                    )
+        clock = round_end
+    report = SimReport(makespan=clock, transfers=transfers, link_busy=link_busy)
+    return SimTimeline(
+        seconds=clock,
+        collective=program.collective,
+        nbytes=nbytes,
+        world=program.world,
+        report=report,
+        strategy_label=f"program:{program.name}@{program.fingerprint()}",
     )
 
 

@@ -380,6 +380,18 @@ class Strategy:
         self.__dict__["_fingerprint"] = fp = h.hexdigest()[:16]
         return fp
 
+    def schedule_program(self, name: Optional[str] = None):
+        """This strategy as a chunk-granular ``compiler.ScheduleProgram``.
+
+        The program view of the tree set: chunk ``t`` is tree ``t``'s
+        segment, reduce rounds aligned by index across trees, then the
+        broadcast rounds — the same merged-round structure the schedule
+        plane executes, now in the one IR the verifier certifies and
+        ``engine.all_reduce(algo="ir")`` lowers (docs/COMPILER.md)."""
+        from adapcc_tpu.compiler.builders import program_from_strategy
+
+        return program_from_strategy(self, name=name)
+
     @staticmethod
     def ring(world_size: int, num_trans: int = 1, ips: Optional[Dict[int, str]] = None) -> "Strategy":
         """Chain ("ring"-schedule) strategy: tree t is the chain rooted at

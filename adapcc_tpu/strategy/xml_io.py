@@ -27,6 +27,31 @@ from xml.etree import ElementTree as ET
 
 from adapcc_tpu.strategy.ir import Strategy, Tree
 
+#: schema version stamped on emitted artifacts (``<trees schema=…>`` and
+#: ``<schedule schema=…>``).  Major.minor: a parser accepts any minor of its
+#: own major (attributes it does not know are additive) and **loudly
+#: rejects** a different major — before this stamp existed, a newer-schema
+#: artifact parsed "successfully" with its new semantics silently dropped.
+#: Absence of the attribute means a legacy/reference artifact and is
+#: accepted: reference strategy/*.xml fixtures never carried one.
+SCHEDULE_SCHEMA_VERSION = "1.0"
+
+
+def _check_schema_version(doc: "ET.Element", element: str) -> None:
+    """Reject an artifact stamped with a schema major we do not speak."""
+    raw = doc.attrib.get("schema")
+    if raw is None:
+        return  # legacy / reference artifact: pre-stamp schema, accepted
+    ours = SCHEDULE_SCHEMA_VERSION.split(".")[0]
+    theirs = raw.split(".")[0]
+    if not theirs.isdigit() or theirs != ours:
+        raise ValueError(
+            f"<{element} schema={raw!r}>: this build speaks schema major "
+            f"{ours} (version {SCHEDULE_SCHEMA_VERSION}); refusing to parse "
+            "a different major rather than silently dropping its semantics"
+        )
+
+
 # closing quote immediately followed by the next attribute pair (name='…'):
 # insert the missing space.  The lookahead requires a quote right after the
 # '=' so attribute *values* containing 'word=' (e.g. ip='host=a') are not
@@ -87,6 +112,7 @@ def parse_strategy_xml(text_or_path: str, chunk_bytes: int = 4 * 1024 * 1024) ->
     doc = _lenient_fromstring(text)
     if doc.tag != "trees":
         raise ValueError(f"expected <trees> root element, got <{doc.tag}>")
+    _check_schema_version(doc, "trees")
 
     trees: List[Tree] = []
     all_ranks: set = set()
@@ -155,6 +181,7 @@ def emit_strategy_xml(strategy: Strategy, path: Optional[str] = None) -> str:
     the chunk-granularity attributes (`<trees chunk_bytes=…>` and per-tree
     on `<root>`) that make the artifact self-contained for ring execution."""
     doc = ET.Element("trees")
+    doc.set("schema", SCHEDULE_SCHEMA_VERSION)
     if strategy.synthesis:
         # provenance: which formulation produced this strategy (a solver
         # fallback in production must be distinguishable from an optimum)
@@ -189,6 +216,96 @@ def emit_strategy_xml(strategy: Strategy, path: Optional[str] = None) -> str:
         with open(path, "w") as f:
             f.write(text)
     return text
+
+
+# --------------------------------------------------------------------------- #
+# schedule programs (the compiler IR's artifact form, docs/COMPILER.md)
+# --------------------------------------------------------------------------- #
+
+def emit_program_xml(program, path: Optional[str] = None) -> str:
+    """Serialize a ``compiler.ScheduleProgram`` to its XML artifact form.
+
+    ``<schedule schema=… name world chunks collective wire_dtype relays>``
+    wrapping one ``<round>`` element per round, one ``<step kind rank chunk
+    [peer] [codec]>`` per step **in program order** — step order inside a
+    round is semantic (it fixes combine order, hence bitwise results), so
+    the artifact preserves it and :func:`parse_program_xml` round-trips to
+    an equal fingerprint.
+    """
+    doc = ET.Element("schedule")
+    doc.set("schema", SCHEDULE_SCHEMA_VERSION)
+    doc.set("name", program.name)
+    doc.set("world", str(program.world))
+    doc.set("chunks", str(program.chunks))
+    doc.set("collective", program.collective)
+    doc.set("wire_dtype", program.wire_dtype)
+    if program.relays:
+        doc.set("relays", ",".join(str(r) for r in program.relays))
+    for round_steps in program.rounds:
+        round_el = ET.SubElement(doc, "round")
+        for step in round_steps:
+            el = ET.SubElement(round_el, "step")
+            el.set("kind", step.kind)
+            el.set("rank", str(step.rank))
+            el.set("chunk", str(step.chunk))
+            if step.peer is not None:
+                el.set("peer", str(step.peer))
+            if step.codec is not None:
+                el.set("codec", step.codec)
+    text = ET.tostring(doc, encoding="unicode")
+    if path:
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+def parse_program_xml(text_or_path: str):
+    """Parse a schedule-program XML artifact back into a
+    ``compiler.ScheduleProgram`` (inverse of :func:`emit_program_xml`).
+
+    Schema-major mismatches reject loudly (:data:`SCHEDULE_SCHEMA_VERSION`);
+    the program's own ``__post_init__`` validation then re-checks every
+    rank/chunk bound, so a corrupted artifact dies at the file that carries
+    it, not inside a later lowering.
+    """
+    from adapcc_tpu.compiler.ir import ScheduleProgram, Step
+
+    text = _maybe_read(text_or_path)
+    doc = _lenient_fromstring(text)
+    if doc.tag != "schedule":
+        raise ValueError(f"expected <schedule> root element, got <{doc.tag}>")
+    _check_schema_version(doc, "schedule")
+    try:
+        world = int(doc.attrib["world"])
+        chunks = int(doc.attrib["chunks"])
+    except (KeyError, ValueError) as e:
+        raise ValueError(f"<schedule>: bad or missing world/chunks attribute: {e}")
+    raw_relays = doc.attrib.get("relays", "")
+    relays = tuple(int(r) for r in raw_relays.split(",") if r.strip()) if raw_relays else ()
+    rounds = []
+    for round_el in doc.findall("round"):
+        steps = []
+        for el in round_el.findall("step"):
+            peer = el.attrib.get("peer")
+            steps.append(
+                Step(
+                    el.attrib["kind"],
+                    int(el.attrib["rank"]),
+                    int(el.attrib["chunk"]),
+                    peer=int(peer) if peer is not None else None,
+                    codec=el.attrib.get("codec"),
+                )
+            )
+        rounds.append(tuple(steps))
+    return ScheduleProgram(
+        name=doc.attrib.get("name", "parsed"),
+        world=world,
+        chunks=chunks,
+        rounds=tuple(rounds),
+        collective=doc.attrib.get("collective", "allreduce"),
+        wire_dtype=doc.attrib.get("wire_dtype", "off"),
+        relays=relays,
+    )
 
 
 # --------------------------------------------------------------------------- #

@@ -113,6 +113,16 @@ A2A_XLA_PATH = "xla"
 #: not a schema change — same rule as the rd/tree cells)
 TWO_LEVEL_PATH = "two-level"
 
+#: the compiled ScheduleProgram executor (``adapcc_tpu/compiler``,
+#: ``engine.all_reduce(algo="ir")``, docs/COMPILER.md) as a key-vocabulary
+#: path: record-mode engines time IR dispatches into this cell (the key's
+#: wire_dtype slot carries the program's codec annotation), and a pre-PR
+#: tuning.jsonl loads byte-identical next to it (a vocabulary extension,
+#: not a schema change — the rd/tree/two-level rule).  IR cells join a
+#: candidate grid only when the caller's ``algos`` names "ir" explicitly
+#: or a recorded cell exists — the default grids stay byte-stable.
+IR_PATH = "ir"
+
 #: the fused XLA collective plane (``engine.all_reduce``'s psum fastpath)
 #: as an allreduce cell: the baseline the algorithm cells compete against
 #: from THAT entry point — it can neither execute nor time the Pallas
@@ -516,7 +526,7 @@ class TuningPolicy:
                 and known not in cells
                 and (
                     known.path
-                    if known.path in ALGO_PATHS
+                    if known.path in ALGO_PATHS or known.path == IR_PATH
                     else ("xla" if known.path == XLA_PATH else "ring")
                 ) in allowed_algos
                 and (
@@ -588,6 +598,7 @@ class TuningPolicy:
             fused_quantized_ring_allreduce_time,
             quantized_ring_allreduce_time,
             recursive_doubling_allreduce_time,
+            ring_allreduce_time,
             staged_ring_allreduce_time,
         )
 
@@ -603,6 +614,12 @@ class TuningPolicy:
         if key.path == TREE_PATH:
             # a tree allreduce is two single-shot phases: reduce + broadcast
             return 2.0 * binomial_tree_time(world, float(nbytes), coeffs)
+        if key.path == IR_PATH:
+            # IR cells carry no program handle in the key, so the prior is
+            # the segmented-ring floor every builder meets or beats; the
+            # exact per-program price is sim.cost_model.schedule_program_time
+            # and a recorded cell's median supersedes this prior anyway
+            return ring_allreduce_time(world, float(nbytes), coeffs, chunks=world)
         if key.primitive == "allreduce" and key.path == XLA_PATH:
             # the fused XLA collective is the bandwidth-optimal ring on a
             # healthy torus: price it with the classic ring term

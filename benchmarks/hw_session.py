@@ -178,6 +178,13 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
       measured ring ↔ recursive-doubling crossover the cost model predicts
       (docs/LATENCY.md).  Needs a power-of-two multi-chip world; explicit
       skip row otherwise.
+    - ``ir_parity`` — the schedule-compiler parity A/B (the hardware twin
+      of ``make compiler-bench``, docs/COMPILER.md): the same 128 MB
+      allreduce once under ``ADAPCC_COLL_ALGO=ir`` (the xla impl row
+      reroutes through the compiled ScheduleProgram executor, program
+      fingerprint in the dispatch trace) and once unpinned (the XLA psum
+      and Pallas ring baselines) — the IR lowering's ppermute rounds vs
+      the hand-written planes on real ICI.
     - ``two_level_synth`` — the composed-vs-flat two-level A/B (the
       hardware twin of ``make hier-bench``, docs/HIERARCHY.md): the
       synthesized RS→AR→AG plan vs the ParTrees projection vs the flat
@@ -221,7 +228,8 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
         for name in (
             "busbw_ici_128m", "ring_smoke", "ring_chunk_sweep",
             "busbw_wire_dtype", "busbw_fused_wire", "tuner_convergence",
-            "overlap_ab", "small_msg_crossover", "two_level_synth",
+            "overlap_ab", "small_msg_crossover", "ir_parity",
+            "two_level_synth",
             "elastic_failover", "online_adaptation", "supervised_failover",
             "fabric_contention", "elastic_rejoin", "decode_slo",
         ):
@@ -337,6 +345,28 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
                 extra_env={"ADAPCC_COLL_ALGO": algo},
                 rec_extra={"coll_algo": algo},
             )
+    # schedule-compiler parity A/B (the hardware twin of `make
+    # compiler-bench`, docs/COMPILER.md): the same 128 MB allreduce once
+    # with ADAPCC_COLL_ALGO=ir — engine.all_reduce reroutes the xla impl
+    # row through the compiled ScheduleProgram executor (strategy-derived
+    # ring program; fingerprint stamped in the dispatch trace) — and once
+    # unpinned, where the xla row is the fused psum and pallas_ring is the
+    # staged kernel: the IR lowering priced against both hand-written
+    # planes on the same payload.  Allreduce ONLY: "ir" steers no other
+    # primitive (RS/AG keep their legacy planes under the pin)
+    for arm, env, impls in (
+        ("ir", {"ADAPCC_COLL_ALGO": "ir"}, "xla"),
+        ("baseline", None, "xla,pallas_ring"),
+    ):
+        _run(
+            "ir_parity",
+            [py, "-m", "benchmarks.collectives", "--world", str(world),
+             "--sizes", "128M", "--impls", impls,
+             "--collectives", "allreduce"],
+            900, out_path,
+            extra_env=env,
+            rec_extra={"arm": arm},
+        )
     # composed-vs-flat two-level A/B (the hardware twin of `make
     # hier-bench`, docs/HIERARCHY.md): one run on a 2x(world/2) virtual
     # pod mesh with the SYNTHESIZED composed plan (--hier emits ONE

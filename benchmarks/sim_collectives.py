@@ -562,6 +562,123 @@ def latency_sweep(
     return rows
 
 
+#: schedule-sweep program grid: the three hand-written planes re-emitted
+#: as compiler IR, plus the pipelined bidirectional schedule only the IR
+#: can express (adapcc_tpu/compiler/synthesize.py)
+SCHEDULE_PROGRAMS = ("ring", "rd", "tree", "pipelined")
+
+
+def schedule_sweep(
+    world: int,
+    sizes: Sequence[int],
+    programs: Sequence[str] = SCHEDULE_PROGRAMS,
+    model: Optional[LinkCostModel] = None,
+) -> List[dict]:
+    """Predicted rows for IR-lowered schedule programs over a size grid —
+    the hardware-free regression artifact for the schedule compiler
+    (``make compiler-bench``, docs/COMPILER.md).
+
+    Each row prices one (size, program) cell twice: ``pred_time_us`` is the
+    verified :class:`~adapcc_tpu.compiler.ScheduleProgram` under
+    :func:`~adapcc_tpu.sim.cost_model.schedule_program_time` (barrier
+    rounds, coalesced per-link bytes, full-duplex fully-connected), and
+    ``legacy_pred_time_us`` is the same algorithm's hand-written plane
+    pricing (the classic ring term / ``recursive_doubling_allreduce_time``
+    / ``2 × binomial_tree_time``), so drift between the IR pricing and the
+    plane pricing is visible in one artifact.  The ``pipelined`` program
+    has no legacy plane — that is the compiler's point — so its row stamps
+    ``legacy_pred_time_us = None`` and ``lockstep_ring_us`` instead, with
+    ``beats_lockstep_ring`` flagging the bandwidth-bound win.  Every
+    program passes :func:`~adapcc_tpu.compiler.verify_program` before it is
+    priced.  Deterministic: same calibration → byte-identical rows.
+    """
+    from adapcc_tpu.compiler import (
+        pipelined_allreduce_program,
+        rd_allreduce_program,
+        ring_allreduce_program,
+        tree_allreduce_program,
+        verify_program,
+    )
+    from adapcc_tpu.sim.cost_model import (
+        binomial_tree_time,
+        bottleneck_ring_coeffs,
+        quantized_ring_allreduce_time,
+        recursive_doubling_allreduce_time,
+        ring_allreduce_time,
+        schedule_program_time,
+    )
+
+    programs = [p.strip() for p in programs if str(p).strip()]
+    bad = [p for p in programs if p not in SCHEDULE_PROGRAMS]
+    if bad:
+        raise ValueError(
+            f"unknown program(s) {bad}; expected a subset of "
+            f"{SCHEDULE_PROGRAMS}"
+        )
+    if model is None:
+        model = load_or_default(world=world)
+    elif model.world != world:
+        raise ValueError(f"model world {model.world} != sweep world {world}")
+    coeffs = bottleneck_ring_coeffs(model, world)
+
+    builders = {
+        "ring": lambda: ring_allreduce_program(world),
+        "rd": lambda: rd_allreduce_program(world),
+        "tree": lambda: tree_allreduce_program(world),
+        "pipelined": lambda: pipelined_allreduce_program(world),
+    }
+    legacy = {
+        # the segmented-ring plane's own term, 2(w−1)·(α + β·n/w) — the IR
+        # re-emission must reproduce it exactly, and the row shows it does
+        "ring": lambda n: quantized_ring_allreduce_time(world, n, coeffs, "off"),
+        "rd": lambda n: recursive_doubling_allreduce_time(world, n, coeffs),
+        "tree": lambda n: 2.0 * binomial_tree_time(world, n, coeffs),
+        "pipelined": None,
+    }
+    rows: List[dict] = []
+    for name in programs:
+        prog = builders[name]()
+        verify_program(prog)
+        fp = prog.fingerprint()
+        for nbytes in sizes:
+            seconds = schedule_program_time(prog, float(nbytes), coeffs)
+            algbw = nbytes / seconds / 1e9 if seconds > 0 else 0.0
+            legacy_fn = legacy[name]
+            legacy_us = (
+                round(legacy_fn(float(nbytes)) * 1e6, 3)
+                if legacy_fn is not None else None
+            )
+            row = {
+                "mode": "simulated",
+                "collective": "allreduce",
+                "impl": "ir",
+                "strategy": prog.name,
+                "program_fingerprint": fp,
+                "world": world,
+                "size_bytes": int(nbytes),
+                "chunks": prog.chunks,
+                "rounds": prog.num_rounds,
+                "pred_time_us": round(seconds * 1e6, 3),
+                "legacy_pred_time_us": legacy_us,
+                "algbw_gbps": round(algbw, 6),
+                "busbw_gbps": round(
+                    algbw * BUS_FACTORS["allreduce"](world), 6
+                ),
+                "calibration": model.source,
+            }
+            if name == "pipelined":
+                lockstep = ring_allreduce_time(world, float(nbytes), coeffs)
+                row["lockstep_ring_us"] = round(lockstep * 1e6, 3)
+                row["beats_lockstep_ring"] = seconds < lockstep
+            rows.append(row)
+    if not rows:
+        raise ValueError(
+            f"schedule sweep produced no rows: sizes={list(sizes)} "
+            f"programs={list(programs)}"
+        )
+    return rows
+
+
 def hier_sweep(
     sizes: Sequence[int],
     pods: Sequence[int] = (2, 4, 8),
@@ -1724,6 +1841,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="latency-sweep algorithm grid",
     )
     ap.add_argument(
+        "--schedule-sweep", action="store_true",
+        help="price IR-lowered schedule programs (compiler.ScheduleProgram: "
+        "ring/rd/tree re-emitted as IR plus the pipelined bidirectional "
+        "schedule no hand-written plane expresses) over --sizes instead of "
+        "the strategy grid, each verified then priced by "
+        "schedule_program_time next to its legacy plane's pricing (make "
+        "compiler-bench; docs/COMPILER.md)",
+    )
+    ap.add_argument(
+        "--programs", default=",".join(SCHEDULE_PROGRAMS),
+        help="schedule-sweep program grid",
+    )
+    ap.add_argument(
         "--adapt-sweep", action="store_true",
         help="replay the closed adaptation loop instead of the strategy "
         "grid: per-step drift-detection timeline rows plus a summary row "
@@ -1801,6 +1931,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--overlap-sweep", args.overlap_sweep),
             ("--hier-sweep", args.hier_sweep),
             ("--latency-sweep", args.latency_sweep),
+            ("--schedule-sweep", args.schedule_sweep),
             ("--fault-sweep", args.fault_sweep),
             ("--adapt-sweep", args.adapt_sweep),
             ("--chaos-sweep", args.chaos_sweep),
@@ -2029,6 +2160,37 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     f"step={row['step']:>2} epoch={row['epoch']}{star} "
                     f"alive={len(row['alive'])} relays={len(row['relays'])} "
                     f"pred={row['pred_time_us']:>10.1f}us"
+                )
+        return 0
+    if args.schedule_sweep:
+        if args.hosts > 1:
+            # the program grid prices the flat --world mesh; silently
+            # accepting --hosts would read as "priced that host split"
+            # when nothing used it (the --hier-sweep precedent)
+            ap.error("--hosts has no effect on --schedule-sweep (programs "
+                     "price the flat --world mesh)")
+        rows = schedule_sweep(
+            world=args.world,
+            sizes=[parse_size(s) for s in args.sizes.split(",")],
+            programs=[p.strip() for p in args.programs.split(",") if p.strip()],
+            model=model,
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            else:
+                legacy = row["legacy_pred_time_us"]
+                legacy_str = (
+                    f"legacy={legacy:>10.1f}us" if legacy is not None
+                    else f"lockstep={row['lockstep_ring_us']:>8.1f}us"
+                    + ("*" if row.get("beats_lockstep_ring") else " ")
+                )
+                print(
+                    f"[sim] schedule {row['size_bytes']:>12}B "
+                    f"{row['strategy']:<20} "
+                    f"pred={row['pred_time_us']:>10.1f}us  {legacy_str}  "
+                    f"busbw={row['busbw_gbps']:>8.3f}GB/s  "
+                    f"rounds={row['rounds']:>2} chunks={row['chunks']:>2}"
                 )
         return 0
     if args.latency_sweep:

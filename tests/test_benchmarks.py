@@ -529,6 +529,92 @@ def test_latency_sweep_cli_emits_json(capsys):
     assert {r["algo"] for r in rows} == {"ring", "rd", "tree"}
 
 
+# ------------------------------------------------ schedule sweep (PR 15)
+
+
+def test_schedule_sweep_rows_byte_identical_and_parity_pinned():
+    """The compiler-bench artifact (docs/COMPILER.md §5) is deterministic
+    to the byte, reproduces each legacy plane's own pricing term on the
+    re-emitted programs, and stamps the pipelined program's
+    beats-lockstep-ring flag at bandwidth-bound sizes."""
+    from benchmarks.sim_collectives import SCHEDULE_PROGRAMS, schedule_sweep
+
+    sizes = [64 << 10, 1 << 20, 128 << 20]
+    rows = schedule_sweep(8, sizes)
+    again = schedule_sweep(8, sizes)
+    assert [json.dumps(r, sort_keys=True) for r in rows] == [
+        json.dumps(r, sort_keys=True) for r in again
+    ]
+    assert len(rows) == len(sizes) * len(SCHEDULE_PROGRAMS)
+    for r in rows:
+        assert r["mode"] == "simulated" and r["impl"] == "ir"
+        assert r["collective"] == "allreduce" and r["world"] == 8
+        assert len(r["program_fingerprint"]) == 16
+    by = {(r["size_bytes"], r["strategy"].split("-")[0]): r for r in rows}
+    for s in sizes:
+        # the ring re-emission reproduces the segmented-ring plane's own
+        # term exactly — every hop is distance 1, so the fully-connected
+        # IR abstraction and the ring embedding agree to the digit
+        r = by[(s, "ring")]
+        assert r["pred_time_us"] == r["legacy_pred_time_us"]
+        # rd/tree legacy terms serialize each message over its ring-hop
+        # distance; the IR price assumes full-duplex point-to-point links,
+        # so it lower-bounds the plane term — the drift the row exposes
+        for algo in ("rd", "tree"):
+            r = by[(s, algo)]
+            assert r["legacy_pred_time_us"] is not None
+            assert r["pred_time_us"] <= r["legacy_pred_time_us"]
+        # the pipelined program has no legacy plane — that is the point —
+        # and beats the lockstep ring at every bandwidth-bound size
+        p = by[(s, "pipelined")]
+        assert p["legacy_pred_time_us"] is None
+        assert p["beats_lockstep_ring"]
+        assert p["pred_time_us"] < p["lockstep_ring_us"]
+    with pytest.raises(ValueError, match="unknown program"):
+        schedule_sweep(8, sizes, programs=("rong",))
+
+
+def test_schedule_sweep_cli_mutually_exclusive_and_rejects_hosts(capsys):
+    from benchmarks.sim_collectives import main
+
+    for other in (
+        ["--ring-sweep"],
+        ["--tune-replay"],
+        ["--fused-sweep"],
+        ["--overlap-sweep"],
+        ["--fault-sweep"],
+        ["--latency-sweep"],
+        ["--hier-sweep"],
+        ["--adapt-sweep"],
+        ["--chaos-sweep"],
+        ["--fabric-sweep"],
+        ["--recovery-sweep"],
+        ["--serve-sweep"],
+        ["--wire-dtype", "off,int8"],
+    ):
+        with pytest.raises(SystemExit):
+            main(["--schedule-sweep"] + other)
+    # the programs price the flat --world mesh: --hosts is meaningless
+    with pytest.raises(SystemExit):
+        main(["--schedule-sweep", "--hosts", "2"])
+    capsys.readouterr()
+
+
+def test_schedule_sweep_cli_emits_json(capsys):
+    from benchmarks.sim_collectives import main
+
+    assert main([
+        "--schedule-sweep", "--world", "8", "--sizes", "1M,128M",
+        "--programs", "ring,pipelined", "--json",
+    ]) == 0
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rows and all(r["impl"] == "ir" for r in rows)
+    assert {r["strategy"] for r in rows} == {
+        "ring-seg-w8", "pipelined-bidir-w8",
+    }
+    assert all("program_fingerprint" in r for r in rows)
+
+
 def test_hier_sweep_rows_byte_identical_and_decision_flagged():
     """The hier-bench artifact (docs/HIERARCHY.md §4) is deterministic to
     the byte over the (pods × pod_size × size) grid and stamps the

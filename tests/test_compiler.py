@@ -1,0 +1,595 @@
+"""Collective schedule compiler (adapcc_tpu/compiler): one chunk-granular
+IR, verified and lowered to every data plane.
+
+Parity contract, pinned per case at the tightest tolerance the legacy
+plane admits:
+
+- **bit-identical** where the legacy plane is a deterministic ppermute
+  schedule whose edge tables and combine-operand order the builder
+  mirrors: the segmented ring program vs the engine's merged strategy
+  plane, the rd program vs ``rd_allreduce_shard``, the tree program vs
+  the binomial reduce/broadcast pair;
+- **ulp-bounded (allclose)** where the reference plane is XLA's fused
+  ``psum`` / ``psum_scatter``, whose reduction tree re-associates floats
+  in an order no ppermute schedule reproduces: the IR executor vs the
+  psum fastpath, and the two-level composed program vs the full sum.
+
+The verifier's mutation battery rejects a dropped recv, a double-reduce,
+and an orphaned encode, each naming the offending (rank, round, chunk);
+the pipelined bidirectional schedule — inexpressible as CommRound partial
+permutations — runs end to end through ``engine.all_reduce(algo="ir")``
+with its fingerprint in the dispatch trace, and the replay layer prices
+the SAME program object the engine executes.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from adapcc_tpu.comm.engine import CollectiveEngine
+from adapcc_tpu.compiler import (
+    PROGRAM_COLLECTIVES,
+    STEP_KINDS,
+    ScheduleProgram,
+    ScheduleVerificationError,
+    Step,
+    execute_program_shard,
+    pipelined_allreduce_program,
+    program_from_strategy,
+    rd_allreduce_program,
+    ring_allreduce_program,
+    tree_allreduce_program,
+    two_level_allreduce_program,
+    verify_program,
+)
+from adapcc_tpu.primitives import ReduceOp
+from adapcc_tpu.strategy.ir import CommRound, Strategy
+from adapcc_tpu.utils.observability import CollectiveTrace
+
+WORLD = 8
+
+
+@pytest.fixture
+def engine8(mesh8):
+    trace = CollectiveTrace()
+    return CollectiveEngine(mesh8, Strategy.ring(WORLD), trace=trace), trace
+
+
+def _payload(n=96, seed=0):
+    return np.random.default_rng(seed).normal(size=(WORLD, n)).astype(np.float32)
+
+
+# --------------------------------------------------------------------------- #
+# IR structure
+# --------------------------------------------------------------------------- #
+
+def test_step_and_program_validation():
+    assert set(STEP_KINDS) == {"send", "recv", "reduce", "copy", "encode", "decode"}
+    assert PROGRAM_COLLECTIVES == ("allreduce",)
+    with pytest.raises(ValueError, match="unknown step kind"):
+        Step("teleport", 0, 0)
+    with pytest.raises(ValueError, match="peer"):
+        Step("send", 0, 0)  # send needs a peer
+    with pytest.raises(ValueError, match="codec"):
+        Step("encode", 0, 0)  # encode needs a codec
+    with pytest.raises(ValueError, match="out of range"):
+        ScheduleProgram(
+            "bad", world=2, chunks=1,
+            rounds=((Step("send", 0, 0, peer=5), Step("recv", 5, 0, peer=0)),),
+        )
+    with pytest.raises(ValueError, match="relay"):
+        ScheduleProgram("all-relay", world=2, chunks=1, rounds=(), relays=(0, 1))
+
+
+def test_fingerprint_is_stable_and_structure_sensitive():
+    a = ring_allreduce_program(WORLD)
+    b = ring_allreduce_program(WORLD)
+    assert a.fingerprint() == b.fingerprint()
+    mutated = dataclasses.replace(a, wire_dtype="bf16")
+    assert mutated.fingerprint() != a.fingerprint()
+
+
+@pytest.mark.parametrize(
+    "build",
+    [
+        lambda: ring_allreduce_program(WORLD),
+        lambda: ring_allreduce_program(4, wire_dtype="int8"),
+        lambda: rd_allreduce_program(WORLD),
+        lambda: rd_allreduce_program(4, wire_dtype="bf16"),
+        lambda: tree_allreduce_program(WORLD),
+        lambda: tree_allreduce_program(6),
+        lambda: two_level_allreduce_program(2, 4),
+        lambda: two_level_allreduce_program(3, 2),
+        lambda: pipelined_allreduce_program(WORLD),
+        lambda: pipelined_allreduce_program(4, wire_dtype="bf16"),
+        lambda: Strategy.binary(WORLD, 2).schedule_program(),
+    ],
+    ids=[
+        "ring8", "ring4-int8", "rd8", "rd4-bf16", "tree8", "tree6",
+        "twolevel-2x4", "twolevel-3x2", "pipelined8", "pipelined4-bf16",
+        "binary8x2",
+    ],
+)
+def test_every_builder_passes_the_verifier(build):
+    verify_program(build())
+
+
+def test_rd_builder_rejects_non_power_of_two():
+    with pytest.raises(ValueError, match="power-of-two"):
+        rd_allreduce_program(6)
+
+
+def test_pipelined_schedule_is_inexpressible_as_comm_rounds():
+    """The novel schedule's point: round 0 has every rank sending on BOTH
+    directed neighbors — two sends per rank — which a CommRound partial
+    permutation (all sources distinct) rejects by construction."""
+    prog = pipelined_allreduce_program(WORLD)
+    first = prog.rounds[0]
+    edges = tuple(
+        (s.rank, s.peer) for s in first if s.kind == "send"
+    )
+    srcs = [src for src, _ in edges]
+    assert len(set(srcs)) < len(srcs)  # duplicate sources: 2 sends per rank
+    with pytest.raises(ValueError, match="not a partial permutation"):
+        CommRound(edges)
+
+
+# --------------------------------------------------------------------------- #
+# verifier mutation battery
+# --------------------------------------------------------------------------- #
+
+def _mutate(program, round_idx, drop=None, add=None):
+    rounds = [list(r) for r in program.rounds]
+    if drop is not None:
+        rounds[round_idx] = [
+            s for s in rounds[round_idx]
+            if not (s.kind == drop.kind and s.rank == drop.rank
+                    and s.chunk == drop.chunk and s.peer == drop.peer)
+        ]
+    if add is not None:
+        rounds[round_idx] = rounds[round_idx] + list(add)
+    return dataclasses.replace(
+        program, rounds=tuple(tuple(r) for r in rounds)
+    )
+
+
+def test_verifier_rejects_dropped_recv_naming_the_step():
+    prog = ring_allreduce_program(4)
+    victim = next(s for _, s in prog.steps() if s.kind == "recv")
+    bad = _mutate(prog, 0, drop=victim)
+    with pytest.raises(ScheduleVerificationError) as ei:
+        verify_program(bad)
+    msg = str(ei.value)
+    assert "round=0" in msg and "deadlock" not in msg
+    # dropping the recv leaves its send unmatched: the send is named
+    assert "no matching recv" in msg
+
+
+def test_verifier_rejects_dropped_send_as_deadlock():
+    prog = ring_allreduce_program(4)
+    victim = next(s for _, s in prog.steps() if s.kind == "send")
+    bad = _mutate(prog, 0, drop=victim)
+    with pytest.raises(ScheduleVerificationError, match="deadlock"):
+        verify_program(bad)
+
+
+def test_verifier_rejects_double_reduce_naming_contributors():
+    # rank 0 sends chunk 0 to rank 1 twice across rounds: the second
+    # reduce folds rank 0's contribution in again
+    rounds = (
+        (Step("send", 0, 0, peer=1), Step("recv", 1, 0, peer=0),
+         Step("reduce", 1, 0)),
+        (Step("send", 0, 0, peer=1), Step("recv", 1, 0, peer=0),
+         Step("reduce", 1, 0)),
+        (Step("send", 1, 0, peer=0), Step("recv", 0, 0, peer=1),
+         Step("copy", 0, 0)),
+    )
+    bad = ScheduleProgram("double", world=2, chunks=1, rounds=rounds)
+    with pytest.raises(ScheduleVerificationError) as ei:
+        verify_program(bad)
+    msg = str(ei.value)
+    assert "double-reduce" in msg and "rank=1" in msg and "round=1" in msg
+
+
+def test_verifier_rejects_orphaned_encode_naming_receiver():
+    prog = ring_allreduce_program(4, wire_dtype="bf16")
+    victim = next(s for _, s in prog.steps() if s.kind == "decode")
+    bad = _mutate(prog, 0, drop=victim)
+    with pytest.raises(ScheduleVerificationError, match="orphaned encode"):
+        verify_program(bad)
+
+
+def test_verifier_rejects_undelivered_chunk():
+    # a reduce-only program: rank 0 never gets rank 1's contribution back
+    rounds = (
+        (Step("send", 1, 0, peer=0), Step("recv", 0, 0, peer=1),
+         Step("reduce", 0, 0)),
+    )
+    bad = ScheduleProgram("undelivered", world=2, chunks=1, rounds=rounds)
+    with pytest.raises(ScheduleVerificationError) as ei:
+        verify_program(bad)
+    assert "missing ranks [0]" in str(ei.value)
+
+
+def test_verifier_rejects_unconsumed_recv():
+    rounds = (
+        (Step("send", 0, 0, peer=1), Step("recv", 1, 0, peer=0)),
+    )
+    bad = ScheduleProgram("unconsumed", world=2, chunks=1, rounds=rounds)
+    with pytest.raises(ScheduleVerificationError, match="never consumed"):
+        verify_program(bad)
+
+
+# --------------------------------------------------------------------------- #
+# lowering parity (tolerances stated per case in the module docstring)
+# --------------------------------------------------------------------------- #
+
+def test_ir_ring_bit_identical_to_merged_strategy_plane(mesh8):
+    """The generic strategy lowering vs the engine's merged multi-tree
+    executor on the SAME Strategy.ring(8, 8): both are ppermute schedules
+    with identical edge tables and combine order — bit-identical."""
+    strat = Strategy.ring(WORLD, num_trans=WORLD)
+    eng = CollectiveEngine(mesh8, strat, use_xla_fastpath=False)
+    x = jnp.asarray(_payload(seed=1))
+    legacy = np.asarray(eng.all_reduce(x))
+    ir = np.asarray(eng.all_reduce(x, algo="ir"))
+    np.testing.assert_array_equal(ir, legacy)
+
+
+def test_ir_rd_and_tree_bit_identical_to_legacy_planes(engine8):
+    """rd/tree builders mirror the legacy planes' edge tables and the
+    ``combine(local, recvd)`` operand order — bit-identical."""
+    eng, _ = engine8
+    x = jnp.asarray(_payload(seed=2))
+    for algo, build in (
+        ("rd", rd_allreduce_program),
+        ("tree", tree_allreduce_program),
+    ):
+        legacy = np.asarray(eng.all_reduce(x, algo=algo))
+        eng.set_schedule_program(build(WORLD))
+        ir = np.asarray(eng.all_reduce(x, algo="ir"))
+        np.testing.assert_array_equal(ir, legacy)
+
+
+def test_ir_vs_psum_is_ulp_bounded(engine8):
+    """vs the fused XLA psum the tolerance is allclose, NOT bitwise: XLA's
+    reduction tree re-associates float adds in its own order."""
+    eng, _ = engine8
+    x = jnp.asarray(_payload(seed=3))
+    psum = np.asarray(eng.all_reduce(x))
+    ir = np.asarray(eng.all_reduce(x, algo="ir"))
+    np.testing.assert_allclose(ir, psum, rtol=1e-5, atol=1e-5)
+
+
+def test_two_level_program_allclose_to_sum(mesh8):
+    """The flat-world two-level program vs the numpy oracle: allclose (the
+    composed plane it mirrors runs an XLA psum_scatter pod phase, so there
+    is no deterministic legacy ordering to pin bitwise)."""
+    eng = CollectiveEngine(mesh8, Strategy.ring(WORLD))
+    eng.set_schedule_program(two_level_allreduce_program(2, 4))
+    xn = _payload(seed=4)
+    got = np.asarray(eng.all_reduce(jnp.asarray(xn), algo="ir"))
+    np.testing.assert_allclose(
+        got, np.broadcast_to(xn.sum(0), xn.shape), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_ir_max_and_avg_ops(engine8):
+    eng, _ = engine8
+    xn = _payload(seed=5)
+    x = jnp.asarray(xn)
+    got_max = np.asarray(eng.all_reduce(x, op=ReduceOp.MAX, algo="ir"))
+    np.testing.assert_array_equal(got_max, np.broadcast_to(xn.max(0), xn.shape))
+    got_avg = np.asarray(eng.all_reduce(x, op=ReduceOp.AVG, algo="ir"))
+    np.testing.assert_allclose(
+        got_avg, np.broadcast_to(xn.mean(0), xn.shape), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_ir_codec_program_roundtrips_quantization(mesh8):
+    """A bf16-annotated program executes the codec on the wire: result is
+    close to the sum at bf16 precision, not fp32-exact."""
+    eng = CollectiveEngine(mesh8, Strategy.ring(WORLD))
+    eng.set_schedule_program(ring_allreduce_program(WORLD, wire_dtype="bf16"))
+    xn = _payload(seed=6)
+    got = np.asarray(eng.all_reduce(jnp.asarray(xn), algo="ir"))
+    want = np.broadcast_to(xn.sum(0), xn.shape)
+    np.testing.assert_allclose(got, want, rtol=0.05, atol=0.1)
+    assert not np.array_equal(got, want)  # the codec really ran
+
+
+def test_relay_program_excludes_relay_from_contribution(mesh8):
+    """A program with a relay: the relay's input is NOT folded in, and
+    non-relay ranks receive the contributors' sum (the engine's relay
+    contract, expressed as first-class program relays)."""
+    relay = WORLD - 1
+    strat = Strategy.ring(WORLD, num_trans=WORLD)
+    prog = dataclasses.replace(
+        program_from_strategy(strat, name="ring-relay"), relays=(relay,)
+    )
+    # the segmented ring forwards through every rank, so the relay is a
+    # pure forwarder: delivery to it is fine, contribution from it is not
+    eng = CollectiveEngine(mesh8, Strategy.ring(WORLD))
+    eng.set_schedule_program(prog)
+    xn = _payload(seed=7)
+    got = np.asarray(eng.all_reduce(jnp.asarray(xn), algo="ir"))
+    want = xn[:relay].sum(0)
+    for r in range(WORLD):
+        if r != relay:
+            np.testing.assert_allclose(got[r], want, rtol=1e-5, atol=1e-5)
+
+
+# --------------------------------------------------------------------------- #
+# novel pipelined schedule end to end
+# --------------------------------------------------------------------------- #
+
+def test_pipelined_program_end_to_end_with_fingerprint_in_trace(engine8):
+    eng, trace = engine8
+    prog = pipelined_allreduce_program(WORLD)
+    eng.set_schedule_program(prog)
+    assert eng.schedule_program() is prog  # replay takes this same object
+    xn = _payload(seed=8)
+    got = np.asarray(eng.all_reduce(jnp.asarray(xn), algo="ir"))
+    np.testing.assert_allclose(
+        got, np.broadcast_to(xn.sum(0), xn.shape), rtol=1e-5, atol=1e-5
+    )
+    ev = trace.events()[-1]
+    assert ev.impl == "ir"
+    assert ev.extra["program"] == prog.name
+    assert ev.extra["program_fingerprint"] == prog.fingerprint()
+    assert "cache_hit" in ev.extra
+
+
+def test_pipelined_beats_lockstep_ring_in_sim_at_bandwidth_bound_sizes():
+    from adapcc_tpu.sim.cost_model import (
+        LinkCoeffs,
+        ring_allreduce_time,
+        schedule_program_time,
+    )
+
+    coeffs = LinkCoeffs(alpha=1e-6, beta=1.0 / 25e9)
+    prog = pipelined_allreduce_program(WORLD)
+    for nbytes in (1 << 20, 128 << 20):
+        pipelined = schedule_program_time(prog, float(nbytes), coeffs)
+        lockstep = ring_allreduce_time(WORLD, float(nbytes), coeffs)
+        assert pipelined < lockstep
+    # and the closed forms: segmented ring exact, pipelined at half its
+    # per-round wire bytes (CW and CCW chunks ride disjoint link sets)
+    n = float(128 << 20)
+    seg = schedule_program_time(ring_allreduce_program(WORLD), n, coeffs)
+    assert seg == pytest.approx(2 * (WORLD - 1) * coeffs.time(n / WORLD))
+    pipe = schedule_program_time(prog, n, coeffs)
+    assert pipe == pytest.approx(2 * (WORLD - 1) * coeffs.time(n / (2 * WORLD)))
+    assert pipe < seg
+
+
+def test_replay_prices_the_same_program_object(engine8):
+    from adapcc_tpu.sim.cost_model import (
+        LinkCostModel,
+        bottleneck_ring_coeffs,
+        schedule_program_time,
+    )
+    from adapcc_tpu.sim.replay import simulate_program
+
+    eng, _ = engine8
+    prog = pipelined_allreduce_program(WORLD)
+    eng.set_schedule_program(prog)
+    model = LinkCostModel.uniform(WORLD)
+    timeline = simulate_program(eng.schedule_program(), model, float(1 << 20))
+    assert timeline.mode == "simulated"
+    assert prog.fingerprint() in timeline.strategy_label
+    # under a uniform model the replay equals the closed pricing exactly
+    coeffs = bottleneck_ring_coeffs(model, WORLD)
+    assert timeline.seconds == pytest.approx(
+        schedule_program_time(prog, float(1 << 20), coeffs), rel=1e-12
+    )
+    row = timeline.to_row()
+    assert row["mode"] == "simulated" and row["collective"] == "allreduce"
+
+
+# --------------------------------------------------------------------------- #
+# engine dispatch contract
+# --------------------------------------------------------------------------- #
+
+def test_engine_derives_program_from_strategy_when_unpinned(engine8):
+    eng, trace = engine8
+    x = jnp.asarray(_payload(seed=9))
+    eng.all_reduce(x, algo="ir")
+    ev = trace.events()[-1]
+    assert ev.extra["program"].startswith("strategy-ring")
+    # a strategy hot-swap re-derives; an explicit pin survives it
+    derived = eng.schedule_program()
+    eng.advance_epoch(Strategy.binary(WORLD))
+    assert eng.schedule_program() is not derived
+    pinned = pipelined_allreduce_program(WORLD)
+    eng.set_schedule_program(pinned)
+    eng.advance_epoch(Strategy.ring(WORLD))
+    assert eng.schedule_program() is pinned
+
+
+def test_engine_env_pin_reroutes_ring_allreduce(engine8, monkeypatch):
+    eng, trace = engine8
+    monkeypatch.setenv("ADAPCC_COLL_ALGO", "ir")
+    x = jnp.asarray(_payload(seed=10))
+    got = np.asarray(eng.ring_allreduce(x))
+    np.testing.assert_allclose(
+        got, np.broadcast_to(np.asarray(x).sum(0), x.shape),
+        rtol=1e-5, atol=1e-5,
+    )
+    assert trace.events()[-1].impl == "ir"
+    # explicit ring-plane knobs cannot ride the IR path: loud reject
+    with pytest.raises(ValueError, match="program properties"):
+        eng.ring_allreduce(x, chunk_bytes=1 << 20)
+
+
+def test_engine_rejects_world_mismatch_and_wire_conflict(
+    engine8, monkeypatch
+):
+    eng, _ = engine8
+    with pytest.raises(ValueError, match="world"):
+        eng.set_schedule_program(ring_allreduce_program(4))
+    # env wire pin disagreeing with the program's codec annotation rejects
+    monkeypatch.setenv("ADAPCC_WIRE_DTYPE", "int8")
+    with pytest.raises(ValueError, match="program properties|wire_dtype"):
+        eng.all_reduce(jnp.ones((WORLD, 16), jnp.float32), algo="ir")
+
+
+def test_engine_rejects_active_gpus_on_ir_path(engine8):
+    eng, _ = engine8
+    with pytest.raises(ValueError, match="relays"):
+        eng.all_reduce(
+            jnp.ones((WORLD, 16), jnp.float32), algo="ir",
+            active_gpus=list(range(WORLD - 1)),
+        )
+
+
+def test_engine_verifies_once_per_fingerprint(engine8):
+    eng, _ = engine8
+    prog = pipelined_allreduce_program(WORLD)
+    eng.set_schedule_program(prog)
+    assert prog.fingerprint() in eng._ir_verified
+    # a corrupted program dies at the pin, loudly
+    victim = next(s for _, s in prog.steps() if s.kind == "recv")
+    rounds = [list(r) for r in prog.rounds]
+    rounds[0] = [s for s in rounds[0] if s is not victim]
+    bad = dataclasses.replace(prog, rounds=tuple(tuple(r) for r in rounds))
+    with pytest.raises(ScheduleVerificationError):
+        eng.set_schedule_program(bad)
+
+
+# --------------------------------------------------------------------------- #
+# XML artifact round-trip + schema versioning (the satellite fix)
+# --------------------------------------------------------------------------- #
+
+def test_program_xml_roundtrip_is_fingerprint_identical(tmp_path):
+    from adapcc_tpu.strategy.xml_io import emit_program_xml, parse_program_xml
+
+    for prog in (
+        pipelined_allreduce_program(WORLD),
+        ring_allreduce_program(4, wire_dtype="bf16"),
+        dataclasses.replace(pipelined_allreduce_program(4), relays=(3,)),
+    ):
+        path = str(tmp_path / f"{prog.name}.xml")
+        text = emit_program_xml(prog, path)
+        back = parse_program_xml(path)
+        assert back.fingerprint() == prog.fingerprint()
+        assert back.relays == prog.relays
+        verify_program(back)
+        # double round-trip is byte-identical: the artifact is canonical
+        assert emit_program_xml(back) == text
+
+
+def test_program_xml_rejects_unknown_schema_major():
+    from adapcc_tpu.strategy.xml_io import emit_program_xml, parse_program_xml
+
+    text = emit_program_xml(pipelined_allreduce_program(4))
+    with pytest.raises(ValueError, match="schema major"):
+        parse_program_xml(text.replace('schema="1.0"', 'schema="2.0"'))
+
+
+def test_strategy_xml_version_stamp_and_unknown_major_reject():
+    """The satellite fix: strategy artifacts are version-stamped, a newer
+    major rejects loudly instead of silently degrading, and unstamped
+    reference fixtures keep parsing (legacy schema)."""
+    from adapcc_tpu.strategy.xml_io import (
+        SCHEDULE_SCHEMA_VERSION,
+        emit_strategy_xml,
+        parse_strategy_xml,
+    )
+
+    s = Strategy.ring(4, 2)
+    text = emit_strategy_xml(s)
+    assert f'schema="{SCHEDULE_SCHEMA_VERSION}"' in text
+    assert parse_strategy_xml(text).fingerprint() == s.fingerprint()
+    with pytest.raises(ValueError, match="schema major"):
+        parse_strategy_xml(text.replace('schema="1.0"', 'schema="9.0"'))
+    # same minor-compatible major accepted
+    parse_strategy_xml(text.replace('schema="1.0"', 'schema="1.7"'))
+    # legacy reference artifact (no stamp) accepted
+    parse_strategy_xml(
+        "<trees><root id='0' ip='a'><gpu id='1' ip='a'/></root></trees>"
+    )
+
+
+# --------------------------------------------------------------------------- #
+# tuner vocabulary round-trip (the PR-8/11 extension shape)
+# --------------------------------------------------------------------------- #
+
+def test_tuner_db_old_records_load_next_to_ir_keys(tmp_path):
+    """Adding IR_PATH is a VOCABULARY extension, not a schema change: a
+    pre-PR tuning.jsonl loads byte-identical next to the new IR cells,
+    and a mixed save/load (compaction) round-trips losslessly."""
+    from adapcc_tpu.tuner.db import SCHEMA_VERSION, TuningDatabase, TuningKey
+    from adapcc_tpu.tuner.policy import IR_PATH, NO_CHUNK
+
+    def key(path="hbm-stream", chunk=1 << 20, wire="off"):
+        return TuningKey("allreduce", 1 << 20, 8, "t", path, chunk, wire)
+
+    path = str(tmp_path / "tuning.jsonl")
+    old_keys = [key(), key(path="vmem", chunk=0), key(path="two-level", chunk=0)]
+    with open(path, "w") as f:
+        for i, k in enumerate(old_keys):
+            f.write(json.dumps(
+                {"v": SCHEMA_VERSION, "key": k.to_dict(),
+                 "t_s": 1e-6 * (i + 1), "ts": float(i)},
+                sort_keys=True,
+            ) + "\n")
+    db = TuningDatabase(path)
+    assert db.skipped_records == 0
+    new_key = key(path=IR_PATH, chunk=NO_CHUNK, wire="bf16")
+    db.record(new_key, 2e-6, ts=10.0)
+    reloaded = TuningDatabase(path)
+    assert reloaded.skipped_records == 0
+    assert set(reloaded.keys()) == set(old_keys) | {new_key}
+    for i, k in enumerate(old_keys):
+        assert reloaded.samples(k) == [1e-6 * (i + 1)]
+    reloaded.save()  # compaction
+    again = TuningDatabase(path)
+    assert set(again.keys()) == set(old_keys) | {new_key}
+    assert again.samples(new_key) == [2e-6]
+
+
+def test_ir_dispatch_records_into_ir_path_cell(mesh8, tmp_path, monkeypatch):
+    """A record-mode engine times IR dispatches into the IR_PATH cell with
+    the program's codec annotation in the key — the vocabulary is live."""
+    from adapcc_tpu.tuner import CollectiveTuner
+    from adapcc_tpu.tuner.db import TuningDatabase
+    from adapcc_tpu.tuner.policy import IR_PATH
+
+    monkeypatch.delenv("ADAPCC_TUNER", raising=False)
+    db = TuningDatabase(str(tmp_path / "tuning.jsonl"))
+    tuner = CollectiveTuner(WORLD, "t", db=db, mode="record")
+    eng = CollectiveEngine(mesh8, Strategy.ring(WORLD), tuner=tuner)
+    # first dispatch is warmup-discarded (it pays trace + XLA compile);
+    # the second lands in the database
+    eng.all_reduce(jnp.ones((WORLD, 64), jnp.float32), algo="ir")
+    eng.all_reduce(jnp.ones((WORLD, 64), jnp.float32), algo="ir")
+    paths = {k.path for k in db.keys()}
+    assert IR_PATH in paths
+
+
+def test_ir_prior_is_the_segmented_ring_floor():
+    from adapcc_tpu.sim.cost_model import (
+        LinkCostModel,
+        bottleneck_ring_coeffs,
+        ring_allreduce_time,
+    )
+    from adapcc_tpu.tuner import CollectiveTuner
+    from adapcc_tpu.tuner.db import TuningDatabase, TuningKey
+    from adapcc_tpu.tuner.policy import IR_PATH, NO_CHUNK
+
+    tuner = CollectiveTuner(
+        WORLD, "t", db=TuningDatabase(persist=False), mode="off"
+    )
+    k = TuningKey("allreduce", 1 << 20, WORLD, "t", IR_PATH, NO_CHUNK, "off")
+    model = tuner.policy._model()
+    coeffs = bottleneck_ring_coeffs(model, WORLD)
+    assert tuner.policy.prior_time(k, 1 << 20) == pytest.approx(
+        ring_allreduce_time(WORLD, float(1 << 20), coeffs, chunks=WORLD)
+    )

@@ -3,9 +3,9 @@
 Every ```python block in docs/PARALLELISM.md, docs/OPERATIONS.md,
 docs/SIMULATION.md, docs/RING.md, docs/QUANT.md, docs/TUNER.md,
 docs/OVERLAP.md, docs/LATENCY.md, docs/ELASTIC.md, docs/ADAPT.md,
-docs/SUPERVISOR.md, docs/HIERARCHY.md, docs/FABRIC.md, docs/RECOVERY.md
-and docs/SERVING.md runs verbatim on the virtual pod.  A snippet that
-stops compiling or produces wrong shapes fails here.
+docs/SUPERVISOR.md, docs/HIERARCHY.md, docs/FABRIC.md, docs/RECOVERY.md,
+docs/SERVING.md and docs/COMPILER.md runs verbatim on the virtual pod.
+A snippet that stops compiling or produces wrong shapes fails here.
 """
 
 import os
@@ -31,6 +31,7 @@ _HIERARCHY = os.path.join(_DOCS_DIR, "HIERARCHY.md")
 _FABRIC = os.path.join(_DOCS_DIR, "FABRIC.md")
 _RECOVERY = os.path.join(_DOCS_DIR, "RECOVERY.md")
 _SERVING = os.path.join(_DOCS_DIR, "SERVING.md")
+_COMPILER = os.path.join(_DOCS_DIR, "COMPILER.md")
 
 
 def _blocks(path):
@@ -370,3 +371,27 @@ def test_serving_doc_covers_the_contract():
 def test_serving_doc_snippet_runs(idx):
     code = _blocks(_SERVING)[idx]
     exec(compile(code, f"{_SERVING}:block{idx}", "exec"), {})
+
+
+def test_compiler_doc_has_snippets():
+    assert len(_blocks(_COMPILER)) >= 5
+
+
+def test_compiler_doc_covers_the_contract():
+    """The schedule-compiler topics the one-IR story leans on."""
+    text = open(_COMPILER).read()
+    for needle in (
+        "ScheduleProgram", "verify_program", "fingerprint",
+        "algo=\"ir\"", "ADAPCC_COLL_ALGO=ir", "set_schedule_program",
+        "schedule_program_time", "simulate_program", "emit_program_xml",
+        "parse_program_xml", "pipelined", "relay", "rank, round, chunk",
+        "make compiler-bench", "ir_parity", "IR_PATH", "schema",
+        "lockstep",
+    ):
+        assert needle in text, f"COMPILER.md lost its {needle!r} coverage"
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_COMPILER))))
+def test_compiler_doc_snippet_runs(idx):
+    code = _blocks(_COMPILER)[idx]
+    exec(compile(code, f"{_COMPILER}:block{idx}", "exec"), {})

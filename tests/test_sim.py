@@ -692,7 +692,7 @@ def test_hw_session_multichip_phases_skip_cleanly_at_world1(tmp_path):
         "busbw_wire_dtype", "busbw_fused_wire", "tuner_convergence",
         "overlap_ab", "small_msg_crossover", "two_level_synth",
         "elastic_failover", "online_adaptation", "supervised_failover",
-        "fabric_contention", "elastic_rejoin", "decode_slo",
+        "fabric_contention", "elastic_rejoin", "decode_slo", "ir_parity",
     }
     for r in rows:
         assert "world=1" in r["skipped"]
