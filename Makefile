@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench compiler-bench hier-bench elastic-bench adapt-bench chaos-bench fabric-bench recovery-bench serve-bench trace-export clean
+.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench compiler-bench hier-bench elastic-bench adapt-bench chaos-bench fabric-bench recovery-bench serve-bench simscale-bench trace-export clean
 
 all: native
 
@@ -163,6 +163,17 @@ serve-bench:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--world 8 --serve-sweep --rates 0.05,0.1,0.25 \
 		--serve-slots 1,2,4,8 --slo-ms 2 --json
+
+# Replay-scaling grid on the vectorized engine (docs/SIMULATION.md §7):
+# deterministic "mode": "simulated" rows over (world x size) at pod
+# scale, each priced on its own uniform synthetic topology and stamped
+# with its certified optimality_gap against the α-β collective lower
+# bound.  Byte-identical across runs — measured replay wall-clock rows
+# live in benchmarks.synthesis_scale instead.
+simscale-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--scale-sweep --scale-worlds 1024,4096,16384,65536 \
+		--sizes 1M,16M,256M --json
 
 # Perfetto/chrome://tracing export of a recorded dispatch trace: run a
 # short virtual-pod collective session under ADAPCC_TUNER=record and emit

@@ -156,6 +156,7 @@ class AdaptationController:
         warm_dtype=np.float32,
         decay: float = 0.5,
         congestion_profile=None,
+        sim_engine: Optional[str] = None,
     ) -> None:
         adapt_mode(mode)  # validate BOTH the env and the explicit mode now
         if top_k < 1:
@@ -178,6 +179,12 @@ class AdaptationController:
         self.warm_shape = tuple(warm_shape)
         self.warm_dtype = warm_dtype
         self.decay = float(decay)
+        #: replay engine for re-rank pricing (None → arg/env/auto funnel).
+        #: Every correction re-prices the SAME candidate structures, so the
+        #: vectorized path's fingerprint-keyed lowering cache turns the
+        #: adapt loop's hottest cost — re-lowering per tick — into a
+        #: per-link-class column re-price (docs/SIMULATION.md §7)
+        self.sim_engine = sim_engine
         world = engine.world_size
         ips = dict(engine.strategy.trees[0].ips or {})
         if fingerprint is None:
@@ -597,6 +604,7 @@ class AdaptationController:
                 parallel_degree=self.parallel_degree,
                 incumbent=incumbent,
                 provenance="congestion-reroute",
+                engine=self.sim_engine,
             )
             report.ranked = [
                 {"label": r.label, "pred_us": round(r.seconds * 1e6, 3)}
@@ -721,6 +729,7 @@ class AdaptationController:
             self.nbytes,
             parallel_degree=self.parallel_degree,
             incumbent=incumbent,
+            engine=self.sim_engine,
         )
         report.ranked = [
             {"label": r.label, "pred_us": round(r.seconds * 1e6, 3)}

@@ -162,6 +162,7 @@ class StandbyPlanCache:
         num_trans: Optional[int] = None,
         shapes: Sequence[str] = ("ring", "binary"),
         include_hosts: bool = True,
+        sim_engine: Optional[str] = None,
     ) -> None:
         if top_k < 1:
             raise ValueError(f"top_k must be >= 1, got {top_k}")
@@ -170,6 +171,11 @@ class StandbyPlanCache:
         self.top_k = top_k
         self.shapes = tuple(shapes)
         self.include_hosts = include_hosts
+        #: replay engine for the scenario sweep (None → arg/env/auto funnel,
+        #: docs/SIMULATION.md §7).  ``build()`` prices O(world) scenarios ×
+        #: shapes; at pod scale the vectorized path's fingerprint-keyed
+        #: column cache turns the sweep's repeated masks into re-prices
+        self.sim_engine = sim_engine
         self.num_trans = (
             num_trans if num_trans is not None else engine.strategy.num_trans
         )
@@ -207,7 +213,8 @@ class StandbyPlanCache:
                 like=self.base_strategy,
             )
             seconds = relay_latency(
-                strategy, self.cost_model, self.nbytes, sorted(active)
+                strategy, self.cost_model, self.nbytes, sorted(active),
+                engine=self.sim_engine,
             )
             if best is None or seconds < best.predicted_s:
                 best = StandbyPlan(label, active, strategy, seconds)

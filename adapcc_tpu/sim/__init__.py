@@ -39,15 +39,31 @@ from adapcc_tpu.sim.cost_model import (
     ICI,
     LinkCoeffs,
     LinkCostModel,
+    bandwidth_lower_bound,
     choose_wire_dtype,
+    collective_lower_bound,
     congested_ring_allreduce_time,
     congested_two_level_allreduce_time,
     contended_coeffs,
+    fastest_coeffs,
     fit_alpha_beta,
+    latency_lower_bound,
+    optimality_gap,
     quantized_ring_allreduce_time,
     wire_bytes_per_element,
 )
 from adapcc_tpu.sim.events import EventSimulator, SimReport, Transfer, TreeSchedule
+from adapcc_tpu.sim.vector import (
+    SIM_ENGINE_ENV,
+    SIM_ENGINES,
+    VECTOR_MIN_WORLD,
+    LoweredColumns,
+    clear_lowering_cache,
+    lowered_columns,
+    lowering_cache_info,
+    resolve_sim_engine,
+    vector_run,
+)
 from adapcc_tpu.sim.replay import (
     CongestionStepRow,
     SimTimeline,
@@ -79,6 +95,20 @@ __all__ = [
     "CongestionWindow",
     "DCN",
     "ICI",
+    "SIM_ENGINE_ENV",
+    "SIM_ENGINES",
+    "VECTOR_MIN_WORLD",
+    "LoweredColumns",
+    "bandwidth_lower_bound",
+    "clear_lowering_cache",
+    "collective_lower_bound",
+    "fastest_coeffs",
+    "latency_lower_bound",
+    "lowered_columns",
+    "lowering_cache_info",
+    "optimality_gap",
+    "resolve_sim_engine",
+    "vector_run",
     "LinkCoeffs",
     "LinkCostModel",
     "choose_wire_dtype",

@@ -22,7 +22,10 @@ Injection points:
 
 - the simulated replay (:func:`adapcc_tpu.sim.replay.
   simulate_congestion_profile`) prices every step's collective under that
-  step's contended model;
+  step's contended model — through the one ``simulate_strategy`` engine
+  funnel, so at pod scale each distinct window re-prices the strategy's
+  cached lowered columns (one β-vector swap per contended class) instead
+  of re-lowering it (docs/SIMULATION.md §7);
 - the adaptation controller's observation funnel
   (:meth:`adapcc_tpu.adapt.AdaptationController.tick`) feeds the drift
   detector contention-scaled priced samples, so the congestion-vs-

@@ -21,6 +21,7 @@ edge.
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass
 from typing import Dict, Mapping, Optional, Sequence, Tuple
 
@@ -342,6 +343,108 @@ def bottleneck_ring_coeffs(
     One shared definition: the benches and the tuner can never disagree
     about which link paces the ring."""
     return model.coeffs(*bottleneck_ring_link(model, world))
+
+
+# --------------------------------------------------------------------------- #
+# lower-bound certification (SCCL, PAPERS.md): per-topology latency and
+# bandwidth floors no schedule can beat, so sim-rank reports every candidate's
+# optimality gap instead of "best of what we happened to generate"
+# --------------------------------------------------------------------------- #
+
+#: collectives the lower-bound terms cover (mirrors sim.replay.COLLECTIVES;
+#: redefined here because replay imports this module)
+_LB_COLLECTIVES = ("allreduce", "reduce", "broadcast")
+
+
+def fastest_coeffs(model: "LinkCostModel") -> LinkCoeffs:
+    """The per-term floor of the topology: the smallest α and the smallest β
+    any link offers, taken independently — exactly what a lower bound needs
+    (no schedule can start a message cheaper than the cheapest α, nor move a
+    byte cheaper than the cheapest β).  Classes in use plus every per-link
+    override are considered; DCN only when an ip table exists to route over
+    it (a flat domain never pays DCN, so its coefficients must not loosen
+    the floor... nor tighten it: mins only ever relax with more links)."""
+    coeffs = [model.classes[ICI]]
+    if model.ips is not None:
+        coeffs.append(model.classes[DCN])
+    coeffs.extend(model.links.values())
+    return LinkCoeffs(
+        alpha=min(c.alpha for c in coeffs),
+        beta=min(c.beta for c in coeffs),
+    )
+
+
+def _check_lb_collective(collective: str) -> None:
+    if collective not in _LB_COLLECTIVES:
+        raise ValueError(
+            f"unknown collective {collective!r}; expected one of "
+            f"{_LB_COLLECTIVES}"
+        )
+
+
+def latency_lower_bound(
+    model: "LinkCostModel",
+    collective: str = "allreduce",
+    world: Optional[int] = None,
+) -> float:
+    """α·⌈log₂ p⌉ — information dissemination doubles the informed set at
+    best once per message generation, so every collective over ``p``
+    participants needs at least ⌈log₂ p⌉ sequential message starts, each
+    costing at least the cheapest link's α (SCCL's latency bound; Chan et
+    al.'s postal-model argument).  ``world`` overrides the model's world
+    for relay-masked collectives (p = active participants)."""
+    _check_lb_collective(collective)
+    p = model.world if world is None else int(world)
+    if p <= 1:
+        return 0.0
+    return math.ceil(math.log2(p)) * fastest_coeffs(model).alpha
+
+
+def bandwidth_lower_bound(
+    model: "LinkCostModel",
+    nbytes: float,
+    collective: str = "allreduce",
+    world: Optional[int] = None,
+) -> float:
+    """The byte floor over the busiest port: allreduce moves at least
+    ``2(p−1)/p·n`` bytes through some rank's ports (reduce-scatter's
+    (p−1)/p·n in plus allgather's (p−1)/p·n out — the classic duplex
+    bound), reduce/broadcast at least ``(p−1)/p·n``; priced at the
+    cheapest β any link offers so no topology assignment can undercut
+    it."""
+    _check_lb_collective(collective)
+    p = model.world if world is None else int(world)
+    n = float(nbytes)
+    if p <= 1 or n <= 0:
+        return 0.0
+    factor = 2.0 * (p - 1) / p if collective == "allreduce" else (p - 1) / p
+    return factor * n * fastest_coeffs(model).beta
+
+
+def collective_lower_bound(
+    model: "LinkCostModel",
+    nbytes: float,
+    collective: str = "allreduce",
+    world: Optional[int] = None,
+) -> float:
+    """Latency + bandwidth floor — the certified denominator of every
+    ``optimality_gap``.  Additive because the two terms bound disjoint
+    costs (sequential message starts vs bytes on the busiest port), the
+    standard α-β decomposition SCCL certifies against."""
+    return latency_lower_bound(model, collective, world) + bandwidth_lower_bound(
+        model, nbytes, collective, world
+    )
+
+
+def optimality_gap(seconds: float, lower_bound_s: float) -> float:
+    """``seconds/LB − 1``: 0 means provably optimal under the α-β model,
+    0.5 means 50% slower than any schedule could possibly be.  A
+    degenerate bound (p ≤ 1 or zero payload → LB 0) reports gap 0 — there
+    is nothing to certify against.  Never clamped: a negative gap would
+    mean the bound is wrong, and tests pin that it never happens."""
+    if lower_bound_s <= 0:
+        return 0.0
+    return seconds / lower_bound_s - 1.0
 
 
 # --------------------------------------------------------------------------- #

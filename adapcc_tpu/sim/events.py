@@ -71,18 +71,41 @@ class TreeSchedule:
 
 @dataclass
 class SimReport:
-    """Replay output: makespan + the full transfer timeline."""
+    """Replay output: makespan + the full transfer timeline.
+
+    At pod scale the per-link map (``link_busy``, O(world) entries per
+    timeline) and the per-transfer log are opt-in; ``class_busy`` — busy
+    seconds aggregated per ICI/DCN link class, O(#classes) — is the
+    always-on accounting surface a 100k-rank ranking can afford to hold
+    per candidate.
+    """
 
     makespan: float
     transfers: List[Transfer] = field(default_factory=list)
     link_busy: Dict[Link, float] = field(default_factory=dict)
+    #: busy seconds aggregated per link class (always bounded: one entry
+    #: per class in use, never per link)
+    class_busy: Dict[str, float] = field(default_factory=dict)
 
     def utilization(self) -> Dict[Link, float]:
-        """Busy fraction per directed link over the makespan."""
+        """Busy fraction per directed link over the makespan (empty when
+        the replay ran with the per-link map opted out)."""
         if self.makespan <= 0:
             return {link: 0.0 for link in self.link_busy}
         return {
             link: busy / self.makespan for link, busy in self.link_busy.items()
+        }
+
+    def class_utilization(self) -> Dict[str, float]:
+        """Aggregate busy seconds per link class over the makespan — the
+        world-size-independent utilization surface.  Note this sums busy
+        time across every link of the class, so values exceed 1.0 as soon
+        as the class has concurrent links (it is a parallelism measure,
+        not a single-wire fraction)."""
+        if self.makespan <= 0:
+            return {cls: 0.0 for cls in self.class_busy}
+        return {
+            cls: busy / self.makespan for cls, busy in self.class_busy.items()
         }
 
     def bytes_moved(self) -> float:
@@ -92,11 +115,19 @@ class SimReport:
 class EventSimulator:
     """Replays :class:`TreeSchedule` lists against a link cost model."""
 
-    def __init__(self, cost_model: LinkCostModel, keep_transfers: bool = True):
+    def __init__(
+        self,
+        cost_model: LinkCostModel,
+        keep_transfers: bool = True,
+        keep_links: bool = True,
+    ):
         self.cost_model = cost_model
         #: pod-scale rankings don't need the per-transfer log; dropping it
         #: keeps a 1000-tree × 1000-chunk replay in constant memory
         self.keep_transfers = keep_transfers
+        #: the per-link busy map is O(world) per report; opting out leaves
+        #: only the per-class aggregation in the returned SimReport
+        self.keep_links = keep_links
 
     def run(self, schedules: Sequence[TreeSchedule]) -> SimReport:
         link_free: Dict[Link, float] = {}
@@ -156,6 +187,13 @@ class EventSimulator:
                                     finish=finish,
                                 )
                             )
+        class_busy: Dict[str, float] = {}
+        for (src, dst), busy in link_busy.items():
+            cls = self.cost_model.link_class_of(src, dst)
+            class_busy[cls] = class_busy.get(cls, 0.0) + busy
         return SimReport(
-            makespan=makespan, transfers=transfers, link_busy=link_busy
+            makespan=makespan,
+            transfers=transfers,
+            link_busy=link_busy if self.keep_links else {},
+            class_busy=class_busy,
         )

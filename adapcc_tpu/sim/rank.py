@@ -23,7 +23,11 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Iterable, List, Optional, Sequence, Tuple, Union
 
-from adapcc_tpu.sim.cost_model import LinkCostModel
+from adapcc_tpu.sim.cost_model import (
+    LinkCostModel,
+    collective_lower_bound,
+    optimality_gap,
+)
 from adapcc_tpu.sim.replay import SimTimeline, simulate_strategy
 from adapcc_tpu.strategy.ir import Strategy
 
@@ -39,10 +43,18 @@ class RankedCandidate:
     seconds: float
     strategy: Optional[Strategy]
     timeline: SimTimeline
+    #: certified topology floor for this (collective, payload, participant
+    #: set) and the candidate's distance above it — ``seconds/LB − 1``,
+    #: non-negative whenever the bound holds (regression-pinned)
+    lower_bound_s: Optional[float] = None
+    optimality_gap: Optional[float] = None
 
     def to_row(self) -> dict:
         row = self.timeline.to_row()
         row["label"] = self.label
+        if self.optimality_gap is not None:
+            row["optimality_gap"] = round(self.optimality_gap, 6)
+            row["lower_bound_us"] = round((self.lower_bound_s or 0.0) * 1e6, 3)
         return row
 
 
@@ -59,8 +71,13 @@ def rank_candidates(
     nbytes: float,
     collective: str = "allreduce",
     active: Optional[Iterable[int]] = None,
+    engine: Optional[str] = None,
 ) -> List[RankedCandidate]:
-    """Simulate every candidate and return them fastest-first.
+    """Simulate every candidate and return them fastest-first, each
+    stamped with its certified ``optimality_gap`` against the topology's
+    latency+bandwidth lower bound (SCCL's certification move: the ranking
+    says how far from *optimal* the winner is, not just that it beat the
+    pool).
 
     Ties break by input order (stable sort), so a caller listing its
     incumbent first keeps it on a tie — re-synthesis must not churn the
@@ -69,6 +86,7 @@ def rank_candidates(
     if not candidates:
         raise ValueError("need at least one candidate to rank")
     active_list = list(active) if active is not None else None
+    lower_cache: dict = {}
     out: List[RankedCandidate] = []
     for i, item in enumerate(candidates):
         label, obj = _as_labeled(item, i)
@@ -77,15 +95,26 @@ def rank_candidates(
         else:
             timeline = simulate_strategy(
                 obj, cost_model, nbytes, collective, active=active_list,
-                keep_transfers=False,
+                keep_transfers=False, engine=engine,
             )
             strategy = obj
+        # relay masks shrink the participant set: the floor certifies the
+        # collective actually priced (p = |active|), not the full world
+        p_eff = len(active_list) if active_list is not None else timeline.world
+        lower = lower_cache.get(p_eff)
+        if lower is None:
+            lower = collective_lower_bound(
+                cost_model, nbytes, collective, world=p_eff
+            )
+            lower_cache[p_eff] = lower
         out.append(
             RankedCandidate(
                 label=label,
                 seconds=timeline.seconds,
                 strategy=strategy,
                 timeline=timeline,
+                lower_bound_s=lower,
+                optimality_gap=optimality_gap(timeline.seconds, lower),
             )
         )
     out.sort(key=lambda c: c.seconds)
@@ -98,12 +127,13 @@ def relay_latency(
     nbytes: float,
     active: Iterable[int],
     collective: str = "allreduce",
+    engine: Optional[str] = None,
 ) -> float:
     """Predicted latency with only ``active`` ranks contributing (everyone
     else a forwarding relay; dead edges pruned as the engine prunes them)."""
     return simulate_strategy(
         strategy, cost_model, nbytes, collective, active=active,
-        keep_transfers=False,
+        keep_transfers=False, engine=engine,
     ).seconds
 
 
@@ -142,6 +172,7 @@ def predict_degradation(
     slow_ranks: Sequence[int],
     slowdown: float = 4.0,
     collective: str = "allreduce",
+    engine: Optional[str] = None,
 ) -> DegradationReport:
     """Price a straggler scenario: every link touching a slow rank is
     ``slowdown``× more expensive.  Returns healthy, degraded, and
@@ -149,15 +180,17 @@ def predict_degradation(
     decision needs."""
     degraded_model = cost_model.degraded(slow_ranks, slowdown)
     healthy = simulate_strategy(
-        strategy, cost_model, nbytes, collective, keep_transfers=False
+        strategy, cost_model, nbytes, collective, keep_transfers=False,
+        engine=engine,
     ).seconds
     degraded = simulate_strategy(
-        strategy, degraded_model, nbytes, collective, keep_transfers=False
+        strategy, degraded_model, nbytes, collective, keep_transfers=False,
+        engine=engine,
     ).seconds
     active = sorted(set(range(strategy.world_size)) - set(slow_ranks))
     relay = simulate_strategy(
         strategy, degraded_model, nbytes, collective, active=active,
-        keep_transfers=False,
+        keep_transfers=False, engine=engine,
     ).seconds
     return DegradationReport(
         healthy_seconds=healthy,

@@ -16,6 +16,14 @@ from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Tuple
 from adapcc_tpu.comm.relay import prune_broadcast_rounds, prune_reduce_rounds
 from adapcc_tpu.sim.cost_model import Link, LinkCostModel
 from adapcc_tpu.sim.events import EventSimulator, SimReport, Transfer, TreeSchedule
+from adapcc_tpu.sim.vector import (
+    SIM_ENGINE_ENV,
+    SIM_ENGINES,
+    VECTOR_MIN_WORLD,
+    lowered_columns,
+    resolve_sim_engine,
+    vector_run,
+)
 from adapcc_tpu.strategy.ir import CommRound, Strategy, Tree
 
 #: collectives the replay layer knows how to lower from a tree strategy
@@ -115,16 +123,39 @@ def simulate_strategy(
     collective: str = "allreduce",
     active: Optional[Iterable[int]] = None,
     keep_transfers: bool = True,
+    engine: Optional[str] = None,
+    keep_links: Optional[bool] = None,
 ) -> SimTimeline:
     """Predict one collective's latency under the cost model.
 
     ``active`` prices the relay scenario: inactive ranks stay on the data
     path as forwarders, edges whose source subtree holds no active rank are
     pruned — the same algebra the engine applies before compiling.
+
+    THE replay chokepoint: every pricing path (ranking, fault/congestion
+    replays, standby scenarios, benches) funnels through here, and the
+    ``engine`` funnel (arg > ``ADAPCC_SIM_ENGINE`` > ``auto``) picks the
+    per-transfer event oracle below :data:`~adapcc_tpu.sim.vector.
+    VECTOR_MIN_WORLD` ranks and the vectorized column replay above it —
+    one pricing engine, parity-pinned, no second implementation to drift.
+    ``keep_links`` opts the O(world) per-link busy map in or out (defaults:
+    on for the event oracle, off for pod-scale vector replays); the
+    vector path never keeps the per-transfer log.
     """
-    report = EventSimulator(cost_model, keep_transfers=keep_transfers).run(
-        lower_strategy(strategy, nbytes, collective, active)
-    )
+    resolved = resolve_sim_engine(engine, strategy.world_size)
+    if resolved == "vector":
+        report = vector_run(
+            lowered_columns(strategy, collective, active),
+            cost_model,
+            nbytes,
+            keep_links=bool(keep_links),
+        )
+    else:
+        report = EventSimulator(
+            cost_model,
+            keep_transfers=keep_transfers,
+            keep_links=True if keep_links is None else keep_links,
+        ).run(lower_strategy(strategy, nbytes, collective, active))
     return SimTimeline(
         seconds=report.makespan,
         collective=collective,
@@ -261,6 +292,7 @@ def simulate_fault_plan(
     collective: str = "allreduce",
     heartbeat_timeout_s: float = 1.0,
     standby_cached: bool = True,
+    engine: Optional[str] = None,
 ) -> List[FaultStepRow]:
     """Replay a :class:`~adapcc_tpu.elastic.faults.FaultPlan` through the
     event simulator: every step's collective is priced under that step's
@@ -301,7 +333,7 @@ def simulate_fault_plan(
         active = None if state.healthy else contributing
         seconds = simulate_strategy(
             strategy, model, nbytes, collective, active=active,
-            keep_transfers=False,
+            keep_transfers=False, engine=engine,
         ).seconds
         if healthy_s is None and state.healthy:
             healthy_s = seconds
@@ -368,6 +400,7 @@ def simulate_congestion_profile(
     profile,
     steps: Optional[int] = None,
     collective: str = "allreduce",
+    engine: Optional[str] = None,
 ) -> List[CongestionStepRow]:
     """Replay a :class:`~adapcc_tpu.sim.congestion.CongestionProfile`
     through the event simulator: every step's collective is priced under
@@ -388,7 +421,8 @@ def simulate_congestion_profile(
         )
     n_steps = steps if steps is not None else profile.last_step() + 1
     healthy_s = simulate_strategy(
-        strategy, cost_model, nbytes, collective, keep_transfers=False
+        strategy, cost_model, nbytes, collective, keep_transfers=False,
+        engine=engine,
     ).seconds
     rows: List[CongestionStepRow] = []
     # every step inside one window prices identically — simulate once per
@@ -405,6 +439,7 @@ def simulate_congestion_profile(
                 nbytes,
                 collective,
                 keep_transfers=False,
+                engine=engine,
             ).seconds
             priced[fkey] = seconds
         rows.append(

@@ -202,6 +202,7 @@ class Synthesizer:
         latency_graph: Optional[Sequence[Sequence[float]]] = None,
         collective: str = "allreduce",
         model=None,
+        engine: Optional[str] = None,
     ):
         """Order labeled candidates fastest-first on the α-β replay.
 
@@ -210,14 +211,22 @@ class Synthesizer:
         the profiled matrices (the exact inputs ``synthesize`` receives
         from the bootstrap), else from the persisted calibration artifact /
         synthetic defaults.  Returns
-        :class:`adapcc_tpu.sim.rank.RankedCandidate` rows.
+        :class:`adapcc_tpu.sim.rank.RankedCandidate` rows, each stamped
+        with its certified ``optimality_gap`` against the topology's
+        latency+bandwidth lower bound — the ranking reports distance from
+        optimal, not just the pool order.  ``engine`` threads through to
+        the replay funnel (``auto`` picks the vectorized path at pod
+        scale; the lowered columns are cached per strategy fingerprint,
+        so repeated re-ranks under drifted models re-price instead of
+        re-lowering).
         """
         from adapcc_tpu import sim
 
         if model is None:
             model = self._cost_model(bandwidth_graph, latency_graph)
         return sim.rank_candidates(
-            list(candidates), model, max(1, int(nbytes)), collective
+            list(candidates), model, max(1, int(nbytes)), collective,
+            engine=engine,
         )
 
     def resynthesize(
@@ -228,6 +237,7 @@ class Synthesizer:
         incumbent: Optional[Strategy] = None,
         collective: str = "allreduce",
         provenance: str = "adapt-rerank",
+        engine: Optional[str] = None,
     ):
         """Online re-rank under a drift-corrected (or transiently
         contended — docs/FABRIC.md) cost model: synthesize the candidate
@@ -253,7 +263,7 @@ class Synthesizer:
             cands.append(("incumbent", incumbent))
         cands.extend(self.candidates(parallel_degree, bw, lat))
         ranked = self.rank(
-            cands, nbytes, collective=collective, model=model
+            cands, nbytes, collective=collective, model=model, engine=engine
         )
         winner = ranked[0]
         if winner.strategy is not None and winner.strategy is not incumbent:

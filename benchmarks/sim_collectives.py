@@ -34,8 +34,11 @@ from adapcc_tpu.sim.cost_model import (
     DCN,
     DEFAULT_HBM_BYTES_PER_S,
     LinkCostModel,
+    collective_lower_bound,
+    optimality_gap,
 )
 from adapcc_tpu.sim.replay import simulate_flow_broadcast, simulate_strategy
+from adapcc_tpu.sim.vector import resolve_sim_engine
 from adapcc_tpu.strategy.ir import Strategy
 
 from benchmarks.collectives import BUS_FACTORS, parse_size
@@ -1718,6 +1721,100 @@ def tune_replay_sweep(
     return rows
 
 
+#: default --scale-worlds grid: pod scale, where only the vectorized
+#: engine replays in seconds (docs/SIMULATION.md §7)
+SCALE_WORLDS = (1024, 4096, 16384)
+
+#: largest world the ring schedule is priced at in the scale sweep — a
+#: ring is ``world`` rounds deep, so its replay cost grows linearly with
+#: world even on the vectorized engine; past this the sweep emits an
+#: explicit skip row instead of silently dropping the shape
+RING_SCALE_MAX_WORLD = 16384
+
+
+def scale_sweep(
+    worlds: Sequence[int],
+    sizes: Sequence[int],
+    collective: str = "allreduce",
+    degree: int = 1,
+) -> List[dict]:
+    """Replay-scaling grid: (world × size × strategy) priced on a uniform
+    synthetic topology, every row stamped with its certified
+    ``optimality_gap`` against the α-β collective lower bound
+    (docs/SIMULATION.md §7).
+
+    Strategies are constructed directly (``Strategy.ring`` /
+    ``Strategy.binary``) — never via :func:`strategy_candidates`, whose
+    ``to_graphs()`` materializes an O(world²) matrix that is exactly the
+    scaling wall this sweep exists to demonstrate the engine clears.  Rows
+    carry no wall-clock times, so two runs of the same grid are
+    byte-identical (the measured replay-latency rows live in
+    ``benchmarks.synthesis_scale``, which is allowed to be nondeterministic).
+    """
+    if collective not in SIM_COLLECTIVES:
+        raise ValueError(
+            f"unknown collective {collective!r}; "
+            f"expected one of {SIM_COLLECTIVES}"
+        )
+    bad = [w for w in worlds if w < 2]
+    if bad:
+        raise ValueError(f"scale sweep worlds must be >= 2, got {bad}")
+    rows: List[dict] = []
+    for world in worlds:
+        # per-world uniform model: O(#classes) memory, deterministic, and
+        # source="synthetic" so the calibration column is honest about it
+        model = LinkCostModel.uniform(world)
+        engine = resolve_sim_engine(None, world)
+        lower = {
+            int(n): collective_lower_bound(model, n, collective, world)
+            for n in sizes
+        }
+        candidates: List[Tuple[str, Optional[Strategy]]] = [
+            ("binary", Strategy.binary(world, degree)),
+            (
+                "ring",
+                Strategy.ring(world, degree)
+                if world <= RING_SCALE_MAX_WORLD
+                else None,
+            ),
+        ]
+        for nbytes in sizes:
+            for label, strategy in candidates:
+                if strategy is None:
+                    rows.append({
+                        "mode": "simulated",
+                        "collective": collective,
+                        "world": world,
+                        "size_bytes": int(nbytes),
+                        "strategy": label,
+                        "skipped": (
+                            f"ring is {world} rounds deep; capped at "
+                            f"--scale-worlds <= {RING_SCALE_MAX_WORLD}"
+                        ),
+                        "calibration": model.source,
+                    })
+                    continue
+                timeline = simulate_strategy(
+                    strategy, model, nbytes, collective, keep_transfers=False
+                )
+                row = _finish_row(timeline.to_row(), collective, world)
+                row["strategy"] = label
+                row["engine"] = engine
+                lb = lower[int(nbytes)]
+                row["lower_bound_us"] = round(lb * 1e6, 3)
+                row["optimality_gap"] = round(
+                    optimality_gap(timeline.seconds, lb), 6
+                )
+                row["calibration"] = model.source
+                rows.append(row)
+    if not rows:
+        raise ValueError(
+            f"scale sweep produced no rows: worlds={list(worlds)} "
+            f"sizes={list(sizes)}"
+        )
+    return rows
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--world", type=int, default=8)
@@ -1919,6 +2016,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "--bucket-caps-mb", default="1,4",
         help="overlap-sweep bucket cap grid (MB)",
     )
+    ap.add_argument(
+        "--scale-sweep", action="store_true",
+        help="replay-scaling grid instead of the strategy grid: "
+        "(--scale-worlds x --sizes) priced on per-world uniform synthetic "
+        "topologies through the vectorized engine, each row stamped with "
+        "its certified optimality_gap against the α-β collective lower "
+        "bound (make simscale-bench; docs/SIMULATION.md §7)",
+    )
+    ap.add_argument(
+        "--scale-worlds", default=",".join(str(w) for w in SCALE_WORLDS),
+        help="scale-sweep world grid (pod scale; ring is skipped above "
+        f"{RING_SCALE_MAX_WORLD})",
+    )
     ap.add_argument("--json", action="store_true", help="one JSON row per line")
     args = ap.parse_args(argv)
 
@@ -1938,6 +2048,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--fabric-sweep", args.fabric_sweep),
             ("--recovery-sweep", args.recovery_sweep),
             ("--serve-sweep", args.serve_sweep),
+            ("--scale-sweep", args.scale_sweep),
         ) if on
     ]
     if len(exclusive) > 1:
@@ -1945,6 +2056,36 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # and dropping the others would read as "ran fine, no data"
         ap.error(f"{' and '.join(exclusive)} are mutually exclusive; "
                  "run one sweep per invocation")
+    if args.scale_sweep:
+        if args.hosts > 1:
+            # the sweep prices per-world uniform synthetic topologies;
+            # silently accepting --hosts would read as "priced that host
+            # split" when nothing used it (the --hier-sweep precedent)
+            ap.error("--hosts has no effect on --scale-sweep (each world "
+                     "is priced on its own uniform synthetic topology)")
+        rows = scale_sweep(
+            worlds=[int(w) for w in args.scale_worlds.split(",") if w],
+            sizes=[parse_size(s) for s in args.sizes.split(",") if s],
+            degree=args.degree,
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            elif "skipped" in row:
+                print(
+                    f"[sim] scale world={row['world']:>6} "
+                    f"{row['strategy']:<6} skipped: {row['skipped']}"
+                )
+            else:
+                print(
+                    f"[sim] scale world={row['world']:>6} "
+                    f"{row['strategy']:<6} {row['size_bytes']:>10}B  "
+                    f"pred={row['pred_time_us']:>10.1f}us  "
+                    f"lb={row['lower_bound_us']:>10.1f}us  "
+                    f"gap={row['optimality_gap']:>8.4f}  "
+                    f"engine={row['engine']}"
+                )
+        return 0
     model = load_or_default(args.calibration, world=args.world)
     if args.serve_sweep:
         if args.hosts > 1:

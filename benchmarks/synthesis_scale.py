@@ -60,6 +60,82 @@ DCN_BW, DCN_LAT = 25.0, 5e-5
 #: sketch policy carries the curve beyond this, matrix-free
 MATRIX_POLICY_MAX_WORLD = 1024
 
+#: replay-scaling world grid (--replay-scale): the vectorized engine's
+#: scaling curve, recorded next to the synthesis curve it unblocks
+REPLAY_WORLDS = (1024, 4096, 16384, 65536, 131072)
+
+#: replay wall-clock budgets the scaling rows pin, mirroring
+#: ``synth_budget_s``: a world<=16384 strategy must replay in < 2 s (the
+#: controller's re-rank window) and even 131072 in < 30 s
+REPLAY_BUDGET_S = 2.0
+REPLAY_BUDGET_LARGE_S = 30.0
+REPLAY_BUDGET_MAX_WORLD = 16384
+
+
+def replay_budget_s(world: int) -> float:
+    """The wall-clock budget a ``world``-rank replay is pinned against."""
+    return REPLAY_BUDGET_S if world <= REPLAY_BUDGET_MAX_WORLD else REPLAY_BUDGET_LARGE_S
+
+
+def bench_replay(
+    world: int,
+    transmission_size: int = 64 << 20,
+    collective: str = "allreduce",
+) -> dict:
+    """Replay-scaling row: build + cold replay + warm re-price wall times
+    for a ``world``-rank binary strategy on a uniform synthetic topology,
+    stamped ``replay_budget_s`` / ``within_replay_budget_s`` (the replay
+    twin of ``synth_budget_s`` / ``within_synth_budget``).
+
+    The cold replay includes column lowering; the re-price row shows what
+    the adaptation loop actually pays once the structure cache is warm
+    (docs/SIMULATION.md §7).  Wall times are measured, so these rows are
+    NOT byte-identical across runs — the deterministic priced grid lives
+    in ``sim_collectives --scale-sweep``.
+    """
+    from adapcc_tpu.sim.cost_model import (
+        LinkCostModel, collective_lower_bound, optimality_gap,
+    )
+    from adapcc_tpu.sim.replay import simulate_strategy
+    from adapcc_tpu.sim.vector import clear_lowering_cache, resolve_sim_engine
+    from adapcc_tpu.strategy.ir import Strategy
+
+    model = LinkCostModel.uniform(world)
+    t0 = time.perf_counter()
+    strategy = Strategy.binary(world, 2)
+    build_s = time.perf_counter() - t0
+
+    clear_lowering_cache()  # the cold number must include column lowering
+    t0 = time.perf_counter()
+    timeline = simulate_strategy(
+        strategy, model, transmission_size, collective, keep_transfers=False
+    )
+    replay_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()  # warm: cached columns, pricing only
+    simulate_strategy(
+        strategy, model, transmission_size, collective, keep_transfers=False
+    )
+    reprice_s = time.perf_counter() - t0
+
+    lb = collective_lower_bound(model, transmission_size, collective, world)
+    budget = replay_budget_s(world)
+    return {
+        "world": world,
+        "policy": "replay",
+        "strategy": "binary",
+        "engine": resolve_sim_engine(None, world),
+        "size_bytes": int(transmission_size),
+        "build_ms": round(build_s * 1e3, 2),
+        "replay_ms": round(replay_s * 1e3, 2),
+        "reprice_ms": round(reprice_s * 1e3, 2),
+        "pred_time_us": round(timeline.seconds * 1e6, 3),
+        "lower_bound_us": round(lb * 1e6, 3),
+        "optimality_gap": round(optimality_gap(timeline.seconds, lb), 6),
+        "replay_budget_s": budget,
+        "within_replay_budget_s": replay_s <= budget,
+    }
+
 
 def synthetic_ip_table(num_hosts: int, per_host: int) -> List[str]:
     """The matrix-free half of :func:`synthetic_topology` — all the
@@ -276,10 +352,19 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="bandwidth factor for the degraded host pair (1.0 = healthy)")
     ap.add_argument("--exec", action="store_true", dest="exec_",
                     help="also execute each policy's allreduce on a virtual pod")
+    ap.add_argument("--replay-scale", action="store_true",
+                    help="also emit replay-scaling rows (--replay-worlds x "
+                    "replay wall-ms on the vectorized engine, budget-stamped)")
+    ap.add_argument("--replay-worlds",
+                    default=",".join(str(w) for w in REPLAY_WORLDS),
+                    help="replay-scaling world grid")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args(argv)
 
     rows: List[dict] = []
+    if args.replay_scale:
+        for world in (int(w) for w in args.replay_worlds.split(",") if w):
+            rows.append(bench_replay(world))
     for world in (int(w) for w in args.worlds.split(",") if w):
         if world % args.per_host:
             raise SystemExit(f"world {world} must divide per-host {args.per_host}")

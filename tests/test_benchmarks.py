@@ -930,3 +930,90 @@ def test_serve_sweep_cli_emits_json(capsys):
     ]) == 0
     rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
     assert rows and all("slo_attainment" not in r for r in rows)
+
+
+def test_scale_sweep_rows_deterministic_and_gap_certified():
+    """The simscale-bench artifact (docs/SIMULATION.md §7) is byte-
+    identical across runs — it carries predictions and certified gaps,
+    never wall-clock — and every priced row's gap is non-negative."""
+    from benchmarks.sim_collectives import scale_sweep
+
+    worlds, sizes = [32, 64, 512], [1 << 20, 16 << 20]
+    rows = scale_sweep(worlds, sizes)
+    again = scale_sweep(worlds, sizes)
+    assert [json.dumps(r, sort_keys=True) for r in rows] == [
+        json.dumps(r, sort_keys=True) for r in again
+    ]
+    priced = [r for r in rows if "skipped" not in r]
+    assert len(priced) == len(worlds) * len(sizes) * 2  # binary + ring
+    for r in priced:
+        assert r["mode"] == "simulated" and r["impl"] == "sim"
+        assert "pred_time_us" in r and "time_us" not in r
+        assert r["optimality_gap"] >= 0.0
+        assert r["pred_time_us"] >= r["lower_bound_us"]
+        assert r["calibration"] == "synthetic"
+        # the engine stamp follows the auto rule: event below the
+        # vector floor, vector at and above it
+        from adapcc_tpu.sim import VECTOR_MIN_WORLD
+
+        want = "vector" if r["world"] >= VECTOR_MIN_WORLD else "event"
+        assert r["engine"] == want
+    with pytest.raises(ValueError, match="no rows"):
+        scale_sweep([], sizes)
+    with pytest.raises(ValueError, match=">= 2"):
+        scale_sweep([1], sizes)
+    with pytest.raises(ValueError, match="unknown collective"):
+        scale_sweep(worlds, sizes, collective="alltoall")
+
+
+def test_scale_sweep_skips_ring_past_depth_cap_loudly():
+    from benchmarks.sim_collectives import RING_SCALE_MAX_WORLD, scale_sweep
+
+    big = RING_SCALE_MAX_WORLD * 2
+    rows = scale_sweep([big], [1 << 20])
+    ring = [r for r in rows if r["strategy"] == "ring"]
+    assert ring and all("skipped" in r for r in ring)
+    assert all(str(RING_SCALE_MAX_WORLD) in r["skipped"] for r in ring)
+    binary = [r for r in rows if r["strategy"] == "binary"]
+    assert binary and all("skipped" not in r for r in binary)
+
+
+def test_scale_sweep_cli_mutually_exclusive_and_rejects_hosts(capsys):
+    from benchmarks.sim_collectives import main
+
+    for other in (
+        ["--ring-sweep"],
+        ["--tune-replay"],
+        ["--fused-sweep"],
+        ["--overlap-sweep"],
+        ["--fault-sweep"],
+        ["--latency-sweep"],
+        ["--schedule-sweep"],
+        ["--adapt-sweep"],
+        ["--chaos-sweep"],
+        ["--hier-sweep"],
+        ["--fabric-sweep"],
+        ["--recovery-sweep"],
+        ["--serve-sweep"],
+        ["--wire-dtype", "off,int8"],
+    ):
+        with pytest.raises(SystemExit):
+            main(["--scale-sweep"] + other)
+    # each world prices its own uniform synthetic topology: --hosts is
+    # meaningless and silently accepting it would mislabel the artifact
+    with pytest.raises(SystemExit):
+        main(["--scale-sweep", "--hosts", "2"])
+    capsys.readouterr()
+
+
+def test_scale_sweep_cli_emits_json(capsys):
+    from benchmarks.sim_collectives import main
+
+    assert main([
+        "--scale-sweep", "--scale-worlds", "32,512",
+        "--sizes", "1M", "--json",
+    ]) == 0
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rows and all(r["mode"] == "simulated" for r in rows)
+    assert {r["world"] for r in rows} == {32, 512}
+    assert all("optimality_gap" in r for r in rows if "skipped" not in r)

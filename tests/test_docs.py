@@ -72,7 +72,7 @@ def test_operations_doc_snippet_runs(idx):
 
 
 def test_simulation_doc_has_snippets():
-    assert len(_blocks(_SIMULATION)) >= 4
+    assert len(_blocks(_SIMULATION)) >= 7
 
 
 def test_simulation_doc_covers_the_contract():
@@ -82,6 +82,10 @@ def test_simulation_doc_covers_the_contract():
         '"mode": "simulated"', "pred_time_us", "topology/calibration.json",
         "sim-rank", "calibrate_from_battery", "make sim-bench",
         "relay_latency", "predict_degradation",
+        # §7 scaling and certification
+        "ADAPCC_SIM_ENGINE", "VECTOR_MIN_WORLD", "optimality_gap",
+        "lowering_cache_info", "make simscale-bench",
+        "within_replay_budget_s",
     ):
         assert needle in text, f"SIMULATION.md lost its {needle!r} coverage"
 
