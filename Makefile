@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench compiler-bench hier-bench elastic-bench adapt-bench chaos-bench fabric-bench recovery-bench serve-bench simscale-bench trace-export clean
+.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench compiler-bench hier-bench elastic-bench adapt-bench chaos-bench fabric-bench recovery-bench serve-bench disagg-bench simscale-bench trace-export clean
 
 all: native
 
@@ -163,6 +163,17 @@ serve-bench:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--world 8 --serve-sweep --rates 0.05,0.1,0.25 \
 		--serve-slots 1,2,4,8 --slo-ms 2 --json
+
+# Colocated-vs-disaggregated serving frontier (docs/SERVING.md §7):
+# deterministic "mode": "simulated" rows over (request mix x pool split
+# x d_model) at equal chip count — prefill priced by pool-world decode
+# steps, the KV migration on the calibrated DCN α-β coefficients, decode
+# by decode_step_time — each row carrying both the two-pool tandem
+# percentiles (simulate_disagg_queue) and the colocated baseline, with
+# disagg_beats_colocated_p99_ttft stamping the frontier cell.
+disagg-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--world 8 --disagg-sweep --json
 
 # Replay-scaling grid on the vectorized engine (docs/SIMULATION.md §7):
 # deterministic "mode": "simulated" rows over (world x size) at pod

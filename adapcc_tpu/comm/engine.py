@@ -40,6 +40,12 @@ from adapcc_tpu.strategy.ir import CommRound, Strategy, Tree
 from adapcc_tpu.comm.mesh import RANKS_AXIS
 
 
+#: default KV-stream granularity: one DCN chunk per ~4 MiB of wire payload
+#: (the reference's transmission contexts moved 4 MB IPC chunks; the trace
+#: records the chunk count so a future live window can sweep it)
+KV_TRANSFER_CHUNK_BYTES = 4 << 20
+
+
 class EpochMismatch(RuntimeError):
     """A collective was issued against a world epoch that is no longer
     current (the coordinator advanced the WorldView — a rank died, was
@@ -1682,6 +1688,96 @@ class CollectiveEngine:
             return inner(v)
 
         return a2a
+
+    def kv_transfer(
+        self,
+        pages: Any,
+        *,
+        src_pod: int,
+        dst_pod: int,
+        wire_dtype: str = "off",
+        block_size: Optional[int] = None,
+        chunk_bytes: int = KV_TRANSFER_CHUNK_BYTES,
+        dst_sharding: Optional[Any] = None,
+        epoch: Optional[int] = None,
+    ) -> Any:
+        """Point-to-point KV-cache handoff between serving pods — a chunked
+        DCN stream as a first-class engine primitive (docs/SERVING.md §7).
+
+        ``pages`` is a pytree of stacked ``[world, ...]`` arrays (one slot's
+        per-layer K/V pages in the :class:`~adapcc_tpu.serve.kv_cache
+        .SlotKVCache` layout); the return value is the same pytree as it
+        arrives on the destination pod.  ``wire_dtype="off"`` (the default)
+        is the bit-exact fp32 path — the values are untouched, which is what
+        the disaggregated-vs-colocated parity drill pins.  A non-"off" codec
+        from the :mod:`adapcc_tpu.quant` registry puts the block-wise
+        quantized wire under the stream: the returned pages carry the
+        decode(encode(x)) wire values, and admission under a lossy wire is
+        gated by the token-level-KL acceptance bound upstream
+        (:mod:`adapcc_tpu.serve.disagg` — the engine moves bytes, the router
+        owns the acceptance bar).
+
+        Every transfer records ONE dispatch-trace event (``primitive=
+        "kv_transfer"``, impl ``dcn_stream[+codec]``) with the executed
+        payload bytes, wire dtype, wire bytes, chunk count at
+        ``chunk_bytes`` granularity, wall duration, and the (src_pod,
+        dst_pod) route — the same honesty contract as every collective.
+        ``dst_sharding`` re-places the arrived pages (the destination
+        pool's cache sharding); chunking is transport accounting — the
+        codec is applied whole-payload so block geometry never depends on
+        the stream granularity.
+        """
+        self._check_epoch(epoch)
+        if chunk_bytes < 1:
+            raise ValueError(f"chunk_bytes must be >= 1, got {chunk_bytes}")
+        from adapcc_tpu.quant import get_codec
+        from adapcc_tpu.sim.cost_model import wire_bytes_per_element
+
+        codec = get_codec(wire_dtype)  # loud on an unknown codec name
+        from adapcc_tpu.quant.codec import DEFAULT_BLOCK_SIZE
+
+        block = int(block_size) if block_size is not None else DEFAULT_BLOCK_SIZE
+        leaves, treedef = jax.tree_util.tree_flatten(pages)
+        if not leaves:
+            raise ValueError("kv_transfer needs at least one page array")
+        for leaf in leaves:
+            self._check_world_dim(leaf, "kv_transfer")
+        t0 = time.perf_counter()
+        nbytes = 0
+        wire_bytes = 0.0
+        moved = []
+        for leaf in leaves:
+            nbytes += int(leaf.nbytes)
+            if codec.name == "off":
+                out = leaf  # identity: the bit-exact default path
+                wire_bytes += float(leaf.nbytes)
+            else:
+                out = codec.apply(leaf, block).astype(leaf.dtype)
+                wire_bytes += float(leaf.size) * wire_bytes_per_element(
+                    codec.name, block
+                )
+            if dst_sharding is not None:
+                out = jax.device_put(out, dst_sharding)
+            moved.append(out)
+        jax.block_until_ready(moved)
+        duration = time.perf_counter() - t0
+        chunks = max(1, -(-int(wire_bytes) // int(chunk_bytes)))
+        if self.trace is not None:
+            suffix = "" if codec.name == "off" else f"+{codec.name}"
+            extras: Dict[str, Any] = {
+                "epoch": self.epoch,
+                "wire_dtype": codec.name,
+                "wire_bytes": int(wire_bytes),
+                "chunks": chunks,
+                "chunk_bytes": int(chunk_bytes),
+                "duration_s": duration,
+                "src_pod": int(src_pod),
+                "dst_pod": int(dst_pod),
+            }
+            if codec.name != "off":
+                extras["block_size"] = block
+            self.trace.record("kv_transfer", f"dcn_stream{suffix}", nbytes, **extras)
+        return jax.tree_util.tree_unflatten(treedef, moved)
 
     def _ring_plan(
         self,

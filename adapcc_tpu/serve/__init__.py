@@ -110,11 +110,25 @@ from adapcc_tpu.serve.trace import (  # noqa: E402
     load_serve_trace,
     synthesize_arrival_trace,
 )
+from adapcc_tpu.serve.disagg import (  # noqa: E402
+    DISAGG_ENV,
+    KV_KL_BOUND_ENV,
+    KV_WIRE_DTYPE_ENV,
+    ClusterRouter,
+    measure_token_kl,
+    resolve_disagg,
+    resolve_kv_kl_bound,
+    resolve_kv_wire_dtype,
+)
 
 __all__ = [
     "ArrivalTrace",
+    "ClusterRouter",
     "DEFAULT_SERVE_SLOTS",
+    "DISAGG_ENV",
     "GPT2Server",
+    "KV_KL_BOUND_ENV",
+    "KV_WIRE_DTYPE_ENV",
     "Request",
     "RequestResult",
     "RequestSpec",
@@ -124,6 +138,10 @@ __all__ = [
     "SlotKVCache",
     "TPDecodeModel",
     "load_serve_trace",
+    "measure_token_kl",
+    "resolve_disagg",
+    "resolve_kv_kl_bound",
+    "resolve_kv_wire_dtype",
     "resolve_serve_slo_ms",
     "resolve_serve_slots",
     "synthesize_arrival_trace",

@@ -1,0 +1,128 @@
+"""Disaggregated prefill/decode serving over DCN (docs/SERVING.md §7).
+
+The colocated batcher (:mod:`adapcc_tpu.serve.scheduler`) interleaves
+prefill and decode in one pool, so a long prompt stalls every decode
+lane behind it for its whole prefill.  This package splits the cluster
+into **two pods** (the PR 11 ``HierarchySketch`` layout): a *prefill
+pool* that turns prompts into KV pages and first tokens, and a *decode
+pool* that streams the remaining tokens — with the finished prefill's
+pages migrated between them by
+:meth:`~adapcc_tpu.comm.engine.CollectiveEngine.kv_transfer`, a chunked
+point-to-point DCN stream that is dispatch-traced (executed bytes, wire
+dtype, chunk count, duration) like every other collective.
+
+- :class:`ClusterRouter` (:mod:`adapcc_tpu.serve.disagg.cluster`) —
+  admission → prefill → migrate → decode, with TTFT/sojourn accounting
+  split per pool and the same ``ADAPCC_SERVE_SLO_MS`` attainment clock
+  the colocated server keeps;
+- the fp32 (``"off"``) KV wire is the default and **bit-exact**: the
+  disaggregated token streams are pinned identical to the colocated
+  ``GPT2Server`` and to the one-at-a-time ``generate`` loop;
+- the int8 wire (``ADAPCC_KV_WIRE_DTYPE=int8``, the EQuARX direction)
+  is gated behind a measured **token-level KL acceptance bound**
+  (``ADAPCC_KV_KL_BOUND``): at router construction a probe prefill
+  compares the next-token distribution over exact vs codec'd pages and
+  admits the lossy wire only under the bound — above it, construction
+  fails loudly rather than silently serving distorted streams.
+
+Offline, :func:`adapcc_tpu.sim.cost_model.simulate_disagg_queue` prices
+the same tandem queue (prefill service → DCN transfer on calibrated α-β
+→ decode service), and ``make disagg-bench`` emits the
+colocated-vs-disaggregated frontier.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+#: opt into the disaggregated serving path (truthy/falsy; env > arg > off)
+DISAGG_ENV = "ADAPCC_DISAGG"
+
+#: wire dtype of the KV-page migration stream (a `quant/` codec name)
+KV_WIRE_DTYPE_ENV = "ADAPCC_KV_WIRE_DTYPE"
+
+#: token-level KL acceptance bound for a lossy KV wire (nats)
+KV_KL_BOUND_ENV = "ADAPCC_KV_KL_BOUND"
+
+#: default acceptance bar: a lossy KV wire may distort the next-token
+#: distribution by at most this much (nats) before it is rejected
+DEFAULT_KV_KL_BOUND = 0.02
+
+_TRUTHY = frozenset({"1", "true", "on", "yes"})
+_FALSY = frozenset({"0", "false", "off", "no"})
+
+
+def resolve_disagg(explicit: Optional[bool] = None) -> bool:
+    """Whether the disaggregated serving path is in force:
+    ``ADAPCC_DISAGG`` env > the caller's explicit value > off.  Anything
+    other than 1/true/on/yes vs 0/false/off/no raises — a typo'd toggle
+    silently serving the wrong topology would invalidate the latency
+    numbers the run was meant to produce (the loud env-knob policy)."""
+    env = os.environ.get(DISAGG_ENV)
+    if env is not None and env.strip():
+        token = env.strip().lower()
+        if token in _TRUTHY:
+            return True
+        if token in _FALSY:
+            return False
+        raise ValueError(
+            f"{DISAGG_ENV}={env!r}: expected one of "
+            f"{sorted(_TRUTHY)} / {sorted(_FALSY)}"
+        )
+    return bool(explicit) if explicit is not None else False
+
+
+def resolve_kv_wire_dtype(explicit: Optional[str] = None) -> str:
+    """KV-migration wire dtype in force: ``ADAPCC_KV_WIRE_DTYPE`` env >
+    the caller's explicit value > ``"off"`` (fp32, bit-exact).  The name
+    is validated against the codec registry immediately, so an unknown
+    codec fails at resolution time, not mid-migration."""
+    from adapcc_tpu.quant import get_codec
+
+    env = os.environ.get(KV_WIRE_DTYPE_ENV)
+    value = env.strip() if env is not None and env.strip() else explicit
+    name = value if value is not None else "off"
+    get_codec(name)  # loud on an unknown codec name
+    return name
+
+
+def resolve_kv_kl_bound(explicit: Optional[float] = None) -> float:
+    """Token-level KL acceptance bound (nats) in force:
+    ``ADAPCC_KV_KL_BOUND`` env > the caller's explicit value >
+    :data:`DEFAULT_KV_KL_BOUND`.  Malformed / non-positive values raise
+    (a zero bound would reject even the bit-exact wire on float fuzz)."""
+    env = os.environ.get(KV_KL_BOUND_ENV)
+    value: object = env if env is not None and env.strip() else explicit
+    if value is None:
+        return DEFAULT_KV_KL_BOUND
+    try:
+        bound = float(str(value).strip())
+    except ValueError as e:
+        raise ValueError(
+            f"{KV_KL_BOUND_ENV}={value!r}: expected a positive KL bound "
+            "in nats"
+        ) from e
+    if bound <= 0:
+        raise ValueError(
+            f"{KV_KL_BOUND_ENV}={value!r}: the KL bound must be > 0"
+        )
+    return bound
+
+
+from adapcc_tpu.serve.disagg.cluster import (  # noqa: E402
+    ClusterRouter,
+    measure_token_kl,
+)
+
+__all__ = [
+    "ClusterRouter",
+    "DEFAULT_KV_KL_BOUND",
+    "DISAGG_ENV",
+    "KV_KL_BOUND_ENV",
+    "KV_WIRE_DTYPE_ENV",
+    "measure_token_kl",
+    "resolve_disagg",
+    "resolve_kv_kl_bound",
+    "resolve_kv_wire_dtype",
+]

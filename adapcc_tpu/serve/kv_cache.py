@@ -49,6 +49,7 @@ class SlotKVCache:
         slots: int,
         mesh=None,
         axis_name: str = "ranks",
+        metrics=None,
     ) -> None:
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
@@ -79,6 +80,25 @@ class SlotKVCache:
              self._place(jnp.zeros(shape, cfg.dtype)))
             for _ in range(cfg.n_layer)
         ]
+        #: optional MetricsRegistry: the slot-lifecycle ledger — occupancy /
+        #: eviction / reuse gauges plus the page-bytes reservoir the serving
+        #: summary surfaces (docs/SERVING.md §7)
+        self.metrics = metrics
+        self._occupied: set = set()
+        self._ever_used: set = set()
+
+    @property
+    def sharding(self):
+        """The pages' placement (None off-mesh) — the destination a
+        cross-pod ``engine.kv_transfer`` re-places migrated pages under."""
+        return self._sharding
+
+    def _note_occupancy(self) -> None:
+        if self.metrics is not None:
+            self.metrics.gauge("kv_cache.occupied_slots", len(self._occupied))
+            self.metrics.gauge(
+                "kv_cache.occupancy", len(self._occupied) / self.slots
+            )
 
     def _place(self, arr: jnp.ndarray) -> jnp.ndarray:
         if self._sharding is not None:
@@ -96,6 +116,68 @@ class SlotKVCache:
             (k.at[:, slot].set(0), v.at[:, slot].set(0))
             for k, v in self.layers
         ]
+        if self.metrics is not None:
+            self.metrics.incr("kv_cache.admissions")
+            if slot in self._ever_used:
+                # the retrace-free reuse the fixed-shape layout exists for
+                self.metrics.incr("kv_cache.slot_reuse")
+        self._ever_used.add(slot)
+        self._occupied.add(slot)
+        self._note_occupancy()
+
+    def release_slot(
+        self, slot: int, used_tokens: Optional[int] = None,
+        evicted: bool = False,
+    ) -> None:
+        """Free one slot's pages at completion: the eviction counter and
+        the page-bytes histogram sample (``used_tokens`` × the per-token KV
+        footprint — the bytes the request actually wrote, not the fixed
+        ``max_seq`` reservation)."""
+        if not 0 <= slot < self.slots:
+            raise ValueError(f"slot {slot} outside [0, {self.slots})")
+        self._occupied.discard(slot)
+        self._note_occupancy()
+        if self.metrics is None:
+            return
+        self.metrics.incr("kv_cache.released")
+        if evicted:
+            self.metrics.incr("kv_cache.evictions")
+        if used_tokens is not None:
+            self.metrics.observe(
+                "kv_cache.page_bytes", used_tokens * self.bytes_per_token
+            )
+
+    @property
+    def bytes_per_token(self) -> int:
+        """KV footprint of ONE cached token across all layers and ranks
+        (K and V) — the unit the page-bytes histogram and the KV-transfer
+        pricing both count in."""
+        itemsize = jnp.dtype(self.cfg.dtype).itemsize
+        return 2 * self.cfg.n_layer * self.cfg.d_model * itemsize
+
+    def stats(self) -> dict:
+        """The slot-lifecycle ledger (counters/gauges plus the page-bytes
+        reservoir percentiles) read back from the registry — the summary
+        row ``serve_gpt2`` surfaces; zeros when no registry is attached."""
+        out = {
+            "occupied_slots": len(self._occupied),
+            "occupancy": len(self._occupied) / self.slots,
+            "admissions": 0, "slot_reuse": 0, "evictions": 0, "released": 0,
+        }
+        if self.metrics is None:
+            return out
+        snap = self.metrics.snapshot()
+        for key in ("admissions", "slot_reuse", "evictions", "released"):
+            out[key] = int(snap["counters"].get(f"kv_cache.{key}", 0))
+        pages = snap["timings"].get("kv_cache.page_bytes")
+        if pages:
+            out["page_bytes"] = {
+                "count": pages["count"],
+                "p50": pages["p50_s"],
+                "p99": pages["p99_s"],
+                "max": pages["max_s"],
+            }
+        return out
 
     def update(
         self, layer: int, k_pages: jnp.ndarray, v_pages: jnp.ndarray

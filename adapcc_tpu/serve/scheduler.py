@@ -171,7 +171,9 @@ class GPT2Server:
         self.tp = TPDecodeModel(
             cfg, self.world, temperature=temperature, top_k=top_k, top_p=top_p
         )
-        self.cache = SlotKVCache(cfg, self.world, self.slots, mesh=mesh)
+        self.cache = SlotKVCache(
+            cfg, self.world, self.slots, mesh=mesh, metrics=self.metrics
+        )
         self.clock = 0
         self._pending: Deque[Request] = deque()
         self._lanes: Dict[int, _Lane] = {}
@@ -322,6 +324,9 @@ class GPT2Server:
         self._free.append(slot)
         self._free.sort()
         req = lane.req
+        self.cache.release_slot(
+            slot, used_tokens=lane.pos + 1, evicted=eos_evicted
+        )
         wall = time.perf_counter() - self._arrival_wall.pop(
             req.req_id, lane.wall_t0
         )
@@ -376,6 +381,7 @@ class GPT2Server:
             "world": self.world,
             "steps": self.clock,
             "kv_cache": self.cache.layout(),
+            "kv_cache_stats": self.cache.stats(),
         }
         if res:
             sojourns = sorted(r.sojourn_steps for r in res)

@@ -45,11 +45,14 @@ from adapcc_tpu.sim.cost_model import (
     congested_ring_allreduce_time,
     congested_two_level_allreduce_time,
     contended_coeffs,
+    contended_lower_bound,
+    disagg_queue_metrics,
     fastest_coeffs,
     fit_alpha_beta,
     latency_lower_bound,
     optimality_gap,
     quantized_ring_allreduce_time,
+    simulate_disagg_queue,
     wire_bytes_per_element,
 )
 from adapcc_tpu.sim.events import EventSimulator, SimReport, Transfer, TreeSchedule
@@ -115,6 +118,9 @@ __all__ = [
     "congested_ring_allreduce_time",
     "congested_two_level_allreduce_time",
     "contended_coeffs",
+    "contended_lower_bound",
+    "disagg_queue_metrics",
+    "simulate_disagg_queue",
     "fit_alpha_beta",
     "load_congestion_profile",
     "simulate_congestion_profile",

@@ -447,6 +447,29 @@ def optimality_gap(seconds: float, lower_bound_s: float) -> float:
     return seconds / lower_bound_s - 1.0
 
 
+def contended_lower_bound(
+    model: "LinkCostModel",
+    nbytes: float,
+    factors: Dict[str, float],
+    collective: str = "allreduce",
+    world: Optional[int] = None,
+) -> float:
+    """The certified floor **of the congestion window itself**:
+    :func:`collective_lower_bound` evaluated on
+    :meth:`LinkCostModel.contended` (β × factor on the shared class, per-
+    link overrides included — :func:`fastest_coeffs` folds both).  During
+    a congestion window the healthy-topology bound is unreachable — no
+    schedule can move a byte cheaper than the *contended* cheapest link —
+    so gapping a congested measurement against the healthy floor inflates
+    every gap by the contention factor and drowns real regressions.
+    Price the window against its own floor: ``optimality_gap(measured,
+    contended_lower_bound(...))`` stays meaningful, and is never larger
+    than the healthy-floor gap (β only grows)."""
+    return collective_lower_bound(
+        model.contended(factors), nbytes, collective, world
+    )
+
+
 # --------------------------------------------------------------------------- #
 # contention pricing (adapcc_tpu/sim/congestion): background traffic on a
 # shared link class — effective-bandwidth scaling, NOT latency degradation
@@ -1856,6 +1879,179 @@ def serve_queue_metrics(
             raise ValueError(f"slo_ms must be > 0, got {slo_ms}")
         within = sum(
             1 for s in sojourns if s * step_time_s * 1e3 <= slo_ms
+        )
+        out["slo_ms"] = float(slo_ms)
+        out["slo_attainment"] = within / len(sojourns)
+    return out
+
+
+def simulate_disagg_queue(
+    arrival_steps: Sequence[int],
+    prefill_steps: Sequence[int],
+    decode_steps: Sequence[int],
+    prefill_slots: int,
+    decode_slots: int,
+    transfer_steps: int = 0,
+) -> list:
+    """Replay the :class:`~adapcc_tpu.serve.disagg.ClusterRouter`'s
+    admission discipline on the integer step clock — the tandem-queue twin
+    of the disaggregated cluster (docs/SERVING.md §7):
+
+    - FIFO admission into a prefill slot at ``max(arrival, earliest
+      prefill-slot-free step)``;
+    - the **first token** lands ``prefill_steps`` later (the step that
+      feeds the last prompt position samples it) — TTFT never waits on
+      the decode pool's backlog, which is the disaggregation win;
+    - migration claims a decode slot at ``max(first_token, earliest
+      decode-slot-free step)`` — a finished prefill with no free decode
+      slot **stays resident in its prefill slot** (the slot frees only at
+      migration, exactly the router's never-drop discipline), then pays
+      ``transfer_steps`` of DCN wire (priced off calibrated α-β by the
+      caller) before decoding;
+    - ``decode_steps`` may be 0 (``max_new_tokens == 1`` / early EOS
+      completes inside the prefill pod: no migration, no transfer).
+
+    Returns one ``(arrival, admitted_prefill, first_token,
+    admitted_decode, completed)`` 5-tuple per request, in input order
+    (``admitted_decode`` is the decode pod's first compute step,
+    transfer included; for an unmigrated request it equals
+    ``first_token``).  Deterministic, analytic — no RNG, no wall clock.
+    """
+    import heapq
+
+    if prefill_slots < 1 or decode_slots < 1:
+        raise ValueError(
+            f"prefill_slots={prefill_slots} / decode_slots={decode_slots} "
+            "must be >= 1"
+        )
+    if transfer_steps < 0:
+        raise ValueError(
+            f"transfer_steps must be >= 0, got {transfer_steps}"
+        )
+    if not (len(arrival_steps) == len(prefill_steps) == len(decode_steps)):
+        raise ValueError(
+            f"{len(arrival_steps)} arrivals vs {len(prefill_steps)} prefill "
+            f"vs {len(decode_steps)} decode budgets: every request needs "
+            "exactly one of each"
+        )
+    if any(a < 0 for a in arrival_steps):
+        raise ValueError("arrival steps must be >= 0")
+    if any(p < 1 for p in prefill_steps):
+        raise ValueError(
+            "prefill steps must be >= 1 (every prompt feeds at least one "
+            "token)"
+        )
+    if any(d < 0 for d in decode_steps):
+        raise ValueError("decode steps must be >= 0")
+    if list(arrival_steps) != sorted(arrival_steps):
+        raise ValueError(
+            "arrival steps must be sorted (the router admits FIFO)"
+        )
+    prefill_free = [0] * int(prefill_slots)
+    decode_free = [0] * int(decode_slots)
+    heapq.heapify(prefill_free)
+    heapq.heapify(decode_free)
+    out = []
+    for arrival, prefill, decode in zip(
+        arrival_steps, prefill_steps, decode_steps
+    ):
+        admitted = max(int(arrival), heapq.heappop(prefill_free))
+        first_token = admitted + int(prefill)
+        if int(decode) < 1:
+            # completes inside the prefill pod — the slot frees at once
+            heapq.heappush(prefill_free, first_token)
+            out.append((int(arrival), admitted, first_token, first_token,
+                        first_token))
+            continue
+        migrated = max(first_token, heapq.heappop(decode_free))
+        heapq.heappush(prefill_free, migrated)  # resident until migration
+        admitted_decode = migrated + int(transfer_steps)
+        completed = admitted_decode + int(decode)
+        heapq.heappush(decode_free, completed)
+        out.append((int(arrival), admitted, first_token, admitted_decode,
+                    completed))
+    return out
+
+
+def disagg_queue_metrics(
+    arrival_steps: Sequence[int],
+    prefill_steps: Sequence[int],
+    decode_steps: Sequence[int],
+    prefill_slots: int,
+    decode_slots: int,
+    transfer_steps: int,
+    prefill_step_time_s: float,
+    decode_step_time_s: float,
+    slo_ms: Optional[float] = None,
+) -> Dict[str, float]:
+    """The disaggregated latency/throughput ledger — the row body
+    ``sim_collectives --disagg-sweep`` prices each frontier cell with.
+    The cluster's pods step in lockstep per router tick, so the wall cost
+    of one step is ``max(prefill_step_time_s, decode_step_time_s)``
+    (reported as ``step_time_s``); TTFT is arrival → first token —
+    **queue wait plus prefill service only**, the tail the two-pool
+    split exists to protect — and ``p99_decode_wait_steps`` (first token
+    → decode admission, transfer included) is the migration-stall signal
+    that explodes first when the decode pool undersizes.  Generated
+    tokens per request are ``1 + decode_steps`` (the prefill pod samples
+    the first).  Deterministic: same inputs → the same bytes.
+    """
+    from adapcc_tpu.utils.observability import nearest_rank_percentile
+
+    if prefill_step_time_s <= 0 or decode_step_time_s <= 0:
+        raise ValueError(
+            f"step times must be > 0, got prefill={prefill_step_time_s} / "
+            f"decode={decode_step_time_s}"
+        )
+    rows = simulate_disagg_queue(
+        arrival_steps, prefill_steps, decode_steps,
+        prefill_slots, decode_slots, transfer_steps,
+    )
+    tick_s = max(float(prefill_step_time_s), float(decode_step_time_s))
+    ttfts = sorted(f - a for a, _, f, _, _ in rows)
+    sojourns = sorted(c - a for a, _, _, _, c in rows)
+    queues = sorted(adm - a for a, adm, _, _, _ in rows)
+    decode_waits = sorted(ad - f for _, _, f, ad, _ in rows)
+
+    def pct(xs, q: float) -> int:
+        # nearest-rank, the shared convention (one spelling repo-wide)
+        return int(nearest_rank_percentile(xs, q))
+
+    makespan = max(c for _, _, _, _, c in rows)
+    # prefill residency runs admission → migration (decode-wait included:
+    # the waiting lane blocks its prefill slot, the never-drop cost)
+    prefill_busy = sum(
+        (ad - int(transfer_steps) if d >= 1 else f) - adm
+        for (_, adm, f, ad, _), d in zip(rows, decode_steps)
+    )
+    decode_busy = sum(int(d) for d in decode_steps)
+    tokens = sum(1 + int(d) for d in decode_steps)
+    out: Dict[str, float] = {
+        "requests": float(len(rows)),
+        "makespan_steps": float(makespan),
+        "step_time_s": tick_s,
+        "transfer_steps": float(transfer_steps),
+        "p50_ttft_steps": float(pct(ttfts, 0.50)),
+        "p99_ttft_steps": float(pct(ttfts, 0.99)),
+        "p50_ttft_ms": pct(ttfts, 0.50) * tick_s * 1e3,
+        "p99_ttft_ms": pct(ttfts, 0.99) * tick_s * 1e3,
+        "p50_sojourn_steps": float(pct(sojourns, 0.50)),
+        "p99_sojourn_steps": float(pct(sojourns, 0.99)),
+        "p50_sojourn_ms": pct(sojourns, 0.50) * tick_s * 1e3,
+        "p99_sojourn_ms": pct(sojourns, 0.99) * tick_s * 1e3,
+        "p99_queue_steps": float(pct(queues, 0.99)),
+        "p99_decode_wait_steps": float(pct(decode_waits, 0.99)),
+        "throughput_tok_s": tokens / (makespan * tick_s),
+        "prefill_utilization": prefill_busy / float(
+            makespan * prefill_slots
+        ),
+        "decode_utilization": decode_busy / float(makespan * decode_slots),
+    }
+    if slo_ms is not None:
+        if slo_ms <= 0:
+            raise ValueError(f"slo_ms must be > 0, got {slo_ms}")
+        within = sum(
+            1 for s in sojourns if s * tick_s * 1e3 <= slo_ms
         )
         out["slo_ms"] = float(slo_ms)
         out["slo_attainment"] = within / len(sojourns)

@@ -54,13 +54,30 @@ def load_env_json_artifact(
         raise FileNotFoundError(
             f"{env_var}={path!r}: no such {kind} artifact"
         )
+    expected = (
+        f" (expected world={world})" if world is not None else ""
+    )
     try:
         with open(path) as f:
             obj = json.load(f)
         artifact = from_dict(obj)
-    except (json.JSONDecodeError, KeyError, TypeError) as e:
+    except json.JSONDecodeError as e:
         raise ValueError(
-            f"{env_var}={path!r} is not a {kind} JSON artifact: {e}"
+            f"{env_var}={path!r} is not a {kind} JSON artifact: invalid "
+            f"JSON — {e}{expected}"
+        ) from e
+    except KeyError as e:
+        # name the offending field, not just the exception repr: the
+        # author of a hand-edited artifact needs to know WHICH key the
+        # schema wants (the generic message was the bug this fixes)
+        raise ValueError(
+            f"{env_var}={path!r} is not a {kind} JSON artifact: missing "
+            f"required field {e.args[0]!r}{expected}"
+        ) from e
+    except TypeError as e:
+        raise ValueError(
+            f"{env_var}={path!r} is not a {kind} JSON artifact: "
+            f"malformed field — {e}{expected}"
         ) from e
     if world is not None and artifact.world != world:
         raise ValueError(

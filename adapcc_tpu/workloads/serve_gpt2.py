@@ -58,6 +58,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "evicts the lane early (slot reuse)")
     p.add_argument("--max-new-tokens", type=int, default=12,
                    help="upper bound of the per-request generation budget")
+    p.add_argument("--disagg", action="store_true",
+                   help="serve disaggregated: split the mesh into a "
+                        "prefill pod and a decode pod (world must be "
+                        "even), KV pages migrating over the traced "
+                        "kv_transfer DCN stream (ADAPCC_DISAGG outranks)")
+    p.add_argument("--kv-wire-dtype", default=None,
+                   help="disagg KV-migration wire codec (off/bf16/int8; "
+                        "ADAPCC_KV_WIRE_DTYPE outranks; 'off' = fp32, "
+                        "bit-exact; lossy codecs are admitted only under "
+                        "the ADAPCC_KV_KL_BOUND token-level KL bound)")
     p.add_argument("--ckpt", "--checkpoint", dest="ckpt", default=None,
                    help="serve trained params (TrainCheckpointState file "
                         "from train_gpt2 --checkpoint-file; shape flags "
@@ -91,7 +101,7 @@ def run(args) -> dict:
 
     from adapcc_tpu.comm.mesh import build_world_mesh
     from adapcc_tpu.models.gpt2 import GPT2, GPT2Config
-    from adapcc_tpu.serve import GPT2Server
+    from adapcc_tpu.serve import GPT2Server, resolve_disagg
     from adapcc_tpu.serve.trace import (
         load_serve_trace,
         synthesize_arrival_trace,
@@ -100,12 +110,24 @@ def run(args) -> dict:
 
     mesh = build_world_mesh(args.world)
     world = int(mesh.devices.size)
+    disagg = resolve_disagg(getattr(args, "disagg", False))
     heads = args.heads if args.heads is not None else max(1, world)
     if heads % world:
         raise SystemExit(
             f"--heads {heads} must divide over the TP world {world} "
             "(head-sharded decode)"
         )
+    if disagg:
+        if world < 2 or world % 2:
+            raise SystemExit(
+                f"--disagg splits the mesh into two equal pods: world "
+                f"{world} must be an even count >= 2"
+            )
+        if heads % (world // 2):
+            raise SystemExit(
+                f"--heads {heads} must divide over the per-pod TP world "
+                f"{world // 2} under --disagg"
+            )
     if args.dmodel % heads:
         raise SystemExit(
             f"--dmodel {args.dmodel} must divide over --heads {heads}"
@@ -154,12 +176,31 @@ def run(args) -> dict:
         print(f"[serve] arrival trace -> {args.trace_out}")
 
     dispatch_trace = CollectiveTrace()
-    server = GPT2Server(
-        cfg, params, mesh, slots=args.slots,
-        temperature=args.temperature, top_k=args.top_k, top_p=args.top_p,
-        eos_id=args.eos_id, algo=args.algo, trace=dispatch_trace,
-        slo_ms=args.slo_ms,
-    )
+    if disagg:
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from adapcc_tpu.serve import ClusterRouter
+
+        pw = world // 2
+        devs = mesh.devices.flatten()
+        server = ClusterRouter(
+            cfg, params,
+            Mesh(np.asarray(devs[:pw]), ("ranks",)),
+            Mesh(np.asarray(devs[pw:]), ("ranks",)),
+            prefill_slots=args.slots, decode_slots=args.slots,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, eos_id=args.eos_id, algo=args.algo,
+            trace=dispatch_trace, slo_ms=args.slo_ms,
+            kv_wire_dtype=args.kv_wire_dtype,
+        )
+    else:
+        server = GPT2Server(
+            cfg, params, mesh, slots=args.slots,
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, eos_id=args.eos_id, algo=args.algo,
+            trace=dispatch_trace, slo_ms=args.slo_ms,
+        )
     server.submit_trace(trace)
     results = server.run()
 
@@ -188,10 +229,17 @@ def run(args) -> dict:
     # which algorithm actually ran (auto → the small-message plane at
     # serving payloads) — the observable the tail claims hang on
     algos: dict = {}
+    kv_events = 0
     for e in dispatch_trace.events():
         if e.primitive == "allreduce":
             algos[e.impl] = algos.get(e.impl, 0) + 1
+        elif e.primitive == "kv_transfer":
+            kv_events += 1
     summary["decode_collectives"] = algos
+    if disagg:
+        # every KV migration must be visible in the dispatch trace — the
+        # acceptance drill cross-checks this count against kv_stream
+        summary["kv_transfer_events"] = kv_events
     summary["trace_label"] = trace.label
     if args.json:
         print(json.dumps({"summary": summary}, sort_keys=True))

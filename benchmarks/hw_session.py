@@ -222,6 +222,13 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
       the arms measure what the small-message plane buys the p50/p99
       decode-step tail and SLO attainment on real ICI, and the ``auto``
       arm records which plane the size-adaptive selector picks live.
+    - ``disagg_transfer`` — disaggregated prefill/decode serving on real
+      chips (the hardware twin of ``make disagg-bench``, docs/SERVING.md
+      §7): the SAME arrival trace served colocated vs split into equal
+      prefill/decode pods with every KV migration riding the traced
+      ``kv_transfer`` stream — the summaries pin the measured TTFT/
+      sojourn split per pool and the kv_stream wire ledger against the
+      simulator's two-pool frontier; needs an even world ≥ 2.
     """
     gate = f"world={world} (needs multi-chip ICI)"
     if world < 2:
@@ -232,6 +239,7 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
             "two_level_synth",
             "elastic_failover", "online_adaptation", "supervised_failover",
             "fabric_contention", "elastic_rejoin", "decode_slo",
+            "disagg_transfer",
         ):
             _skip(name, gate, out_path)
         return
@@ -573,6 +581,31 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
             900, out_path,
             rec_extra={"algo": algo, "serve": True},
         )
+    # disaggregated prefill/decode A/B on real chips (the hardware twin
+    # of `make disagg-bench`, docs/SERVING.md §7): the SAME seeded
+    # arrival trace served colocated, then split into two equal pods
+    # with KV pages migrating over the traced kv_transfer DCN stream —
+    # the two summaries put the measured per-pool TTFT/sojourn split and
+    # the kv_stream wire ledger next to the colocated baseline the
+    # simulator's frontier (simulate_disagg_queue) prices.  Pod split
+    # needs an even world.
+    if world % 2:
+        _skip("disagg_transfer",
+              f"world={world} (the pod split needs an even world)",
+              out_path)
+    else:
+        for arm in ("colocated", "disagg"):
+            _run(
+                "disagg_transfer",
+                [py, "-m", "adapcc_tpu.workloads.serve_gpt2",
+                 "--requests", "16", "--rate", "0.25", "--slots", "4",
+                 "--world", str(world), "--heads", str(world),
+                 "--dmodel", str(64 * world), "--seq", "64",
+                 "--max-new-tokens", "16", "--slo-ms", "2000", "--json"]
+                + (["--disagg"] if arm == "disagg" else []),
+                900, out_path,
+                rec_extra={"arm": arm, "serve": True},
+            )
 
 
 def run_simulated_fallback(py: str, out_path: str, world: int = 8) -> dict:

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from adapcc_tpu.sim.calibrate import DEFAULT_CALIBRATION_PATH, load_or_default
@@ -1623,6 +1624,246 @@ def serve_sweep(
     return rows
 
 
+#: request mixes of the disaggregation frontier: (prompt range, max-new
+#: range) — "prefill-heavy" is prompt-dominated traffic (long contexts,
+#: short answers), "decode-heavy" the inverse (chat tails)
+DISAGG_MIXES = {
+    "prefill-heavy": ((24, 48), (4, 8)),
+    "balanced": ((8, 16), (8, 16)),
+    "decode-heavy": ((4, 8), (24, 48)),
+}
+
+
+def disagg_sweep(
+    world: int,
+    mixes: Sequence[str] = ("prefill-heavy", "balanced", "decode-heavy"),
+    splits: Sequence[str] = ("1:1", "3:1"),
+    dims: Sequence[int] = (128, 256),
+    rate: float = 0.05,
+    num_requests: int = 64,
+    total_slots: int = 8,
+    n_layer: int = 2,
+    seed: int = 0,
+    slo_ms: Optional[float] = None,
+    model: Optional[LinkCostModel] = None,
+) -> List[dict]:
+    """The colocated-vs-disaggregated serving frontier (``make
+    disagg-bench``, docs/SERVING.md §7): for each (request mix × pool
+    split × d_model) cell, the SAME seeded arrival trace is priced both
+    ways at **equal chip count and equal total KV-lane budget** (slots
+    follow chips — lane count is bounded by per-chip KV HBM, so a pod
+    with ``k`` of the chips gets ``k``'s share of the lanes):
+
+    - **disaggregated**: a prefill pod and a decode pod splitting
+      ``--world`` per ``split`` (``"3:1"`` = three quarters of the chips
+      prefill), each pod's step priced by :func:`decode_step_time` at
+      its own world and lane count, the KV handoff priced on the
+      calibrated **DCN** α-β (mean-prompt page bytes, ceil'd to router
+      ticks), the tandem queue replayed by
+      :func:`~adapcc_tpu.sim.cost_model.disagg_queue_metrics`;
+    - **colocated**: one ``--world``-wide batcher with all
+      ``total_slots`` lanes, replayed by :func:`serve_queue_metrics`
+      (TTFT recovered from the admission triples).
+
+    Each row stamps ``disagg_beats_colocated_p99_ttft`` — the frontier
+    claim the regression suite pins: half-world pods pay fewer α hops
+    and smaller per-step payloads per token, so prefill-heavy traffic at
+    moderate load beats the colocated tail on p99 TTFT **ms**, while the
+    queueing twin prices exactly where the smaller prefill pool's queue
+    eats the win (rate up → colocated's 2× lanes win back).
+    Deterministic: seeded trace, analytic replay — byte-identical rows.
+    """
+    from adapcc_tpu.serve.trace import synthesize_arrival_trace
+    from adapcc_tpu.sim.cost_model import (
+        DCN,
+        bottleneck_ring_coeffs,
+        decode_step_time,
+        disagg_queue_metrics,
+        serve_queue_metrics,
+        simulate_serve_queue,
+    )
+    from adapcc_tpu.utils.observability import nearest_rank_percentile
+
+    if world < 2:
+        raise ValueError(
+            f"world must be >= 2 to split into two pods, got {world}"
+        )
+    if num_requests < 1:
+        raise ValueError(f"num_requests must be >= 1, got {num_requests}")
+    if rate <= 0:
+        raise ValueError(f"arrival rate must be > 0, got {rate}")
+    if total_slots < 2:
+        raise ValueError(
+            f"total_slots must be >= 2 (one lane per pool), got "
+            f"{total_slots}"
+        )
+    unknown = [m for m in mixes if m not in DISAGG_MIXES]
+    if unknown:
+        raise ValueError(
+            f"unknown request mix(es) {unknown}; expected "
+            f"{sorted(DISAGG_MIXES)}"
+        )
+    if model is None:
+        model = load_or_default(world=world)
+    elif model.world != world:
+        raise ValueError(f"model world {model.world} != sweep world {world}")
+    dcn = model.classes[DCN]
+    rows: List[dict] = []
+    for mix in mixes:
+        prompt_rng, new_rng = DISAGG_MIXES[mix]
+        trace = synthesize_arrival_trace(
+            world, num_requests, float(rate), seed=seed,
+            prompt_len=prompt_rng, max_new_tokens=new_rng,
+            label=f"disagg-sweep-{mix}",
+        )
+        arrivals = [r.arrival_step for r in trace.requests]
+        prompts = [len(r.prompt) for r in trace.requests]
+        prefills = prompts  # one forced step per prompt token
+        decodes = [r.max_new_tokens - 1 for r in trace.requests]
+        services = [p + d for p, d in zip(prefills, decodes)]  # total - 1
+        generated = [r.max_new_tokens for r in trace.requests]
+        mean_prompt = sum(prompts) / len(prompts)
+        for split in splits:
+            try:
+                p_share, d_share = (int(x) for x in split.split(":"))
+            except ValueError as e:
+                raise ValueError(
+                    f"pool split {split!r} is not 'P:D' integers"
+                ) from e
+            parts = p_share + d_share
+            if p_share < 1 or d_share < 1:
+                raise ValueError(
+                    f"pool split {split!r}: both shares must be >= 1"
+                )
+            if world % parts or total_slots % parts:
+                raise ValueError(
+                    f"pool split {split!r} does not divide world={world} "
+                    f"and total_slots={total_slots} into whole pods"
+                )
+            pw = world * p_share // parts
+            dw = world - pw
+            ps = total_slots * p_share // parts
+            ds = total_slots - ps
+            for d_model in dims:
+                d_model = int(d_model)
+                p_step = decode_step_time(
+                    pw, ps, n_layer, d_model,
+                    bottleneck_ring_coeffs(model, max(2, pw)),
+                )
+                d_step = decode_step_time(
+                    dw, ds, n_layer, d_model,
+                    bottleneck_ring_coeffs(model, max(2, dw)),
+                )
+                c_step = decode_step_time(
+                    world, total_slots, n_layer, d_model,
+                    bottleneck_ring_coeffs(model, max(2, world)),
+                )
+                tick_s = max(
+                    float(p_step["step_time_s"]),
+                    float(d_step["step_time_s"]),
+                )
+                # the migrated payload: the filled KV prefix of a mean
+                # prompt (K and V, all layers, fp32), on the DCN wire
+                kv_bytes = 2 * n_layer * mean_prompt * d_model * 4
+                transfer_steps = int(math.ceil(dcn.time(kv_bytes) / tick_s))
+                dm = disagg_queue_metrics(
+                    arrivals, prefills, decodes, ps, ds, transfer_steps,
+                    float(p_step["step_time_s"]),
+                    float(d_step["step_time_s"]), slo_ms=slo_ms,
+                )
+                cm = serve_queue_metrics(
+                    arrivals, services, total_slots,
+                    float(c_step["step_time_s"]), slo_ms=slo_ms,
+                    generated_steps=generated,
+                )
+                triples = simulate_serve_queue(
+                    arrivals, services, total_slots
+                )
+                coloc_ttfts = sorted(
+                    adm + p - a
+                    for (a, adm, _), p in zip(triples, prefills)
+                )
+                coloc_p99_ttft = int(
+                    nearest_rank_percentile(coloc_ttfts, 0.99)
+                )
+                coloc_step_s = float(c_step["step_time_s"])
+                row = {
+                    "mode": "simulated",
+                    "collective": "allreduce",
+                    "impl": "disagg",
+                    "world": world,
+                    "mix": mix,
+                    "split": split,
+                    "rate_req_per_step": float(rate),
+                    "requests": num_requests,
+                    "trace_seed": seed,
+                    "n_layer": n_layer,
+                    "d_model": d_model,
+                    "prefill_world": pw,
+                    "decode_world": dw,
+                    "prefill_slots": ps,
+                    "decode_slots": ds,
+                    "coloc_slots": total_slots,
+                    "transfer_steps": transfer_steps,
+                    "kv_bytes_mean": int(kv_bytes),
+                    "prefill_algo": p_step["algo"],
+                    "decode_algo": d_step["algo"],
+                    "coloc_algo": c_step["algo"],
+                    "pred_prefill_step_us": round(
+                        float(p_step["step_time_s"]) * 1e6, 3
+                    ),
+                    "pred_decode_step_us": round(
+                        float(d_step["step_time_s"]) * 1e6, 3
+                    ),
+                    "pred_coloc_step_us": round(coloc_step_s * 1e6, 3),
+                    "p50_ttft_ms": round(dm["p50_ttft_ms"], 6),
+                    "p99_ttft_steps": int(dm["p99_ttft_steps"]),
+                    "p99_ttft_ms": round(dm["p99_ttft_ms"], 6),
+                    "p99_sojourn_ms": round(dm["p99_sojourn_ms"], 6),
+                    "p99_queue_steps": int(dm["p99_queue_steps"]),
+                    "p99_decode_wait_steps": int(
+                        dm["p99_decode_wait_steps"]
+                    ),
+                    "throughput_tok_s": round(dm["throughput_tok_s"], 3),
+                    "prefill_utilization": round(
+                        dm["prefill_utilization"], 6
+                    ),
+                    "decode_utilization": round(
+                        dm["decode_utilization"], 6
+                    ),
+                    "coloc_p99_ttft_steps": coloc_p99_ttft,
+                    "coloc_p99_ttft_ms": round(
+                        coloc_p99_ttft * coloc_step_s * 1e3, 6
+                    ),
+                    "coloc_p99_sojourn_ms": round(
+                        cm["p99_sojourn_ms"], 6
+                    ),
+                    "coloc_throughput_tok_s": round(
+                        cm["throughput_tok_s"], 3
+                    ),
+                    "disagg_beats_colocated_p99_ttft": bool(
+                        dm["p99_ttft_ms"]
+                        < coloc_p99_ttft * coloc_step_s * 1e3
+                    ),
+                    "calibration": model.source,
+                }
+                if slo_ms is not None:
+                    row["slo_ms"] = float(slo_ms)
+                    row["slo_attainment"] = round(
+                        dm["slo_attainment"], 6
+                    )
+                    row["coloc_slo_attainment"] = round(
+                        cm["slo_attainment"], 6
+                    )
+                rows.append(row)
+    if not rows:
+        raise ValueError(
+            f"disagg sweep produced no rows: mixes={list(mixes)} "
+            f"splits={list(splits)} dims={list(dims)}"
+        )
+    return rows
+
+
 def tune_replay_sweep(
     world: int,
     sizes: Sequence[int],
@@ -2003,6 +2244,37 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "(0 = no SLO-attainment column)",
     )
     ap.add_argument(
+        "--disagg-sweep", action="store_true",
+        help="price the colocated-vs-disaggregated serving frontier "
+        "instead of the strategy grid: one seeded arrival trace per "
+        "request mix, replayed through the two-pool tandem queue "
+        "(prefill pod -> DCN KV transfer -> decode pod) AND the "
+        "colocated batcher at equal chip count, p99 TTFT verdict "
+        "stamped per row (make disagg-bench; docs/SERVING.md §7)",
+    )
+    ap.add_argument(
+        "--disagg-mixes", default="prefill-heavy,balanced,decode-heavy",
+        help="disagg-sweep request-mix grid (prompt-vs-decode balance)",
+    )
+    ap.add_argument(
+        "--disagg-splits", default="1:1,3:1",
+        help="disagg-sweep prefill:decode chip-split grid (slots follow "
+        "chips — the per-chip KV HBM budget)",
+    )
+    ap.add_argument(
+        "--disagg-dims", default="128,256",
+        help="disagg-sweep d_model grid",
+    )
+    ap.add_argument(
+        "--disagg-slots", type=int, default=8,
+        help="disagg-sweep TOTAL cluster lane budget (the colocated arm "
+        "runs all of them in one pool)",
+    )
+    ap.add_argument(
+        "--disagg-rate", type=float, default=0.05,
+        help="disagg-sweep Poisson arrival rate (requests per step)",
+    )
+    ap.add_argument(
         "--overlap-sweep", action="store_true",
         help="price the overlapped DDP gradient sync over (accum x "
         "bucket cap x overlap schedule) with overlapped_step_time instead "
@@ -2048,6 +2320,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--fabric-sweep", args.fabric_sweep),
             ("--recovery-sweep", args.recovery_sweep),
             ("--serve-sweep", args.serve_sweep),
+            ("--disagg-sweep", args.disagg_sweep),
             ("--scale-sweep", args.scale_sweep),
         ) if on
     ]
@@ -2118,6 +2391,43 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     f"tok/s={row['throughput_tok_s']:>11.1f}  "
                     f"util={row['utilization']:.3f}"
                     + (f"  slo={att:.3f}" if att is not None else "")
+                )
+        return 0
+    if args.disagg_sweep:
+        if args.hosts > 1:
+            # the sweep fixes its own two-pod split of --world; silently
+            # accepting --hosts would read as "priced that host split"
+            # when nothing used it (the --hier-sweep precedent)
+            ap.error("--hosts has no effect on --disagg-sweep (the sweep "
+                     "splits --world into its own prefill/decode pods)")
+        if args.slo_ms < 0:
+            ap.error(f"--slo-ms must be >= 0, got {args.slo_ms}")
+        rows = disagg_sweep(
+            world=args.world,
+            mixes=[m for m in args.disagg_mixes.split(",") if m],
+            splits=[s for s in args.disagg_splits.split(",") if s],
+            dims=[int(d) for d in args.disagg_dims.split(",") if d],
+            rate=args.disagg_rate,
+            num_requests=args.serve_requests,
+            total_slots=args.disagg_slots,
+            slo_ms=args.slo_ms if args.slo_ms > 0 else None,
+            model=model,
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            else:
+                star = (
+                    "*" if row["disagg_beats_colocated_p99_ttft"] else " "
+                )
+                print(
+                    f"[sim] disagg {row['mix']:<13} {row['split']:<4} "
+                    f"d={row['d_model']:>4}{star} "
+                    f"ttft p99={row['p99_ttft_ms']:>9.3f}ms "
+                    f"(coloc {row['coloc_p99_ttft_ms']:>9.3f}ms)  "
+                    f"xfer={row['transfer_steps']:>2}st  "
+                    f"tok/s={row['throughput_tok_s']:>10.1f} "
+                    f"(coloc {row['coloc_throughput_tok_s']:>10.1f})"
                 )
         return 0
     if args.fabric_sweep:

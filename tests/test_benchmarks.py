@@ -932,6 +932,93 @@ def test_serve_sweep_cli_emits_json(capsys):
     assert rows and all("slo_attainment" not in r for r in rows)
 
 
+def test_disagg_sweep_rows_byte_identical_and_frontier_shaped():
+    """The disagg-bench artifact (docs/SERVING.md §7) is deterministic
+    to the byte over (mix × split × d_model) at equal chip count, every
+    row carries both the two-pool tandem and the colocated baseline, and
+    the frontier has its load-bearing cell: a prefill-heavy mix at the
+    3:1 chip split strictly beats the colocated p99 TTFT."""
+    from benchmarks.sim_collectives import disagg_sweep
+
+    rows = disagg_sweep(8)
+    again = disagg_sweep(8)
+    assert [json.dumps(r, sort_keys=True) for r in rows] == [
+        json.dumps(r, sort_keys=True) for r in again
+    ]
+    assert len(rows) == 3 * 2 * 2  # mixes x splits x dims
+    for r in rows:
+        assert r["mode"] == "simulated" and r["impl"] == "disagg"
+        assert r["world"] == 8
+        assert r["prefill_world"] + r["decode_world"] == 8
+        assert r["prefill_slots"] + r["decode_slots"] == r["coloc_slots"]
+        assert r["transfer_steps"] >= 1  # DCN is never free
+        assert r["p99_ttft_ms"] > 0 and r["coloc_p99_ttft_ms"] > 0
+        assert r["p99_ttft_ms"] >= r["p50_ttft_ms"]
+        assert r["p99_sojourn_ms"] > 0 and r["throughput_tok_s"] > 0
+        assert r["disagg_beats_colocated_p99_ttft"] == (
+            r["p99_ttft_ms"] < r["coloc_p99_ttft_ms"]
+        )
+    # the acceptance cell: prefill-heavy traffic, 3:1 chips to prefill
+    wins = [r for r in rows
+            if r["mix"] == "prefill-heavy" and r["split"] == "3:1"]
+    assert wins and all(r["disagg_beats_colocated_p99_ttft"] for r in wins)
+    # ... and it is a frontier, not a universal win: some cell prefers
+    # colocation (decode-heavy traffic pays for the idle prefill pod)
+    assert any(not r["disagg_beats_colocated_p99_ttft"] for r in rows)
+
+    with pytest.raises(ValueError, match="even|divide"):
+        disagg_sweep(7)
+    with pytest.raises(ValueError, match="mix"):
+        disagg_sweep(8, mixes=("bursty",))
+    with pytest.raises(ValueError, match="split"):
+        disagg_sweep(8, splits=("5:1",))
+    with pytest.raises(ValueError):
+        disagg_sweep(8, total_slots=1)
+
+
+def test_disagg_sweep_cli_mutually_exclusive_and_rejects_hosts(capsys):
+    from benchmarks.sim_collectives import main
+
+    for other in (
+        ["--ring-sweep"],
+        ["--tune-replay"],
+        ["--fused-sweep"],
+        ["--overlap-sweep"],
+        ["--fault-sweep"],
+        ["--latency-sweep"],
+        ["--adapt-sweep"],
+        ["--chaos-sweep"],
+        ["--hier-sweep"],
+        ["--fabric-sweep"],
+        ["--recovery-sweep"],
+        ["--serve-sweep"],
+        ["--scale-sweep"],
+    ):
+        with pytest.raises(SystemExit):
+            main(["--disagg-sweep"] + other)
+    # the sweep splits --world into its own prefill/decode pods: --hosts
+    # is meaningless and silently accepting it would mislabel the artifact
+    with pytest.raises(SystemExit):
+        main(["--disagg-sweep", "--hosts", "2"])
+    with pytest.raises(SystemExit):
+        main(["--disagg-sweep", "--slo-ms", "-1"])
+    capsys.readouterr()
+
+
+def test_disagg_sweep_cli_emits_json(capsys):
+    from benchmarks.sim_collectives import main
+
+    assert main([
+        "--disagg-sweep", "--world", "8",
+        "--disagg-mixes", "prefill-heavy,decode-heavy",
+        "--disagg-splits", "1:1", "--disagg-dims", "128", "--json",
+    ]) == 0
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rows and all(r["impl"] == "disagg" for r in rows)
+    assert {r["mix"] for r in rows} == {"prefill-heavy", "decode-heavy"}
+    assert all(r["split"] == "1:1" and r["d_model"] == 128 for r in rows)
+
+
 def test_scale_sweep_rows_deterministic_and_gap_certified():
     """The simscale-bench artifact (docs/SIMULATION.md §7) is byte-
     identical across runs — it carries predictions and certified gaps,

@@ -1,0 +1,524 @@
+"""Disaggregated prefill/decode serving: KV streams, the cluster router,
+and the two-pool queueing model (docs/SERVING.md §7).
+
+The acceptance drill (ISSUE 18): the SAME arrival trace served through
+the two-pod ``ClusterRouter`` produces token streams **bit-identical**
+to the colocated ``GPT2Server`` on the fp32 (``"off"``) KV wire, with
+every migration visible in the dispatch trace as a ``kv_transfer``
+event.  The int8 wire is admitted only under the measured token-level
+KL bound and rejected loudly above it.  Router edge cases pin the
+never-drop contract: zero free decode slots → lanes wait resident;
+decode-pod death → re-prefill with exactly the victims' TTFT as the
+casualty, never their tokens.  The offline twin ``simulate_disagg_queue``
+is hand-checkable, and the contended lower bound keeps ``optimality_gap``
+meaningful on degraded topologies (ROADMAP item 5).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from adapcc_tpu.comm.engine import CollectiveEngine
+from adapcc_tpu.models.gpt2 import GPT2, GPT2Config
+from adapcc_tpu.serve import (
+    DISAGG_ENV,
+    KV_KL_BOUND_ENV,
+    KV_WIRE_DTYPE_ENV,
+    ClusterRouter,
+    GPT2Server,
+    measure_token_kl,
+    resolve_disagg,
+    resolve_kv_kl_bound,
+    resolve_kv_wire_dtype,
+)
+from adapcc_tpu.serve.trace import (
+    SERVE_TRACE_ENV,
+    ArrivalTrace,
+    RequestSpec,
+    load_serve_trace,
+)
+from adapcc_tpu.sim.cost_model import (
+    DCN,
+    ICI,
+    LinkCoeffs,
+    LinkCostModel,
+    bandwidth_lower_bound,
+    collective_lower_bound,
+    contended_lower_bound,
+    disagg_queue_metrics,
+    latency_lower_bound,
+    optimality_gap,
+    simulate_disagg_queue,
+)
+from adapcc_tpu.sim.replay import simulate_strategy
+from adapcc_tpu.strategy.ir import Strategy
+from adapcc_tpu.utils.observability import CollectiveTrace
+
+
+@pytest.fixture(scope="module")
+def tiny4():
+    """(cfg, model, params): n_head=4 splits over world 4 AND the 2+2
+    pod split (head-sharded migration compatibility)."""
+    cfg = GPT2Config(
+        vocab_size=64, max_seq=16, n_layer=1, n_head=4, d_model=32,
+        dtype=jnp.float32,
+    )
+    model = GPT2(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32)
+    )["params"]
+    return cfg, model, params
+
+
+def _pool_meshes():
+    devs = jax.devices()
+    return Mesh(devs[:2], ("ranks",)), Mesh(devs[2:4], ("ranks",))
+
+
+def _trace(n=5, world=4):
+    """Staggered arrivals, prompts 2-4 tokens, budgets 3-5 (all >= 2, so
+    every request migrates) — everything fits max_seq 16."""
+    reqs = []
+    for i in range(n):
+        plen = 2 + (i % 3)
+        reqs.append(RequestSpec(
+            req_id=i, arrival_step=i // 2,
+            prompt=tuple(1 + (j + 7 * i) % 63 for j in range(plen)),
+            max_new_tokens=3 + (i % 3), seed=100 + i,
+        ))
+    return ArrivalTrace(world=world, seed=0, requests=reqs)
+
+
+def _by_id(results):
+    return {r.req_id: r for r in results}
+
+
+@pytest.fixture(scope="module")
+def colocated_base(tiny4, mesh4):
+    """The colocated ledger for _trace(), computed once under jit (the
+    production path) and reused across the parity drills.  Token streams
+    are slot-count independent, so one baseline serves them all."""
+    cfg, _, params = tiny4
+    srv = GPT2Server(cfg, params, mesh4, slots=2,
+                     temperature=1.0, top_k=8)
+    srv.submit_trace(_trace())
+    return _by_id(srv.run())
+
+
+@pytest.fixture(scope="module")
+def disagg_run(tiny4):
+    """One 2+2-pod fp32 serve of _trace(), computed once under jit:
+    (router, results, kv_transfer events) shared by the drills below."""
+    cfg, _, params = tiny4
+    pmesh, dmesh = _pool_meshes()
+    ctrace = CollectiveTrace()
+    rt = ClusterRouter(cfg, params, pmesh, dmesh,
+                       prefill_slots=2, decode_slots=2,
+                       temperature=1.0, top_k=8, trace=ctrace)
+    rt.submit_trace(_trace())
+    got = _by_id(rt.run())
+    events = [e for e in ctrace.events() if e.primitive == "kv_transfer"]
+    return rt, got, events
+
+
+# ------------------------------------------------------- the acceptance drill
+
+
+def test_disagg_streams_bit_identical_to_colocated(colocated_base,
+                                                   disagg_run):
+    """THE drill: same trace, colocated vs 2+2 pods, fp32 wire, both
+    under jit (the production path) — every request's token stream
+    identical, every migration traced."""
+    base = colocated_base
+    rt, got, events = disagg_run
+    trace = _trace()
+    assert set(got) == set(base)
+    for rid, r in got.items():
+        assert r.generated == base[rid].generated, (
+            f"req {rid}: disaggregated stream diverged from colocated"
+        )
+        assert not r.eos_evicted and not base[rid].eos_evicted
+
+    # every request migrated exactly once (all budgets >= 2: the first
+    # token lands in the prefill pod, the rest stream from decode)
+    assert len(events) == len(trace.requests)
+    for e in events:
+        assert e.impl == "dcn_stream"
+        assert e.extra["wire_dtype"] == "off"
+        assert e.extra["wire_bytes"] == e.nbytes  # fp32 wire: bit-exact
+        assert e.extra["src_pod"] == 0 and e.extra["dst_pod"] == 1
+        assert e.extra["chunks"] >= 1
+
+    s = rt.summary()
+    assert s["disagg"] is True
+    assert s["kv_stream"]["transfers"] == len(events)
+    assert s["kv_stream"]["wire_dtype"] == "off"
+    assert s["kv_stream"]["payload_bytes"] == s["kv_stream"]["wire_bytes"]
+    assert s["pools"]["prefill"]["world"] == 2
+    assert s["pools"]["decode"]["world"] == 2
+    for pod in ("prefill", "decode"):
+        assert s["kv_cache_stats"][pod]["admissions"] == len(trace.requests)
+
+
+def test_disagg_streams_bit_identical_eager_1rank_pods(tiny4):
+    """The fusion-free cross-check: eager mode (no XLA fusion noise) on
+    the other pod shape — world 2, one rank per pod — still lands every
+    stream bit-identical to its colocated twin.  Parity is a property of
+    (prompt, RNG, pages), not of the compiler or the pod split."""
+    cfg, _, params = tiny4
+    devs = jax.devices()
+    trace = _trace(n=3, world=2)
+    with jax.disable_jit():
+        srv = GPT2Server(cfg, params, Mesh(devs[:2], ("ranks",)), slots=2,
+                         temperature=1.0, top_k=8)
+        srv.submit_trace(trace)
+        base = _by_id(srv.run())
+        rt = ClusterRouter(cfg, params,
+                           Mesh(devs[:1], ("ranks",)),
+                           Mesh(devs[1:2], ("ranks",)),
+                           prefill_slots=2, decode_slots=2,
+                           temperature=1.0, top_k=8)
+        rt.submit_trace(trace)
+        got = _by_id(rt.run())
+    assert set(got) == set(base)
+    for rid in got:
+        assert got[rid].generated == base[rid].generated, (
+            f"req {rid}: eager 1+1-pod stream diverged from colocated"
+        )
+
+
+def test_disagg_single_decode_slot_waits_resident_never_drops(
+        tiny4, colocated_base):
+    """Zero free decode slots at migration time: finished prefills wait
+    resident (frozen, RNG untouched) and every stream still lands
+    bit-identical — the never-drop contract under decode pressure."""
+    cfg, _, params = tiny4
+    trace = _trace()
+    base = colocated_base
+    pmesh, dmesh = _pool_meshes()
+    rt = ClusterRouter(cfg, params, pmesh, dmesh,
+                       prefill_slots=3, decode_slots=1,
+                       temperature=1.0, top_k=8)
+    rt.submit_trace(trace)
+    got = _by_id(rt.run())
+
+    assert set(got) == set(base)
+    for rid in got:
+        assert got[rid].generated == base[rid].generated
+    snap = rt.metrics.snapshot()["counters"]
+    assert snap["serve.migrated"] == len(trace.requests)
+    assert snap["serve.completed"] == len(trace.requests)
+
+
+def test_decode_pod_death_reprefills_exact_casualty(tiny4, disagg_run):
+    """Kill the decode pod mid-stream: victims re-prefill from their
+    seeds — same tokens, no hang, and the pinned loss is exactly the
+    victims' TTFT (non-victims' ledgers untouched).  The un-killed
+    disagg_run (same pods, same trace) is the baseline ledger."""
+    cfg, _, params = tiny4
+    trace = _trace()
+    base = disagg_run[1]
+    pmesh, dmesh = _pool_meshes()
+    rt = ClusterRouter(cfg, params, pmesh, dmesh,
+                       prefill_slots=2, decode_slots=2,
+                       temperature=1.0, top_k=8)
+    rt.submit_trace(trace)
+    # kill at the FIRST step the decode pod holds live lanes — the
+    # mid-stream moment, not a fixed clock tick
+    for _ in range(60):
+        rt.step()
+        if rt.decode.lanes:
+            break
+    assert rt.decode.lanes, "decode pod never went live"
+    # the non-victims already admitted (completed, or resident in a
+    # prefill lane) must keep their TTFT to the step; still-pending
+    # requests may only be DELAYED by the re-queued victims ahead of
+    # them in the FIFO — never dropped, never token-changed
+    admitted = {r.req_id for r in rt.results()} | {
+        lane.req.req_id for lane in rt.prefill.lanes.values()
+    }
+    victims = rt.kill_decode_pool()
+    assert victims
+    assert not (set(victims) & admitted)
+    got = _by_id(rt.run(max_steps=400))
+
+    assert set(got) == set(base)
+    for rid in got:
+        assert got[rid].generated == base[rid].generated, (
+            f"req {rid}: re-prefilled stream diverged"
+        )
+    for rid in admitted:
+        assert got[rid].ttft_steps == base[rid].ttft_steps, (
+            f"req {rid} was not a victim; its TTFT must be untouched"
+        )
+    for rid in set(got) - set(victims) - admitted:
+        assert got[rid].ttft_steps >= base[rid].ttft_steps
+    for rid in victims:
+        # the victim had already produced its first token in the prefill
+        # pod before migrating; the re-prefill recomputes it later
+        assert got[rid].ttft_steps > base[rid].ttft_steps
+    snap = rt.metrics.snapshot()["counters"]
+    assert snap["serve.decode_pod_deaths"] == 1
+    assert snap["serve.re_prefilled"] == len(victims)
+
+
+# ------------------------------------------------------------ the KV wire
+
+
+def test_kv_transfer_trace_and_validation(mesh2):
+    eng_trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh2, Strategy.ring(2), trace=eng_trace)
+    k = jnp.ones((2, 4, 2, 8), jnp.float32)
+    pages = [(k, k + 1.0)]
+
+    out = eng.kv_transfer(pages, src_pod=0, dst_pod=1, chunk_bytes=512)
+    (ok, ov), = out
+    np.testing.assert_array_equal(np.asarray(ok), np.asarray(k))
+    np.testing.assert_array_equal(np.asarray(ov), np.asarray(k + 1.0))
+    e = eng_trace.events()[-1]
+    assert e.primitive == "kv_transfer" and e.impl == "dcn_stream"
+    assert e.nbytes == 2 * k.nbytes
+    assert e.extra["chunks"] == (2 * k.nbytes + 511) // 512
+
+    with pytest.raises(ValueError, match="at least one page"):
+        eng.kv_transfer([], src_pod=0, dst_pod=1)
+    with pytest.raises(ValueError, match="chunk_bytes"):
+        eng.kv_transfer(pages, src_pod=0, dst_pod=1, chunk_bytes=0)
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        eng.kv_transfer(pages, src_pod=0, dst_pod=1, wire_dtype="zstd")
+    with pytest.raises(ValueError, match="kv_transfer"):
+        eng.kv_transfer([(jnp.ones((3, 2)), jnp.ones((3, 2)))],
+                        src_pod=0, dst_pod=1)
+
+
+def test_int8_wire_gated_by_token_kl(tiny4):
+    cfg, _, params = tiny4
+    pmesh, dmesh = _pool_meshes()
+
+    assert measure_token_kl(cfg, params, 2, "off") == 0.0
+    kl = measure_token_kl(cfg, params, 2, "int8")
+    assert kl > 0.0
+
+    # over the bound: loud rejection naming the env knob — never a
+    # silently-degraded token stream
+    with pytest.raises(ValueError) as ei:
+        ClusterRouter(cfg, params, pmesh, dmesh,
+                      prefill_slots=2, decode_slots=2,
+                      kv_wire_dtype="int8", kv_kl_bound=1e-12)
+    msg = str(ei.value)
+    assert "exceeds the acceptance bound" in msg
+    assert KV_KL_BOUND_ENV in msg and "int8" in msg
+
+    # under the bound: admitted, served, and the wire ledger shows the
+    # int8 stream actually shrank the DCN traffic
+    trace = _trace(n=3)
+    rt = ClusterRouter(cfg, params, pmesh, dmesh,
+                       prefill_slots=2, decode_slots=2,
+                       temperature=1.0, top_k=8,
+                       kv_wire_dtype="int8", kv_kl_bound=1.0)
+    rt.submit_trace(trace)
+    results = rt.run()
+    assert len(results) == len(trace.requests)
+    s = rt.summary()["kv_stream"]
+    assert s["wire_dtype"] == "int8"
+    assert 0.0 < s["token_kl"] <= s["kl_bound"]
+    assert s["wire_bytes"] < s["payload_bytes"]
+
+
+def test_router_rejects_unequal_pods_and_wrong_trace_world(tiny4):
+    cfg, _, params = tiny4
+    devs = jax.devices()
+    with pytest.raises(ValueError, match="equal"):
+        ClusterRouter(cfg, params,
+                      Mesh(devs[:4], ("ranks",)), Mesh(devs[4:6], ("ranks",)),
+                      prefill_slots=1, decode_slots=1)
+    pmesh, dmesh = _pool_meshes()
+    rt = ClusterRouter(cfg, params, pmesh, dmesh,
+                       prefill_slots=1, decode_slots=1)
+    with pytest.raises(ValueError, match=r"2 pods x 2"):
+        rt.submit_trace(_trace(world=2))
+
+
+# ----------------------------------------------------------- the env knobs
+
+
+def test_env_resolvers(monkeypatch):
+    monkeypatch.delenv(DISAGG_ENV, raising=False)
+    assert resolve_disagg() is False
+    assert resolve_disagg(True) is True
+    monkeypatch.setenv(DISAGG_ENV, "1")
+    assert resolve_disagg(False) is True  # env outranks
+    monkeypatch.setenv(DISAGG_ENV, "off")
+    assert resolve_disagg(True) is False
+    monkeypatch.setenv(DISAGG_ENV, "maybe")
+    with pytest.raises(ValueError, match=DISAGG_ENV):
+        resolve_disagg()
+
+    monkeypatch.delenv(KV_WIRE_DTYPE_ENV, raising=False)
+    assert resolve_kv_wire_dtype() == "off"
+    assert resolve_kv_wire_dtype("bf16") == "bf16"
+    monkeypatch.setenv(KV_WIRE_DTYPE_ENV, "int8")
+    assert resolve_kv_wire_dtype("off") == "int8"
+    monkeypatch.setenv(KV_WIRE_DTYPE_ENV, "zstd")
+    with pytest.raises(ValueError, match="unknown wire codec"):
+        resolve_kv_wire_dtype()
+
+    monkeypatch.delenv(KV_KL_BOUND_ENV, raising=False)
+    assert resolve_kv_kl_bound() == pytest.approx(0.02)
+    monkeypatch.setenv(KV_KL_BOUND_ENV, "0.5")
+    assert resolve_kv_kl_bound(0.1) == pytest.approx(0.5)
+    for bad in ("-1", "0", "cheap"):
+        monkeypatch.setenv(KV_KL_BOUND_ENV, bad)
+        with pytest.raises(ValueError, match=KV_KL_BOUND_ENV):
+            resolve_kv_kl_bound()
+
+
+# ------------------------------------------------- the offline queueing twin
+
+
+def test_simulate_disagg_queue_tandem_blocking():
+    """Hand-checkable: one prefill slot, one decode slot, 1-step
+    transfer — request 1 waits for request 0's migration (prefill slot
+    frees at 3) AND its decode completion (8) before migrating."""
+    assert simulate_disagg_queue([0, 0], [3, 3], [4, 4], 1, 1,
+                                 transfer_steps=1) == [
+        (0, 0, 3, 4, 8), (0, 3, 6, 9, 13),
+    ]
+    # decode budget 0: the request completes inside the prefill pod —
+    # no migration, no transfer, every later field equals first_token
+    assert simulate_disagg_queue([0], [4], [0], 1, 1,
+                                 transfer_steps=3) == [(0, 0, 4, 4, 4)]
+    # TTFT never waits on the decode backlog: with ample prefill slots,
+    # first_token is admission + prefill even when decode is clogged
+    rows = simulate_disagg_queue([0, 0, 0], [2, 2, 2], [9, 9, 9], 3, 1)
+    assert [r[2] for r in rows] == [2, 2, 2]
+    assert rows[2][3] > rows[1][3] > rows[0][3]  # serialized decode
+
+
+def test_simulate_disagg_queue_validation():
+    with pytest.raises(ValueError, match="FIFO"):
+        simulate_disagg_queue([2, 1], [1, 1], [1, 1], 1, 1)
+    with pytest.raises(ValueError, match="at least one token"):
+        simulate_disagg_queue([0], [0], [1], 1, 1)
+    with pytest.raises(ValueError):
+        simulate_disagg_queue([0], [1], [-1], 1, 1)
+    with pytest.raises(ValueError):
+        simulate_disagg_queue([0], [1], [1], 0, 1)
+    with pytest.raises(ValueError):
+        simulate_disagg_queue([0], [1], [1], 1, 1, transfer_steps=-1)
+    with pytest.raises(ValueError):
+        simulate_disagg_queue([0, 1], [1], [1, 1], 1, 1)
+
+
+def test_disagg_queue_metrics_row():
+    m = disagg_queue_metrics([0, 0], [3, 3], [4, 4], 1, 1, 1,
+                             prefill_step_time_s=1e-3,
+                             decode_step_time_s=5e-4, slo_ms=20.0)
+    assert m["requests"] == 2
+    assert m["p99_ttft_steps"] == 6.0   # request 1's queued prefill
+    assert m["p99_decode_wait_steps"] == 3.0
+    assert m["p99_ttft_ms"] == pytest.approx(6.0)  # priced on the 1 ms tick
+    assert 0.0 < m["prefill_utilization"] <= 1.0
+    assert 0.0 < m["decode_utilization"] <= 1.0
+    assert 0.0 <= m["slo_attainment"] <= 1.0
+    with pytest.raises(ValueError):
+        disagg_queue_metrics([0], [1], [1], 1, 1, 0,
+                             prefill_step_time_s=0.0,
+                             decode_step_time_s=1e-3)
+    with pytest.raises(ValueError):
+        disagg_queue_metrics([0], [1], [1], 1, 1, 0,
+                             prefill_step_time_s=1e-3,
+                             decode_step_time_s=1e-3, slo_ms=0.0)
+
+
+# --------------------------------------- contended lower bounds (ROADMAP 5)
+
+
+def test_contended_lower_bound_keeps_gap_meaningful():
+    """The regression pin: a congestion window priced against the
+    healthy floor inflates every gap by the contention factor; against
+    its own contended floor the gap stays comparable to healthy runs."""
+    world, n, factor = 8, 1 << 20, 4.0
+    model = LinkCostModel.uniform(world, alpha=2e-6, beta=1.0 / 40e9)
+
+    lb_h = collective_lower_bound(model, n, "allreduce", world)
+    lb_c = contended_lower_bound(model, n, {ICI: factor}, "allreduce", world)
+    # analytic, not a magic constant: contention scales β only, so the
+    # contended floor is latency + factor x the bandwidth term
+    assert lb_c == pytest.approx(
+        latency_lower_bound(model, "allreduce", world)
+        + factor * bandwidth_lower_bound(model, n, "allreduce", world)
+    )
+    assert lb_c > lb_h
+
+    contended = model.contended({ICI: factor})
+    got = simulate_strategy(Strategy.ring(world), contended, n).seconds
+    gap_c = optimality_gap(got, lb_c)
+    gap_h = optimality_gap(got, lb_h)
+    assert got >= lb_c           # still a certified floor
+    assert 0.0 <= gap_c < gap_h  # the healthy floor drowns the signal
+    # unknown class / sub-1 factor stay loud at the bound too
+    with pytest.raises(ValueError, match="unknown link class"):
+        contended_lower_bound(model, n, {"pcie": 2.0}, "allreduce", world)
+    with pytest.raises(ValueError, match=">= 1"):
+        contended_lower_bound(model, n, {ICI: 0.5}, "allreduce", world)
+
+
+# ------------------------------------- the artifact funnel (satellite fix)
+
+
+def test_serve_trace_rejection_names_field_and_world(tmp_path, monkeypatch):
+    """A broken ADAPCC_SERVE_TRACE must say WHICH field the schema wants
+    and the world the run expected — not a bare exception repr."""
+    p = tmp_path / "trace.json"
+    p.write_text(json.dumps({"world": 4, "seed": 0}))  # no "requests"
+    monkeypatch.setenv(SERVE_TRACE_ENV, str(p))
+    with pytest.raises(ValueError) as ei:
+        load_serve_trace(world=4)
+    msg = str(ei.value)
+    assert "missing required field 'requests'" in msg
+    assert "(expected world=4)" in msg
+
+    p.write_text("{not json")
+    with pytest.raises(ValueError, match="invalid JSON"):
+        load_serve_trace(world=4)
+
+    p.write_text(json.dumps(_trace(n=1, world=2).to_dict()))
+    with pytest.raises(ValueError) as ei:
+        load_serve_trace(world=4)
+    assert "world=2" in str(ei.value) and "world=4" in str(ei.value)
+
+
+# -------------------------------------------------- fabric + workload wiring
+
+
+def test_kv_stream_registers_as_fabric_job(disagg_run):
+    from adapcc_tpu.adapt.fabric import SharedFabric
+
+    rt = disagg_run[0]
+    world = 8
+    ips = {r: f"10.0.0.{r // 4}" for r in range(world)}
+    model = LinkCostModel(
+        world,
+        classes={ICI: LinkCoeffs(1e-6, 1.0 / 45e9),
+                 DCN: LinkCoeffs(25e-6, 1.0 / 12.5e9)},
+        ips=ips, source="test-disagg",
+    )
+    fab = SharedFabric(model, [ips[r] for r in range(world)])
+    job = rt.kv_stream_fabric_job(fab)
+    assert job.nbytes == rt.summary()["kv_stream"]["wire_bytes"] > 0
+    assert job.priority == "high"
+
+
+def test_serve_gpt2_disagg_rejects_odd_world():
+    from adapcc_tpu.workloads.serve_gpt2 import build_parser, run
+
+    args = build_parser().parse_args(
+        ["--disagg", "--world", "3", "--heads", "3"]
+    )
+    with pytest.raises(SystemExit, match="even"):
+        run(args)

@@ -55,6 +55,7 @@ def test_operations_doc_covers_the_contract():
         "ip_table.txt", "topo_detect_<r>.xml", "logical_graph.xml",
         "strategy.xml", "reconstruct_topology", "hw_watch.py", "hw_session",
         "BENCH_FLASH_BLOCK", "--entry_point", "--dry-run",
+        "ADAPCC_DISAGG", "ADAPCC_KV_WIRE_DTYPE", "ADAPCC_KV_KL_BOUND",
     ):
         assert needle in text, f"OPERATIONS.md lost its {needle!r} coverage"
 
@@ -367,6 +368,11 @@ def test_serving_doc_covers_the_contract():
         "bit-identical", "head-sharded", "simulate_serve_queue",
         "serve_queue_metrics", "decode_step_time", "make serve-bench",
         "decode_slo", "small-message", "p99", "without retracing",
+        # the disaggregated plane (§7)
+        "ClusterRouter", "kv_transfer", "simulate_disagg_queue",
+        "ADAPCC_DISAGG", "ADAPCC_KV_WIRE_DTYPE", "ADAPCC_KV_KL_BOUND",
+        "make disagg-bench", "KL", "measure_token_kl", "disagg_transfer",
+        "bit-identical",
     ):
         assert needle in text, f"SERVING.md lost its {needle!r} coverage"
 
