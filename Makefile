@@ -6,7 +6,7 @@ CXXFLAGS ?= -O2 -std=c++17 -fPIC -Wall -Wextra
 LIB := libadapcc_rt.so
 SRCS := csrc/schedule_engine.cpp
 
-.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench compiler-bench hier-bench elastic-bench adapt-bench chaos-bench fabric-bench recovery-bench serve-bench disagg-bench simscale-bench trace-export clean
+.PHONY: all native test sim-bench ring-sweep quant-bench fused-bench tune-bench overlap-bench latency-bench compiler-bench hier-bench elastic-bench adapt-bench chaos-bench fabric-bench recovery-bench serve-bench disagg-bench simscale-bench pipe-bench trace-export clean
 
 all: native
 
@@ -185,6 +185,18 @@ simscale-bench:
 	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
 		--scale-sweep --scale-worlds 1024,4096,16384,65536 \
 		--sizes 1M,16M,256M --json
+
+# GPipe-vs-1F1B pipeline frontier on the same simulator
+# (docs/PIPELINE.md): deterministic "mode": "simulated" rows over the
+# (stages x microbatches x hop bytes) grid, each cell's verified hop
+# program replayed next to the closed-form step time and stash bound,
+# the 1F1B memory win flagged per row.  Byte-identical across runs —
+# measured gpipe-vs-1f1b A/B rows live in the device-gated pipeline_ab
+# battery (benchmarks.hw_session) instead.
+pipe-bench:
+	JAX_PLATFORMS=cpu python -m benchmarks.sim_collectives \
+		--pipe-sweep --pipe-stages 2,4 --pipe-microbatches 2,4,8 \
+		--sizes 1M,16M --json
 
 # Perfetto/chrome://tracing export of a recorded dispatch trace: run a
 # short virtual-pod collective session under ADAPCC_TUNER=record and emit

@@ -1779,6 +1779,89 @@ class CollectiveEngine:
             self.trace.record("kv_transfer", f"dcn_stream{suffix}", nbytes, **extras)
         return jax.tree_util.tree_unflatten(treedef, moved)
 
+    def pipe_send(
+        self,
+        stacked: jnp.ndarray,
+        *,
+        src: int,
+        dst: int,
+        kind: str = "activation",
+        mb: Optional[int] = None,
+        tick: Optional[int] = None,
+        epoch: Optional[int] = None,
+    ) -> jnp.ndarray:
+        """Point-to-point pipeline stage hop over the ICI fabric: move rank
+        ``src``'s row of a stacked ``[world, ...]`` buffer to rank ``dst``,
+        leaving every other row untouched (docs/PIPELINE.md).
+
+        The single-controller analog of a send/recv pair — one compiled
+        ``shard_map`` ppermute per (route, shape, dtype), cached like every
+        other engine program.  Each hop records ONE dispatch-trace event
+        (``primitive="pipe_send"``, impl ``ici_hop``) with the executed
+        payload bytes (one row, not the stacked buffer) and the
+        (src, dst) route, plus the schedule coordinates (``kind``
+        ``activation``/``grad``/``tied_embed``, microbatch, tick) when the
+        executor provides them — the stage-hop analog of the
+        :meth:`kv_transfer` honesty contract.
+        """
+        self._check_epoch(epoch)
+        self._check_world_dim(stacked, "pipe_send")
+        w = self.world_size
+        for label, r in (("src", src), ("dst", dst)):
+            if not 0 <= r < w:
+                raise ValueError(
+                    f"pipe_send {label}={r} outside world [0, {w})"
+                )
+        if src == dst:
+            raise ValueError(f"pipe_send src == dst == {src}: nothing to move")
+        if kind not in ("activation", "grad", "tied_embed"):
+            raise ValueError(
+                f"pipe_send kind={kind!r}: expected 'activation', 'grad' or "
+                "'tied_embed'"
+            )
+        axis = self.axis_name
+
+        def per_shard(x: jnp.ndarray) -> jnp.ndarray:
+            me = lax.axis_index(axis)
+            moved = lax.ppermute(x, axis, perm=[(src, dst)])
+            return jnp.where(me == dst, moved, x)
+
+        fn = self._shard_mapped(
+            ("pipe_send", src, dst, stacked.shape, stacked.dtype.name),
+            per_shard,
+            1,
+        )
+        out = fn(stacked)
+        if self.trace is not None:
+            extras: Dict[str, Any] = {
+                "epoch": self.epoch,
+                "src": int(src),
+                "dst": int(dst),
+                "kind": kind,
+            }
+            if mb is not None:
+                extras["mb"] = int(mb)
+            if tick is not None:
+                extras["tick"] = int(tick)
+            self.trace.record(
+                "pipe_send", "ici_hop", int(stacked.nbytes) // w, **extras
+            )
+        return out
+
+    def pipe_recv(
+        self,
+        stacked: jnp.ndarray,
+        *,
+        src: int,
+        dst: int,
+        **kwargs: Any,
+    ) -> jnp.ndarray:
+        """Destination-side spelling of the stage hop.  In the
+        single-controller engine one dispatch is both halves of a
+        send/recv pair, so this forwards to :meth:`pipe_send` — calling
+        either records exactly one trace event for the hop."""
+        return self.pipe_send(stacked, src=src, dst=dst, **kwargs)
+
     def _ring_plan(
         self,
         stacked: jnp.ndarray,

@@ -41,8 +41,12 @@ from typing import Iterator, Optional, Tuple
 #: the closed set of step kinds; anything else is a construction error
 STEP_KINDS = ("send", "recv", "reduce", "copy", "encode", "decode")
 
-#: collectives a program may declare; today only allreduce has a lowering
-PROGRAM_COLLECTIVES = ("allreduce",)
+#: collectives a program may declare.  ``allreduce`` has a lowering to
+#: every data plane; ``pipeline`` names point-to-point stage-hop programs
+#: (GC3-style: each chunk is one payload routed from a source rank to a
+#: sink rank) — verified and priced through the same object, executed by
+#: the pipeline engine rather than ``compiler/lower.py``.
+PROGRAM_COLLECTIVES = ("allreduce", "pipeline")
 
 
 @dataclass(frozen=True)
@@ -104,6 +108,13 @@ class ScheduleProgram:
     wire_dtype: str = "off"
     #: ranks that forward without contributing input or needing delivery
     relays: Tuple[int, ...] = ()
+    #: ``pipeline`` programs only: per-chunk origin and destination rank.
+    #: Chunk ``c`` starts as rank ``chunk_sources[c]``'s private payload and
+    #: must end up delivered (unmodified contribution set) at rank
+    #: ``chunk_sinks[c]``.  Empty for collective programs, where every
+    #: non-relay rank both contributes and requires delivery.
+    chunk_sources: Tuple[int, ...] = ()
+    chunk_sinks: Tuple[int, ...] = ()
 
     def __post_init__(self) -> None:
         if self.world < 1:
@@ -124,6 +135,36 @@ class ScheduleProgram:
                 raise ValueError(f"relay rank {r} out of range [0, {self.world})")
         if len(self.relays) >= self.world:
             raise ValueError("every rank is a relay: nothing contributes")
+        object.__setattr__(self, "chunk_sources", tuple(self.chunk_sources))
+        object.__setattr__(self, "chunk_sinks", tuple(self.chunk_sinks))
+        if self.collective == "pipeline":
+            if len(self.chunk_sources) != self.chunks or (
+                len(self.chunk_sinks) != self.chunks
+            ):
+                raise ValueError(
+                    "pipeline programs route each chunk point-to-point: "
+                    f"need chunk_sources/chunk_sinks of length {self.chunks}, "
+                    f"got {len(self.chunk_sources)}/{len(self.chunk_sinks)}"
+                )
+            if self.relays:
+                raise ValueError(
+                    "pipeline programs have no relays: intermediate stages "
+                    "are named by the per-chunk hop steps themselves"
+                )
+            for label, ranks in (
+                ("chunk_sources", self.chunk_sources),
+                ("chunk_sinks", self.chunk_sinks),
+            ):
+                for c, r in enumerate(ranks):
+                    if not (0 <= r < self.world):
+                        raise ValueError(
+                            f"{label}[{c}] = {r} out of range [0, {self.world})"
+                        )
+        elif self.chunk_sources or self.chunk_sinks:
+            raise ValueError(
+                "chunk_sources/chunk_sinks are pipeline-program routing "
+                f"metadata; collective {self.collective!r} does not take them"
+            )
         for i, rnd in enumerate(self.rounds):
             for step in rnd:
                 if not (0 <= step.rank < self.world):
@@ -174,6 +215,10 @@ class ScheduleProgram:
             f"{self.name}|{self.world}|{self.chunks}|{self.collective}|"
             f"{self.wire_dtype}|{self.relays}".encode()
         )
+        if self.chunk_sources or self.chunk_sinks:
+            # folded in only when present so collective-program fingerprints
+            # predating the pipeline family are unchanged
+            h.update(f"|{self.chunk_sources}|{self.chunk_sinks}".encode())
         for i, rnd in enumerate(self.rounds):
             h.update(f"r{i}".encode())
             for s in rnd:

@@ -25,6 +25,14 @@ loudly with the offending ``(rank, round, chunk)`` named:
    ``decode`` with no encoded incoming message decodes nothing.
 5. **Delivery** — after the last round every non-relay rank holds, for
    every chunk, exactly the full contributor set (all non-relay ranks).
+
+Point-to-point (``collective="pipeline"``) programs run the same abstract
+interpretation with routed initial/final states: chunk ``c`` starts as the
+private payload of ``chunk_sources[c]`` only, every hop must forward a
+chunk its sender actually holds at round entry (an unheld send is a
+use-before-receive ordering bug), and delivery means ``chunk_sinks[c]``
+ends holding exactly the source's contribution — intermediate stages may
+hold stale copies, sinks may not hold a wrong or empty one.
 """
 
 from __future__ import annotations
@@ -49,13 +57,22 @@ def _fail(round_idx: int, step: Step, why: str) -> None:
 def verify_program(program: ScheduleProgram) -> None:
     """Certify ``program`` or raise :class:`ScheduleVerificationError`."""
     contributors = frozenset(program.contributors())
+    pipeline = program.collective == "pipeline"
     # contribution state: state[rank][chunk] -> frozenset of folded ranks;
     # relays start empty (they forward, they do not contribute)
-    state: List[List[FrozenSet[int]]] = [
-        [frozenset((r,)) if r in contributors else frozenset()
-         for _ in range(program.chunks)]
-        for r in range(program.world)
-    ]
+    if pipeline:
+        # routed payloads: chunk c exists only at its source rank
+        state: List[List[FrozenSet[int]]] = [
+            [frozenset((r,)) if program.chunk_sources[c] == r else frozenset()
+             for c in range(program.chunks)]
+            for r in range(program.world)
+        ]
+    else:
+        state = [
+            [frozenset((r,)) if r in contributors else frozenset()
+             for _ in range(program.chunks)]
+            for r in range(program.world)
+        ]
 
     for i, rnd in enumerate(program.rounds):
         sends: Dict[Tuple[int, int, int], Step] = {}  # (src, dst, chunk)
@@ -161,6 +178,18 @@ def verify_program(program: ScheduleProgram) -> None:
         # 3. dataflow: sends read round-entry state; reduce unions
         # disjoint contribution sets; copy overwrites
         entry = [list(row) for row in state]
+        if pipeline:
+            # a hop may only forward a payload its sender holds at round
+            # entry — an empty send is a use-before-receive ordering bug
+            for (src, _dst, chunk), step in sends.items():
+                if not entry[src][chunk]:
+                    _fail(
+                        i, step,
+                        f"sends chunk {chunk} before holding it — the "
+                        f"payload (source rank "
+                        f"{program.chunk_sources[chunk]}) has not reached "
+                        f"rank {src} by round {i}",
+                    )
         for (dst, chunk), (src, _step) in landing.items():
             incoming = entry[src][chunk]
             consumer = consumers[(dst, chunk)][0]
@@ -176,7 +205,22 @@ def verify_program(program: ScheduleProgram) -> None:
                     )
                 state[dst][chunk] = state[dst][chunk] | incoming
 
-    # 5. delivery: every non-relay rank holds the full contributor set
+    # 5. delivery
+    if pipeline:
+        # routed delivery: each chunk's sink holds exactly its source's
+        # contribution (nothing lost, nothing folded in along the way)
+        for c in range(program.chunks):
+            src, sink = program.chunk_sources[c], program.chunk_sinks[c]
+            want = frozenset((src,))
+            if state[sink][c] != want:
+                raise ScheduleVerificationError(
+                    f"undelivered chunk at (rank={sink}, "
+                    f"round={program.num_rounds - 1}, chunk={c}): sink holds "
+                    f"{sorted(state[sink][c])}, expected the source payload "
+                    f"from rank {src}"
+                )
+        return
+    # collective delivery: every non-relay rank holds the full contributor set
     for r in program.contributors():
         for c in range(program.chunks):
             if state[r][c] != contributors:

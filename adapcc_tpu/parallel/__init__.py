@@ -20,7 +20,7 @@ from adapcc_tpu.parallel.tensor import (
     row_parallel_dense,
     tree_shardings,
 )
-from adapcc_tpu.parallel.pipeline import pipeline_apply
+from adapcc_tpu.pipe.forward import pipeline_apply
 from adapcc_tpu.parallel.expert import expert_parallel_moe
 from adapcc_tpu.parallel.fsdp import (
     Zero1Optimizer,

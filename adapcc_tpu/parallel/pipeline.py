@@ -1,82 +1,20 @@
-"""Pipeline parallelism: GPipe-style microbatch pipeline over a mesh axis.
-
-Stages live on consecutive ranks of a ``stages`` mesh axis; activations hop
-stage→stage with ``lax.ppermute`` inside one ``lax.scan`` over
-``num_microbatches + num_stages - 1`` ticks (the classic fill/drain bubble).
-Everything is static-shape and branch-free — per-rank behavior (ingest on
-stage 0, emit on the last stage) is expressed with ``jnp.where`` masks on the
-traced ``lax.axis_index``, so the whole pipeline is one compiled XLA program
-with collective-permute on ICI between neighbors.
-
-The reference has no pipeline parallelism (SURVEY §2.3); this is new
-capability built on the same ppermute machinery as the ring collectives.
-"""
+"""Deprecated location: the forward pipeline block moved to
+``adapcc_tpu.pipe.forward`` when the pipeline-parallel training plane
+landed (docs/PIPELINE.md).  This shim keeps old imports working and
+warns ONCE per process — parity between the two spellings is pinned in
+``tests/test_pipe.py``."""
 
 from __future__ import annotations
 
-from functools import partial
+import warnings
 from typing import Any, Callable
 
-import jax
 import jax.numpy as jnp
-from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from jax.sharding import Mesh
 
+from adapcc_tpu.pipe.forward import pipeline_apply as _pipeline_apply
 
-def _pipeline_shard(
-    stage_params: Any,
-    x: jnp.ndarray,
-    *,
-    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
-    axis_name: str,
-):
-    """Per-shard pipeline body.
-
-    ``stage_params``: this rank's stage slice (leading stage axis stripped to
-    size 1 by shard_map; squeezed here).  ``x``: the full microbatched input
-    ``[M, mb, ...]``, replicated across the stage axis.  Returns ``[M, mb, ...]``
-    outputs (valid on every rank — the last stage's results are broadcast
-    back through the same ppermute ring during drain... simpler: gathered via
-    psum-masking at the end).
-    """
-    params = jax.tree_util.tree_map(lambda a: a[0], stage_params)
-    stages = lax.psum(1, axis_name)
-    stage = lax.axis_index(axis_name)
-    M = x.shape[0]
-    ticks = M + stages - 1
-
-    # send stage i -> i+1 (the last stage's send wraps to 0 and is ignored)
-    fwd = [(i, (i + 1) % stages) for i in range(stages)]
-
-    out0 = jnp.zeros(x.shape, jax.eval_shape(lambda p, b: stage_fn(p, b), params, x[0]).dtype)
-    carry0 = jnp.zeros_like(x[0])
-
-    def tick(carry, t):
-        incoming, outputs = carry
-        # stage 0 ingests microbatch t while filling; afterwards it computes
-        # on zeros whose results are never collected
-        feed_idx = jnp.clip(t, 0, M - 1)
-        inp = jnp.where(stage == 0, x[feed_idx], incoming)
-        out = stage_fn(params, inp)
-        # the last stage owns microbatch t-(stages-1) at tick t
-        emit_idx = jnp.clip(t - (stages - 1), 0, M - 1)
-        is_emit = jnp.logical_and(stage == stages - 1, t >= stages - 1)
-        outputs = lax.dynamic_update_index_in_dim(
-            outputs,
-            jnp.where(is_emit, out, lax.dynamic_index_in_dim(outputs, emit_idx, 0, False)),
-            emit_idx,
-            0,
-        )
-        incoming = lax.ppermute(out, axis_name, fwd)
-        return (incoming, outputs), None
-
-    (_, outputs), _ = lax.scan(tick, (carry0, out0), jnp.arange(ticks))
-
-    # only the last stage holds real outputs; replicate them to every stage
-    # so the caller sees a replicated result (one psum over the stage axis)
-    outputs = jnp.where(stage == stages - 1, outputs, jnp.zeros_like(outputs))
-    return lax.psum(outputs, axis_name)
+_MOVED_WARNED = False
 
 
 def pipeline_apply(
@@ -87,25 +25,23 @@ def pipeline_apply(
     axis_name: str = "stages",
     num_microbatches: int = 4,
 ) -> jnp.ndarray:
-    """Run ``stage_fn`` as a pipeline over ``mesh[axis_name]``.
-
-    ``stacked_params``: pytree whose leaves have a leading ``num_stages`` axis
-    (stage s uses ``leaf[s]``).  ``batch [B, ...]`` with ``B`` divisible by
-    ``num_microbatches``; microbatch size ``B // num_microbatches`` must keep
-    ``stage_fn`` shape-preserving (same in/out shape), as in a transformer
-    block stack.  Returns ``[B, ...]`` outputs, replicated.
-    """
-    B = batch.shape[0]
-    if B % num_microbatches:
-        raise ValueError(f"batch {B} not divisible by microbatches {num_microbatches}")
-    x = batch.reshape(num_microbatches, B // num_microbatches, *batch.shape[1:])
-
-    fn = shard_map(
-        partial(_pipeline_shard, stage_fn=stage_fn, axis_name=axis_name),
-        mesh=mesh,
-        in_specs=(P(axis_name), P()),
-        out_specs=P(),
-        check_vma=False,
+    """Deprecated alias of :func:`adapcc_tpu.pipe.forward.pipeline_apply`.
+    Warns once — a long loop must not drown in a warning per call — then
+    delegates unchanged."""
+    global _MOVED_WARNED
+    if not _MOVED_WARNED:
+        _MOVED_WARNED = True
+        warnings.warn(
+            "adapcc_tpu.parallel.pipeline moved to adapcc_tpu.pipe.forward; "
+            "import pipeline_apply from there",
+            DeprecationWarning,
+            stacklevel=2,
+        )
+    return _pipeline_apply(
+        stage_fn,
+        stacked_params,
+        batch,
+        mesh,
+        axis_name=axis_name,
+        num_microbatches=num_microbatches,
     )
-    out = fn(stacked_params, x)
-    return out.reshape(B, *out.shape[2:])

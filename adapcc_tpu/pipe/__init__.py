@@ -1,0 +1,52 @@
+"""Pipeline-parallel training plane (docs/PIPELINE.md).
+
+``partition`` splits GPT-2 across stages, ``schedule`` lays GPipe/1F1B
+tick tables and re-emits them as verifiable ``compiler/`` programs,
+``executor`` interprets the table with real backward through the traced
+engine, and ``forward`` is the fused forward-only building block."""
+
+from adapcc_tpu.pipe.forward import pipeline_apply
+from adapcc_tpu.pipe.partition import (
+    StagePartition,
+    composed_loss,
+    merge_params,
+    partition_gpt2,
+    split_params,
+    stage_forward,
+)
+from adapcc_tpu.pipe.schedule import (
+    DEFAULT_PIPE_SCHEDULE,
+    PIPE_SCHEDULE_ENV,
+    PIPE_SCHEDULES,
+    PipelineSchedule,
+    PipeTask,
+    pipeline_program,
+    pipeline_schedule,
+    resolve_pipe_schedule,
+)
+from adapcc_tpu.pipe.executor import (
+    PipelineExecutor,
+    PipelineReport,
+    sync_tied_embedding,
+)
+
+__all__ = [
+    "DEFAULT_PIPE_SCHEDULE",
+    "PIPE_SCHEDULE_ENV",
+    "PIPE_SCHEDULES",
+    "PipeTask",
+    "PipelineExecutor",
+    "PipelineReport",
+    "PipelineSchedule",
+    "StagePartition",
+    "composed_loss",
+    "merge_params",
+    "partition_gpt2",
+    "pipeline_apply",
+    "pipeline_program",
+    "pipeline_schedule",
+    "resolve_pipe_schedule",
+    "split_params",
+    "stage_forward",
+    "sync_tied_embedding",
+]

@@ -1,0 +1,313 @@
+"""Pipeline executor: interpret a tick table with real backward.
+
+The executor runs the SAME static :class:`~adapcc_tpu.pipe.schedule
+.PipelineSchedule` the verifier certified and the simulator priced —
+tick by tick, one task per stage per tick.  Forward tasks run the pure
+stage functions from :mod:`adapcc_tpu.pipe.partition` under ``jax.vjp``
+and stash the pullback; backward tasks pop the stash, pull the upstream
+gradient through, and accumulate per-stage parameter gradients in
+microbatch order (identical order under GPipe and 1F1B, which is what
+makes the two schedules' gradients bit-comparable).  Every stage-to-stage
+hop — forward activations, backward activation gradients, and the final
+Megatron-style tied-embedding gradient exchange — is dispatched through
+the traced :meth:`~adapcc_tpu.comm.engine.CollectiveEngine.pipe_send`,
+so the dispatch trace holds one event per hop with executed bytes and
+route, and the hop count equals ``program.total_sends()`` of the emitted
+IR program by construction.
+
+The activation stash is the memory story: its per-stage high-water mark
+is measured (count and bytes) and reported, so the 1F1B-vs-GPipe window
+``min(m, stages − s)`` vs ``m`` is an observable, not a claim.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from adapcc_tpu.compiler.verify import verify_program
+from adapcc_tpu.models.gpt2 import GPT2Config
+from adapcc_tpu.pipe.partition import StagePartition, stage_forward
+from adapcc_tpu.pipe.schedule import (
+    PipelineSchedule,
+    pipeline_program,
+    pipeline_schedule,
+    resolve_pipe_schedule,
+)
+
+
+@dataclass(frozen=True)
+class PipelineReport:
+    """What one pipelined step actually did."""
+
+    schedule: str
+    stages: int
+    microbatches: int
+    ticks: int
+    hops: int
+    stash_peak: Tuple[int, ...]        #: per-stage peak in-flight stash count
+    stash_peak_bytes: Tuple[int, ...]  #: per-stage peak stashed activation bytes
+    bubble_fraction: float
+    step_time_s: float
+
+
+class PipelineExecutor:
+    """Drive GPT-2 stages over a pipeline schedule through a traced engine.
+
+    ``engine`` is a :class:`~adapcc_tpu.comm.engine.CollectiveEngine`
+    whose world hosts the stages (rank ``s`` is stage ``s``; extra ranks
+    idle).  ``schedule`` resolves env > arg > tuner > default via
+    :func:`~adapcc_tpu.pipe.schedule.resolve_pipe_schedule`.
+    """
+
+    def __init__(
+        self,
+        cfg: GPT2Config,
+        partition: StagePartition,
+        engine: Any,
+        *,
+        num_microbatches: int = 4,
+        schedule: Optional[str] = None,
+        tuner_db: Optional[Any] = None,
+    ) -> None:
+        if num_microbatches < 1:
+            raise ValueError(
+                f"num_microbatches must be >= 1, got {num_microbatches}"
+            )
+        S = partition.num_stages
+        if engine.world_size < S:
+            raise ValueError(
+                f"engine world {engine.world_size} cannot host {S} stages"
+            )
+        self.cfg = cfg
+        self.partition = partition
+        self.engine = engine
+        self.num_microbatches = int(num_microbatches)
+        self.tuner_db = tuner_db
+        topology = ""
+        if tuner_db is not None:
+            # the tuner cell lookup must spell the same topology slot the
+            # recorder stamps, or measured cells can never win
+            from adapcc_tpu.tuner.db import mesh_fingerprint
+
+            topology = mesh_fingerprint(engine.mesh)
+        self.schedule_kind = resolve_pipe_schedule(
+            schedule,
+            tuner_db=tuner_db,
+            world=engine.world_size,
+            microbatches=num_microbatches,
+            topology=topology,
+        )
+        self.schedule: PipelineSchedule = pipeline_schedule(
+            S, self.num_microbatches, self.schedule_kind
+        )
+        if S > 1:
+            # the executor runs the verified object: emit the hop program
+            # from the same tick table and certify it up front
+            self.program = pipeline_program(
+                self.schedule, world=engine.world_size, tied_embedding=True
+            )
+            verify_program(self.program)
+        else:
+            self.program = None
+
+    # -- one pipelined step ----------------------------------------------------
+
+    def _hop(
+        self,
+        value: jnp.ndarray,
+        src: int,
+        dst: int,
+        kind: str,
+        mb: Optional[int],
+        tick: Optional[int],
+    ) -> jnp.ndarray:
+        """Route one payload src→dst through the traced engine primitive:
+        stack it into the [world, ...] buffer layout, move the row, and
+        read it back at the destination."""
+        w = self.engine.world_size
+        buf = jnp.zeros((w,) + value.shape, value.dtype).at[src].set(value)
+        moved = self.engine.pipe_send(
+            buf, src=src, dst=dst, kind=kind, mb=mb, tick=tick
+        )
+        return moved[dst]
+
+    def forward_backward(
+        self,
+        stage_params: List[Dict[str, Any]],
+        tokens: jnp.ndarray,
+        *,
+        grad_sync: Optional[Callable[[Any], Any]] = None,
+    ) -> Tuple[jnp.ndarray, List[Any], PipelineReport]:
+        """One pipelined forward/backward over ``tokens`` ``[B, T]``.
+
+        Returns ``(loss, stage_grads, report)``: the mean microbatch loss,
+        per-stage gradient pytrees already scaled to the full-batch mean
+        (stage 0's ``wte`` gradient includes the tied-head contribution
+        routed back from the last stage; the last stage's ``head_wte``
+        slot is zeroed — stage 0 owns the shared tensor), and the step
+        report.  ``grad_sync``, when given, is applied to each stage's
+        accumulated gradients before return — the DP×PP attach point for
+        the DDP grad-sync hook (docs/PIPELINE.md §DP×PP).
+        """
+        t0 = time.perf_counter()
+        S = self.partition.num_stages
+        M = self.num_microbatches
+        B = tokens.shape[0]
+        if B % M != 0:
+            raise ValueError(
+                f"batch {B} is not divisible into {M} microbatches"
+            )
+        mb_tokens = tokens.reshape(M, B // M, *tokens.shape[1:])
+        cfg, part = self.cfg, self.partition
+
+        fwd_inbox: Dict[Tuple[int, int], jnp.ndarray] = {}
+        bwd_inbox: Dict[Tuple[int, int], jnp.ndarray] = {}
+        stash: List[Dict[int, Any]] = [dict() for _ in range(S)]
+        stash_bytes: List[Dict[int, int]] = [dict() for _ in range(S)]
+        peak = [0] * S
+        peak_bytes = [0] * S
+        losses: List[Optional[jnp.ndarray]] = [None] * M
+        grads: List[Any] = [None] * S
+        hops = 0
+
+        def accumulate(s: int, g: Any) -> None:
+            grads[s] = (
+                g
+                if grads[s] is None
+                else jax.tree_util.tree_map(jnp.add, grads[s], g)
+            )
+
+        for t, row in enumerate(self.schedule.ticks):
+            for s, task in enumerate(row):
+                if task is None:
+                    continue
+                m = task.mb
+                if task.kind == "fwd":
+                    if s == 0:
+                        out, vjp = jax.vjp(
+                            lambda p: stage_forward(
+                                cfg, part, 0, p, None, mb_tokens[m]
+                            ),
+                            stage_params[0],
+                        )
+                        in_bytes = int(out.nbytes)
+                    else:
+                        x = fwd_inbox.pop((s, m))
+                        toks = mb_tokens[m] if s == S - 1 else None
+                        out, vjp = jax.vjp(
+                            lambda p, xx: stage_forward(
+                                cfg, part, s, p, xx, toks
+                            ),
+                            stage_params[s],
+                            x,
+                        )
+                        in_bytes = int(x.nbytes)
+                    stash[s][m] = vjp
+                    stash_bytes[s][m] = in_bytes
+                    peak[s] = max(peak[s], len(stash[s]))
+                    peak_bytes[s] = max(
+                        peak_bytes[s], sum(stash_bytes[s].values())
+                    )
+                    if s == S - 1:
+                        losses[m] = out
+                    else:
+                        fwd_inbox[(s + 1, m)] = self._hop(
+                            out, s, s + 1, "activation", m, t
+                        )
+                        hops += 1
+                else:  # bwd
+                    vjp = stash[s].pop(m)
+                    stash_bytes[s].pop(m)
+                    if s == S - 1:
+                        seed = jnp.ones((), dtype=losses[m].dtype)
+                        pulled = vjp(seed)
+                    else:
+                        pulled = vjp(bwd_inbox.pop((s, m)))
+                    accumulate(s, pulled[0])
+                    if s > 0:
+                        bwd_inbox[(s - 1, m)] = self._hop(
+                            pulled[1], s, s - 1, "grad", m, t
+                        )
+                        hops += 1
+
+        assert not fwd_inbox and not bwd_inbox and all(
+            not st for st in stash
+        ), "pipeline drain left in-flight state (schedule/executor drift)"
+
+        # microbatch-mean loss and grads (each microbatch loss is already a
+        # mean over its tokens; equal sizes make sum/M the full-batch mean)
+        loss = sum(losses[1:], losses[0]) / M
+        grads = [
+            jax.tree_util.tree_map(lambda g: g / M, gs) for gs in grads
+        ]
+
+        if S > 1:
+            # Megatron-style tied-embedding exchange: the head copy's
+            # gradient rides one traced hop back to the owner of wte
+            head_g = grads[S - 1]["head_wte"]["embedding"]
+            arrived = self._hop(head_g, S - 1, 0, "tied_embed", None, None)
+            hops += 1
+            grads[0]["wte"]["embedding"] = (
+                grads[0]["wte"]["embedding"] + arrived
+            )
+            grads[S - 1]["head_wte"]["embedding"] = jnp.zeros_like(head_g)
+            assert self.program is not None
+            assert hops == self.program.total_sends(), (
+                f"executor ran {hops} hops but the verified program has "
+                f"{self.program.total_sends()} sends"
+            )
+
+        if grad_sync is not None:
+            grads = [grad_sync(gs) for gs in grads]
+
+        step_time = time.perf_counter() - t0
+        if self.tuner_db is not None:
+            self._record_tuner_sample(step_time)
+        report = PipelineReport(
+            schedule=self.schedule_kind,
+            stages=S,
+            microbatches=M,
+            ticks=self.schedule.num_ticks,
+            hops=hops,
+            stash_peak=tuple(peak),
+            stash_peak_bytes=tuple(peak_bytes),
+            bubble_fraction=self.schedule.bubble_fraction,
+            step_time_s=step_time,
+        )
+        return loss, grads, report
+
+    def _record_tuner_sample(self, seconds: float) -> None:
+        from adapcc_tpu.pipe.schedule import PIPE_PRIMITIVE
+        from adapcc_tpu.tuner.db import (
+            TuningKey,
+            mesh_fingerprint,
+            size_bucket,
+        )
+        from adapcc_tpu.tuner.policy import pipe_path
+
+        key = TuningKey(
+            primitive=PIPE_PRIMITIVE,
+            size_bucket=size_bucket(0),
+            world=self.engine.world_size,
+            topology=mesh_fingerprint(self.engine.mesh),
+            path=pipe_path(self.schedule_kind),
+            chunk_bytes=self.num_microbatches,
+            wire_dtype="off",
+        )
+        self.tuner_db.record(key, seconds)
+
+
+def sync_tied_embedding(stage_params: List[Dict[str, Any]]) -> None:
+    """Refresh the last stage's ``head_wte`` copy from stage 0's ``wte``
+    after an optimizer update — the other half of the weight tie (the
+    gradient half lives in :meth:`PipelineExecutor.forward_backward`).
+    Mutates the per-stage dicts in place."""
+    if len(stage_params) > 1 and "head_wte" in stage_params[-1]:
+        stage_params[-1]["head_wte"]["embedding"] = (
+            stage_params[0]["wte"]["embedding"]
+        )

@@ -1,0 +1,243 @@
+"""Stage partitioner: split GPT-2 across a pipeline ``stages`` axis.
+
+The partitioner owns the **model side** of the pipeline plane: which
+transformer blocks (plus the embedding front and the tied-head back) live
+on which stage, how a full ``model.init`` param tree splits into
+per-stage subtrees, and the pure per-stage forward functions the executor
+differentiates with ``jax.vjp``.  The stage functions re-apply the *same
+flax modules* ``models/gpt2.py`` builds inline (``nn.Embed``/``Block``/
+``nn.LayerNorm`` with identical construction), so the staged composition
+is the single-stage model's math by construction — the parity tests pin
+the composed forward against ``GPT2.apply`` to the bit.
+
+Weight tying across the cut: stage 0 owns ``wte``/``wpe``; the last stage
+holds a ``head_wte`` *copy* of the token embedding for the tied LM head.
+After the backward drain the executor routes the head copy's gradient
+back to stage 0 (the Megatron-LM embedding-grad exchange) and folds it
+into stage 0's ``wte`` gradient, so merged gradients match the
+single-stage model where the head and the lookup share one tensor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from adapcc_tpu.models.gpt2 import GPT2, GPT2Config, Block, lm_loss
+
+
+@dataclass(frozen=True)
+class StagePartition:
+    """A contiguous block split: stage ``s`` runs blocks
+    ``[block_ranges[s][0], block_ranges[s][1])``; ``param_counts`` is the
+    per-stage parameter count including the embedding/head residents."""
+
+    num_stages: int
+    n_layer: int
+    block_ranges: Tuple[Tuple[int, int], ...]
+    param_counts: Tuple[int, ...]
+
+    def blocks_of(self, stage: int) -> range:
+        lo, hi = self.block_ranges[stage]
+        return range(lo, hi)
+
+
+def _param_count(tree: Any) -> int:
+    return sum(
+        int(jnp.size(x)) if hasattr(x, "size") else 0
+        for x in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def _module_sizes(cfg: GPT2Config) -> Dict[str, int]:
+    """Per-top-module parameter counts from an abstract ``model.init`` —
+    shapes only, nothing materialized."""
+    model = GPT2(cfg)
+    sample = jnp.zeros((1, min(2, cfg.max_seq)), dtype=jnp.int32)
+    shapes = jax.eval_shape(lambda r: model.init(r, sample), jax.random.PRNGKey(0))
+    return {
+        name: sum(int(jnp.prod(jnp.array(l.shape))) for l in
+                  jax.tree_util.tree_leaves(sub))
+        for name, sub in shapes["params"].items()
+    }
+
+
+def partition_gpt2(cfg: GPT2Config, num_stages: int) -> StagePartition:
+    """Split ``cfg.n_layer`` blocks over ``num_stages`` contiguous stages
+    with balanced parameter counts.
+
+    Every stage gets ``n_layer // num_stages`` blocks; the remainder
+    blocks go one at a time to the lightest stages (the embedding makes
+    stage 0 and the tied head makes the last stage heavier, so middle
+    stages absorb the extras first).  Loud reject on un-splittable
+    layouts: more stages than blocks, or a degenerate stage count.
+    """
+    if num_stages < 1:
+        raise ValueError(f"num_stages must be >= 1, got {num_stages}")
+    if num_stages > cfg.n_layer:
+        raise ValueError(
+            f"un-splittable layout: {cfg.n_layer} transformer blocks cannot "
+            f"feed {num_stages} pipeline stages (each stage needs >= 1 block)"
+        )
+    if cfg.dropout != 0.0:
+        raise ValueError("pipeline parallelism requires dropout == 0")
+    if cfg.sp_axis is not None:
+        raise ValueError(
+            "pipeline parallelism does not compose with sequence "
+            "parallelism (cfg.sp_axis must be None)"
+        )
+
+    sizes = _module_sizes(cfg)
+    block_size = sizes["h0"]
+    embed_size = sizes["wte"] + sizes["wpe"]
+    head_size = sizes["wte"] + sizes["ln_f"]  # head_wte copy + final norm
+
+    counts = [cfg.n_layer // num_stages] * num_stages
+    extra = cfg.n_layer - sum(counts)
+    overhead = [0.0] * num_stages
+    overhead[0] += embed_size
+    if num_stages > 1:
+        overhead[-1] += head_size
+    else:
+        overhead[0] += sizes["ln_f"]
+    for _ in range(extra):
+        # lightest stage first; ties break toward the earlier stage
+        load = [overhead[s] + counts[s] * block_size for s in range(num_stages)]
+        s = min(range(num_stages), key=lambda i: (load[i], i))
+        counts[s] += 1
+
+    ranges: List[Tuple[int, int]] = []
+    lo = 0
+    for s in range(num_stages):
+        ranges.append((lo, lo + counts[s]))
+        lo += counts[s]
+    param_counts = tuple(
+        int(overhead[s]) + counts[s] * block_size for s in range(num_stages)
+    )
+    return StagePartition(
+        num_stages=num_stages,
+        n_layer=cfg.n_layer,
+        block_ranges=tuple(ranges),
+        param_counts=param_counts,
+    )
+
+
+# -- param tree surgery --------------------------------------------------------
+
+
+def split_params(params: Any, partition: StagePartition) -> List[Dict[str, Any]]:
+    """Split a full ``model.init`` tree into per-stage subtrees (each a
+    plain ``{module_name: leaves}`` dict).  The last stage's ``head_wte``
+    starts as a copy of ``wte`` — the executor keeps them in sync via the
+    tied-embedding gradient exchange."""
+    p = params["params"] if "params" in params else params
+    out: List[Dict[str, Any]] = []
+    S = partition.num_stages
+    for s in range(S):
+        sub: Dict[str, Any] = {}
+        if s == 0:
+            sub["wte"] = p["wte"]
+            sub["wpe"] = p["wpe"]
+        for i in partition.blocks_of(s):
+            sub[f"h{i}"] = p[f"h{i}"]
+        if s == S - 1:
+            sub["ln_f"] = p["ln_f"]
+            if S > 1:
+                sub["head_wte"] = {"embedding": p["wte"]["embedding"]}
+        out.append(sub)
+    return out
+
+
+def merge_params(stage_params: List[Dict[str, Any]], partition: StagePartition) -> Dict[str, Any]:
+    """Inverse of :func:`split_params`: rebuild the flat ``{"params": …}``
+    tree (dropping the derived ``head_wte`` copy — stage 0's ``wte`` is
+    authoritative)."""
+    flat: Dict[str, Any] = {}
+    for sub in stage_params:
+        for name, leaves in sub.items():
+            if name != "head_wte":
+                flat[name] = leaves
+    return {"params": flat}
+
+
+# -- per-stage forward functions ----------------------------------------------
+
+
+def _wte(cfg: GPT2Config) -> nn.Embed:
+    return nn.Embed(
+        cfg.vocab_size,
+        cfg.d_model,
+        embedding_init=nn.initializers.normal(0.02),
+        dtype=cfg.dtype,
+    )
+
+
+def _wpe(cfg: GPT2Config) -> nn.Embed:
+    return nn.Embed(
+        cfg.max_seq,
+        cfg.d_model,
+        embedding_init=nn.initializers.normal(0.01),
+        dtype=cfg.dtype,
+    )
+
+
+def stage_forward(
+    cfg: GPT2Config,
+    partition: StagePartition,
+    stage: int,
+    stage_params: Dict[str, Any],
+    x: Optional[jnp.ndarray],
+    tokens: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Pure forward of one stage: embeds on stage 0 (``tokens`` required,
+    ``x`` ignored), runs the stage's blocks, and on the last stage applies
+    the final norm + tied head and returns the LM loss against
+    ``tokens``.  Middle stages map activations to activations."""
+    S = partition.num_stages
+    if stage == 0:
+        if tokens is None:
+            raise ValueError("stage 0 embeds: tokens is required")
+        T = tokens.shape[1]
+        x = (
+            _wte(cfg).apply({"params": stage_params["wte"]}, tokens)
+            + _wpe(cfg).apply({"params": stage_params["wpe"]}, jnp.arange(T))[None]
+        )
+    for i in partition.blocks_of(stage):
+        x = Block(cfg).apply(
+            {"params": stage_params[f"h{i}"]}, x, True, False
+        )
+    if stage == S - 1:
+        if tokens is None:
+            raise ValueError("last stage computes the loss: tokens is required")
+        x = nn.LayerNorm(dtype=jnp.float32).apply(
+            {"params": stage_params["ln_f"]}, x
+        )
+        head = (
+            stage_params["head_wte"]["embedding"]
+            if S > 1
+            else stage_params["wte"]["embedding"]
+        )
+        logits = (
+            x.astype(cfg.dtype) @ head.T.astype(cfg.dtype)
+        ).astype(jnp.float32)
+        return lm_loss(logits, tokens)
+    return x
+
+
+def composed_loss(
+    cfg: GPT2Config,
+    partition: StagePartition,
+    stage_params: List[Dict[str, Any]],
+    tokens: jnp.ndarray,
+) -> jnp.ndarray:
+    """Sequential composition of every stage — the single-process baseline
+    the pipeline executor is parity-pinned against (same stage functions,
+    same order, no pipeline)."""
+    x: Optional[jnp.ndarray] = None
+    for s in range(partition.num_stages):
+        x = stage_forward(cfg, partition, s, stage_params[s], x, tokens)
+    return x  # the last stage returned the scalar loss

@@ -61,10 +61,15 @@ from adapcc_tpu.sim.vector import (
     SIM_ENGINES,
     VECTOR_MIN_WORLD,
     LoweredColumns,
+    ProgramColumns,
     clear_lowering_cache,
+    clear_program_cache,
     lowered_columns,
     lowering_cache_info,
+    program_cache_info,
+    program_columns,
     resolve_sim_engine,
+    vector_program_run,
     vector_run,
 )
 from adapcc_tpu.sim.replay import (
@@ -102,15 +107,20 @@ __all__ = [
     "SIM_ENGINES",
     "VECTOR_MIN_WORLD",
     "LoweredColumns",
+    "ProgramColumns",
     "bandwidth_lower_bound",
     "clear_lowering_cache",
+    "clear_program_cache",
     "collective_lower_bound",
     "fastest_coeffs",
     "latency_lower_bound",
     "lowered_columns",
     "lowering_cache_info",
     "optimality_gap",
+    "program_cache_info",
+    "program_columns",
     "resolve_sim_engine",
+    "vector_program_run",
     "vector_run",
     "LinkCoeffs",
     "LinkCostModel",

@@ -1529,6 +1529,96 @@ def schedule_program_time(program, nbytes: float, coeffs: LinkCoeffs) -> float:
 
 
 # --------------------------------------------------------------------------- #
+# pipeline-parallel pricing (adapcc_tpu/pipe): bubble, step time, stash
+# --------------------------------------------------------------------------- #
+
+
+def pipeline_bubble_fraction(stages: int, microbatches: int) -> float:
+    """Idle fraction of a GPipe/1F1B pipeline step: ``(s−1)/(m+s−1)``.
+
+    Both schedules run the same ``2·(m+s−1)`` ticks over ``2·m`` useful
+    tasks per stage, so the bubble is schedule-independent — the schedules
+    differ in *memory* (:func:`pipeline_stash_bytes`), not in ticks.
+    """
+    if stages < 1:
+        raise ValueError(f"stages must be >= 1, got {stages}")
+    if microbatches < 1:
+        raise ValueError(f"microbatches must be >= 1, got {microbatches}")
+    return (stages - 1) / (microbatches + stages - 1)
+
+
+def pipeline_step_time(
+    stages: int,
+    microbatches: int,
+    fwd_time_s: float,
+    hop_bytes: float,
+    coeffs: LinkCoeffs,
+    bwd_ratio: float = 2.0,
+) -> float:
+    """Analytical latency of one pipelined forward/backward step.
+
+    ``2·(m+s−1)`` ticks (fill + steady + drain, forward and backward);
+    each tick costs one stage task — ``fwd_time_s`` per-stage forward
+    compute, ``bwd_ratio``× that on the backward half — plus one α+β hop
+    of ``hop_bytes`` activation (or activation-gradient) bytes on the
+    calibrated link class.  GPipe and 1F1B price identically here: same
+    tick count, same hop count per tick; the tuner cell between them is
+    decided by measured step times and the stash bound, not this form.
+    """
+    if stages < 1:
+        raise ValueError(f"stages must be >= 1, got {stages}")
+    if microbatches < 1:
+        raise ValueError(f"microbatches must be >= 1, got {microbatches}")
+    if fwd_time_s < 0 or hop_bytes < 0 or bwd_ratio < 0:
+        raise ValueError(
+            "fwd_time_s, hop_bytes, and bwd_ratio must be non-negative"
+        )
+    if stages == 1:
+        # no hops: m forwards + m backwards, back to back
+        return microbatches * fwd_time_s * (1.0 + bwd_ratio)
+    ticks = microbatches + stages - 1
+    hop = coeffs.time(hop_bytes)
+    fwd_half = ticks * (fwd_time_s + hop)
+    bwd_half = ticks * (fwd_time_s * bwd_ratio + hop)
+    return fwd_half + bwd_half
+
+
+def pipeline_stash_bytes(
+    stages: int,
+    microbatches: int,
+    schedule: str,
+    stage: int,
+    act_bytes: float,
+) -> float:
+    """Peak stashed-activation bytes at ``stage`` — the closed form of the
+    executor's measured high-water mark (``PipelineReport.stash_peak``).
+
+    GPipe stashes every microbatch before draining: ``m·act_bytes`` at
+    every stage.  1F1B bounds the window to the in-flight depth
+    ``min(m, stages − stage)`` — the whole reason to prefer it at large
+    ``m``.
+    """
+    if stages < 1:
+        raise ValueError(f"stages must be >= 1, got {stages}")
+    if microbatches < 1:
+        raise ValueError(f"microbatches must be >= 1, got {microbatches}")
+    if not 0 <= stage < stages:
+        raise ValueError(
+            f"stage must be in [0, {stages}), got {stage}"
+        )
+    from adapcc_tpu.pipe.schedule import PIPE_SCHEDULES  # deferred: pipe prices via us
+
+    if schedule not in PIPE_SCHEDULES:
+        raise ValueError(
+            f"unknown pipeline schedule {schedule!r}: expected one of "
+            f"{PIPE_SCHEDULES}"
+        )
+    if schedule == "gpipe":
+        return microbatches * float(act_bytes)
+    return min(microbatches, stages - stage) * float(act_bytes)
+
+
+# --------------------------------------------------------------------------- #
 # durable-recovery pricing (adapcc_tpu/elastic/redundancy): replicated
 # ZeRO-1 shards vs a checkpoint reload — the recovery sweep's rows
 # --------------------------------------------------------------------------- #

@@ -171,6 +171,8 @@ def simulate_program(
     cost_model: LinkCostModel,
     nbytes: float,
     keep_transfers: bool = True,
+    engine: Optional[str] = None,
+    keep_links: Optional[bool] = None,
 ) -> SimTimeline:
     """Replay a ``compiler.ScheduleProgram`` — the SAME object the engine's
     ``algo="ir"`` dispatch lowers and ``engine.schedule_program()`` returns,
@@ -185,8 +187,36 @@ def simulate_program(
     the cross-check ``tests/test_compiler.py`` pins — while a heterogeneous
     model (degraded links, two-level classes) prices each link at its own
     α/β.
+
+    The same ``engine`` funnel as :func:`simulate_strategy` (arg >
+    ``ADAPCC_SIM_ENGINE`` > ``auto``) applies: below
+    :data:`~adapcc_tpu.sim.vector.VECTOR_MIN_WORLD` ranks the per-round
+    event loop below runs with its per-transfer log; above it the cached
+    column replay (``vector.vector_program_run``) prices the program as
+    numpy algebra, parity-pinned on the makespan, per-transfer log never
+    kept.  ``keep_links`` defaults on for the event path and off for the
+    vector path, like ``simulate_strategy``.
     """
+    resolved = resolve_sim_engine(engine, program.world)
+    if resolved == "vector":
+        from adapcc_tpu.sim.vector import program_columns, vector_program_run
+
+        report = vector_program_run(
+            program_columns(program),
+            cost_model,
+            nbytes,
+            keep_links=bool(keep_links),
+        )
+        return SimTimeline(
+            seconds=report.makespan,
+            collective=program.collective,
+            nbytes=nbytes,
+            world=program.world,
+            report=report,
+            strategy_label=f"program:{program.name}@{program.fingerprint()}",
+        )
     seg = float(nbytes) / max(1, program.chunks)
+    keep_link_busy = True if keep_links is None else bool(keep_links)
     transfers: List[Transfer] = []
     link_busy: Dict[Link, float] = {}
     clock = 0.0
@@ -200,7 +230,8 @@ def simulate_program(
         round_end = clock
         for (src, dst), chunks in link_chunks.items():
             dur = cost_model.time_for(src, dst, seg * len(chunks))
-            link_busy[(src, dst)] = link_busy.get((src, dst), 0.0) + dur
+            if keep_link_busy:
+                link_busy[(src, dst)] = link_busy.get((src, dst), 0.0) + dur
             round_end = max(round_end, clock + dur)
             if keep_transfers:
                 for chunk in chunks:

@@ -115,22 +115,11 @@ class TreeCols:
     label: str = ""
 
 
-@dataclass
-class LoweredColumns:
-    """A strategy lowered once into numpy columns, re-priced many times."""
-
-    world: int
-    trees: List[TreeCols]
-    #: global directed-link table: link ``i`` is (link_srcs[i], link_dsts[i])
-    link_srcs: np.ndarray
-    link_dsts: np.ndarray
-    link_pos: Dict[Link, int]
-    strategy_label: str = ""
-    #: per-ips-table host-id vectors, keyed by ``id(ips)`` with a strong
-    #: reference to the keyed object so the id can never be recycled
-    _host_ids: "OrderedDict[int, Tuple[object, np.ndarray]]" = field(
-        default_factory=OrderedDict, repr=False
-    )
+class _LinkTable:
+    """Shared link-table behavior for column structures: the directed-link
+    vocabulary plus the cached rank → host-id vectors the class-membership
+    pricing uses.  Subclasses provide ``world``, ``link_srcs``,
+    ``link_dsts``, ``link_pos`` and a ``_host_ids`` OrderedDict field."""
 
     @property
     def num_links(self) -> int:
@@ -156,6 +145,24 @@ class LoweredColumns:
         while len(self._host_ids) > 8:
             self._host_ids.popitem(last=False)
         return out
+
+
+@dataclass
+class LoweredColumns(_LinkTable):
+    """A strategy lowered once into numpy columns, re-priced many times."""
+
+    world: int
+    trees: List[TreeCols]
+    #: global directed-link table: link ``i`` is (link_srcs[i], link_dsts[i])
+    link_srcs: np.ndarray
+    link_dsts: np.ndarray
+    link_pos: Dict[Link, int]
+    strategy_label: str = ""
+    #: per-ips-table host-id vectors, keyed by ``id(ips)`` with a strong
+    #: reference to the keyed object so the id can never be recycled
+    _host_ids: "OrderedDict[int, Tuple[object, np.ndarray]]" = field(
+        default_factory=OrderedDict, repr=False
+    )
 
 
 def _split_waves(
@@ -304,6 +311,164 @@ def lowering_cache_info() -> Dict[str, int]:
         "hits": _LOWERING_CACHE_STATS["hits"],
         "misses": _LOWERING_CACHE_STATS["misses"],
     }
+
+
+# --------------------------------------------------------------------------- #
+# ScheduleProgram columns: the IR-replay twin of the strategy lowering
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class ProgramRoundCols:
+    """One IR round as columns: one entry per *distinct directed link*,
+    with the number of chunks that coalesce onto it (the event loop's
+    ``seg * len(chunks)`` serialization rule, pre-grouped)."""
+
+    srcs: np.ndarray    # int64 (E,)
+    dsts: np.ndarray    # int64 (E,)
+    eidx: np.ndarray    # int64 (E,) — indices into the link table
+    counts: np.ndarray  # float64 (E,) — chunks coalesced per link
+
+
+@dataclass
+class ProgramColumns(_LinkTable):
+    """A ``compiler.ScheduleProgram`` lowered once into per-round link
+    columns, re-priced many times (the pipeline-sweep / large-stage-count
+    workload).  Rounds with no sends are dropped — they cost nothing in
+    the event loop too."""
+
+    world: int
+    chunks: int
+    rounds: List[ProgramRoundCols]
+    link_srcs: np.ndarray
+    link_dsts: np.ndarray
+    link_pos: Dict[Link, int]
+    label: str = ""
+    _host_ids: "OrderedDict[int, Tuple[object, np.ndarray]]" = field(
+        default_factory=OrderedDict, repr=False
+    )
+
+
+def lower_program_columns(program) -> ProgramColumns:
+    """Group each round's sends by directed link into numpy columns."""
+    link_pos: Dict[Link, int] = {}
+    rounds: List[ProgramRoundCols] = []
+    for rnd in program.rounds:
+        per_link: "OrderedDict[Link, int]" = OrderedDict()
+        for step in rnd:
+            if step.kind == "send":
+                link = (step.rank, step.peer)
+                per_link[link] = per_link.get(link, 0) + 1
+        if not per_link:
+            continue
+        E = len(per_link)
+        srcs = np.empty(E, dtype=np.int64)
+        dsts = np.empty(E, dtype=np.int64)
+        eidx = np.empty(E, dtype=np.int64)
+        counts = np.empty(E, dtype=np.float64)
+        for j, (link, count) in enumerate(per_link.items()):
+            pos = link_pos.get(link)
+            if pos is None:
+                pos = link_pos[link] = len(link_pos)
+            srcs[j], dsts[j] = link
+            eidx[j] = pos
+            counts[j] = float(count)
+        rounds.append(ProgramRoundCols(srcs, dsts, eidx, counts))
+    link_srcs = np.array([l[0] for l in link_pos], dtype=np.int64)
+    link_dsts = np.array([l[1] for l in link_pos], dtype=np.int64)
+    return ProgramColumns(
+        world=program.world,
+        chunks=program.chunks,
+        rounds=rounds,
+        link_srcs=link_srcs,
+        link_dsts=link_dsts,
+        link_pos=link_pos,
+        label=f"program:{program.name}@{program.fingerprint()}",
+    )
+
+
+#: program fingerprint → ProgramColumns (the program is immutable, so the
+#: fingerprint alone keys the structure — no chunking spec or mask axis)
+_PROGRAM_CACHE: "OrderedDict[str, ProgramColumns]" = OrderedDict()
+_PROGRAM_CACHE_MAX = 64
+_PROGRAM_CACHE_STATS = {"hits": 0, "misses": 0}
+
+
+def program_columns(program) -> ProgramColumns:
+    """:func:`lower_program_columns` behind the module LRU — re-pricing a
+    pipeline program across a (stages × microbatches) sweep pays the
+    grouping walk once per program."""
+    key = program.fingerprint()
+    hit = _PROGRAM_CACHE.get(key)
+    if hit is not None:
+        _PROGRAM_CACHE_STATS["hits"] += 1
+        _PROGRAM_CACHE.move_to_end(key)
+        return hit
+    _PROGRAM_CACHE_STATS["misses"] += 1
+    cols = lower_program_columns(program)
+    _PROGRAM_CACHE[key] = cols
+    while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
+        _PROGRAM_CACHE.popitem(last=False)
+    return cols
+
+
+def clear_program_cache() -> None:
+    """Drop cached program columns (tests pin cold-vs-warm equivalence)."""
+    _PROGRAM_CACHE.clear()
+    _PROGRAM_CACHE_STATS["hits"] = _PROGRAM_CACHE_STATS["misses"] = 0
+
+
+def program_cache_info() -> Dict[str, int]:
+    return {
+        "entries": len(_PROGRAM_CACHE),
+        "max": _PROGRAM_CACHE_MAX,
+        "hits": _PROGRAM_CACHE_STATS["hits"],
+        "misses": _PROGRAM_CACHE_STATS["misses"],
+    }
+
+
+def vector_program_run(
+    cols: ProgramColumns,
+    model: LinkCostModel,
+    nbytes: float,
+    keep_links: bool = False,
+) -> SimReport:
+    """Replay program columns under ``model`` — the numpy twin of
+    ``replay.simulate_program``'s event loop, bitwise equal on the
+    makespan: per round each link's coalesced transfer costs
+    ``α + β·(seg·count)`` (the identical float expression), distinct
+    links run concurrently, and the round-barrier advance
+    ``clock + max(durs)`` is the same operation as the event loop's
+    ``max(clock + dur_i)`` because addition is monotone.  The
+    per-transfer log is never kept on this path (that is what the event
+    oracle is for); per-link busy is opt-in via ``keep_links``.
+    """
+    alpha, beta, cls_vec = _link_coeff_vectors(cols, model)
+    seg = float(nbytes) / max(1, cols.chunks)
+    busy = np.zeros(cols.num_links)
+    clock = 0.0
+    for rc in cols.rounds:
+        durs = alpha[rc.eidx] + beta[rc.eidx] * (seg * rc.counts)
+        busy[rc.eidx] += durs
+        clock = clock + float(durs.max())
+
+    class_busy: Dict[str, float] = {}
+    if cols.num_links:
+        class_busy[ICI] = float(busy[~cls_vec].sum())
+        if bool(cls_vec.any()):
+            class_busy[DCN] = float(busy[cls_vec].sum())
+    link_busy: Dict[Link, float] = {}
+    if keep_links:
+        link_busy = {
+            (int(s), int(d)): float(b)
+            for s, d, b in zip(cols.link_srcs, cols.link_dsts, busy)
+        }
+    return SimReport(
+        makespan=clock,
+        transfers=[],
+        link_busy=link_busy,
+        class_busy=class_busy,
+    )
 
 
 # --------------------------------------------------------------------------- #

@@ -173,6 +173,43 @@ def _is_hook_path(path: str) -> bool:
     return path == HOOK_PATH or path.startswith(HOOK_PATH + "-")
 
 
+#: pipelined-step dispatches (``pipe_step`` cells recorded by
+#: ``PipelineExecutor``): the knob is the tick schedule, encoded in the
+#: key's path slot like the hook overlap modes — the persistent schema
+#: stays untouched (the key's chunk_bytes slot carries the microbatch
+#: count; there is no chunk knob)
+PIPE_PATH = "pipe"
+
+#: schedules a pipe_step cell can carry; mirrors
+#: ``adapcc_tpu.pipe.schedule.PIPE_SCHEDULES`` (drift pinned by a test —
+#: a module-level import would couple the tuner's import graph to the
+#: pipeline package for two strings)
+PIPE_SCHEDULE_MODES = ("gpipe", "1f1b")
+
+
+def pipe_path(schedule: str) -> str:
+    """The ``TuningKey.path`` spelling of a pipe_step cell's schedule:
+    always ``"pipe-<schedule>"`` — unlike :func:`hook_path` there is no
+    pre-existing bare cell to stay compatible with, so both schedules
+    spell themselves explicitly."""
+    if schedule not in PIPE_SCHEDULE_MODES:
+        raise ValueError(
+            f"schedule={schedule!r}: expected one of {PIPE_SCHEDULE_MODES}"
+        )
+    return f"{PIPE_PATH}-{schedule}"
+
+
+def pipe_schedule_of(path: str) -> str:
+    """Inverse of :func:`pipe_path`; loud on a non-pipe path."""
+    prefix = PIPE_PATH + "-"
+    if path.startswith(prefix) and path[len(prefix):] in PIPE_SCHEDULE_MODES:
+        return path[len(prefix):]
+    raise ValueError(
+        f"path={path!r} is not a pipe_step cell (expected "
+        f"{prefix}<{'|'.join(PIPE_SCHEDULE_MODES)}>)"
+    )
+
+
 @dataclass(frozen=True)
 class TunedPlan:
     """What the policy committed for one dispatch.

@@ -147,6 +147,76 @@ def evaluate_hits_at_1(
 # --- training -----------------------------------------------------------------
 
 
+def _run_pipeline(
+    args, mesh, world, model, cfg, params, tx, train_set, val_set
+) -> Tuple[float, float]:
+    """The --pp-stages branch: block stack split over stages, every hop
+    through the traced engine, schedule resolved env > flag > tuner
+    (docs/PIPELINE.md)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from adapcc_tpu.comm.engine import CollectiveEngine
+    from adapcc_tpu.pipe import (
+        PipelineExecutor,
+        merge_params,
+        partition_gpt2,
+        split_params,
+        sync_tied_embedding,
+    )
+    from adapcc_tpu.strategy.ir import Strategy
+    from adapcc_tpu.utils import AverageMeter
+
+    partition = partition_gpt2(cfg, args.pp_stages)
+    engine = CollectiveEngine(mesh, Strategy.ring(world))
+    executor = PipelineExecutor(
+        cfg,
+        partition,
+        engine,
+        num_microbatches=args.pp_microbatches,
+        schedule=args.pp_schedule,
+    )
+    stage_params = split_params(params["params"], partition)
+    opt_state = tx.init(stage_params)
+    print(
+        f"pipeline: {args.pp_stages} stages x {args.pp_microbatches} "
+        f"microbatches, schedule {executor.schedule_kind}, "
+        f"params/stage {partition.param_counts}"
+    )
+
+    def merged():
+        # merge_params already rebuilds the {"params": ...} wrapper
+        return merge_params(stage_params, partition)
+
+    initial_ppl = evaluate_perplexity(model, merged(), val_set)
+    print(f"val ppl before training: {initial_ppl:.1f} (uniform bound {float(args.vocab):.0f})")
+
+    rng = np.random.default_rng(0)
+    steps_per_epoch = max(1, len(train_set) // args.batch)
+    ppl = initial_ppl
+    for epoch in range(args.epochs):
+        losses = AverageMeter("lm_loss", ":.4f")
+        order = rng.permutation(len(train_set))
+        for i in range(steps_per_epoch):
+            b = jnp.asarray(train_set[order[i * args.batch : (i + 1) * args.batch]])
+            loss, grads, report = executor.forward_backward(stage_params, b)
+            updates, opt_state = tx.update(grads, opt_state, stage_params)
+            stage_params = optax.apply_updates(stage_params, updates)
+            sync_tied_embedding(stage_params)
+            losses.update(float(loss), args.batch)
+        ppl = evaluate_perplexity(model, merged(), val_set)
+        print(
+            f"epoch {epoch:3d}  {losses}  val ppl {ppl:.2f}  "
+            f"(bubble {report.bubble_fraction:.2f}, stash peak "
+            f"{report.stash_peak})"
+        )
+
+    hits = evaluate_hits_at_1(model, merged(), val_set)
+    print(f"hits@1 over 4 candidates: {hits:.2f} (chance 0.25)")
+    return initial_ppl, ppl
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--epochs", type=int, default=2)
@@ -181,6 +251,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "adaptive DDP step (fp32 flat master)")
     p.add_argument("--grad-compress", choices=["off", "bf16"], default="off",
                    help="bf16 gradient-sync wire compression (DDP path)")
+    p.add_argument("--pp-stages", type=int, default=0,
+                   help="pipeline parallelism: split the block stack over "
+                        "this many stages (0 = off; docs/PIPELINE.md)")
+    p.add_argument("--pp-microbatches", type=int, default=4,
+                   help="microbatches per pipelined step (--batch must "
+                        "divide by it)")
+    p.add_argument("--pp-schedule", choices=("gpipe", "1f1b"), default=None,
+                   help="pipeline tick schedule; omitted = "
+                        "ADAPCC_PIPE_SCHEDULE > tuner > 1f1b")
     return p
 
 
@@ -191,6 +270,35 @@ def run(args) -> Tuple[float, float]:
             "--accum/--zero1 ride the DDP trainer; they are not wired "
             "into the sequence-parallel step — drop --sp to use them"
         )
+    if args.pp_stages:
+        incompatible = []
+        if args.sp != "none":
+            incompatible.append("--sp")
+        if args.accum != 1:
+            incompatible.append("--accum")
+        if args.zero1:
+            incompatible.append("--zero1")
+        if args.grad_compress != "off":
+            incompatible.append("--grad-compress")
+        if args.checkpoint_file:
+            incompatible.append("--checkpoint-file")
+        if incompatible:
+            raise ValueError(
+                f"{', '.join(incompatible)} ride the DDP trainer; the "
+                "pipeline-parallel step (--pp-stages) runs its own "
+                "executor — the pipeline already microbatches, syncs no "
+                "gradients, and is not checkpoint-wired (docs/PIPELINE.md)"
+            )
+        if args.pp_stages < 2:
+            raise ValueError(
+                f"--pp-stages {args.pp_stages}: a pipeline needs at least "
+                "2 stages (omit the flag for single-stage training)"
+            )
+        if args.batch % args.pp_microbatches:
+            raise ValueError(
+                f"--batch {args.batch} must divide by --pp-microbatches "
+                f"{args.pp_microbatches}"
+            )
     from adapcc_tpu.launch import maybe_initialize_distributed
 
     maybe_initialize_distributed()
@@ -253,6 +361,10 @@ def run(args) -> Tuple[float, float]:
         optax.clip_by_global_norm(args.clip_norm),
         optax.adamw(schedule, weight_decay=0.01),
     )
+    if args.pp_stages:
+        return _run_pipeline(
+            args, mesh, world, model, cfg, params, tx, train_set, val_set
+        )
     if args.sp != "none":
         # sequence parallelism: the batch is replicated and the SEQUENCE is
         # sharded over the world axis — the long-context regime (the DDP

@@ -229,6 +229,11 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
       ``kv_transfer`` stream — the summaries pin the measured TTFT/
       sojourn split per pool and the kv_stream wire ledger against the
       simulator's two-pool frontier; needs an even world ≥ 2.
+    - ``pipeline_ab`` — the pipeline-schedule A/B (the hardware twin of
+      ``make pipe-bench``, docs/PIPELINE.md): the SAME train_gpt2
+      pipeline cell (2 stages × 4 microbatches) under ``--pp-schedule
+      gpipe`` vs ``1f1b`` — identical tick count, so the walltime delta
+      isolates the schedules' dispatch/stash behavior on real ICI.
     """
     gate = f"world={world} (needs multi-chip ICI)"
     if world < 2:
@@ -239,7 +244,7 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
             "two_level_synth",
             "elastic_failover", "online_adaptation", "supervised_failover",
             "fabric_contention", "elastic_rejoin", "decode_slo",
-            "disagg_transfer",
+            "disagg_transfer", "pipeline_ab",
         ):
             _skip(name, gate, out_path)
         return
@@ -606,6 +611,28 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
                 900, out_path,
                 rec_extra={"arm": arm, "serve": True},
             )
+    # pipeline-schedule A/B on real chips (the hardware twin of `make
+    # pipe-bench`, docs/PIPELINE.md): the SAME train_gpt2 pipeline run at
+    # a fixed (stages × microbatches) cell under --pp-schedule gpipe vs
+    # 1f1b — identical tick count, so the phase walltime delta isolates
+    # the schedules' dispatch/stash behavior on real ICI while the
+    # printed reports pin the stash high-water the closed form predicts.
+    pp_stages = 2
+    for pp_schedule in ("gpipe", "1f1b"):
+        _run(
+            "pipeline_ab",
+            [py, "-m", "adapcc_tpu.workloads.train_gpt2",
+             "--epochs", "1", "--corpus-tokens", "40000",
+             "--batch", "8", "--world", str(world),
+             "--pp-stages", str(pp_stages), "--pp-microbatches", "4",
+             "--pp-schedule", pp_schedule,
+             "--layers", "2", "--dmodel", "64", "--heads", "2"],
+            900, out_path,
+            rec_extra={
+                "pp_schedule": pp_schedule, "pp_stages": pp_stages,
+                "pp_microbatches": 4,
+            },
+        )
 
 
 def run_simulated_fallback(py: str, out_path: str, world: int = 8) -> dict:

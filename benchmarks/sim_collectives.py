@@ -683,6 +683,137 @@ def schedule_sweep(
     return rows
 
 
+def pipe_sweep(
+    sizes: Sequence[int],
+    stages_grid: Sequence[int] = (2, 4),
+    microbatch_grid: Sequence[int] = (2, 4, 8),
+    fwd_us: float = 100.0,
+    model: Optional[LinkCostModel] = None,
+    engine: Optional[str] = None,
+) -> List[dict]:
+    """Predicted GPipe-vs-1F1B frontier over a (stages × microbatches ×
+    hop-bytes) grid — the hardware-free regression artifact for the
+    pipeline plane (``make pipe-bench``, docs/PIPELINE.md).
+
+    Each cell builds the SAME objects the executor runs: the tick table
+    (:func:`~adapcc_tpu.pipe.schedule.pipeline_schedule`), its emitted hop
+    program (verified by :func:`~adapcc_tpu.compiler.verify_program`
+    before pricing), and three prices per row — ``pred_step_us`` from the
+    closed-form :func:`~adapcc_tpu.sim.cost_model.pipeline_step_time`
+    (compute + hops over the calibrated link class), ``hop_program_us``
+    from replaying the verified program through ``simulate_program``
+    (engine funneled like every replay: ``ADAPCC_SIM_ENGINE``), and
+    ``stash_bytes`` from the closed-form per-stage stash bound (max over
+    stages).  The frontier's two invariants are visible per row:
+    ``bubble_fraction`` depends only on (stages, microbatches) and
+    shrinks as microbatches grow, and the 1F1B row at ``microbatches >
+    stages − 1`` stamps ``memory_win_vs_gpipe`` — same ticks, smaller
+    stash, the whole reason the schedule exists.  Deterministic: same
+    calibration → byte-identical rows.
+    """
+    from adapcc_tpu.compiler import verify_program
+    from adapcc_tpu.pipe.schedule import (
+        PIPE_SCHEDULES,
+        pipeline_program,
+        pipeline_schedule,
+    )
+    from adapcc_tpu.sim.cost_model import (
+        ICI,
+        bottleneck_ring_coeffs,
+        pipeline_bubble_fraction,
+        pipeline_step_time,
+        pipeline_stash_bytes,
+    )
+    from adapcc_tpu.sim.replay import simulate_program
+    from adapcc_tpu.sim.vector import resolve_sim_engine
+    from adapcc_tpu.tuner.policy import pipe_path
+
+    stages_grid = [int(s) for s in stages_grid]
+    microbatch_grid = [int(m) for m in microbatch_grid]
+    bad = [s for s in stages_grid if s < 2]
+    if bad:
+        raise ValueError(
+            f"pipe sweep stages must be >= 2 (a single stage has no "
+            f"pipeline), got {bad}"
+        )
+    if any(m < 1 for m in microbatch_grid):
+        raise ValueError(
+            f"pipe sweep microbatches must be >= 1, got {microbatch_grid}"
+        )
+    if fwd_us < 0:
+        raise ValueError(f"fwd_us must be >= 0, got {fwd_us}")
+    if model is None:
+        model = load_or_default(world=max(stages_grid))
+    coeffs = bottleneck_ring_coeffs(model, model.world)
+
+    rows: List[dict] = []
+    for stages in stages_grid:
+        # the hop fabric: one uniform class model at the calibration's
+        # bottleneck coefficients, sized to the stage chain
+        hop_model = LinkCostModel(
+            stages, classes={ICI: coeffs}, source=model.source
+        )
+        for microbatches in microbatch_grid:
+            gpipe_stash: Dict[int, int] = {}
+            for schedule in PIPE_SCHEDULES:
+                sched = pipeline_schedule(stages, microbatches, schedule)
+                prog = pipeline_program(sched, tied_embedding=True)
+                verify_program(prog)
+                fp = prog.fingerprint()
+                for nbytes in sizes:
+                    step_s = pipeline_step_time(
+                        stages, microbatches, fwd_us * 1e-6,
+                        float(nbytes), coeffs,
+                    )
+                    # each program chunk carries one hop payload, so the
+                    # replay's total is hop bytes × chunks
+                    tl = simulate_program(
+                        prog, hop_model, float(nbytes) * prog.chunks,
+                        keep_transfers=False, engine=engine,
+                        keep_links=False,
+                    )
+                    stash = max(
+                        int(pipeline_stash_bytes(
+                            stages, microbatches, schedule, s, nbytes
+                        ))
+                        for s in range(stages)
+                    )
+                    row = {
+                        "mode": "simulated",
+                        "collective": "pipeline",
+                        "impl": pipe_path(schedule),
+                        "schedule": schedule,
+                        "stages": stages,
+                        "microbatches": microbatches,
+                        "size_bytes": int(nbytes),
+                        "ticks": sched.num_ticks,
+                        "rounds": prog.num_rounds,
+                        "program_fingerprint": fp,
+                        "bubble_fraction": round(
+                            pipeline_bubble_fraction(stages, microbatches),
+                            6,
+                        ),
+                        "pred_step_us": round(step_s * 1e6, 3),
+                        "hop_program_us": round(tl.seconds * 1e6, 3),
+                        "stash_bytes": stash,
+                        "engine": resolve_sim_engine(engine, prog.world),
+                        "calibration": model.source,
+                    }
+                    if schedule == "gpipe":
+                        gpipe_stash[int(nbytes)] = stash
+                    else:
+                        row["memory_win_vs_gpipe"] = (
+                            stash < gpipe_stash[int(nbytes)]
+                        )
+                    rows.append(row)
+    if not rows:
+        raise ValueError(
+            f"pipe sweep produced no rows: sizes={list(sizes)} "
+            f"stages={stages_grid} microbatches={microbatch_grid}"
+        )
+    return rows
+
+
 def hier_sweep(
     sizes: Sequence[int],
     pods: Sequence[int] = (2, 4, 8),
@@ -2301,6 +2432,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="scale-sweep world grid (pod scale; ring is skipped above "
         f"{RING_SCALE_MAX_WORLD})",
     )
+    ap.add_argument(
+        "--pipe-sweep", action="store_true",
+        help="price the GPipe-vs-1F1B pipeline frontier instead of the "
+        "strategy grid: (stages x microbatches x hop bytes), each cell's "
+        "verified hop program replayed next to the closed-form step time "
+        "and stash bound (make pipe-bench; docs/PIPELINE.md)",
+    )
+    ap.add_argument(
+        "--pipe-stages", default="2,4",
+        help="pipe-sweep stage-count grid",
+    )
+    ap.add_argument(
+        "--pipe-microbatches", default="2,4,8",
+        help="pipe-sweep microbatch grid",
+    )
+    ap.add_argument(
+        "--pipe-fwd-us", type=float, default=100.0,
+        help="pipe-sweep per-stage forward compute term (microseconds)",
+    )
     ap.add_argument("--json", action="store_true", help="one JSON row per line")
     args = ap.parse_args(argv)
 
@@ -2322,6 +2472,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             ("--serve-sweep", args.serve_sweep),
             ("--disagg-sweep", args.disagg_sweep),
             ("--scale-sweep", args.scale_sweep),
+            ("--pipe-sweep", args.pipe_sweep),
         ) if on
     ]
     if len(exclusive) > 1:
@@ -2360,6 +2511,39 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 )
         return 0
     model = load_or_default(args.calibration, world=args.world)
+    if args.pipe_sweep:
+        if args.hosts > 1:
+            # the sweep prices stage chains on the calibration's bottleneck
+            # class; silently accepting --hosts would read as "priced that
+            # host split" when nothing used it (the --hier-sweep precedent)
+            ap.error("--hosts has no effect on --pipe-sweep (each stage "
+                     "chain prices on the calibration's bottleneck link "
+                     "class)")
+        rows = pipe_sweep(
+            sizes=[parse_size(s) for s in args.sizes.split(",") if s],
+            stages_grid=[int(s) for s in args.pipe_stages.split(",") if s],
+            microbatch_grid=[
+                int(m) for m in args.pipe_microbatches.split(",") if m
+            ],
+            fwd_us=args.pipe_fwd_us,
+            model=model,
+        )
+        for row in rows:
+            if args.json:
+                print(json.dumps(row))
+            else:
+                win = row.get("memory_win_vs_gpipe")
+                print(
+                    f"[sim] pipe {row['schedule']:<5} "
+                    f"s={row['stages']:>2} m={row['microbatches']:>2} "
+                    f"{row['size_bytes']:>10}B  "
+                    f"bubble={row['bubble_fraction']:.3f}  "
+                    f"step={row['pred_step_us']:>10.1f}us  "
+                    f"hops={row['hop_program_us']:>9.1f}us  "
+                    f"stash={row['stash_bytes']:>10}B"
+                    + ("  mem-win" if win else "")
+                )
+        return 0
     if args.serve_sweep:
         if args.hosts > 1:
             # the frontier prices the TP decode mesh of --world; silently

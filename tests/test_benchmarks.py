@@ -1104,3 +1104,106 @@ def test_scale_sweep_cli_emits_json(capsys):
     assert rows and all(r["mode"] == "simulated" for r in rows)
     assert {r["world"] for r in rows} == {32, 512}
     assert all("optimality_gap" in r for r in rows if "skipped" not in r)
+
+
+# --------------------------------------------------------------------------- #
+# pipe sweep (make pipe-bench, docs/PIPELINE.md)
+# --------------------------------------------------------------------------- #
+
+def test_pipe_sweep_rows_byte_identical_and_frontier_shaped():
+    """The pipe-bench artifact is deterministic to the byte and carries
+    the frontier's two invariants per row: the bubble shrinks as
+    microbatches grow at fixed stages, and 1F1B stamps its memory win
+    exactly where its stash bound is strictly below GPipe's."""
+    from benchmarks.sim_collectives import pipe_sweep
+
+    sizes = [1 << 20, 16 << 20]
+    rows = pipe_sweep(sizes, stages_grid=(2, 4), microbatch_grid=(2, 4, 8))
+    again = pipe_sweep(sizes, stages_grid=(2, 4), microbatch_grid=(2, 4, 8))
+    assert [json.dumps(r, sort_keys=True) for r in rows] == [
+        json.dumps(r, sort_keys=True) for r in again
+    ]
+    assert len(rows) == 2 * 3 * 2 * 2  # stages x microbatches x schedules x sizes
+    for r in rows:
+        assert r["mode"] == "simulated" and r["collective"] == "pipeline"
+        assert r["impl"] == f"pipe-{r['schedule']}"
+        assert r["ticks"] == 2 * (r["microbatches"] + r["stages"] - 1)
+        assert len(r["program_fingerprint"]) == 16
+        assert r["pred_step_us"] > 0 and r["hop_program_us"] > 0
+
+    # bubble shrinks with m at fixed stages — schedule-independent
+    for stages in (2, 4):
+        for schedule in ("gpipe", "1f1b"):
+            bubbles = [
+                r["bubble_fraction"] for r in rows
+                if r["stages"] == stages and r["schedule"] == schedule
+                and r["size_bytes"] == sizes[0]
+            ]
+            assert bubbles == sorted(bubbles, reverse=True)
+            assert bubbles[0] > bubbles[-1]
+
+    # the memory win stamps exactly the strict-stash-win cells
+    gpipe = {
+        (r["stages"], r["microbatches"], r["size_bytes"]): r["stash_bytes"]
+        for r in rows if r["schedule"] == "gpipe"
+    }
+    for r in rows:
+        if r["schedule"] != "1f1b":
+            assert "memory_win_vs_gpipe" not in r
+            continue
+        key = (r["stages"], r["microbatches"], r["size_bytes"])
+        assert r["memory_win_vs_gpipe"] == (r["stash_bytes"] < gpipe[key])
+        # stash_bytes is the max over stages: 1F1B's worst stage holds
+        # min(m, stages), so the win appears exactly at m > stages
+        assert r["memory_win_vs_gpipe"] == (r["microbatches"] > r["stages"])
+
+    with pytest.raises(ValueError, match="stages"):
+        pipe_sweep(sizes, stages_grid=(1,))
+    with pytest.raises(ValueError, match="microbatches"):
+        pipe_sweep(sizes, microbatch_grid=(0,))
+    with pytest.raises(ValueError, match="fwd_us"):
+        pipe_sweep(sizes, fwd_us=-1.0)
+
+
+def test_pipe_sweep_cli_mutually_exclusive_and_rejects_hosts(capsys):
+    from benchmarks.sim_collectives import main
+
+    for other in (
+        ["--ring-sweep"],
+        ["--tune-replay"],
+        ["--fused-sweep"],
+        ["--overlap-sweep"],
+        ["--fault-sweep"],
+        ["--latency-sweep"],
+        ["--schedule-sweep"],
+        ["--adapt-sweep"],
+        ["--chaos-sweep"],
+        ["--hier-sweep"],
+        ["--fabric-sweep"],
+        ["--recovery-sweep"],
+        ["--serve-sweep"],
+        ["--disagg-sweep"],
+        ["--scale-sweep"],
+        ["--wire-dtype", "off,int8"],
+    ):
+        with pytest.raises(SystemExit):
+            main(["--pipe-sweep"] + other)
+    # each stage chain prices on the calibration's bottleneck link class:
+    # --hosts is meaningless and silently accepting it would mislabel rows
+    with pytest.raises(SystemExit):
+        main(["--pipe-sweep", "--hosts", "2"])
+    capsys.readouterr()
+
+
+def test_pipe_sweep_cli_emits_json(capsys):
+    from benchmarks.sim_collectives import main
+
+    assert main([
+        "--pipe-sweep", "--pipe-stages", "2", "--pipe-microbatches", "2,4",
+        "--sizes", "1M", "--json",
+    ]) == 0
+    rows = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rows and all(r["collective"] == "pipeline" for r in rows)
+    assert {r["impl"] for r in rows} == {"pipe-gpipe", "pipe-1f1b"}
+    assert {r["microbatches"] for r in rows} == {2, 4}
+    assert all("program_fingerprint" in r for r in rows)

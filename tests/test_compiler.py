@@ -71,7 +71,7 @@ def _payload(n=96, seed=0):
 
 def test_step_and_program_validation():
     assert set(STEP_KINDS) == {"send", "recv", "reduce", "copy", "encode", "decode"}
-    assert PROGRAM_COLLECTIVES == ("allreduce",)
+    assert PROGRAM_COLLECTIVES == ("allreduce", "pipeline")
     with pytest.raises(ValueError, match="unknown step kind"):
         Step("teleport", 0, 0)
     with pytest.raises(ValueError, match="peer"):

@@ -4,7 +4,8 @@ Every ```python block in docs/PARALLELISM.md, docs/OPERATIONS.md,
 docs/SIMULATION.md, docs/RING.md, docs/QUANT.md, docs/TUNER.md,
 docs/OVERLAP.md, docs/LATENCY.md, docs/ELASTIC.md, docs/ADAPT.md,
 docs/SUPERVISOR.md, docs/HIERARCHY.md, docs/FABRIC.md, docs/RECOVERY.md,
-docs/SERVING.md and docs/COMPILER.md runs verbatim on the virtual pod.
+docs/SERVING.md, docs/COMPILER.md and docs/PIPELINE.md runs verbatim on
+the virtual pod.
 A snippet that stops compiling or produces wrong shapes fails here.
 """
 
@@ -32,6 +33,7 @@ _FABRIC = os.path.join(_DOCS_DIR, "FABRIC.md")
 _RECOVERY = os.path.join(_DOCS_DIR, "RECOVERY.md")
 _SERVING = os.path.join(_DOCS_DIR, "SERVING.md")
 _COMPILER = os.path.join(_DOCS_DIR, "COMPILER.md")
+_PIPELINE = os.path.join(_DOCS_DIR, "PIPELINE.md")
 
 
 def _blocks(path):
@@ -56,6 +58,7 @@ def test_operations_doc_covers_the_contract():
         "strategy.xml", "reconstruct_topology", "hw_watch.py", "hw_session",
         "BENCH_FLASH_BLOCK", "--entry_point", "--dry-run",
         "ADAPCC_DISAGG", "ADAPCC_KV_WIRE_DTYPE", "ADAPCC_KV_KL_BOUND",
+        "ADAPCC_PIPE_SCHEDULE",
     ):
         assert needle in text, f"OPERATIONS.md lost its {needle!r} coverage"
 
@@ -405,3 +408,30 @@ def test_compiler_doc_covers_the_contract():
 def test_compiler_doc_snippet_runs(idx):
     code = _blocks(_COMPILER)[idx]
     exec(compile(code, f"{_COMPILER}:block{idx}", "exec"), {})
+
+
+def test_pipeline_doc_has_snippets():
+    assert len(_blocks(_PIPELINE)) >= 6
+
+
+def test_pipeline_doc_covers_the_contract():
+    """The pipeline-parallel topics the one-schedule-four-places story leans on."""
+    text = open(_PIPELINE).read()
+    for needle in (
+        "pipeline_schedule", "pipeline_program", "verify_program",
+        "PipelineExecutor", "partition_gpt2", "split_params", "merge_params",
+        "pipe_send", "total_sends", "stash_high_water",
+        "min(m, stages - stage)", "bubble", "1f1b", "gpipe",
+        "pipeline_step_time", "pipeline_stash_bytes", "simulate_program",
+        "ADAPCC_PIPE_SCHEDULE", "resolve_pipe_schedule", "pipe_step",
+        "pipe-gpipe", "pipe-1f1b", "--pp-stages", "--pp-microbatches",
+        "--pp-schedule", "make pipe-bench", "pipeline_ab", "grad_sync",
+        "rank, round, chunk", "head_wte", "pipeline_apply",
+    ):
+        assert needle in text, f"PIPELINE.md lost its {needle!r} coverage"
+
+
+@pytest.mark.parametrize("idx", range(len(_blocks(_PIPELINE))))
+def test_pipeline_doc_snippet_runs(idx):
+    code = _blocks(_PIPELINE)[idx]
+    exec(compile(code, f"{_PIPELINE}:block{idx}", "exec"), {})

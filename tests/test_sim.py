@@ -693,7 +693,7 @@ def test_hw_session_multichip_phases_skip_cleanly_at_world1(tmp_path):
         "overlap_ab", "small_msg_crossover", "two_level_synth",
         "elastic_failover", "online_adaptation", "supervised_failover",
         "fabric_contention", "elastic_rejoin", "decode_slo", "ir_parity",
-        "disagg_transfer",
+        "disagg_transfer", "pipeline_ab",
     }
     for r in rows:
         assert "world=1" in r["skipped"]

@@ -33,7 +33,11 @@ from adapcc_tpu.sim import (
 )
 from adapcc_tpu.sim.congestion import CongestionProfile, CongestionWindow
 from adapcc_tpu.sim.cost_model import ICI
-from adapcc_tpu.sim.replay import lower_strategy, simulate_fault_plan
+from adapcc_tpu.sim.replay import (
+    lower_strategy,
+    simulate_fault_plan,
+    simulate_program,
+)
 from adapcc_tpu.strategy.ir import Strategy
 
 MB = 1 << 20
@@ -196,6 +200,97 @@ def test_event_report_keep_links_opt_out():
     assert lean.class_busy[ICI] == pytest.approx(
         sum(full.link_busy.values()), rel=1e-12
     )
+
+
+# --------------------------------------------------------------------------- #
+# ScheduleProgram replay: the IR twin of the strategy funnel
+# --------------------------------------------------------------------------- #
+
+def test_simulate_program_vector_matches_event_bitwise():
+    """simulate_program must give BITWISE-equal makespans on both engines —
+    per round the vector engine evaluates the identical IEEE expression the
+    event loop does, so this is ==, not approx."""
+    from adapcc_tpu.compiler.builders import (
+        rd_allreduce_program,
+        ring_allreduce_program,
+    )
+
+    model = uniform_model(8)
+    for prog in (ring_allreduce_program(8), rd_allreduce_program(8)):
+        ev = simulate_program(prog, model, MB, engine="event")
+        ve = simulate_program(prog, model, MB, engine="vector")
+        assert ve.seconds == ev.seconds
+        assert ve.world == ev.world and ve.collective == ev.collective
+
+
+def test_simulate_program_vector_parity_on_heterogeneous_links():
+    """Per-link overrides and a two-class split must price identically on
+    both engines — the vector path reads the same per-link α/β table."""
+    from adapcc_tpu.compiler.builders import ring_allreduce_program
+
+    from adapcc_tpu.sim.cost_model import DCN
+
+    model = LinkCostModel(
+        8,
+        classes={ICI: LinkCoeffs(ALPHA, BETA), DCN: LinkCoeffs(5e-5, 1.0 / 5e9)},
+        ips={r: "10.0.0.1" if r < 4 else "10.0.0.2" for r in range(8)},
+    )
+    model.links[(3, 4)] = LinkCoeffs(1e-4, 1.0 / 1e9)  # one degraded link
+    prog = ring_allreduce_program(8)
+    ev = simulate_program(prog, model, MB, engine="event")
+    ve = simulate_program(prog, model, MB, engine="vector")
+    assert ve.seconds == ev.seconds
+
+
+def test_program_columns_cache_hits_on_fingerprint():
+    from adapcc_tpu.compiler.builders import ring_allreduce_program
+    from adapcc_tpu.sim import (
+        clear_program_cache,
+        program_cache_info,
+        program_columns,
+    )
+
+    clear_program_cache()
+    prog = ring_allreduce_program(8)
+    cols = program_columns(prog)
+    assert program_cache_info()["misses"] >= 1
+    hits = program_cache_info()["hits"]
+    again = program_columns(ring_allreduce_program(8))  # same fingerprint
+    assert again is cols
+    assert program_cache_info()["hits"] == hits + 1
+
+
+def test_simulate_program_keep_links_defaults_per_engine():
+    """Event replay keeps the per-link busy map by default (the oracle's
+    debuggability contract); the vector replay drops it unless asked —
+    at 100k ranks that map is a world-sized allocation."""
+    from adapcc_tpu.compiler.builders import ring_allreduce_program
+
+    model = uniform_model(8)
+    prog = ring_allreduce_program(8)
+    ev = simulate_program(prog, model, MB, engine="event")
+    assert ev.report.link_busy
+    ve = simulate_program(prog, model, MB, engine="vector")
+    assert ve.report.link_busy == {}
+    ve_full = simulate_program(prog, model, MB, engine="vector", keep_links=True)
+    assert set(ve_full.report.link_busy) == set(ev.report.link_busy)
+    for link, busy in ev.report.link_busy.items():
+        assert ve_full.report.link_busy[link] == pytest.approx(busy, rel=1e-12)
+
+
+def test_simulate_program_honors_env_engine(monkeypatch):
+    from adapcc_tpu.compiler.builders import ring_allreduce_program
+
+    model = uniform_model(8)
+    prog = ring_allreduce_program(8)
+    baseline = simulate_program(prog, model, MB, engine="event")
+    monkeypatch.setenv(SIM_ENGINE_ENV, "vector")
+    enved = simulate_program(prog, model, MB)
+    assert enved.seconds == baseline.seconds
+    assert enved.report.link_busy == {}  # the vector default rode the env
+    monkeypatch.setenv(SIM_ENGINE_ENV, "heap")
+    with pytest.raises(ValueError, match=SIM_ENGINE_ENV):
+        simulate_program(prog, model, MB)
 
 
 # --------------------------------------------------------------------------- #
