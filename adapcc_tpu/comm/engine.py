@@ -726,6 +726,14 @@ class CollectiveEngine:
         #: program fingerprints already certified by compiler.verify — a
         #: program is verified once, not per compiled shape
         self._ir_verified: set = set()
+        #: (base fingerprint, resolved passes) -> optimized program memo
+        #: (compiler/optimize.py); keyed by fingerprint so a strategy
+        #: hot-swap or re-pin misses naturally instead of needing a flush
+        self._ir_optimized: Dict[Tuple, Any] = {}
+        #: whether the last strategy-derived IR program came from the
+        #: Strategy.schedule_program memo (dispatch-trace extra); None
+        #: until something derives
+        self._ir_derived_cache_hit: Optional[bool] = None
 
     # -- elastic plan failover -------------------------------------------------
 
@@ -983,17 +991,57 @@ class CollectiveEngine:
         self._ir_program_explicit = True
 
     def schedule_program(self):
-        """The exact ScheduleProgram object ``algo="ir"`` executes: the
-        pinned one, else a verified program derived from the engine's
-        strategy.  ``sim/replay.simulate_program`` takes this same object
-        — pricing and execution share the schedule by construction."""
+        """The pre-optimization ScheduleProgram ``algo="ir"`` dispatches
+        resolve: the pinned one, else a verified program derived from the
+        engine's strategy (memoized in ``Strategy.schedule_program`` —
+        whether that memo hit is surfaced in the dispatch-trace extras).
+        On a two-level ``(dcn, ici)`` mesh the derived program is the
+        composed two-level schedule, the hierarchy the mesh can actually
+        execute.  ``sim/replay.simulate_program`` takes this same object —
+        pricing and execution share the schedule by construction."""
         if self._ir_program is None:
-            from adapcc_tpu.compiler.builders import program_from_strategy
+            if self.two_level:
+                from adapcc_tpu.compiler.builders import (
+                    two_level_allreduce_program,
+                )
 
-            program = program_from_strategy(self.strategy)
+                program = two_level_allreduce_program(
+                    self.num_slices,
+                    self.ici_size,
+                    wire_dtype=self.strategy.wire_dtype,
+                )
+                self._ir_derived_cache_hit = False
+            else:
+                program = self.strategy.schedule_program()
+                self._ir_derived_cache_hit = bool(
+                    self.strategy.__dict__.get("_last_program_cache_hit")
+                )
             self._certify_program(program)
             self._ir_program = program
         return self._ir_program
+
+    def optimized_schedule_program(self):
+        """The post-optimization program ``algo="ir"`` actually lowers:
+        :meth:`schedule_program` through the ``compiler/optimize.py`` pass
+        pipeline in force (``ADAPCC_IR_OPT``), memoized per (base
+        fingerprint, resolved passes).  Every pass verifies pass-in and
+        pass-out inside ``optimize_program``, so the result joins the
+        certified set; an already-optimal program comes back as the SAME
+        object (the passes are identity on it)."""
+        from adapcc_tpu.compiler.optimize import (
+            optimize_program,
+            resolve_ir_opt,
+        )
+
+        base = self.schedule_program()
+        passes = resolve_ir_opt()
+        key = (base.fingerprint(), passes)
+        program = self._ir_optimized.get(key)
+        if program is None:
+            program = optimize_program(base, passes=passes)
+            self._ir_verified.add(program.fingerprint())
+            self._ir_optimized[key] = program
+        return program
 
     def _ir_allreduce(
         self,
@@ -1003,26 +1051,23 @@ class CollectiveEngine:
         active_gpus: Optional[Sequence[int]],
     ) -> jnp.ndarray:
         """Dispatch one allreduce through the compiled ScheduleProgram
-        executor (``compiler/lower.py``), with the executed program's
-        fingerprint in the dispatch trace and record-mode timings under
-        the tuner's ``IR_PATH`` cells."""
+        executor (``compiler/lower.py``): resolve the program, run the
+        optimizer pipeline in force, lower the POST-optimization object —
+        flat mesh or native two-level — with the executed program's
+        fingerprint, pass list and dispatch count in the trace, and
+        record-mode timings under the tuner's ``IR_PATH`` /
+        ``IR_OPT_PATH`` cells."""
         from adapcc_tpu.compiler import lower as ir_lower
-        from adapcc_tpu.tuner.policy import IR_PATH, NO_CHUNK
+        from adapcc_tpu.tuner.policy import IR_OPT_PATH, IR_PATH, NO_CHUNK
 
-        if self.two_level:
-            raise ValueError(
-                "algo='ir' has no two-level lowering yet: ScheduleProgram "
-                "execution needs the flat ranks axis (the composed plan's "
-                "IR ride is a ROADMAP REMAINING item); run the composed "
-                "plane or a flat mesh"
-            )
         if active_gpus is not None:
             raise ValueError(
                 "algo='ir' executes the program's own relay masks; "
                 "active_gpus subsets are not expressible on this path — "
                 "build a program with relays= and set_schedule_program it"
             )
-        program = self.schedule_program()
+        base = self.schedule_program()
+        program = self.optimized_schedule_program()
         # two explicit pins in conflict reject loudly (the rd/tree wire
         # policy): on the IR path the wire codec is a PROGRAM property,
         # so an env/argument pin that disagrees with the program's
@@ -1043,7 +1088,23 @@ class CollectiveEngine:
             "ir_allreduce", program.fingerprint(), stacked.shape,
             stacked.dtype.name, op,
         )
-        per_shard = ir_lower.allreduce_per_shard(program, self.axis_name, op)
+        if self.two_level:
+            # native hierarchy execution: every color ships over exactly
+            # the (dcn | ici) axis its classification names — rejects
+            # loudly (naming the round) for programs that do not
+            # decompose, BEFORE anything compiles
+            dcn_axis, ici_axis = self.axis_name
+            ir_lower.two_level_color_axes(
+                program, self.num_slices, self.ici_size
+            )
+            per_shard = ir_lower.allreduce_per_shard_two_level(
+                program, self.num_slices, self.ici_size,
+                dcn_axis, ici_axis, op,
+            )
+        else:
+            per_shard = ir_lower.allreduce_per_shard(
+                program, self.axis_name, op
+            )
         cache_hit = key in self._cache
         timing = tuner is not None and tuner.recording
         t0 = time.perf_counter()
@@ -1053,14 +1114,28 @@ class CollectiveEngine:
             "program": program.name,
             "program_fingerprint": program.fingerprint(),
             "wire_dtype": program.wire_dtype,
+            "passes": list(program.applied_passes),
+            "dispatches": ir_lower.dispatch_count(program),
         }
+        if program is not base:
+            extras["base_fingerprint"] = base.fingerprint()
+        if not self._ir_program_explicit and (
+            self._ir_derived_cache_hit is not None
+        ):
+            extras["program_cache_hit"] = self._ir_derived_cache_hit
+        if self.two_level:
+            extras["hier"] = f"{self.num_slices}x{self.ici_size}"
         if timing:
             jax.block_until_ready(out)
             duration = time.perf_counter() - t0
             extras["duration_s"] = duration
+            # optimized and naive lowerings are different executables:
+            # they live in different tuner cells so measured medians can
+            # arbitrate the opt axis (the ADAPCC_IR_OPT A/B)
+            path = IR_PATH if program is base else IR_OPT_PATH
             tuner.observe_dispatch(
                 tuner.key_for(
-                    "allreduce", per_rank_bytes, IR_PATH, NO_CHUNK,
+                    "allreduce", per_rank_bytes, path, NO_CHUNK,
                     program.wire_dtype,
                 ),
                 key,

@@ -9,8 +9,12 @@ lowered to every data plane (docs/COMPILER.md).
   express (the bidirectional pipelined ring);
 - :mod:`adapcc_tpu.compiler.verify` — static certification before
   lowering, loud rejection with the offending (rank, round, chunk);
+- :mod:`adapcc_tpu.compiler.optimize` — the pass pipeline between a
+  verified schedule and the wire (dce / fuse_codec / coalesce, gated by
+  ``ADAPCC_IR_OPT``, every pass verified pass-in/pass-out);
 - :mod:`adapcc_tpu.compiler.lower` — the ONE shard_map/ppermute lowering
-  behind ``engine.all_reduce(algo="ir")``.
+  behind ``engine.all_reduce(algo="ir")``, flat-mesh and two-level
+  ``(dcn, ici)`` alike, with a static per-program dispatch count.
 """
 
 from adapcc_tpu.compiler.builders import (
@@ -26,23 +30,53 @@ from adapcc_tpu.compiler.ir import (
     ScheduleProgram,
     Step,
 )
-from adapcc_tpu.compiler.lower import allreduce_per_shard, execute_program_shard
+from adapcc_tpu.compiler.lower import (
+    allreduce_per_shard,
+    allreduce_per_shard_two_level,
+    dispatch_count,
+    execute_program_shard,
+    execute_program_two_level_shard,
+    round_dispatch_counts,
+    two_level_color_axes,
+)
+from adapcc_tpu.compiler.optimize import (
+    IR_OPT_ENV,
+    PASS_NAMES,
+    PASSES,
+    optimize_program,
+    resolve_ir_opt,
+)
 from adapcc_tpu.compiler.synthesize import pipelined_allreduce_program
-from adapcc_tpu.compiler.verify import ScheduleVerificationError, verify_program
+from adapcc_tpu.compiler.verify import (
+    ScheduleVerificationError,
+    normalize_program,
+    verify_program,
+)
 
 __all__ = [
+    "IR_OPT_ENV",
+    "PASSES",
+    "PASS_NAMES",
     "PROGRAM_COLLECTIVES",
     "STEP_KINDS",
     "ScheduleProgram",
     "ScheduleVerificationError",
     "Step",
     "allreduce_per_shard",
+    "allreduce_per_shard_two_level",
+    "dispatch_count",
     "execute_program_shard",
+    "execute_program_two_level_shard",
+    "normalize_program",
+    "optimize_program",
     "pipelined_allreduce_program",
     "program_from_strategy",
     "rd_allreduce_program",
+    "resolve_ir_opt",
     "ring_allreduce_program",
+    "round_dispatch_counts",
     "tree_allreduce_program",
     "two_level_allreduce_program",
+    "two_level_color_axes",
     "verify_program",
 ]

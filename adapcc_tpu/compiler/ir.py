@@ -55,7 +55,14 @@ class Step:
 
     ``peer`` is the destination rank for ``send`` and the source rank for
     ``recv`` (required for both, meaningless elsewhere); ``codec`` names
-    the registered wire codec for ``encode``/``decode`` steps.
+    the registered wire codec for ``encode``/``decode`` steps — and, after
+    the ``fuse_codec`` optimizer pass (``compiler/optimize.py``), on the
+    ``send``/``recv`` pair itself, meaning the codec's transport arrays
+    (not the decoded value) cross the wire.  ``span`` widens the step to
+    the contiguous chunk range ``[chunk, chunk + span)`` — the coalesced
+    form the ``coalesce`` pass emits; the verifier checks span steps by
+    expanding them back to unit steps, so a span is an execution-shape
+    annotation, never a semantic change.
     """
 
     kind: str
@@ -63,6 +70,7 @@ class Step:
     chunk: int
     peer: Optional[int] = None
     codec: Optional[str] = None
+    span: int = 1
 
     def __post_init__(self) -> None:
         if self.kind not in STEP_KINDS:
@@ -73,10 +81,17 @@ class Step:
             raise ValueError(f"{self.kind} step at rank {self.rank} needs a peer")
         if self.kind in ("encode", "decode") and not self.codec:
             raise ValueError(f"{self.kind} step at rank {self.rank} needs a codec")
+        if self.span < 1:
+            raise ValueError(
+                f"{self.kind} step at rank {self.rank}: span must be >= 1, "
+                f"got {self.span}"
+            )
 
     def describe(self) -> str:
         """Human-readable spelling used by verifier rejections."""
         bits = f"{self.kind}(rank={self.rank}, chunk={self.chunk}"
+        if self.span != 1:
+            bits += f", span={self.span}"
         if self.peer is not None:
             bits += f", peer={self.peer}"
         if self.codec is not None:
@@ -115,6 +130,12 @@ class ScheduleProgram:
     #: non-relay rank both contributes and requires delivery.
     chunk_sources: Tuple[int, ...] = ()
     chunk_sinks: Tuple[int, ...] = ()
+    #: block size the fused block codec executes with (``fuse_codec`` sets
+    #: it for block-scaled wires like int8); ``None`` = no fused block math
+    block_size: Optional[int] = None
+    #: optimizer passes that actually rewrote this program, in application
+    #: order (``compiler/optimize.py``).  Empty for naive/builder programs.
+    applied_passes: Tuple[str, ...] = ()
 
     def __post_init__(self) -> None:
         if self.world < 1:
@@ -165,6 +186,9 @@ class ScheduleProgram:
                 "chunk_sources/chunk_sinks are pipeline-program routing "
                 f"metadata; collective {self.collective!r} does not take them"
             )
+        object.__setattr__(self, "applied_passes", tuple(self.applied_passes))
+        if self.block_size is not None and self.block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {self.block_size}")
         for i, rnd in enumerate(self.rounds):
             for step in rnd:
                 if not (0 <= step.rank < self.world):
@@ -181,6 +205,11 @@ class ScheduleProgram:
                     raise ValueError(
                         f"round {i}: {step.describe()} chunk out of range "
                         f"[0, {self.chunks})"
+                    )
+                if step.chunk + step.span > self.chunks:
+                    raise ValueError(
+                        f"round {i}: {step.describe()} span reaches past the "
+                        f"last chunk (chunks={self.chunks})"
                     )
 
     # -- queries ---------------------------------------------------------------
@@ -219,12 +248,21 @@ class ScheduleProgram:
             # folded in only when present so collective-program fingerprints
             # predating the pipeline family are unchanged
             h.update(f"|{self.chunk_sources}|{self.chunk_sinks}".encode())
+        if self.block_size is not None or self.applied_passes:
+            # optimizer provenance (same only-when-present rule): an
+            # optimized program and its naive source must never collide in
+            # the standby cache or the tuner's key space — the pass list
+            # and the fused block size are part of WHAT executes
+            h.update(f"|b{self.block_size}|{self.applied_passes}".encode())
         for i, rnd in enumerate(self.rounds):
             h.update(f"r{i}".encode())
             for s in rnd:
                 h.update(
-                    f"{s.kind},{s.rank},{s.chunk},{s.peer},{s.codec};".encode()
+                    f"{s.kind},{s.rank},{s.chunk},{s.peer},{s.codec}".encode()
                 )
+                if s.span != 1:
+                    h.update(f",x{s.span}".encode())
+                h.update(b";")
         fp = h.hexdigest()[:16]
         self.__dict__["_fingerprint"] = fp
         return fp

@@ -15,30 +15,58 @@ Execution model (mirrors the IR's barrier-round semantics exactly):
   the verifier's abstract interpretation says it does;
 - a round's messages are **colored** into partial permutations (distinct
   sources, distinct destinations per color) — each color is one
-  ``lax.ppermute``.  The IR places no per-round fan-out limit; the
-  coloring is where the free-form schedule meets the ppermute contract,
-  which is exactly what lets one executor run schedules (two sends per
-  rank per round, say) that the CommRound-shaped planes cannot;
+  ``lax.ppermute``.  A message covers the ``span`` chunk rows its step
+  names (one row naive, several after the ``coalesce`` optimizer pass),
+  and every message in a color ships the same row count, so one ppermute
+  moves every pair's concatenated chunk buffer at once: the optimized
+  recursive-doubling round that naively issued one dispatch per chunk
+  issues exactly one.  The collective **dispatch count** — the number of
+  ppermutes the compiled program issues — is a static property of this
+  color plan (:func:`dispatch_count`), reported in the dispatch trace;
 - ``reduce`` consumers combine ``(local, received)`` in that operand
   order — the same order ``comm/latency.py`` uses, which is what makes
   the rd/tree parity bit-identical; ``copy`` consumers overwrite;
-- ``encode``/``decode`` pairs execute as the named codec's jittable
-  quantize→dequantize round trip (``WireCodec.apply``) on the wire value
-  — numerically identical to encode/ship/decode, with XLA free to fuse;
+- legacy ``encode``/``decode`` pairs execute as the named codec's
+  jittable quantize→dequantize round trip (``WireCodec.apply``) on the
+  wire value; **fused** codec steps (``fuse_codec`` pass: the codec on
+  the send/recv pair itself) ship the codec's real transport arrays —
+  quantize on the sender, ppermute each wire array, dequantize on the
+  receiver.  Both are applied per chunk row, so the fused wire VALUE is
+  bit-identical to the unfused apply-then-ship form (same block math on
+  the same rows — pinned by test), while the bytes that cross the fabric
+  are the codec's.  One caveat, stated rather than hidden: a ``reduce``
+  consuming a fused block-scaled wire may land within one ulp of the
+  unfused plane, because XLA contracts the receiver-side dequantize
+  multiply into the combine (a single-rounding FMA) — fp32 payloads,
+  where the optimizer's bit-identity guarantee lives, have no such
+  multiply and stay exact;
 - relays enter with the reduction identity and are excluded from the
-  ``AVG`` normalization count.
+  ``AVG`` normalization count;
+- on a two-level ``(dcn, ici)`` mesh, :func:`execute_program_two_level_shard`
+  classifies every color as intra-pod (one member-level permutation,
+  shipped over the ICI axis in every pod at once) or cross-pod (one
+  slice-level permutation over the DCN axis) — the composed two-level
+  program runs natively on the hierarchy, DCN carrying 1/pod_size of the
+  payload, with no flat-mesh detour.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from adapcc_tpu.compiler.ir import ScheduleProgram
+from adapcc_tpu.compiler.ir import ScheduleProgram, Step
+
 from adapcc_tpu.primitives import ReduceOp
+
+#: ppermutes per color and fused wire codec: each of the codec's
+#: transport arrays (``WireCodec.encode``'s tuple) is one ppermute.
+#: Codecs not named ship one array (bf16's cast, or the payload itself).
+_WIRE_ARRAYS = {"int8": 2}
 
 
 def _combine(a: jnp.ndarray, b: jnp.ndarray, op: ReduceOp) -> jnp.ndarray:
@@ -57,37 +85,56 @@ def _identity_value(op: ReduceOp, dtype) -> float:
 
 class _Color:
     """One partial permutation of one round: the per-rank constant tables
-    a single ppermute + masked commit needs."""
+    a single ppermute + masked commit needs.  ``k`` chunk rows ride per
+    pair; every pair in a color ships the same ``k`` and the same fused
+    wire codec, so the concatenated buffer is one homogeneous transfer."""
 
     __slots__ = (
-        "perm", "send_chunk", "is_src", "dst_chunk", "is_dst", "is_copy",
-        "encoded", "any_encoded",
+        "world", "k", "codec", "perm", "send_rows", "dst_rows", "copy_row",
+        "is_src", "is_dst", "encoded", "any_encoded",
     )
 
-    def __init__(self, world: int) -> None:
+    def __init__(self, world: int, k: int, codec: Optional[str]) -> None:
+        self.world = world
+        self.k = k
+        self.codec = codec
         self.perm: List[Tuple[int, int]] = []
-        self.send_chunk = np.zeros(world, dtype=np.int32)
+        self.send_rows = np.zeros((world, k), dtype=np.int32)
+        self.dst_rows = np.zeros((world, k), dtype=np.int32)
+        self.copy_row = np.zeros((world, k), dtype=bool)
         self.is_src = np.zeros(world, dtype=bool)
-        self.dst_chunk = np.zeros(world, dtype=np.int32)
         self.is_dst = np.zeros(world, dtype=bool)
-        self.is_copy = np.zeros(world, dtype=bool)
-        self.encoded = np.zeros(world, dtype=bool)
+        self.encoded = np.zeros((world, k), dtype=bool)
         self.any_encoded = False
 
-    def can_take(self, src: int, dst: int) -> bool:
-        return not self.is_src[src] and not self.is_dst[dst]
+    def can_take(self, src: int, dst: int, k: int, codec: Optional[str]) -> bool:
+        return (
+            not self.is_src[src] and not self.is_dst[dst]
+            and self.k == k and self.codec == codec
+        )
 
     def take(
-        self, src: int, dst: int, chunk: int, copy: bool, encoded: bool
+        self,
+        src: int,
+        dst: int,
+        rows: Sequence[int],
+        copy: Sequence[bool],
+        encoded: Sequence[bool],
     ) -> None:
         self.perm.append((src, dst))
-        self.send_chunk[src] = chunk
+        self.send_rows[src] = rows
         self.is_src[src] = True
-        self.dst_chunk[dst] = chunk
+        self.dst_rows[dst] = rows
         self.is_dst[dst] = True
-        self.is_copy[dst] = copy
+        self.copy_row[dst] = copy
         self.encoded[src] = encoded
-        self.any_encoded = self.any_encoded or encoded
+        self.any_encoded = self.any_encoded or any(encoded)
+
+    def dispatches(self) -> int:
+        """ppermutes this color issues: one per wire array."""
+        if self.codec is None:
+            return 1
+        return _WIRE_ARRAYS.get(self.codec, 1)
 
 
 def _color_rounds(program: ScheduleProgram) -> List[List[_Color]]:
@@ -104,26 +151,130 @@ def _color_rounds(program: ScheduleProgram) -> List[List[_Color]]:
         encodes = set()
         for step in rnd:
             if step.kind == "send":
-                sends.append((step.rank, step.peer, step.chunk))
+                sends.append(step)
             elif step.kind in ("reduce", "copy"):
-                consumers[(step.rank, step.chunk)] = step.kind
+                for i in range(step.span):
+                    consumers[(step.rank, step.chunk + i)] = step.kind
             elif step.kind == "encode":
-                encodes.add((step.rank, step.chunk))
+                for i in range(step.span):
+                    encodes.add((step.rank, step.chunk + i))
         colors: List[_Color] = []
-        for src, dst, chunk in sends:
-            copy = consumers.get((dst, chunk)) == "copy"
-            encoded = (src, chunk) in encodes
+        for step in sends:
+            src, dst = step.rank, step.peer
+            rows = list(range(step.chunk, step.chunk + step.span))
+            copy = [consumers.get((dst, c)) == "copy" for c in rows]
+            encoded = [(src, c) in encodes for c in rows]
+            k = len(rows)
             for col in colors:
-                if col.can_take(src, dst):
-                    col.take(src, dst, chunk, copy, encoded)
+                if col.can_take(src, dst, k, step.codec):
+                    col.take(src, dst, rows, copy, encoded)
                     break
             else:
-                col = _Color(program.world)
-                col.take(src, dst, chunk, copy, encoded)
+                col = _Color(program.world, k, step.codec)
+                col.take(src, dst, rows, copy, encoded)
                 colors.append(col)
         plan.append(colors)
     program.__dict__["_lowering_colors"] = plan
     return plan
+
+
+def round_dispatch_counts(program: ScheduleProgram) -> List[int]:
+    """Collective dispatches (ppermutes) per round of the compiled
+    executor — static, from the color plan alone."""
+    return [
+        sum(col.dispatches() for col in colors)
+        for colors in _color_rounds(program)
+    ]
+
+
+def dispatch_count(program: ScheduleProgram) -> int:
+    """Total collective dispatches the compiled program issues — the
+    number the optimizer exists to shrink, stamped in the dispatch trace
+    and priced by ``schedule_program_time(..., per_dispatch_s=...)``."""
+    return sum(round_dispatch_counts(program))
+
+
+def _ship_flat(axis_name: str) -> Callable:
+    def ship(col: _Color, wire: jnp.ndarray) -> jnp.ndarray:
+        return lax.ppermute(wire, axis_name, col.perm)
+
+    return ship
+
+
+def _execute(
+    x: jnp.ndarray,
+    program: ScheduleProgram,
+    op: ReduceOp,
+    me: jnp.ndarray,
+    ship_for: Callable[[int, int], Callable],
+) -> jnp.ndarray:
+    """The shared executor core: ``me`` is this rank's flat index and
+    ``ship_for(round_idx, color_idx)`` returns the transfer callable for
+    one color — a flat-axis ppermute, or the classified single-axis
+    ppermute of the two-level lowering."""
+    k = program.chunks
+    flat = x.reshape(-1)
+    n = flat.size
+    seg = -(-n // k)
+    pad = k * seg - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    acc = flat.reshape(k, seg)
+    if program.relays:
+        relay = np.zeros(program.world, dtype=bool)
+        relay[list(program.relays)] = True
+        ident = jnp.full_like(acc, _identity_value(op, acc.dtype))
+        acc = jnp.where(jnp.asarray(relay)[me], ident, acc)
+    codec = None
+    if program.wire_dtype != "off":
+        from adapcc_tpu.quant.codec import get_codec
+
+        codec = get_codec(program.wire_dtype)
+    from adapcc_tpu.quant.codec import DEFAULT_BLOCK_SIZE, get_codec
+
+    block_size = program.block_size or DEFAULT_BLOCK_SIZE
+    for ri, colors in enumerate(_color_rounds(program)):
+        entry = acc
+        for ci, col in enumerate(colors):
+            ship = ship_for(ri, ci)
+            wire = entry[jnp.asarray(col.send_rows)[me]]  # [k, seg]
+            if col.any_encoded and codec is not None:
+                # legacy unfused form: the wire VALUE takes the codec's
+                # round trip per chunk row; fp32 still crosses the fabric
+                applied = jax.vmap(lambda r: codec.apply(r, block_size))(wire)
+                wire = jnp.where(
+                    jnp.asarray(col.encoded)[me][:, None], applied, wire
+                )
+            if col.codec is not None:
+                # fused form: the codec's transport arrays cross the
+                # fabric, quantized per chunk row on the sender and
+                # decoded on the receiver — same block math as the
+                # unfused round trip, a fraction of the wire bytes
+                fused = get_codec(col.codec)
+                seg_n = wire.shape[-1]
+                arrays = jax.vmap(lambda r: fused.encode(r, block_size))(
+                    wire.astype(jnp.float32)
+                    if col.codec == "int8" else wire
+                )
+                shipped = tuple(ship(col, a) for a in arrays)
+                recvd = jax.vmap(
+                    lambda *w: fused.decode(w, seg_n, block_size)
+                )(*shipped).astype(acc.dtype)
+            else:
+                recvd = ship(col, wire)
+            dst_rows = jnp.asarray(col.dst_rows)[me]
+            cur = acc[dst_rows]
+            new = jnp.where(
+                jnp.asarray(col.copy_row)[me][:, None],
+                recvd,
+                _combine(cur, recvd, op),
+            )
+            acc = acc.at[dst_rows].set(
+                jnp.where(jnp.asarray(col.is_dst)[me], new, cur)
+            )
+    if op is ReduceOp.AVG:
+        acc = acc / len(program.contributors())
+    return acc.reshape(-1)[:n].reshape(x.shape)
 
 
 def execute_program_shard(
@@ -139,45 +290,9 @@ def execute_program_shard(
     to have verified the program (the engine verifies once per
     fingerprint before compiling).
     """
-    k = program.chunks
-    flat = x.reshape(-1)
-    n = flat.size
-    seg = -(-n // k)
-    pad = k * seg - n
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-    acc = flat.reshape(k, seg)
     me = lax.axis_index(axis_name)
-    if program.relays:
-        relay = np.zeros(program.world, dtype=bool)
-        relay[list(program.relays)] = True
-        ident = jnp.full_like(acc, _identity_value(op, acc.dtype))
-        acc = jnp.where(jnp.asarray(relay)[me], ident, acc)
-    codec = None
-    if program.wire_dtype != "off":
-        from adapcc_tpu.quant.codec import get_codec
-
-        codec = get_codec(program.wire_dtype)
-    for colors in _color_rounds(program):
-        entry = acc
-        for col in colors:
-            wire = entry[jnp.asarray(col.send_chunk)[me]]
-            if col.any_encoded and codec is not None:
-                wire = jnp.where(
-                    jnp.asarray(col.encoded)[me], codec.apply(wire), wire
-                )
-            recvd = lax.ppermute(wire, axis_name, col.perm)
-            dst_chunk = jnp.asarray(col.dst_chunk)[me]
-            cur = acc[dst_chunk]
-            new = jnp.where(
-                jnp.asarray(col.is_copy)[me], recvd, _combine(cur, recvd, op)
-            )
-            acc = acc.at[dst_chunk].set(
-                jnp.where(jnp.asarray(col.is_dst)[me], new, cur)
-            )
-    if op is ReduceOp.AVG:
-        acc = acc / len(program.contributors())
-    return acc.reshape(-1)[:n].reshape(x.shape)
+    ship = _ship_flat(axis_name)
+    return _execute(x, program, op, me, lambda ri, ci: ship)
 
 
 def allreduce_per_shard(
@@ -188,5 +303,125 @@ def allreduce_per_shard(
 
     def per_shard(x: jnp.ndarray) -> jnp.ndarray:
         return execute_program_shard(x[0], program, axis_name, op)[None]
+
+    return per_shard
+
+
+# --------------------------------------------------------------------------- #
+# two-level (dcn, ici) mesh execution
+# --------------------------------------------------------------------------- #
+
+
+def _partial_permutation(pairs: List[Tuple[int, int]]) -> Optional[List[Tuple[int, int]]]:
+    """The deduplicated pair set as a partial permutation, or None when
+    sources or destinations collide."""
+    uniq = sorted(set(pairs))
+    if len({s for s, _ in uniq}) != len(uniq):
+        return None
+    if len({d for _, d in uniq}) != len(uniq):
+        return None
+    return uniq
+
+
+def two_level_color_axes(
+    program: ScheduleProgram, num_slices: int, ici_size: int
+) -> List[List[Tuple[str, List[Tuple[int, int]]]]]:
+    """Classify every color of ``program`` onto the ``(dcn, ici)`` mesh:
+    per round, per color, ``("ici", member_perm)`` when every pair stays
+    inside its pod and the member-level projection is one partial
+    permutation (shipped in every pod at once — pods missing a pair just
+    mask the commit), or ``("dcn", slice_perm)`` when every pair connects
+    the same member across pods.  A color that is neither rejects loudly
+    naming the round — the program does not decompose onto the hierarchy
+    and must run on a flat mesh instead.  Memoized per (program, shape).
+    """
+    key = ("_two_level_axes", num_slices, ici_size)
+    cached = program.__dict__.get(key)
+    if cached is not None:
+        return cached
+    if program.world != num_slices * ici_size:
+        raise ValueError(
+            f"program {program.name!r} is for world {program.world}, the "
+            f"(dcn, ici) mesh is {num_slices}x{ici_size}"
+        )
+    plan: List[List[Tuple[str, List[Tuple[int, int]]]]] = []
+    for ri, colors in enumerate(_color_rounds(program)):
+        out: List[Tuple[str, List[Tuple[int, int]]]] = []
+        for col in colors:
+            intra = all(s // ici_size == d // ici_size for s, d in col.perm)
+            cross = all(s % ici_size == d % ici_size for s, d in col.perm)
+            axis_perm = None
+            if intra:
+                axis_perm = _partial_permutation(
+                    [(s % ici_size, d % ici_size) for s, d in col.perm]
+                )
+                if axis_perm is not None:
+                    out.append(("ici", axis_perm))
+                    continue
+            if cross:
+                axis_perm = _partial_permutation(
+                    [(s // ici_size, d // ici_size) for s, d in col.perm]
+                )
+                if axis_perm is not None:
+                    out.append(("dcn", axis_perm))
+                    continue
+            raise ValueError(
+                f"program {program.name!r} round {ri} has a transfer group "
+                "that is neither intra-pod nor member-aligned cross-pod: "
+                "it does not decompose onto the (dcn, ici) mesh — run it "
+                "on a flat mesh, or build a two-level program "
+                "(compiler.two_level_allreduce_program)"
+            )
+        plan.append(out)
+    program.__dict__[key] = plan
+    return plan
+
+
+def execute_program_two_level_shard(
+    x: jnp.ndarray,
+    program: ScheduleProgram,
+    num_slices: int,
+    ici_size: int,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+    op: ReduceOp = ReduceOp.SUM,
+) -> jnp.ndarray:
+    """Run ``program`` natively on a two-level ``(dcn, ici)`` mesh inside
+    shard_map: flat rank ``slice · ici_size + lane`` (the
+    ``comm/two_level.py`` layout), every color shipped over exactly the
+    axis its classification names — intra-pod traffic never touches DCN,
+    and the composed program's cross-pod phase moves 1/pod_size of the
+    payload per member over the DCN axis, which is the hierarchy's whole
+    point."""
+    axes = two_level_color_axes(program, num_slices, ici_size)
+    me = lax.axis_index(dcn_axis) * ici_size + lax.axis_index(ici_axis)
+
+    def ship_for(ri: int, ci: int) -> Callable:
+        axis_kind, perm = axes[ri][ci]
+        axis = ici_axis if axis_kind == "ici" else dcn_axis
+
+        def ship(col: _Color, wire: jnp.ndarray) -> jnp.ndarray:
+            return lax.ppermute(wire, axis, perm)
+
+        return ship
+
+    return _execute(x, program, op, me, ship_for)
+
+
+def allreduce_per_shard_two_level(
+    program: ScheduleProgram,
+    num_slices: int,
+    ici_size: int,
+    dcn_axis: str = "dcn",
+    ici_axis: str = "ici",
+    op: ReduceOp = ReduceOp.SUM,
+):
+    """The engine-facing two-level per-shard callable (stacked
+    ``[1, *payload]`` convention)."""
+
+    def per_shard(x: jnp.ndarray) -> jnp.ndarray:
+        return execute_program_two_level_shard(
+            x[0], program, num_slices, ici_size, dcn_axis, ici_axis, op
+        )[None]
 
     return per_shard

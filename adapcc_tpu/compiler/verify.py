@@ -54,8 +54,60 @@ def _fail(round_idx: int, step: Step, why: str) -> None:
     )
 
 
+def normalize_program(program: ScheduleProgram) -> ScheduleProgram:
+    """The unit-step, unfused view of an optimized program.
+
+    The optimizer (``compiler/optimize.py``) emits two execution-shape
+    annotations with no semantic content of their own: ``span`` steps
+    (one step over a contiguous chunk range) and fused ``send``/``recv``
+    steps carrying a ``codec`` (the encode/decode moved into the wire op).
+    This expands both back to the legacy one-chunk encode/send/recv/decode
+    form, so the abstract interpretation below — and the replay layer's
+    per-chunk transfer log — check and price exactly what executes, with
+    no optimizer-aware second implementation of either.  Programs already
+    in normal form are returned unchanged (same object).
+    """
+    changed = False
+    rounds: List[tuple] = []
+    for rnd in program.rounds:
+        steps: List[Step] = []
+        for step in rnd:
+            units = (
+                [step] if step.span == 1 else [
+                    Step(step.kind, step.rank, step.chunk + i,
+                         peer=step.peer, codec=step.codec)
+                    for i in range(step.span)
+                ]
+            )
+            for unit in units:
+                if unit.kind == "send" and unit.codec is not None:
+                    steps.append(
+                        Step("encode", unit.rank, unit.chunk, codec=unit.codec)
+                    )
+                    steps.append(Step("send", unit.rank, unit.chunk, peer=unit.peer))
+                    changed = True
+                elif unit.kind == "recv" and unit.codec is not None:
+                    steps.append(Step("recv", unit.rank, unit.chunk, peer=unit.peer))
+                    steps.append(
+                        Step("decode", unit.rank, unit.chunk, codec=unit.codec)
+                    )
+                    changed = True
+                else:
+                    steps.append(unit)
+            changed = changed or len(units) > 1
+        rounds.append(tuple(steps))
+    if not changed:
+        return program
+    import dataclasses
+
+    return dataclasses.replace(
+        program, rounds=tuple(rounds), applied_passes=(), block_size=None
+    )
+
+
 def verify_program(program: ScheduleProgram) -> None:
     """Certify ``program`` or raise :class:`ScheduleVerificationError`."""
+    program = normalize_program(program)
     contributors = frozenset(program.contributors())
     pipeline = program.collective == "pipeline"
     # contribution state: state[rank][chunk] -> frozenset of folded ranks;

@@ -1496,7 +1496,9 @@ def ring_allreduce_time(
     return (2 * (world - 1) + chunks - 1) * per_hop
 
 
-def schedule_program_time(program, nbytes: float, coeffs: LinkCoeffs) -> float:
+def schedule_program_time(
+    program, nbytes: float, coeffs: LinkCoeffs, per_dispatch_s: float = 0.0
+) -> float:
     """Analytical latency of a ``compiler.ScheduleProgram``.
 
     The IR's rounds are barriers, so the program's makespan is the sum over
@@ -1506,7 +1508,18 @@ def schedule_program_time(program, nbytes: float, coeffs: LinkCoeffs) -> float:
     (full-duplex, fully-connected: the same abstraction
     :func:`ring_allreduce_time` and the recursive-doubling/tree terms price
     against, so cross-plane rankings compare like with like).  Each send
-    carries one chunk of ``nbytes / program.chunks``.
+    carries ``span`` chunks of ``nbytes / program.chunks`` each, so an
+    optimized program and its naive source price IDENTICALLY by default —
+    same bytes on the same links — which is the invariant that lets one
+    pricing serve both.
+
+    ``per_dispatch_s`` opts into the launch-overhead term the default
+    model coalesces away: each collective dispatch the lowering would
+    issue (``compiler.lower.round_dispatch_counts`` — one ppermute per
+    color per wire array) costs this many seconds on top of the transfer
+    time.  With it set, the coalesced program's strictly-lower dispatch
+    count becomes a strictly-lower price — the gap ``make compiler-bench``
+    reports.  The default 0.0 keeps every pre-existing pin byte-exact.
 
     For the builders this reproduces the closed forms exactly: the
     segmented ring prices at ``2(w−1)·(α + β·n/w)``, and the bidirectional
@@ -1522,9 +1535,13 @@ def schedule_program_time(program, nbytes: float, coeffs: LinkCoeffs) -> float:
         for step in round_steps:
             if step.kind == "send":
                 link = (step.rank, step.peer)
-                link_bytes[link] = link_bytes.get(link, 0.0) + seg
+                link_bytes[link] = link_bytes.get(link, 0.0) + seg * step.span
         if link_bytes:
             total += max(coeffs.time(b) for b in link_bytes.values())
+    if per_dispatch_s:
+        from adapcc_tpu.compiler.lower import round_dispatch_counts
+
+        total += per_dispatch_s * float(sum(round_dispatch_counts(program)))
     return total
 
 

@@ -197,7 +197,16 @@ def simulate_program(
     kept.  ``keep_links`` defaults on for the event path and off for the
     vector path, like ``simulate_strategy``.
     """
+    # optimized programs (span steps, fused codecs) replay through their
+    # unit-step expansion — same bytes on the same links, and the
+    # per-chunk transfer log keeps one chunk per row; the label below
+    # stays the ORIGINAL program's name@fingerprint, because that is the
+    # object the caller handed in and the engine lowers
+    from adapcc_tpu.compiler.verify import normalize_program
+
+    label = f"program:{program.name}@{program.fingerprint()}"
     resolved = resolve_sim_engine(engine, program.world)
+    program = normalize_program(program)
     if resolved == "vector":
         from adapcc_tpu.sim.vector import program_columns, vector_program_run
 
@@ -213,7 +222,7 @@ def simulate_program(
             nbytes=nbytes,
             world=program.world,
             report=report,
-            strategy_label=f"program:{program.name}@{program.fingerprint()}",
+            strategy_label=label,
         )
     seg = float(nbytes) / max(1, program.chunks)
     keep_link_busy = True if keep_links is None else bool(keep_links)
@@ -255,7 +264,7 @@ def simulate_program(
         nbytes=nbytes,
         world=program.world,
         report=report,
-        strategy_label=f"program:{program.name}@{program.fingerprint()}",
+        strategy_label=label,
     )
 
 

@@ -279,6 +279,13 @@ def _pack_rounds(
     return [CommRound(tuple(es)) for es in rounds]
 
 
+#: process-wide Strategy → ScheduleProgram memo, keyed by (structural
+#: fingerprint, wire_dtype, synthesis, explicit name).  Programs are
+#: immutable and small (step tuples, no payload), so the cache is
+#: unbounded — the live vocabulary is a handful of strategies per run.
+_PROGRAM_CACHE: Dict[tuple, object] = {}
+
+
 @dataclass
 class Strategy:
     """A full communication strategy: ``num_trans`` parallel spanning trees.
@@ -387,10 +394,24 @@ class Strategy:
         segment, reduce rounds aligned by index across trees, then the
         broadcast rounds — the same merged-round structure the schedule
         plane executes, now in the one IR the verifier certifies and
-        ``engine.all_reduce(algo="ir")`` lowers (docs/COMPILER.md)."""
+        ``engine.all_reduce(algo="ir")`` lowers (docs/COMPILER.md).
+
+        Memoized per (structural fingerprint, wire_dtype, name): repeated
+        ``algo="ir"`` dispatches reuse one immutable program object instead
+        of rebuilding the IR on the hot path.  Whether THIS call hit the
+        cache is left on ``_last_program_cache_hit`` for the engine's
+        dispatch-trace extras."""
         from adapcc_tpu.compiler.builders import program_from_strategy
 
-        return program_from_strategy(self, name=name)
+        # synthesis rides in the key because the derived program NAME
+        # spells it when the caller passes none
+        key = (self.fingerprint(), self.wire_dtype, self.synthesis, name)
+        program = _PROGRAM_CACHE.get(key)
+        self.__dict__["_last_program_cache_hit"] = program is not None
+        if program is None:
+            program = program_from_strategy(self, name=name)
+            _PROGRAM_CACHE[key] = program
+        return program
 
     @staticmethod
     def ring(world_size: int, num_trans: int = 1, ips: Optional[Dict[int, str]] = None) -> "Strategy":

@@ -123,6 +123,15 @@ TWO_LEVEL_PATH = "two-level"
 #: or a recorded cell exists — the default grids stay byte-stable.
 IR_PATH = "ir"
 
+#: the optimizer axis of the IR plane (``compiler/optimize.py``,
+#: ``ADAPCC_IR_OPT``): dispatches whose executed program was actually
+#: rewritten by the pass pipeline time into this cell, naive/identity
+#: ones stay in ``IR_PATH`` — two different executables, two cells, so
+#: measured medians arbitrate the A/B instead of averaging it away.
+#: Same vocabulary-extension rule as IR_PATH: pre-PR tuning.jsonl loads
+#: byte-identical next to it, and the cell joins no default grid.
+IR_OPT_PATH = "ir-opt"
+
 #: the fused XLA collective plane (``engine.all_reduce``'s psum fastpath)
 #: as an allreduce cell: the baseline the algorithm cells compete against
 #: from THAT entry point — it can neither execute nor time the Pallas
@@ -564,7 +573,13 @@ class TuningPolicy:
                 and (
                     known.path
                     if known.path in ALGO_PATHS or known.path == IR_PATH
-                    else ("xla" if known.path == XLA_PATH else "ring")
+                    # the opt cell is the same algo="ir" entry point —
+                    # which executable runs is the engine's ADAPCC_IR_OPT
+                    # resolution, not a selector choice
+                    else (
+                        "ir" if known.path == IR_OPT_PATH
+                        else ("xla" if known.path == XLA_PATH else "ring")
+                    )
                 ) in allowed_algos
                 and (
                     known.wire_dtype == "off"
@@ -651,11 +666,13 @@ class TuningPolicy:
         if key.path == TREE_PATH:
             # a tree allreduce is two single-shot phases: reduce + broadcast
             return 2.0 * binomial_tree_time(world, float(nbytes), coeffs)
-        if key.path == IR_PATH:
+        if key.path in (IR_PATH, IR_OPT_PATH):
             # IR cells carry no program handle in the key, so the prior is
-            # the segmented-ring floor every builder meets or beats; the
-            # exact per-program price is sim.cost_model.schedule_program_time
-            # and a recorded cell's median supersedes this prior anyway
+            # the segmented-ring floor every builder meets or beats (the
+            # optimizer never raises a program's price — same floor for
+            # the opt cell); the exact per-program price is
+            # sim.cost_model.schedule_program_time and a recorded cell's
+            # median supersedes this prior anyway
             return ring_allreduce_time(world, float(nbytes), coeffs, chunks=world)
         if key.primitive == "allreduce" and key.path == XLA_PATH:
             # the fused XLA collective is the bandwidth-optimal ring on a

@@ -313,6 +313,12 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     ap.add_argument("--dtype", choices=["f32", "bf16", "int8"], default="f32",
                     help="payload dtype (pallas_ring has per-dtype tiling)")
     ap.add_argument(
+        "--wire-dtype", choices=["off", "bf16", "int8"], default="off",
+        help="strategy wire codec for the IR path (the compiled program "
+        "carries it, so an ADAPCC_WIRE_DTYPE pin of the same codec agrees "
+        "instead of tripping the engine's conflict guard)",
+    )
+    ap.add_argument(
         "--two-level", default="",
         help='"DxI" (e.g. 2x4): hierarchical (dcn, ici) mesh — the strategy '
         "is ParTrees-synthesized over the slice layout and executes as "
@@ -411,6 +417,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
             if args.strategy == "ring"
             else Strategy.binary(world, args.trans)
         )
+    if args.wire_dtype != "off":
+        strategy.wire_dtype = args.wire_dtype
     engine = CollectiveEngine(mesh, strategy)
 
     results = run_sweep(

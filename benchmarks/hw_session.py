@@ -367,15 +367,30 @@ def run_multichip_phases(py: str, out_path: str, world: int) -> None:
     # staged kernel: the IR lowering priced against both hand-written
     # planes on the same payload.  Allreduce ONLY: "ir" steers no other
     # primitive (RS/AG keep their legacy planes under the pin)
-    for arm, env, impls in (
-        ("ir", {"ADAPCC_COLL_ALGO": "ir"}, "xla"),
-        ("baseline", None, "xla,pallas_ring"),
+    # the ir arm family also carries the optimizer A/B (ADAPCC_IR_OPT on
+    # vs off on the same payload — the hardware answer to `make
+    # compiler-bench`'s opt_faster flag) and the fused-int8-IR arm, where
+    # the optimizer's fuse_codec pass ships the codec's real transport
+    # arrays (int8 + block scales) through the compiled program
+    for arm, env, impls, extra_args in (
+        ("ir", {"ADAPCC_COLL_ALGO": "ir"}, "xla", []),
+        ("ir_opt", {"ADAPCC_COLL_ALGO": "ir", "ADAPCC_IR_OPT": "on"}, "xla",
+         []),
+        ("ir_naive", {"ADAPCC_COLL_ALGO": "ir", "ADAPCC_IR_OPT": "off"},
+         "xla", []),
+        # the strategy carries int8 so the compiled program's wire_dtype
+        # agrees with the env pin (a bare pin against an "off" program is
+        # the conflict the engine rejects by design)
+        ("ir_fused_int8",
+         {"ADAPCC_COLL_ALGO": "ir", "ADAPCC_IR_OPT": "on",
+          "ADAPCC_WIRE_DTYPE": "int8"}, "xla", ["--wire-dtype", "int8"]),
+        ("baseline", None, "xla,pallas_ring", []),
     ):
         _run(
             "ir_parity",
             [py, "-m", "benchmarks.collectives", "--world", str(world),
              "--sizes", "128M", "--impls", impls,
-             "--collectives", "allreduce"],
+             "--collectives", "allreduce"] + extra_args,
             900, out_path,
             extra_env=env,
             rec_extra={"arm": arm},

@@ -595,8 +595,21 @@ def schedule_sweep(
     ``beats_lockstep_ring`` flagging the bandwidth-bound win.  Every
     program passes :func:`~adapcc_tpu.compiler.verify_program` before it is
     priced.  Deterministic: same calibration → byte-identical rows.
+
+    Each row also carries the optimizer A/B (``compiler/optimize.py``):
+    ``dispatches`` / ``opt_dispatches`` are the naive and optimized
+    programs' static collective dispatch counts from the lowering's color
+    plan, ``opt_pred_time_us`` prices the optimized program with the
+    per-dispatch launch term set to the calibrated α (the overhead each
+    coalesced ppermute saves), ``opt_speedup`` is naive-priced-with-α over
+    that, and ``opt_faster`` flags a strict win.  ``passes`` and
+    ``opt_fingerprint`` record what rewrote and what executes — empty /
+    equal to ``program_fingerprint`` for programs the optimizer leaves
+    alone (the segmented ring is already one dispatch per round).
     """
     from adapcc_tpu.compiler import (
+        dispatch_count,
+        optimize_program,
         pipelined_allreduce_program,
         rd_allreduce_program,
         ring_allreduce_program,
@@ -644,6 +657,11 @@ def schedule_sweep(
         prog = builders[name]()
         verify_program(prog)
         fp = prog.fingerprint()
+        # the full canonical pipeline, independent of the ambient
+        # ADAPCC_IR_OPT, so the artifact is byte-deterministic
+        opt = optimize_program(prog, passes=["dce", "fuse_codec", "coalesce"])
+        naive_dispatches = dispatch_count(prog)
+        opt_dispatches = dispatch_count(opt)
         for nbytes in sizes:
             seconds = schedule_program_time(prog, float(nbytes), coeffs)
             algbw = nbytes / seconds / 1e9 if seconds > 0 else 0.0
@@ -651,6 +669,14 @@ def schedule_sweep(
             legacy_us = (
                 round(legacy_fn(float(nbytes)) * 1e6, 3)
                 if legacy_fn is not None else None
+            )
+            # the optimizer gap, priced with the launch-overhead term the
+            # default model coalesces away: one α per collective dispatch
+            naive_with_launch = schedule_program_time(
+                prog, float(nbytes), coeffs, per_dispatch_s=coeffs.alpha
+            )
+            opt_with_launch = schedule_program_time(
+                opt, float(nbytes), coeffs, per_dispatch_s=coeffs.alpha
             )
             row = {
                 "mode": "simulated",
@@ -668,6 +694,16 @@ def schedule_sweep(
                 "busbw_gbps": round(
                     algbw * BUS_FACTORS["allreduce"](world), 6
                 ),
+                "dispatches": naive_dispatches,
+                "opt_dispatches": opt_dispatches,
+                "opt_fingerprint": opt.fingerprint(),
+                "passes": list(opt.applied_passes),
+                "opt_pred_time_us": round(opt_with_launch * 1e6, 3),
+                "naive_launch_pred_time_us": round(naive_with_launch * 1e6, 3),
+                "opt_speedup": round(
+                    naive_with_launch / opt_with_launch, 6
+                ) if opt_with_launch > 0 else None,
+                "opt_faster": opt_with_launch < naive_with_launch,
                 "calibration": model.source,
             }
             if name == "pipelined":

@@ -570,6 +570,24 @@ def test_schedule_sweep_rows_byte_identical_and_parity_pinned():
         assert p["legacy_pred_time_us"] is None
         assert p["beats_lockstep_ring"]
         assert p["pred_time_us"] < p["lockstep_ring_us"]
+        # the optimizer gap rows (PR 20): recursive doubling coalesces to
+        # one dispatch per round, so the launch-priced optimized plan is
+        # a strict win; the segmented ring is already one-message-per-
+        # peer-per-round, so optimization is identity there
+        r = by[(s, "rd")]
+        assert r["opt_dispatches"] < r["dispatches"]
+        assert r["passes"] == ["coalesce"]
+        assert r["opt_faster"] and r["opt_speedup"] > 1
+        assert r["opt_pred_time_us"] < r["naive_launch_pred_time_us"]
+        assert r["opt_fingerprint"] != r["program_fingerprint"]
+        r = by[(s, "ring")]
+        assert r["opt_dispatches"] == r["dispatches"]
+        assert r["passes"] == [] and not r["opt_faster"]
+        assert r["opt_fingerprint"] == r["program_fingerprint"]
+    # priced optimized <= naive at EVERY size, every program (the
+    # launch term can only shrink)
+    for r in rows:
+        assert r["opt_pred_time_us"] <= r["naive_launch_pred_time_us"]
     with pytest.raises(ValueError, match="unknown program"):
         schedule_sweep(8, sizes, programs=("rong",))
 

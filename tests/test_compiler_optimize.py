@@ -1,0 +1,501 @@
+"""IR lowering optimizer (adapcc_tpu/compiler/optimize.py): fused codec
+steps, superstep coalescing, dead-copy elimination, and two-level mesh
+execution of compiled schedules.
+
+The contract under test, per ISSUE 20's acceptance pins:
+
+- **fp32 bit-identity** — on fp32 payloads the optimized lowering is
+  bit-identical to the naive one across every builder (coalescing
+  concatenates the same chunk buffers the naive program ships one by
+  one; the combine-operand order is unchanged).  Under a relay mask the
+  pin narrows to non-relay ranks: dce removes dead deliveries TO the
+  relay, whose local value carries no contract.
+- **strictly fewer dispatches** — at w >= 4 chunks the coalesced
+  recursive-doubling program issues one ppermute per round where the
+  naive program issued one per chunk (rd8: 14 -> 6, pinned from the
+  dispatch-trace extras).
+- **priced, not just counted** — ``schedule_program_time`` with a
+  per-dispatch launch term prices optimized <= naive at every
+  bandwidth-bound size (and identical at the default, where only bytes
+  move the model).
+- **pass-in/pass-out verification** — every pass preserves every
+  builder's contribution sets (the verifier IS the contribution-set
+  oracle), and a deliberately broken pass dies at the rewrite naming
+  the offending (rank, round, chunk), never at a traced collective.
+- **native two-level execution** — a two-level IR program runs
+  end-to-end on a virtual (dcn, ici) pod via ``algo="ir"`` exactly
+  equal (integer payloads) to the composed two-level plane it retires,
+  with the hierarchy and pass list in the dispatch trace.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from adapcc_tpu.comm.engine import CollectiveEngine
+from adapcc_tpu.compiler import (
+    IR_OPT_ENV,
+    PASS_NAMES,
+    PASSES,
+    ScheduleVerificationError,
+    Step,
+    allreduce_per_shard,
+    dispatch_count,
+    normalize_program,
+    optimize_program,
+    pipelined_allreduce_program,
+    program_from_strategy,
+    rd_allreduce_program,
+    resolve_ir_opt,
+    ring_allreduce_program,
+    tree_allreduce_program,
+    two_level_allreduce_program,
+    verify_program,
+)
+from adapcc_tpu.strategy.ir import Strategy
+from adapcc_tpu.utils.observability import CollectiveTrace
+
+WORLD = 8
+
+
+def _relay_ring_program():
+    """The segmented ring with the last rank demoted to a pure relay —
+    the shape test_compiler.py's relay test pins, reused here so dce has
+    real dead deliveries to eliminate."""
+    strat = Strategy.ring(WORLD, num_trans=WORLD)
+    return dataclasses.replace(
+        program_from_strategy(strat, name="ring-relay"), relays=(WORLD - 1,)
+    )
+
+
+# every builder family x (plain, relay-masked) — the optimizer's property
+# battery domain
+PROGRAMS = [
+    ("ring-seg8", lambda: Strategy.ring(WORLD, num_trans=WORLD).schedule_program()),
+    ("rd8", lambda: rd_allreduce_program(WORLD)),
+    ("tree8", lambda: tree_allreduce_program(WORLD)),
+    ("twolevel-2x4", lambda: two_level_allreduce_program(2, 4)),
+    ("pipelined8", lambda: pipelined_allreduce_program(WORLD)),
+    ("ring-relay", _relay_ring_program),
+    ("rd8-relay", lambda: dataclasses.replace(
+        rd_allreduce_program(WORLD), relays=(WORLD - 1,))),
+]
+
+
+def _run(program, mesh, xn):
+    fn = jax.jit(
+        jax.shard_map(
+            allreduce_per_shard(program, "ranks"),
+            mesh=mesh,
+            in_specs=P("ranks"),
+            out_specs=P("ranks"),
+            check_vma=False,
+        )
+    )
+    n = xn.shape[1]
+    return np.asarray(fn(xn.reshape(WORLD, 1, n))).reshape(WORLD, n)
+
+
+# --------------------------------------------------------------------------- #
+# the ADAPCC_IR_OPT knob
+# --------------------------------------------------------------------------- #
+
+def test_resolve_ir_opt_values(monkeypatch):
+    monkeypatch.delenv(IR_OPT_ENV, raising=False)
+    assert resolve_ir_opt() == PASS_NAMES          # default: every pass
+    assert resolve_ir_opt("off") == ()
+    assert resolve_ir_opt("on") == PASS_NAMES
+    # comma lists come back in canonical order, whatever order was typed
+    assert resolve_ir_opt("coalesce,dce") == ("dce", "coalesce")
+    assert resolve_ir_opt("fuse_codec") == ("fuse_codec",)
+    # env beats the argument (the ADAPCC_COLL_ALGO precedence)
+    monkeypatch.setenv(IR_OPT_ENV, "off")
+    assert resolve_ir_opt("on") == ()
+
+
+@pytest.mark.parametrize("bad", ["coalesse", "on,dce", ",", "none"])
+def test_resolve_ir_opt_rejects_malformed(monkeypatch, bad):
+    monkeypatch.delenv(IR_OPT_ENV, raising=False)
+    with pytest.raises(ValueError, match="expected off|on or a comma list"):
+        resolve_ir_opt(bad)
+    monkeypatch.setenv(IR_OPT_ENV, bad)
+    with pytest.raises(ValueError, match=IR_OPT_ENV):
+        resolve_ir_opt()
+
+
+def test_engine_rejects_malformed_ir_opt_env(mesh8, monkeypatch):
+    """A typo'd knob dies at the dispatch, loudly — not as a silent
+    fall-back to naive lowering that would invalidate the A/B."""
+    monkeypatch.setenv(IR_OPT_ENV, "coalesse")
+    eng = CollectiveEngine(mesh8, Strategy.ring(WORLD))
+    with pytest.raises(ValueError, match=IR_OPT_ENV):
+        eng.all_reduce(jnp.ones((WORLD, 8), jnp.float32), algo="ir")
+
+
+def test_optimize_program_rejects_unknown_pass_name():
+    with pytest.raises(ValueError, match="unknown optimizer pass"):
+        optimize_program(rd_allreduce_program(4), passes=["coalesse"])
+
+
+# --------------------------------------------------------------------------- #
+# fp32 bit-identity: optimized lowering == naive lowering, every builder
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.parametrize(
+    "name,build", PROGRAMS, ids=[name for name, _ in PROGRAMS]
+)
+def test_optimized_lowering_bit_identical_on_fp32(mesh8, name, build):
+    prog = build()
+    opt = optimize_program(prog, passes=PASS_NAMES)
+    assert dispatch_count(opt) <= dispatch_count(prog)
+    xn = np.random.default_rng(3).normal(size=(WORLD, 96)).astype(np.float32)
+    naive, fast = _run(prog, mesh8, xn), _run(opt, mesh8, xn)
+    if prog.relays:
+        live = [r for r in range(WORLD) if r not in prog.relays]
+        # dce removed deliveries TO the relay, whose local value is
+        # outside the contract; everyone else is bitwise unchanged
+        assert np.array_equal(naive[live], fast[live])
+    else:
+        assert np.array_equal(naive, fast)
+
+
+def test_rd8_coalesces_to_one_dispatch_per_round():
+    prog = rd_allreduce_program(WORLD)
+    opt = optimize_program(prog, passes=PASS_NAMES)
+    assert dispatch_count(prog) == 14          # sum of per-round chunk counts
+    assert dispatch_count(opt) == 6            # one ppermute per round
+    assert opt.applied_passes == ("coalesce",)
+    # the strictly-fewer pin holds from w=4 chunks up
+    small = rd_allreduce_program(4)
+    assert dispatch_count(optimize_program(small, passes=PASS_NAMES)) < (
+        dispatch_count(small)
+    )
+
+
+def test_already_optimal_programs_keep_object_identity():
+    """The segmented ring ships one chunk per (src, dst) per round — no
+    pass has anything to do, so the SAME object (and fingerprint) comes
+    back and the engine stays on the IR_PATH tuner cell."""
+    for build in (
+        lambda: Strategy.ring(WORLD, num_trans=WORLD).schedule_program(),
+        lambda: tree_allreduce_program(WORLD),
+        lambda: pipelined_allreduce_program(WORLD),
+    ):
+        prog = build()
+        assert optimize_program(prog, passes=PASS_NAMES) is prog
+
+
+def test_dce_removes_dead_relay_deliveries():
+    prog = _relay_ring_program()
+    opt = optimize_program(prog, passes=["dce"])
+    assert opt.applied_passes == ("dce",)
+    n_steps = lambda p: sum(len(r) for r in p.rounds)  # noqa: E731
+    assert n_steps(opt) < n_steps(prog)
+    # no copy into the relay survives unless a later round reads it (a
+    # send forwards it on; sends read round-ENTRY snapshots, so a
+    # same-round send is not a read)
+    relay = WORLD - 1
+    rounds = normalize_program(opt).rounds
+    for i, rnd in enumerate(rounds):
+        for s in rnd:
+            if s.kind == "copy" and s.rank == relay:
+                assert any(
+                    t.kind in ("send", "reduce")
+                    and t.rank == relay and t.chunk == s.chunk
+                    for later in rounds[i + 1:] for t in later
+                ), f"dead relay copy survived at round {i} chunk {s.chunk}"
+    # dce alone is identity on relay-free programs
+    plain = rd_allreduce_program(WORLD)
+    assert optimize_program(plain, passes=["dce"]) is plain
+
+
+# --------------------------------------------------------------------------- #
+# fused codec steps
+# --------------------------------------------------------------------------- #
+
+def test_fuse_codec_rewrites_encode_decode_into_wire_ops():
+    prog = ring_allreduce_program(4, wire_dtype="int8")
+    opt = optimize_program(prog, passes=["fuse_codec"])
+    assert "fuse_codec" in opt.applied_passes
+    from adapcc_tpu.quant.codec import DEFAULT_BLOCK_SIZE
+
+    assert opt.block_size == DEFAULT_BLOCK_SIZE
+    kinds_naive = {s.kind for _, s in prog.steps()}
+    kinds_opt = {s.kind for _, s in opt.steps()}
+    assert {"encode", "decode"} <= kinds_naive
+    assert not ({"encode", "decode"} & kinds_opt)
+    # the codec moved onto the wire pair
+    assert any(
+        s.kind == "send" and s.codec == "int8" for _, s in opt.steps()
+    )
+    # normalization re-expands the fused wire to the legacy step shape
+    assert {"encode", "decode"} <= {
+        s.kind for _, s in normalize_program(opt).steps()
+    }
+
+
+def test_fused_int8_ir_matches_naive_int8(mesh4):
+    """The fused wire ships the codec's REAL transport arrays (int8 +
+    block scales); the values agree with the naive locally-round-tripped
+    plane to one ulp (XLA contracts the receiver-side dequantize multiply
+    into the combine — lower.py module doc), bit-exactly on most
+    elements."""
+    world = 4
+    prog = ring_allreduce_program(world, wire_dtype="int8")
+    opt = optimize_program(prog, passes=PASS_NAMES)
+    assert "fuse_codec" in opt.applied_passes
+    xn = np.random.default_rng(5).normal(size=(world, 64)).astype(np.float32)
+
+    def run(p):
+        fn = jax.jit(
+            jax.shard_map(
+                allreduce_per_shard(p, "ranks"),
+                mesh=mesh4, in_specs=P("ranks"), out_specs=P("ranks"),
+                check_vma=False,
+            )
+        )
+        return np.asarray(fn(xn.reshape(world, 1, 64))).reshape(world, 64)
+
+    naive, fused = run(prog), run(opt)
+    np.testing.assert_allclose(fused, naive, rtol=5e-7, atol=1e-7)
+    # and the codec really ran: the quantized result differs from exact
+    exact = np.broadcast_to(xn.sum(0), xn.shape)
+    assert not np.array_equal(fused, exact)
+
+
+# --------------------------------------------------------------------------- #
+# verifier property battery: every pass preserves contribution sets
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.parametrize("pass_name", PASS_NAMES)
+@pytest.mark.parametrize(
+    "name,build", PROGRAMS, ids=[name for name, _ in PROGRAMS]
+)
+def test_every_pass_preserves_contribution_sets(pass_name, name, build):
+    """verify_program IS the contribution-set oracle (it replays delivery
+    and contribution per (rank, chunk)): a pass output that drops or
+    double-counts a contribution cannot verify."""
+    prog = build()
+    out = PASSES[pass_name](prog)
+    verify_program(out)
+    # and the full pipeline composes
+    verify_program(optimize_program(prog, passes=PASS_NAMES))
+
+
+def test_broken_pass_is_rejected_naming_rank_round_chunk():
+    """The (name, callable) hook: a rewrite that silently retargets a
+    reduce into a copy dies at the pass boundary, before anything
+    lowers, naming the offending (rank, round, chunk)."""
+
+    def clobber_first_reduce(program):
+        rounds = []
+        broken = False
+        for rnd in program.rounds:
+            steps = []
+            for s in rnd:
+                if not broken and s.kind == "reduce":
+                    s = Step("copy", s.rank, s.chunk, span=s.span)
+                    broken = True
+                steps.append(s)
+            rounds.append(tuple(steps))
+        return dataclasses.replace(program, rounds=tuple(rounds))
+
+    with pytest.raises(
+        ScheduleVerificationError, match=r"rank=\d+, round=\d+, chunk=\d+"
+    ):
+        optimize_program(
+            rd_allreduce_program(WORLD),
+            passes=[("clobber", clobber_first_reduce)],
+        )
+
+
+# --------------------------------------------------------------------------- #
+# fingerprints: optimized and naive variants can never collide
+# --------------------------------------------------------------------------- #
+
+def test_fingerprint_separates_optimized_from_naive():
+    prog = rd_allreduce_program(WORLD)
+    opt = optimize_program(prog, passes=PASS_NAMES)
+    assert opt.fingerprint() != prog.fingerprint()
+    # applied_passes alone separates (two structurally equal programs
+    # from different pipelines are different executables)
+    stamped = dataclasses.replace(prog, applied_passes=("coalesce",))
+    assert stamped.fingerprint() != prog.fingerprint()
+    # block geometry folds in on the fused wire
+    fused = optimize_program(
+        ring_allreduce_program(4, wire_dtype="int8"), passes=["fuse_codec"]
+    )
+    rebanked = dataclasses.replace(fused, block_size=128)
+    assert rebanked.fingerprint() != fused.fingerprint()
+    # legacy programs keep their legacy fingerprints (no stamp, no span)
+    assert "|b" not in prog.fingerprint()
+
+
+# --------------------------------------------------------------------------- #
+# the engine: dispatch-count pin from the trace, memo extras, tuner cells
+# --------------------------------------------------------------------------- #
+
+def test_engine_trace_pins_fewer_dispatches_and_pass_list(
+    mesh8, monkeypatch
+):
+    trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh8, Strategy.ring(WORLD), trace=trace)
+    eng.set_schedule_program(rd_allreduce_program(WORLD))
+    x = jnp.asarray(
+        np.random.default_rng(9).normal(size=(WORLD, 32)).astype(np.float32)
+    )
+    monkeypatch.setenv(IR_OPT_ENV, "off")
+    naive = np.asarray(eng.all_reduce(x, algo="ir"))
+    monkeypatch.setenv(IR_OPT_ENV, "on")
+    fast = np.asarray(eng.all_reduce(x, algo="ir"))
+    assert np.array_equal(naive, fast)  # fp32 bit-identity through the engine
+    ev_naive, ev_opt = trace.events()[-2:]
+    assert ev_naive.extra["dispatches"] == 14
+    assert ev_naive.extra["passes"] == []
+    assert "base_fingerprint" not in ev_naive.extra
+    assert ev_opt.extra["dispatches"] == 6
+    assert ev_opt.extra["passes"] == ["coalesce"]
+    # the optimized trace names BOTH programs: what lowered and what the
+    # strategy/pin spelled
+    assert ev_opt.extra["base_fingerprint"] == (
+        ev_naive.extra["program_fingerprint"]
+    )
+    assert ev_opt.extra["program_fingerprint"] != (
+        ev_naive.extra["program_fingerprint"]
+    )
+
+
+def test_ir_opt_dispatch_records_into_ir_opt_path_cell(
+    mesh8, tmp_path, monkeypatch
+):
+    """Optimized and naive lowerings are different executables: they get
+    different tuner cells so measured medians can arbitrate the opt axis."""
+    from adapcc_tpu.tuner import CollectiveTuner
+    from adapcc_tpu.tuner.db import TuningDatabase
+    from adapcc_tpu.tuner.policy import IR_OPT_PATH, IR_PATH
+
+    monkeypatch.delenv("ADAPCC_TUNER", raising=False)
+    monkeypatch.setenv(IR_OPT_ENV, "on")
+    db = TuningDatabase(str(tmp_path / "tuning.jsonl"))
+    tuner = CollectiveTuner(WORLD, "t", db=db, mode="record")
+    eng = CollectiveEngine(mesh8, Strategy.ring(WORLD), tuner=tuner)
+    eng.set_schedule_program(rd_allreduce_program(WORLD))
+    for _ in range(2):  # first dispatch is warmup-discarded
+        eng.all_reduce(jnp.ones((WORLD, 64), jnp.float32), algo="ir")
+    assert IR_OPT_PATH in {k.path for k in db.keys()}
+    # the segmented ring is identity under optimization -> stays IR_PATH
+    eng2 = CollectiveEngine(mesh8, Strategy.ring(WORLD), tuner=tuner)
+    for _ in range(2):
+        eng2.all_reduce(jnp.ones((WORLD, 64), jnp.float32), algo="ir")
+    assert IR_PATH in {k.path for k in db.keys()}
+
+
+def test_strategy_program_memo_and_cache_hit_extra(mesh8, monkeypatch):
+    """Strategy.schedule_program memoizes per (fingerprint, wire_dtype):
+    a second Strategy with the same spelling replays the SAME program
+    object, and the engine surfaces the memo hit in the dispatch trace."""
+    monkeypatch.setenv(IR_OPT_ENV, "on")
+    s1 = Strategy.ring(WORLD, num_trans=5)  # a spelling no other test uses
+    p1 = s1.schedule_program()
+    s2 = Strategy.ring(WORLD, num_trans=5)
+    p2 = s2.schedule_program()
+    assert p2 is p1
+    assert s2.__dict__["_last_program_cache_hit"] is True
+    trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh8, s2, trace=trace)
+    eng.all_reduce(jnp.ones((WORLD, 16), jnp.float32), algo="ir")
+    ev = trace.events()[-1]
+    assert ev.extra["program_cache_hit"] is True
+    # an explicit set_schedule_program pin is not a memo derive: no extra
+    eng.set_schedule_program(rd_allreduce_program(WORLD))
+    eng.all_reduce(jnp.ones((WORLD, 16), jnp.float32), algo="ir")
+    assert "program_cache_hit" not in trace.events()[-1].extra
+
+
+# --------------------------------------------------------------------------- #
+# pricing: the cost model sees the dispatch savings
+# --------------------------------------------------------------------------- #
+
+def test_cost_model_prices_optimized_at_or_below_naive():
+    from adapcc_tpu.sim.cost_model import LinkCoeffs, schedule_program_time
+
+    coeffs = LinkCoeffs(alpha=1e-6, beta=1.0 / 45e9)
+    prog = rd_allreduce_program(WORLD)
+    opt = optimize_program(prog, passes=PASS_NAMES)
+    for nbytes in (1 << 18, 1 << 20, 1 << 24):  # every bandwidth-bound size
+        naive_t = schedule_program_time(prog, nbytes, coeffs)
+        opt_t = schedule_program_time(opt, nbytes, coeffs)
+        # default pricing moves only bytes: identical wire time
+        assert opt_t == pytest.approx(naive_t)
+        # the launch term prices the dispatch savings
+        naive_l = schedule_program_time(
+            prog, nbytes, coeffs, per_dispatch_s=coeffs.alpha
+        )
+        opt_l = schedule_program_time(
+            opt, nbytes, coeffs, per_dispatch_s=coeffs.alpha
+        )
+        assert opt_l < naive_l
+
+
+# --------------------------------------------------------------------------- #
+# native two-level execution: the comm/two_level.py detour is retired
+# --------------------------------------------------------------------------- #
+
+@pytest.fixture(scope="module")
+def mesh2x4():
+    from adapcc_tpu.comm.two_level import build_two_level_mesh
+
+    return build_two_level_mesh(2, 4)
+
+
+def test_two_level_ir_runs_natively_equal_to_composed(mesh2x4, monkeypatch):
+    """algo="ir" on a (dcn, ici) mesh lowers the two-level program onto
+    the real hierarchy — exactly equal (integer payloads sum exactly in
+    any order) to the composed plane, with the hierarchy and pass list
+    in the trace."""
+    from adapcc_tpu.comm.mesh import mesh_ip_table
+    from adapcc_tpu.strategy.hierarchy import (
+        HierarchySketch,
+        synthesize_two_level,
+    )
+
+    monkeypatch.setenv(IR_OPT_ENV, "on")
+    plan = synthesize_two_level(
+        HierarchySketch(2, 4, tuple(mesh_ip_table(mesh2x4))), nbytes=1 << 20
+    )
+    trace = CollectiveTrace()
+    eng = CollectiveEngine(mesh2x4, plan.strategy, trace=trace)
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.integers(-8, 9, size=(8, 23)).astype(np.float32))
+    got = np.asarray(eng.all_reduce(x, algo="ir"))
+    want = np.asarray(eng.all_reduce(x))  # the composed two-level plane
+    assert trace.events()[-1].impl == "two_level[composed]"
+    assert np.array_equal(got, want)
+    ev = [e for e in trace.events() if e.extra.get("algo") == "ir"][-1]
+    assert ev.extra["hier"] == "2x4"
+    assert isinstance(ev.extra["passes"], list)
+    assert ev.extra["dispatches"] == dispatch_count(
+        eng.optimized_schedule_program()
+    )
+
+
+def test_two_level_color_axes_classifies_and_rejects(mesh2x4):
+    """Every color of the two-level program classifies onto exactly one
+    mesh axis (DCN legs carry 1/pod_size of the payload by construction);
+    a flat all-pairs program that straddles pods rejects loudly, naming
+    the round, before anything compiles."""
+    from adapcc_tpu.compiler import two_level_color_axes
+
+    prog = two_level_allreduce_program(2, 4)
+    axes = two_level_color_axes(prog, 2, 4)
+    flat = [a for rnd in axes for a, _ in rnd]
+    assert set(flat) == {"ici", "dcn"}
+    # the flat ring's 3->4 edge crosses the pod boundary with a
+    # different member index: neither an ICI member-permutation nor a
+    # same-member DCN leg
+    with pytest.raises(ValueError, match="round"):
+        two_level_color_axes(ring_allreduce_program(WORLD), 2, 4)

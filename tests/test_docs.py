@@ -58,7 +58,7 @@ def test_operations_doc_covers_the_contract():
         "strategy.xml", "reconstruct_topology", "hw_watch.py", "hw_session",
         "BENCH_FLASH_BLOCK", "--entry_point", "--dry-run",
         "ADAPCC_DISAGG", "ADAPCC_KV_WIRE_DTYPE", "ADAPCC_KV_KL_BOUND",
-        "ADAPCC_PIPE_SCHEDULE",
+        "ADAPCC_PIPE_SCHEDULE", "ADAPCC_IR_OPT",
     ):
         assert needle in text, f"OPERATIONS.md lost its {needle!r} coverage"
 
@@ -387,7 +387,7 @@ def test_serving_doc_snippet_runs(idx):
 
 
 def test_compiler_doc_has_snippets():
-    assert len(_blocks(_COMPILER)) >= 5
+    assert len(_blocks(_COMPILER)) >= 8
 
 
 def test_compiler_doc_covers_the_contract():
@@ -400,6 +400,10 @@ def test_compiler_doc_covers_the_contract():
         "parse_program_xml", "pipelined", "relay", "rank, round, chunk",
         "make compiler-bench", "ir_parity", "IR_PATH", "schema",
         "lockstep",
+        # the optimizer (PR 20): the pass pipeline and its knob
+        "ADAPCC_IR_OPT", "optimize_program", "coalesce", "fuse_codec",
+        "dce", "dispatch_count", "IR_OPT_PATH", "applied_passes",
+        "two_level_color_axes", "per_dispatch_s",
     ):
         assert needle in text, f"COMPILER.md lost its {needle!r} coverage"
 
